@@ -1,0 +1,21 @@
+"""production-stack-tpu: a TPU-native LLM serving stack.
+
+A ground-up rebuild of the vLLM Production Stack's capability surface
+(reference: pouyahmdn/production-stack) designed TPU-first:
+
+- ``router``    — OpenAI-compatible request router (aiohttp data plane),
+                  service discovery, pluggable routing logic, stats,
+                  Prometheus metrics, dynamic config hot-reload.
+- ``engine``    — the piece the reference outsources to vLLM: a JAX/XLA
+                  serving engine with paged KV cache, continuous batching,
+                  and Pallas attention kernels, exposing the same
+                  OpenAI-compatible API + vLLM-compatible /metrics names.
+- ``models``    — JAX model definitions (Llama, OPT, ...).
+- ``ops``       — Pallas kernels + XLA reference implementations.
+- ``parallel``  — mesh/sharding utilities (tensor parallel over ICI,
+                  multi-host via jax.distributed).
+"""
+
+from production_stack_tpu.version import __version__
+
+__all__ = ["__version__"]

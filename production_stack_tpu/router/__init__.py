@@ -1,0 +1,6 @@
+"""OpenAI-compatible request router (data plane).
+
+Capability parity with reference src/vllm_router/ (see SURVEY.md §2.1),
+re-designed on aiohttp: one asyncio process, background threads only for
+service discovery / metric scraping, streaming proxy with zero-copy chunks.
+"""

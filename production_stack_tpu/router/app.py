@@ -1,0 +1,383 @@
+"""Router application assembly (parity: src/vllm_router/app.py +
+routers/main_router.py + files/batches routers).
+
+One aiohttp application; daemon threads for the pod watcher, metrics
+scraper, config watcher and stats logger; everything else async on the
+event loop. API surface:
+
+  POST /v1/chat/completions | /v1/completions | /v1/embeddings
+       /v1/rerank | /rerank | /v1/score | /score      -> proxied to engines
+  GET  /v1/models   aggregated from discovery
+  GET  /health      composes thread liveness + dynamic config
+  GET  /version, /metrics
+  Files API  POST/GET/DELETE /v1/files...
+  Batch API  POST/GET /v1/batches...   (--enable-batch-api)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router import protocols
+from production_stack_tpu.router.dynamic_config import (
+    get_dynamic_config_watcher,
+    initialize_dynamic_config_watcher,
+)
+from production_stack_tpu.router.experimental.feature_gates import (
+    PII_DETECTION_GATE,
+    SEMANTIC_CACHE_GATE,
+    get_feature_gates,
+    initialize_feature_gates,
+)
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.router.routing.logic import (
+    initialize_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import (
+    get_service_discovery,
+    initialize_service_discovery,
+)
+from production_stack_tpu.router.services.batch import (
+    initialize_batch_processor,
+)
+from production_stack_tpu.router.services.files import initialize_storage
+from production_stack_tpu.router.services.metrics_service import (
+    render_exposition,
+)
+from production_stack_tpu.router.services.request_service import (
+    route_general_request,
+)
+from production_stack_tpu.router.services.rewriter import (
+    initialize_request_rewriter,
+)
+from production_stack_tpu.router.stats.engine_stats import (
+    get_engine_stats_scraper,
+    initialize_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.log_stats import log_stats
+from production_stack_tpu.router.stats.request_stats import (
+    initialize_request_stats_monitor,
+)
+from production_stack_tpu.utils import (
+    parse_comma_separated_urls,
+    parse_comma_separated_values,
+    set_ulimit,
+)
+from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+PROXY_PATHS = [
+    "/v1/chat/completions",
+    "/v1/completions",
+    "/v1/embeddings",
+    "/v1/rerank",
+    "/rerank",
+    "/v1/score",
+    "/score",
+]
+
+
+# ---- handlers --------------------------------------------------------------
+
+def _make_proxy_handler(path: str):
+    async def handler(request: web.Request) -> web.StreamResponse:
+        gates = get_feature_gates()
+        if path == "/v1/chat/completions" and gates.enabled(
+                SEMANTIC_CACHE_GATE):
+            from production_stack_tpu.router.experimental.semantic_cache \
+                import integration as sc
+            hit = await sc.check_semantic_cache(request)
+            if hit is not None:
+                return hit
+        if gates.enabled(PII_DETECTION_GATE):
+            from production_stack_tpu.router.experimental.pii import (
+                middleware as pii,
+            )
+            blocked = await pii.check_request(request)
+            if blocked is not None:
+                return blocked
+        return await route_general_request(request, path)
+
+    return handler
+
+
+async def show_models(request: web.Request) -> web.Response:
+    cards = {}
+    try:
+        endpoints = get_service_discovery().get_endpoint_info()
+    except ValueError:
+        endpoints = []
+    for ep in endpoints:
+        for model in ep.model_names:
+            cards.setdefault(model, protocols.ModelCard(id=model))
+    return web.json_response(
+        protocols.ModelList(data=list(cards.values())).model_dump()
+    )
+
+
+async def health(request: web.Request) -> web.Response:
+    try:
+        discovery = get_service_discovery()
+    except ValueError:
+        return web.json_response(
+            {"status": "starting"}, status=503
+        )
+    if not discovery.get_health():
+        return web.json_response(
+            {"status": "Service discovery module is down."}, status=503
+        )
+    if not get_engine_stats_scraper().get_health():
+        return web.json_response(
+            {"status": "Engine stats scraper is down."}, status=503
+        )
+    body = {"status": "healthy"}
+    watcher = get_dynamic_config_watcher()
+    if watcher is not None:
+        config = watcher.get_current_config()
+        body["dynamic_config"] = config.to_dict() if config else None
+    return web.json_response(body)
+
+
+async def version(request: web.Request) -> web.Response:
+    return web.json_response({"version": __version__})
+
+
+async def metrics(request: web.Request) -> web.Response:
+    payload, content_type = render_exposition()
+    return web.Response(body=payload, content_type=content_type.split(";")[0])
+
+
+# ---- files API -------------------------------------------------------------
+
+def _user_id(request: web.Request) -> str:
+    return request.headers.get("x-user-id", "anonymous")
+
+
+async def upload_file(request: web.Request) -> web.Response:
+    storage = request.app["file_storage"]
+    reader = await request.multipart()
+    filename, content, purpose = "upload", b"", "batch"
+    async for part in reader:
+        if part.name == "file":
+            filename = part.filename or filename
+            content = await part.read(decode=False)
+        elif part.name == "purpose":
+            purpose = (await part.text()).strip() or purpose
+    file = await storage.save_file(
+        _user_id(request), filename, content, purpose=purpose
+    )
+    return web.json_response(file.metadata())
+
+
+async def list_files(request: web.Request) -> web.Response:
+    storage = request.app["file_storage"]
+    files = await storage.list_files(_user_id(request))
+    return web.json_response(
+        {"object": "list", "data": [f.metadata() for f in files]}
+    )
+
+
+async def get_file(request: web.Request) -> web.Response:
+    storage = request.app["file_storage"]
+    try:
+        file = await storage.get_file(
+            _user_id(request), request.match_info["file_id"]
+        )
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": {"message": "File not found"}}, status=404
+        )
+    return web.json_response(file.metadata())
+
+
+async def get_file_content(request: web.Request) -> web.Response:
+    storage = request.app["file_storage"]
+    try:
+        content = await storage.get_file_content(
+            _user_id(request), request.match_info["file_id"]
+        )
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": {"message": "File not found"}}, status=404
+        )
+    return web.Response(body=content,
+                        content_type="application/octet-stream")
+
+
+async def delete_file(request: web.Request) -> web.Response:
+    storage = request.app["file_storage"]
+    file_id = request.match_info["file_id"]
+    await storage.delete_file(_user_id(request), file_id)
+    return web.json_response(
+        {"id": file_id, "object": "file", "deleted": True}
+    )
+
+
+# ---- batch API -------------------------------------------------------------
+
+def _batch_processor(request: web.Request):
+    processor = request.app.get("batch_processor")
+    if processor is None:
+        raise web.HTTPNotImplemented(
+            text='{"error": {"message": "Batch API disabled; start the '
+                 'router with --enable-batch-api"}}',
+            content_type="application/json",
+        )
+    return processor
+
+
+async def create_batch(request: web.Request) -> web.Response:
+    processor = _batch_processor(request)
+    body = await request.json()
+    try:
+        info = await processor.create_batch(
+            _user_id(request),
+            input_file_id=body["input_file_id"],
+            endpoint=body["endpoint"],
+            completion_window=body.get("completion_window", "24h"),
+            metadata=body.get("metadata"),
+        )
+    except KeyError as e:
+        return web.json_response(
+            {"error": {"message": f"Missing field: {e}"}}, status=400
+        )
+    return web.json_response(info.to_dict())
+
+
+async def retrieve_batch(request: web.Request) -> web.Response:
+    processor = _batch_processor(request)
+    try:
+        info = await processor.retrieve_batch(
+            _user_id(request), request.match_info["batch_id"]
+        )
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": {"message": "Batch not found"}}, status=404
+        )
+    return web.json_response(info.to_dict())
+
+
+async def list_batches(request: web.Request) -> web.Response:
+    processor = _batch_processor(request)
+    batches = await processor.list_batches(_user_id(request))
+    return web.json_response(
+        {"object": "list", "data": [b.to_dict() for b in batches]}
+    )
+
+
+async def cancel_batch(request: web.Request) -> web.Response:
+    processor = _batch_processor(request)
+    try:
+        info = await processor.cancel_batch(
+            _user_id(request), request.match_info["batch_id"]
+        )
+    except FileNotFoundError:
+        return web.json_response(
+            {"error": {"message": "Batch not found"}}, status=404
+        )
+    return web.json_response(info.to_dict())
+
+
+# ---- assembly --------------------------------------------------------------
+
+def initialize_all(app: web.Application, args) -> None:
+    if args.service_discovery == "static":
+        initialize_service_discovery(
+            "static",
+            urls=parse_comma_separated_urls(args.static_backends),
+            models=parse_comma_separated_values(args.static_models) or None,
+        )
+    else:
+        initialize_service_discovery(
+            "k8s", namespace=args.k8s_namespace, port=args.k8s_port,
+            label_selector=args.k8s_label_selector,
+        )
+    initialize_engine_stats_scraper(args.engine_stats_interval)
+    initialize_request_stats_monitor(args.request_stats_window)
+    initialize_routing_logic(args.routing_logic,
+                             session_key=args.session_key)
+    initialize_request_rewriter(args.request_rewriter)
+    initialize_feature_gates(args.feature_gates)
+
+    app["file_storage"] = initialize_storage(
+        args.file_storage_class, args.file_storage_path
+    )
+    app["enable_batch_api"] = args.enable_batch_api
+    app["batch_processor_kind"] = args.batch_processor
+
+    if args.dynamic_config_json:
+        initialize_dynamic_config_watcher(args.dynamic_config_json)
+    if args.log_stats:
+        log_stats(args.log_stats_interval)
+
+
+def build_app(args=None) -> web.Application:
+    app = web.Application(client_max_size=1024 ** 3)
+
+    async def on_startup(app: web.Application):
+        app["backend_session"] = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
+            connector=aiohttp.TCPConnector(limit=0),
+        )
+        if app.get("enable_batch_api"):
+            processor = initialize_batch_processor(
+                app.get("batch_processor_kind", "local"),
+                app["file_storage"],
+            )
+            await processor.initialize()
+            app["batch_processor"] = processor
+
+    async def on_cleanup(app: web.Application):
+        processor = app.get("batch_processor")
+        if processor is not None:
+            await processor.close()
+        session = app.get("backend_session")
+        if session is not None:
+            await session.close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+
+    for path in PROXY_PATHS:
+        app.router.add_post(path, _make_proxy_handler(path))
+    app.router.add_get("/v1/models", show_models)
+    app.router.add_get("/health", health)
+    app.router.add_get("/version", version)
+    app.router.add_get("/metrics", metrics)
+
+    app.router.add_post("/v1/files", upload_file)
+    app.router.add_get("/v1/files", list_files)
+    app.router.add_get("/v1/files/{file_id}", get_file)
+    app.router.add_get("/v1/files/{file_id}/content", get_file_content)
+    app.router.add_delete("/v1/files/{file_id}", delete_file)
+
+    app.router.add_post("/v1/batches", create_batch)
+    app.router.add_get("/v1/batches", list_batches)
+    app.router.add_get("/v1/batches/{batch_id}", retrieve_batch)
+    app.router.add_post("/v1/batches/{batch_id}/cancel", cancel_batch)
+
+    if args is not None:
+        initialize_all(app, args)
+    return app
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    import logging
+    logging.getLogger().setLevel(args.log_level.upper())
+    set_ulimit()
+    app = build_app(args)
+    logger.info("tpu-router %s listening on %s:%d",
+                __version__, args.host, args.port)
+    web.run_app(app, host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""Dynamic config hot-reload (parity: src/vllm_router/dynamic_config.py).
+
+A daemon thread polls a JSON file (written by the control-plane agent or a
+mounted ConfigMap); on content change it reconfigures service discovery and
+routing logic live, without restarting the router. The current config is
+surfaced in ``/health`` responses.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from production_stack_tpu.utils import (
+    SingletonMeta,
+    parse_comma_separated_urls,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+DEFAULT_POLL_INTERVAL_S = 10.0
+
+
+@dataclass
+class DynamicRouterConfig:
+    """The hot-reloadable subset of router configuration."""
+
+    service_discovery: str = "static"
+    routing_logic: str = "roundrobin"
+    static_backends: List[str] = field(default_factory=list)
+    static_models: List[str] = field(default_factory=list)
+    session_key: Optional[str] = None
+    k8s_namespace: str = "default"
+    k8s_port: int = 8000
+    k8s_label_selector: str = ""
+
+    @classmethod
+    def from_json(cls, text: str) -> "DynamicRouterConfig":
+        raw = json.loads(text)
+        backends = raw.get("static_backends", "")
+        models = raw.get("static_models", "")
+        if isinstance(backends, list):
+            backends = ",".join(backends)
+        # Same validation/normalization as the --static-backends CLI path.
+        backends = parse_comma_separated_urls(backends)
+        if isinstance(models, str):
+            models = [m.strip() for m in models.split(",") if m.strip()]
+        return cls(
+            service_discovery=raw.get("service_discovery", "static"),
+            routing_logic=raw.get("routing_logic", "roundrobin"),
+            static_backends=backends,
+            static_models=models,
+            session_key=raw.get("session_key"),
+            k8s_namespace=raw.get("k8s_namespace", "default"),
+            k8s_port=int(raw.get("k8s_port", 8000)),
+            k8s_label_selector=raw.get("k8s_label_selector", ""),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "service_discovery": self.service_discovery,
+            "routing_logic": self.routing_logic,
+            "static_backends": self.static_backends,
+            "static_models": self.static_models,
+            "session_key": self.session_key,
+        }
+
+
+def apply_dynamic_config(config: DynamicRouterConfig) -> None:
+    from production_stack_tpu.router.routing.logic import (
+        reconfigure_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        reconfigure_service_discovery,
+    )
+
+    if config.service_discovery == "static":
+        reconfigure_service_discovery(
+            "static", urls=config.static_backends,
+            models=config.static_models or None,
+        )
+    else:
+        reconfigure_service_discovery(
+            "k8s", namespace=config.k8s_namespace, port=config.k8s_port,
+            label_selector=config.k8s_label_selector,
+        )
+    reconfigure_routing_logic(
+        config.routing_logic, session_key=config.session_key
+    )
+
+
+class DynamicConfigWatcher(metaclass=SingletonMeta):
+    """Polls the dynamic-config JSON file and applies changes."""
+
+    def __init__(self, config_path: Optional[str] = None,
+                 poll_interval_s: float = DEFAULT_POLL_INTERVAL_S):
+        if getattr(self, "_initialized", False):
+            return
+        if config_path is None:
+            raise ValueError("DynamicConfigWatcher needs config_path")
+        self.config_path = config_path
+        self.poll_interval_s = poll_interval_s
+        self._digest: Optional[str] = None
+        self._current: Optional[DynamicRouterConfig] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dynamic-config-watcher"
+        )
+        self._thread.start()
+        self._initialized = True
+
+    def _run(self) -> None:
+        # First tick immediately so a pre-existing file applies at startup.
+        while True:
+            self.check_and_apply()
+            if self._stop.wait(self.poll_interval_s):
+                return
+
+    def check_and_apply(self) -> bool:
+        """Returns True if a new config was applied."""
+        try:
+            with open(self.config_path) as f:
+                text = f.read()
+        except FileNotFoundError:
+            return False
+        except OSError as e:
+            logger.warning("Cannot read dynamic config: %s", e)
+            return False
+        digest = hashlib.sha256(text.encode()).hexdigest()
+        if digest == self._digest:
+            return False
+        try:
+            config = DynamicRouterConfig.from_json(text)
+            apply_dynamic_config(config)
+        except Exception as e:
+            logger.error("Invalid dynamic config %s: %s",
+                         self.config_path, e)
+            self._digest = digest  # don't retry a bad file every tick
+            return False
+        self._digest = digest
+        self._current = config
+        logger.info("Applied dynamic config from %s", self.config_path)
+        return True
+
+    def get_current_config(self) -> Optional[DynamicRouterConfig]:
+        return self._current
+
+    def get_health(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+def initialize_dynamic_config_watcher(
+        config_path: str,
+        poll_interval_s: float = DEFAULT_POLL_INTERVAL_S
+) -> DynamicConfigWatcher:
+    return DynamicConfigWatcher(config_path, poll_interval_s)
+
+
+def get_dynamic_config_watcher() -> Optional[DynamicConfigWatcher]:
+    if DynamicConfigWatcher in SingletonMeta._instances:
+        return SingletonMeta._instances[DynamicConfigWatcher]
+    return None

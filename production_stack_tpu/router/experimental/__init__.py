@@ -1,0 +1,11 @@
+from production_stack_tpu.router.experimental.feature_gates import (
+    FeatureGates,
+    FeatureStage,
+    get_feature_gates,
+    initialize_feature_gates,
+)
+
+__all__ = [
+    "FeatureGates", "FeatureStage", "get_feature_gates",
+    "initialize_feature_gates",
+]

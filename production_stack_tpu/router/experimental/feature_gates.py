@@ -1,0 +1,99 @@
+"""K8s-style feature gates (parity: experimental/feature_gates.py:18-141).
+
+``--feature-gates SemanticCache=true,PIIDetection=false`` or the
+``PSTPU_FEATURE_GATES`` environment variable. Each gate has a maturity
+stage; Alpha gates default off, Beta/GA default on unless disabled.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+ENV_VAR = "PSTPU_FEATURE_GATES"
+
+SEMANTIC_CACHE_GATE = "SemanticCache"
+PII_DETECTION_GATE = "PIIDetection"
+KV_AWARE_ROUTING_GATE = "KVAwareRouting"
+
+
+class FeatureStage(str, enum.Enum):
+    ALPHA = "Alpha"
+    BETA = "Beta"
+    GA = "GA"
+
+
+@dataclass
+class FeatureSpec:
+    name: str
+    stage: FeatureStage
+    default: bool
+    description: str = ""
+
+
+_KNOWN_FEATURES: Dict[str, FeatureSpec] = {
+    SEMANTIC_CACHE_GATE: FeatureSpec(
+        SEMANTIC_CACHE_GATE, FeatureStage.ALPHA, False,
+        "Embedding-similarity response cache for chat completions"),
+    PII_DETECTION_GATE: FeatureSpec(
+        PII_DETECTION_GATE, FeatureStage.ALPHA, False,
+        "Request-blocking PII detection middleware"),
+    KV_AWARE_ROUTING_GATE: FeatureSpec(
+        KV_AWARE_ROUTING_GATE, FeatureStage.ALPHA, False,
+        "Prefix-cache-aware routing hints"),
+}
+
+
+class FeatureGates:
+    def __init__(self, spec: Optional[str] = None):
+        self._enabled: Dict[str, bool] = {
+            name: fs.default for name, fs in _KNOWN_FEATURES.items()
+        }
+        merged = ",".join(
+            s for s in (os.environ.get(ENV_VAR, ""), spec or "") if s
+        )
+        for item in merged.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ValueError(
+                    f"Feature gate must be Name=true|false, got {item!r}"
+                )
+            name, _, value = item.partition("=")
+            name = name.strip()
+            if name not in _KNOWN_FEATURES:
+                raise ValueError(f"Unknown feature gate: {name}")
+            self._enabled[name] = value.strip().lower() == "true"
+        for name, on in self._enabled.items():
+            if on:
+                logger.info("Feature gate enabled: %s (%s)", name,
+                            _KNOWN_FEATURES[name].stage.value)
+
+    def enabled(self, name: str) -> bool:
+        return self._enabled.get(name, False)
+
+    def as_dict(self) -> Dict[str, bool]:
+        return dict(self._enabled)
+
+
+_instance: Optional[FeatureGates] = None
+
+
+def initialize_feature_gates(spec: Optional[str] = None) -> FeatureGates:
+    global _instance
+    _instance = FeatureGates(spec)
+    return _instance
+
+
+def get_feature_gates() -> FeatureGates:
+    global _instance
+    if _instance is None:
+        _instance = FeatureGates()
+    return _instance

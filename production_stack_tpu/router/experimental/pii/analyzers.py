@@ -1,0 +1,118 @@
+"""PII analyzers (parity: experimental/pii/analyzers/{regex,presidio}.py).
+
+The regex analyzer is self-contained; the presidio analyzer is
+import-gated on the optional ``presidio_analyzer`` package.
+"""
+
+from __future__ import annotations
+
+import abc
+import re
+from typing import Dict, Iterable, Optional
+
+from production_stack_tpu.router.experimental.pii.types import (
+    PIIAnalysisResult,
+    PIIMatch,
+    PIIType,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class PIIAnalyzer(abc.ABC):
+    @abc.abstractmethod
+    def analyze(self, text: str,
+                types: Optional[Iterable[PIIType]] = None
+                ) -> PIIAnalysisResult:
+        ...
+
+
+_PATTERNS: Dict[PIIType, re.Pattern] = {
+    PIIType.EMAIL: re.compile(
+        r"\b[A-Za-z0-9._%+-]+@[A-Za-z0-9.-]+\.[A-Za-z]{2,}\b"),
+    PIIType.PHONE: re.compile(
+        r"\b(?:\+?\d{1,3}[-. ]?)?\(?\d{3}\)?[-. ]?\d{3}[-. ]?\d{4}\b"),
+    PIIType.SSN: re.compile(r"\b\d{3}-\d{2}-\d{4}\b"),
+    PIIType.CREDIT_CARD: re.compile(
+        r"\b(?:\d[ -]*?){13,16}\b"),
+    PIIType.IP_ADDRESS: re.compile(
+        r"\b(?:(?:25[0-5]|2[0-4]\d|1?\d?\d)\.){3}"
+        r"(?:25[0-5]|2[0-4]\d|1?\d?\d)\b"),
+    PIIType.API_KEY: re.compile(
+        r"\b(?:sk|pk|api|key|token)[-_][A-Za-z0-9_\-]{16,}\b",
+        re.IGNORECASE),
+    PIIType.IBAN: re.compile(
+        r"\b[A-Z]{2}\d{2}[A-Z0-9]{11,30}\b"),
+}
+
+
+def _luhn_ok(digits: str) -> bool:
+    total, parity = 0, len(digits) % 2
+    for i, ch in enumerate(digits):
+        d = int(ch)
+        if i % 2 == parity:
+            d *= 2
+            if d > 9:
+                d -= 9
+        total += d
+    return total % 10 == 0
+
+
+class RegexAnalyzer(PIIAnalyzer):
+    def analyze(self, text: str,
+                types: Optional[Iterable[PIIType]] = None
+                ) -> PIIAnalysisResult:
+        result = PIIAnalysisResult()
+        wanted = set(types) if types else set(_PATTERNS)
+        for pii_type in wanted:
+            pattern = _PATTERNS.get(pii_type)
+            if pattern is None:
+                continue
+            for m in pattern.finditer(text):
+                if pii_type == PIIType.CREDIT_CARD:
+                    digits = re.sub(r"\D", "", m.group())
+                    if not (13 <= len(digits) <= 16
+                            and _luhn_ok(digits)):
+                        continue
+                result.has_pii = True
+                result.detected_types.add(pii_type)
+                result.matches.append(PIIMatch(
+                    pii_type=pii_type, start=m.start(), end=m.end(),
+                    snippet=m.group()[:32],
+                ))
+        return result
+
+
+class PresidioAnalyzer(PIIAnalyzer):  # pragma: no cover - optional dep
+    def __init__(self):
+        try:
+            from presidio_analyzer import AnalyzerEngine
+        except ImportError as e:
+            raise RuntimeError(
+                "presidio analyzer requires the presidio_analyzer package"
+            ) from e
+        self._engine = AnalyzerEngine()
+
+    def analyze(self, text, types=None) -> PIIAnalysisResult:
+        result = PIIAnalysisResult()
+        for finding in self._engine.analyze(text=text, language="en"):
+            result.has_pii = True
+            try:
+                pii_type = PIIType(finding.entity_type.lower())
+            except ValueError:
+                continue
+            result.detected_types.add(pii_type)
+            result.matches.append(PIIMatch(
+                pii_type=pii_type, start=finding.start, end=finding.end,
+                snippet=text[finding.start:finding.end][:32],
+            ))
+        return result
+
+
+def create_analyzer(kind: str = "regex") -> PIIAnalyzer:
+    if kind == "regex":
+        return RegexAnalyzer()
+    if kind == "presidio":
+        return PresidioAnalyzer()
+    raise ValueError(f"Unknown PII analyzer: {kind}")

@@ -1,0 +1,98 @@
+"""Request-blocking PII middleware
+(parity: experimental/pii/middleware.py:20-154 incl. its 5 Prometheus
+metrics and the conservative block-on-error stance).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+from prometheus_client import Counter, Gauge
+
+from production_stack_tpu.router.experimental.pii.analyzers import (
+    PIIAnalyzer,
+    create_analyzer,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+pii_requests_scanned = Counter(
+    "vllm:pii_requests_scanned_total", "Requests scanned for PII")
+pii_requests_blocked = Counter(
+    "vllm:pii_requests_blocked_total", "Requests blocked due to PII")
+pii_types_detected = Counter(
+    "vllm:pii_types_detected_total", "PII types detected", ["pii_type"])
+pii_scan_latency = Gauge(
+    "vllm:pii_scan_latency_seconds", "Latency of last PII scan")
+pii_analyzer_errors = Counter(
+    "vllm:pii_analyzer_errors_total", "PII analyzer errors")
+
+_analyzer: Optional[PIIAnalyzer] = None
+
+
+def enable_pii_detection(kind: str = "regex") -> None:
+    global _analyzer
+    _analyzer = create_analyzer(kind)
+
+
+def _extract_text(payload: dict) -> str:
+    parts = []
+    for message in payload.get("messages", []) or []:
+        content = message.get("content")
+        if isinstance(content, str):
+            parts.append(content)
+        elif isinstance(content, list):
+            parts.extend(
+                c.get("text", "") for c in content if isinstance(c, dict)
+            )
+    prompt = payload.get("prompt")
+    if isinstance(prompt, str):
+        parts.append(prompt)
+    elif isinstance(prompt, list):
+        parts.extend(p for p in prompt if isinstance(p, str))
+    return "\n".join(parts)
+
+
+async def check_request(request: web.Request) -> Optional[web.Response]:
+    """Return a blocking response if the request contains PII, else None."""
+    global _analyzer
+    if _analyzer is None:
+        _analyzer = create_analyzer("regex")
+    try:
+        body = await request.read()
+        payload = json.loads(body) if body else {}
+        text = _extract_text(payload)
+        start = time.time()
+        result = _analyzer.analyze(text)
+        pii_scan_latency.set(time.time() - start)
+        pii_requests_scanned.inc()
+    except Exception as e:
+        # Conservative: a scanner failure blocks the request.
+        pii_analyzer_errors.inc()
+        logger.error("PII analysis failed; blocking request: %s", e)
+        return web.json_response(
+            {"error": {"message": "PII analysis failed",
+                       "type": "pii_analysis_error"}},
+            status=500,
+        )
+    if result.has_pii:
+        pii_requests_blocked.inc()
+        for t in result.detected_types:
+            pii_types_detected.labels(pii_type=t.value).inc()
+        logger.warning("Blocked request containing PII: %s",
+                       sorted(t.value for t in result.detected_types))
+        return web.json_response(
+            {"error": {
+                "message": "Request blocked: contains personally "
+                           "identifiable information",
+                "type": "pii_detected",
+                "detected_types": sorted(
+                    t.value for t in result.detected_types),
+            }},
+            status=400,
+        )
+    return None

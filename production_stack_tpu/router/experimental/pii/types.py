@@ -1,0 +1,30 @@
+"""PII detection types (parity: experimental/pii/types.py)."""
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Set
+
+
+class PIIType(str, enum.Enum):
+    EMAIL = "email"
+    PHONE = "phone"
+    SSN = "ssn"
+    CREDIT_CARD = "credit_card"
+    IP_ADDRESS = "ip_address"
+    API_KEY = "api_key"
+    IBAN = "iban"
+
+
+@dataclass
+class PIIMatch:
+    pii_type: PIIType
+    start: int
+    end: int
+    snippet: str
+
+
+@dataclass
+class PIIAnalysisResult:
+    has_pii: bool = False
+    detected_types: Set[PIIType] = field(default_factory=set)
+    matches: List[PIIMatch] = field(default_factory=list)

@@ -1,0 +1,166 @@
+"""Semantic response cache (parity: experimental/semantic_cache/).
+
+Embeds the chat request, searches a vector index for a similar past
+request, and serves the cached response on a hit. The reference uses
+sentence-transformers + FAISS; this environment has no FAISS and no
+network to fetch embedding weights, so the default embedder is a
+hashing n-gram projection (deterministic, dependency-free) and the index
+is exact cosine search over a numpy matrix. Both are pluggable:
+``SemanticCache(embedder=...)`` accepts any callable str -> np.ndarray.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+DEFAULT_DIM = 384
+DEFAULT_THRESHOLD = 0.95
+
+
+def hashing_embedder(text: str, dim: int = DEFAULT_DIM) -> np.ndarray:
+    """Deterministic bag-of-ngrams feature hashing with signed buckets."""
+    vec = np.zeros(dim, dtype=np.float32)
+    tokens = text.lower().split()
+    grams = tokens + [
+        " ".join(tokens[i:i + 2]) for i in range(len(tokens) - 1)
+    ]
+    for gram in grams:
+        h = hashlib.blake2b(gram.encode(), digest_size=8).digest()
+        idx = int.from_bytes(h[:4], "big") % dim
+        sign = 1.0 if h[4] & 1 else -1.0
+        vec[idx] += sign
+    norm = np.linalg.norm(vec)
+    return vec / norm if norm > 0 else vec
+
+
+class VectorIndex:
+    """Exact cosine-similarity search over a growable numpy matrix."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._matrix = np.zeros((0, dim), dtype=np.float32)
+        self._payloads: List[Any] = []
+
+    def __len__(self) -> int:
+        return len(self._payloads)
+
+    def add(self, vector: np.ndarray, payload: Any) -> None:
+        self._matrix = np.vstack([self._matrix, vector[None, :]])
+        self._payloads.append(payload)
+
+    def search(self, vector: np.ndarray) -> Tuple[float, Optional[Any]]:
+        if not self._payloads:
+            return -1.0, None
+        scores = self._matrix @ vector
+        best = int(np.argmax(scores))
+        return float(scores[best]), self._payloads[best]
+
+
+class SemanticCache:
+    def __init__(self,
+                 embedder: Optional[Callable[[str], np.ndarray]] = None,
+                 dim: int = DEFAULT_DIM,
+                 threshold: float = DEFAULT_THRESHOLD,
+                 persist_dir: Optional[str] = None):
+        self.embedder = embedder or hashing_embedder
+        self.threshold = threshold
+        self.persist_dir = persist_dir
+        self._lock = threading.Lock()
+        # One index per model: answers must never cross models.
+        self._indexes: Dict[str, VectorIndex] = {}
+        self.dim = dim
+        self.hits = 0
+        self.misses = 0
+        if persist_dir:
+            self._load()
+
+    @staticmethod
+    def request_text(messages: List[dict]) -> str:
+        return "\n".join(
+            f"{m.get('role', '')}: {m.get('content', '')}" for m in messages
+        )
+
+    def lookup(self, model: str,
+               messages: List[dict]) -> Optional[dict]:
+        vec = self.embedder(self.request_text(messages))
+        with self._lock:
+            index = self._indexes.get(model)
+            if index is None:
+                self.misses += 1
+                return None
+            score, payload = index.search(vec)
+            if score >= self.threshold:
+                self.hits += 1
+                logger.debug("Semantic cache hit (score=%.3f)", score)
+                return payload
+            self.misses += 1
+            return None
+
+    def store(self, model: str, messages: List[dict],
+              response: dict) -> None:
+        vec = self.embedder(self.request_text(messages))
+        with self._lock:
+            index = self._indexes.setdefault(
+                model, VectorIndex(self.dim)
+            )
+            index.add(vec, response)
+        if self.persist_dir:
+            self._persist(model, messages, response)
+
+    # ---- persistence (append-only JSONL per model) ------------------------
+
+    def _model_path(self, model: str) -> str:
+        safe = model.replace("/", "_")
+        return os.path.join(self.persist_dir, f"{safe}.jsonl")
+
+    def _persist(self, model: str, messages: List[dict],
+                 response: dict) -> None:
+        os.makedirs(self.persist_dir, exist_ok=True)
+        with open(self._model_path(model), "a") as f:
+            f.write(json.dumps(
+                {"messages": messages, "response": response}
+            ) + "\n")
+
+    def _load(self) -> None:
+        if not os.path.isdir(self.persist_dir):
+            return
+        for name in os.listdir(self.persist_dir):
+            if not name.endswith(".jsonl"):
+                continue
+            model = name[:-len(".jsonl")]
+            with open(os.path.join(self.persist_dir, name)) as f:
+                for line in f:
+                    try:
+                        entry = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    vec = self.embedder(
+                        self.request_text(entry["messages"])
+                    )
+                    self._indexes.setdefault(
+                        model, VectorIndex(self.dim)
+                    ).add(vec, entry["response"])
+
+
+_instance: Optional[SemanticCache] = None
+
+
+def initialize_semantic_cache(**kwargs) -> SemanticCache:
+    global _instance
+    _instance = SemanticCache(**kwargs)
+    return _instance
+
+
+def get_semantic_cache() -> Optional[SemanticCache]:
+    return _instance

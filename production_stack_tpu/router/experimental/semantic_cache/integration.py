@@ -1,0 +1,79 @@
+"""Router integration for the semantic cache
+(parity: experimental/semantic_cache_integration.py, incl. the Prometheus
+gauges and the hit short-circuit in the chat-completions handler).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+from prometheus_client import Gauge
+
+from production_stack_tpu.router.experimental.semantic_cache.cache import (
+    get_semantic_cache,
+    initialize_semantic_cache,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+semantic_cache_size = Gauge(
+    "vllm:semantic_cache_size", "Entries in the semantic cache")
+semantic_cache_hits = Gauge(
+    "vllm:semantic_cache_hits", "Semantic cache hit count")
+semantic_cache_misses = Gauge(
+    "vllm:semantic_cache_misses", "Semantic cache miss count")
+semantic_cache_hit_ratio = Gauge(
+    "vllm:semantic_cache_hit_ratio", "Semantic cache hit ratio")
+semantic_cache_latency = Gauge(
+    "vllm:semantic_cache_latency", "Semantic cache lookup latency (s)")
+
+
+def enable_semantic_cache(**kwargs) -> None:
+    initialize_semantic_cache(**kwargs)
+
+
+def _refresh_gauges(cache) -> None:
+    total = cache.hits + cache.misses
+    semantic_cache_hits.set(cache.hits)
+    semantic_cache_misses.set(cache.misses)
+    semantic_cache_hit_ratio.set(cache.hits / total if total else 0.0)
+    semantic_cache_size.set(
+        sum(len(ix) for ix in cache._indexes.values())
+    )
+
+
+async def check_semantic_cache(
+        request: web.Request) -> Optional[web.Response]:
+    """Return a cached response, or None to continue to the engines."""
+    cache = get_semantic_cache()
+    if cache is None:
+        return None
+    try:
+        payload = json.loads(await request.read())
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    if payload.get("stream"):
+        return None  # only cache non-streaming requests
+    model = payload.get("model")
+    messages = payload.get("messages")
+    if not model or not messages:
+        return None
+    start = time.time()
+    cached = cache.lookup(model, messages)
+    semantic_cache_latency.set(time.time() - start)
+    _refresh_gauges(cache)
+    if cached is None:
+        return None
+    return web.json_response(cached)
+
+
+def store_in_semantic_cache(model: str, messages, response: dict) -> None:
+    cache = get_semantic_cache()
+    if cache is None:
+        return
+    cache.store(model, messages, response)
+    _refresh_gauges(cache)

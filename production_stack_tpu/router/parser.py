@@ -1,0 +1,98 @@
+"""Router CLI flags (parity: src/vllm_router/parsers/parser.py:30-209)."""
+
+import argparse
+
+from production_stack_tpu.utils import (
+    parse_comma_separated_urls,
+    parse_comma_separated_values,
+)
+from production_stack_tpu.version import __version__
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="tpu-router",
+        description="OpenAI-compatible router for TPU serving engines",
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8001)
+
+    parser.add_argument(
+        "--service-discovery", choices=["static", "k8s"], default="static"
+    )
+    parser.add_argument(
+        "--static-backends", default=None,
+        help="Comma-separated engine URLs (static discovery)",
+    )
+    parser.add_argument(
+        "--static-models", default=None,
+        help="Comma-separated model names, aligned with --static-backends",
+    )
+    parser.add_argument("--k8s-namespace", default="default")
+    parser.add_argument("--k8s-port", type=int, default=8000)
+    parser.add_argument("--k8s-label-selector", default="")
+
+    parser.add_argument(
+        "--routing-logic",
+        choices=["roundrobin", "session", "llq", "hra", "custom"],
+        default="roundrobin",
+    )
+    parser.add_argument(
+        "--session-key", default=None,
+        help="Header key for session-sticky routing",
+    )
+
+    parser.add_argument("--engine-stats-interval", type=float, default=30.0)
+    parser.add_argument("--request-stats-window", type=float, default=60.0)
+    parser.add_argument("--log-stats", action="store_true")
+    parser.add_argument("--log-stats-interval", type=float, default=10.0)
+
+    parser.add_argument(
+        "--dynamic-config-json", default=None,
+        help="Path to hot-reloaded dynamic config JSON",
+    )
+    parser.add_argument(
+        "--feature-gates", default=None,
+        help="Comma-separated Name=true|false feature gates",
+    )
+
+    parser.add_argument("--enable-batch-api", action="store_true")
+    parser.add_argument(
+        "--file-storage-class", default="local_file",
+        choices=["local_file"],
+    )
+    parser.add_argument("--file-storage-path", default="/tmp/pstpu_files")
+    parser.add_argument(
+        "--batch-processor", default="local", choices=["local"]
+    )
+
+    parser.add_argument(
+        "--request-rewriter", default="noop", choices=["noop"]
+    )
+    parser.add_argument(
+        "--log-level", default="info",
+        choices=["debug", "info", "warning", "error", "critical"],
+    )
+    parser.add_argument(
+        "--version", action="version",
+        version=f"%(prog)s {__version__}",
+    )
+    args = parser.parse_args(argv)
+    validate_args(args)
+    return args
+
+
+def validate_args(args: argparse.Namespace) -> None:
+    if args.service_discovery == "static":
+        urls = parse_comma_separated_urls(args.static_backends)
+        if not urls:
+            raise ValueError(
+                "--static-backends is required with static discovery"
+            )
+        models = parse_comma_separated_values(args.static_models)
+        if models and len(models) != len(urls):
+            raise ValueError(
+                "--static-models must align with --static-backends"
+            )
+    if args.routing_logic == "session" and not args.session_key:
+        raise ValueError("--session-key is required with session routing")

@@ -1,0 +1,76 @@
+"""OpenAI-compatible wire models (parity: src/vllm_router/protocols.py:7-51)."""
+
+import time
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, Field
+
+
+class ModelCard(BaseModel):
+    id: str
+    object: str = "model"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    owned_by: str = "production-stack-tpu"
+    root: Optional[str] = None
+    parent: Optional[str] = None
+
+
+class ModelList(BaseModel):
+    object: str = "list"
+    data: List[ModelCard] = Field(default_factory=list)
+
+
+class ErrorInfo(BaseModel):
+    message: str
+    type: str = "invalid_request_error"
+    param: Optional[str] = None
+    code: Optional[int] = None
+
+
+class ErrorResponse(BaseModel):
+    error: ErrorInfo
+
+    @classmethod
+    def make(cls, message: str, type: str = "invalid_request_error",
+             code: Optional[int] = None) -> "ErrorResponse":
+        return cls(error=ErrorInfo(message=message, type=type, code=code))
+
+
+class ChatMessage(BaseModel):
+    role: str
+    content: Any = None
+    name: Optional[str] = None
+
+    model_config = {"extra": "allow"}
+
+
+class ChatCompletionRequest(BaseModel):
+    model: str
+    messages: List[ChatMessage]
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    stream: bool = False
+    stop: Optional[Any] = None
+    n: int = 1
+    user: Optional[str] = None
+
+    model_config = {"extra": "allow"}
+
+
+class CompletionRequest(BaseModel):
+    model: str
+    prompt: Any
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    max_tokens: Optional[int] = None
+    stream: bool = False
+    stop: Optional[Any] = None
+    n: int = 1
+
+    model_config = {"extra": "allow"}
+
+
+def model_dump(obj: BaseModel) -> Dict[str, Any]:
+    return obj.model_dump(exclude_none=True)

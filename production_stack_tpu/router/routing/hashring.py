@@ -1,0 +1,74 @@
+"""Consistent hash ring (replaces the reference's uhashring dependency).
+
+Used by the session router for sticky sessions with minimal remapping when
+the endpoint set changes: each node is placed at ``vnodes`` pseudo-random
+points on a 2^64 ring; a key maps to the first node clockwise.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, List, Optional
+
+
+def _hash64(key: str) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "big"
+    )
+
+
+class ConsistentHashRing:
+    def __init__(self, vnodes: int = 128):
+        self.vnodes = vnodes
+        self._ring: List[int] = []  # sorted vnode positions
+        self._owner: Dict[int, str] = {}  # position -> node
+        self._nodes: set[str] = set()
+
+    def get_nodes(self) -> List[str]:
+        return sorted(self._nodes)
+
+    def add_node(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.vnodes):
+            pos = _hash64(f"{node}#{i}")
+            # On the (vanishingly rare) collision keep the lexicographically
+            # smaller owner so add/remove order doesn't matter.
+            if pos in self._owner:
+                if node >= self._owner[pos]:
+                    continue
+                self._owner[pos] = node
+                continue
+            bisect.insort(self._ring, pos)
+            self._owner[pos] = node
+
+    def remove_node(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        for i in range(self.vnodes):
+            pos = _hash64(f"{node}#{i}")
+            if self._owner.get(pos) == node:
+                del self._owner[pos]
+                idx = bisect.bisect_left(self._ring, pos)
+                if idx < len(self._ring) and self._ring[idx] == pos:
+                    self._ring.pop(idx)
+
+    def sync(self, nodes: List[str]) -> None:
+        """Make the ring contain exactly *nodes* (minimal churn)."""
+        target = set(nodes)
+        for node in self._nodes - target:
+            self.remove_node(node)
+        for node in target - self._nodes:
+            self.add_node(node)
+
+    def get_node(self, key: str) -> Optional[str]:
+        if not self._ring:
+            return None
+        pos = _hash64(key)
+        idx = bisect.bisect_right(self._ring, pos)
+        if idx == len(self._ring):
+            idx = 0
+        return self._owner[self._ring[idx]]

@@ -1,0 +1,334 @@
+"""Pluggable routing policies.
+
+Capability parity with reference src/vllm_router/routers/routing_logic.py:
+roundrobin (L50), session consistent-hash + QPS fallback (L88), llq
+least-loaded (L186), hra head-room admission with SJF queue (L272), and the
+work-estimate custom policy (L408). Fresh implementation: policies receive a
+plain headers mapping (not a framework request object) and the HRA policy
+returns an ``asyncio.Future`` the proxy awaits until admission.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import enum
+import heapq
+import itertools
+import time
+from dataclasses import dataclass
+from math import ceil
+from typing import Dict, List, Mapping, Optional, Union
+
+from production_stack_tpu.router.routing.hashring import ConsistentHashRing
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.router.stats.engine_stats import EngineStats
+from production_stack_tpu.router.stats.request_stats import (
+    BLOCK_SIZE,
+    DECODE_TO_PREFILL_RATIO,
+    SAFETY_FRACTION,
+    TOTAL_NUMBER_OF_BLOCKS,
+    RequestStats,
+    get_request_stats_monitor,
+)
+from production_stack_tpu.utils import SingletonABCMeta
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+RouteResult = Union[str, "asyncio.Future[str]"]
+
+
+class RoutingLogic(str, enum.Enum):
+    ROUND_ROBIN = "roundrobin"
+    SESSION_BASED = "session"
+    LEAST_LOADED = "llq"
+    HRA = "hra"
+    CUSTOM_LOGIC = "custom"
+
+
+class RoutingPolicy(metaclass=SingletonABCMeta):
+    """A routing decision: pick an engine URL for one request.
+
+    ``route_request`` may return the URL directly, or (for admission-control
+    policies) an asyncio Future resolving to the URL once admitted.
+    """
+
+    @abc.abstractmethod
+    def route_request(
+        self,
+        endpoints: List[EndpointInfo],
+        engine_stats: Dict[str, EngineStats],
+        request_stats: Dict[str, RequestStats],
+        headers: Mapping[str, str],
+        request_id: str,
+        num_prefill_tokens: int = 0,
+    ) -> RouteResult:
+        raise NotImplementedError
+
+    def on_request_complete(self, engine_url: str) -> None:
+        """Hook fired when any request finishes; admission policies use it."""
+
+
+def _mark_routed(url: str, request_id: str, num_prefill_tokens: int) -> str:
+    get_request_stats_monitor().on_request_routed(
+        url, request_id, num_prefill_tokens
+    )
+    return url
+
+
+class RoundRobinPolicy(RoutingPolicy):
+    def __init__(self):
+        if getattr(self, "_initialized", False):
+            return
+        self._counter = itertools.count()
+        self._initialized = True
+
+    def route_request(self, endpoints, engine_stats, request_stats, headers,
+                      request_id, num_prefill_tokens=0) -> str:
+        ordered = sorted(endpoints, key=lambda e: e.url)
+        url = ordered[next(self._counter) % len(ordered)].url
+        return _mark_routed(url, request_id, num_prefill_tokens)
+
+
+class SessionPolicy(RoutingPolicy):
+    """Sticky sessions via consistent hashing on a header key.
+
+    Requests without the session header fall back to lowest-QPS placement.
+    """
+
+    def __init__(self, session_key: Optional[str] = None):
+        if getattr(self, "_initialized", False):
+            return
+        if not session_key:
+            raise ValueError("SessionPolicy requires a session_key")
+        self.session_key = session_key
+        self._ring = ConsistentHashRing()
+        self._initialized = True
+
+    @staticmethod
+    def _lowest_qps(endpoints, request_stats) -> str:
+        best_url, best_qps = None, float("inf")
+        for ep in endpoints:
+            stat = request_stats.get(ep.url)
+            if stat is None:
+                return ep.url  # never seen traffic: coldest
+            if stat.qps < best_qps:
+                best_qps, best_url = stat.qps, ep.url
+        return best_url
+
+    def route_request(self, endpoints, engine_stats, request_stats, headers,
+                      request_id, num_prefill_tokens=0) -> str:
+        self._ring.sync([ep.url for ep in endpoints])
+        session_id = headers.get(self.session_key)
+        if session_id is None:
+            url = self._lowest_qps(endpoints, request_stats)
+        else:
+            url = self._ring.get_node(session_id)
+        return _mark_routed(url, request_id, num_prefill_tokens)
+
+
+class LeastLoadedPolicy(RoutingPolicy):
+    """LLQ: route to the engine with the fewest in-flight requests."""
+
+    def __init__(self):
+        if getattr(self, "_initialized", False):
+            return
+        self._initialized = True
+
+    def route_request(self, endpoints, engine_stats, request_stats, headers,
+                      request_id, num_prefill_tokens=0) -> str:
+        def load(url: str) -> int:
+            stat = request_stats.get(url)
+            if stat is None:
+                return 0
+            return stat.in_prefill_requests + stat.in_decoding_requests
+
+        url = min(endpoints, key=lambda ep: load(ep.url)).url
+        return _mark_routed(url, request_id, num_prefill_tokens)
+
+
+@dataclass
+class _PendingAdmission:
+    prefill_tokens: int
+    arrived_at: float
+    endpoints: List[EndpointInfo]
+    future: "asyncio.Future[str]"
+    request_id: str
+
+    @property
+    def sjf_key(self):
+        return (self.prefill_tokens, self.arrived_at)
+
+
+class AdmissionError(Exception):
+    """Raised (via the admission future) when a request can never fit."""
+
+
+class HeadRoomAdmissionPolicy(RoutingPolicy):
+    """HRA: block-budget admission control with an SJF queue.
+
+    A request is only admitted to a replica whose projected KV-block usage
+    (allocated + pending-reserved + this request's pessimistic demand)
+    leaves at least ``SAFETY_FRACTION`` of the budget free. Inadmissible
+    requests wait on a future; completions re-trigger scheduling. Shortest
+    job first, FIFO among equals; head-of-line blocking is intentional
+    (a short unschedulable request gates longer ones). Requests whose
+    demand exceeds the budget of an *empty* engine are rejected outright
+    rather than wedging the queue forever.
+    """
+
+    def __init__(self):
+        if getattr(self, "_initialized", False):
+            return
+        self._queue: List[_PendingAdmission] = []
+        self._initialized = True
+
+    def route_request(self, endpoints, engine_stats, request_stats, headers,
+                      request_id, num_prefill_tokens=0):
+        future: "asyncio.Future[str]" = (
+            asyncio.get_event_loop().create_future()
+        )
+        max_admissible = int(
+            TOTAL_NUMBER_OF_BLOCKS * (1 - SAFETY_FRACTION)
+        )
+        if self.block_demand(num_prefill_tokens) > max_admissible:
+            future.set_exception(AdmissionError(
+                f"Request needs {self.block_demand(num_prefill_tokens)} KV "
+                f"blocks but at most {max_admissible} can ever be admitted"
+            ))
+            return future
+        self._queue.append(_PendingAdmission(
+            prefill_tokens=num_prefill_tokens,
+            arrived_at=time.time(),
+            endpoints=list(endpoints),
+            future=future,
+            request_id=request_id,
+        ))
+        self._queue.sort(key=lambda p: p.sjf_key)
+        self._drain_queue()
+        return future
+
+    def on_request_complete(self, engine_url: str) -> None:
+        self._drain_queue()
+
+    @staticmethod
+    def block_demand(prefill_tokens: int) -> int:
+        return ceil(
+            prefill_tokens * (1 + DECODE_TO_PREFILL_RATIO) / BLOCK_SIZE
+        )
+
+    def _drain_queue(self) -> None:
+        if not self._queue:
+            return
+        monitor = get_request_stats_monitor()
+        snapshot = monitor.get_request_stats(time.time())
+
+        urls = {ep.url for p in self._queue for ep in p.endpoints}
+        allocated = {u: monitor.estimate_allocated_blocks(u) for u in urls}
+        reserved = {
+            u: monitor.estimate_pending_reserved_blocks(u) for u in urls
+        }
+        qlen = {
+            u: (snapshot[u].in_prefill_requests
+                + snapshot[u].in_decoding_requests) if u in snapshot else 0
+            for u in urls
+        }
+        headroom = int(TOTAL_NUMBER_OF_BLOCKS * SAFETY_FRACTION)
+
+        while self._queue:
+            pending = self._queue[0]
+            if pending.future.done():
+                # Client gave up (disconnect cancels the future): drop the
+                # entry without registering a phantom reservation.
+                self._queue.pop(0)
+                continue
+            demand = self.block_demand(pending.prefill_tokens)
+            fits = [
+                ep.url for ep in pending.endpoints
+                if (TOTAL_NUMBER_OF_BLOCKS
+                    - (allocated[ep.url] + reserved[ep.url] + demand))
+                >= headroom
+            ]
+            if not fits:
+                break  # SJF head-of-line block
+            target = min(fits, key=lambda u: (qlen[u],
+                                              allocated[u] + reserved[u]))
+            self._queue.pop(0)
+            monitor.on_request_routed(
+                target, pending.request_id, pending.prefill_tokens
+            )
+            pending.future.set_result(target)
+            reserved[target] += demand
+            qlen[target] += 1
+
+
+class WorkEstimatePolicy(RoutingPolicy):
+    """'custom' policy: routes by estimated outstanding work per engine.
+
+    Work = (queued prefills x avg decode length) + sum over decoding
+    requests of max(age, avg decode length). Falls back to QPS while no
+    decode-length estimate exists yet.
+    """
+
+    def __init__(self):
+        if getattr(self, "_initialized", False):
+            return
+        self._initialized = True
+
+    def route_request(self, endpoints, engine_stats, request_stats, headers,
+                      request_id, num_prefill_tokens=0) -> str:
+        def work(url: str) -> float:
+            stat = request_stats.get(url)
+            if stat is None:
+                return 0.0
+            avg_dec = stat.avg_decoding_length
+            if avg_dec < 0:
+                return stat.qps
+            queued = len(stat.ts_prefill_enqueue) * avg_dec
+            decoding = sum(
+                max(age, avg_dec) for age in stat.ts_decoding_enqueue
+            )
+            return queued + decoding
+
+        url = min(endpoints, key=lambda ep: work(ep.url)).url
+        return _mark_routed(url, request_id, num_prefill_tokens)
+
+
+_POLICY_CLASSES = (
+    RoundRobinPolicy, SessionPolicy, LeastLoadedPolicy,
+    HeadRoomAdmissionPolicy, WorkEstimatePolicy,
+)
+
+
+def initialize_routing_logic(routing_logic: Union[str, RoutingLogic],
+                             **kwargs) -> RoutingPolicy:
+    logic = RoutingLogic(routing_logic)
+    logger.info("Initializing routing logic: %s", logic.value)
+    if logic == RoutingLogic.ROUND_ROBIN:
+        return RoundRobinPolicy()
+    if logic == RoutingLogic.SESSION_BASED:
+        return SessionPolicy(kwargs.get("session_key"))
+    if logic == RoutingLogic.LEAST_LOADED:
+        return LeastLoadedPolicy()
+    if logic == RoutingLogic.HRA:
+        return HeadRoomAdmissionPolicy()
+    if logic == RoutingLogic.CUSTOM_LOGIC:
+        return WorkEstimatePolicy()
+    raise ValueError(f"Unknown routing logic: {routing_logic}")
+
+
+def reconfigure_routing_logic(routing_logic: Union[str, RoutingLogic],
+                              **kwargs) -> RoutingPolicy:
+    from production_stack_tpu.utils import SingletonMeta
+    for cls in _POLICY_CLASSES:
+        SingletonMeta._instances.pop(cls, None)
+    return initialize_routing_logic(routing_logic, **kwargs)
+
+
+def get_routing_logic() -> RoutingPolicy:
+    from production_stack_tpu.utils import SingletonMeta
+    for cls in _POLICY_CLASSES:
+        if cls in SingletonMeta._instances:
+            return SingletonMeta._instances[cls]
+    raise ValueError("Routing logic has not been initialized")

@@ -1,0 +1,220 @@
+"""Service discovery: which engine endpoints exist and what they serve.
+
+Capability parity with reference src/vllm_router/service_discovery.py:
+``StaticServiceDiscovery`` (fixed URL/model lists, L64) and
+``K8sServiceDiscovery`` (label-selector pod watch + readiness + model probe,
+L85-239), with global init/get/reconfigure (L293-337). The K8s backend is
+import-gated: the ``kubernetes`` client is only required when selected.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import requests
+
+from production_stack_tpu.utils import SingletonMeta
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class EndpointInfo:
+    url: str
+    model_names: List[str] = field(default_factory=list)
+    added_timestamp: float = field(default_factory=time.time)
+    pod_name: Optional[str] = None
+
+    def serves_model(self, model: str) -> bool:
+        return not self.model_names or model in self.model_names
+
+
+class ServiceDiscoveryType(str, enum.Enum):
+    STATIC = "static"
+    K8S = "k8s"
+
+
+class ServiceDiscovery:
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        raise NotImplementedError
+
+    def get_health(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+
+class StaticServiceDiscovery(ServiceDiscovery):
+    """Fixed backend list from --static-backends / --static-models flags."""
+
+    def __init__(self, urls: List[str],
+                 models: Optional[List[str]] = None):
+        if models and len(models) != len(urls):
+            raise ValueError(
+                "static models list must match static backends list"
+            )
+        now = time.time()
+        self._endpoints = [
+            EndpointInfo(
+                url=url,
+                model_names=[models[i]] if models else [],
+                added_timestamp=now,
+            )
+            for i, url in enumerate(urls)
+        ]
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        return list(self._endpoints)
+
+
+class K8sServiceDiscovery(ServiceDiscovery):
+    """Kubernetes pod watch: pods matching a label selector become engines.
+
+    A daemon thread runs a watch on pods in *namespace*; on ADDED/MODIFIED
+    ready pods, the pod IP is probed at ``GET /v1/models`` to learn what it
+    serves; on DELETED/not-ready, the endpoint is removed so traffic stops.
+    """
+
+    _MODEL_PROBE_TIMEOUT_S = 5.0
+
+    def __init__(self, namespace: str, port: int, label_selector: str):
+        try:
+            from kubernetes import client, config, watch  # noqa: F401
+        except ImportError as e:  # pragma: no cover - env without k8s
+            raise RuntimeError(
+                "K8s service discovery requires the 'kubernetes' package; "
+                "use --service-discovery static in this environment"
+            ) from e
+        try:
+            config.load_incluster_config()
+        except Exception:
+            config.load_kube_config()
+        self._core = client.CoreV1Api()
+        self._watch = watch.Watch()
+        self.namespace = namespace
+        self.port = port
+        self.label_selector = label_selector
+        self._endpoints: Dict[str, EndpointInfo] = {}  # pod name -> info
+        self._lock = threading.Lock()
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._watch_pods, daemon=True, name="k8s-pod-watcher"
+        )
+        self._thread.start()
+
+    @staticmethod
+    def _pod_is_ready(pod) -> bool:
+        conditions = (pod.status and pod.status.conditions) or []
+        return any(
+            c.type == "Ready" and c.status == "True" for c in conditions
+        )
+
+    def _probe_models(self, url: str) -> List[str]:
+        try:
+            resp = requests.get(
+                f"{url}/v1/models", timeout=self._MODEL_PROBE_TIMEOUT_S
+            )
+            resp.raise_for_status()
+            return [m["id"] for m in resp.json().get("data", [])]
+        except Exception as e:
+            logger.warning("Model probe failed for %s: %s", url, e)
+            return []
+
+    def _watch_pods(self) -> None:
+        from kubernetes import watch
+        while self._running:
+            try:
+                self._watch = watch.Watch()
+                stream = self._watch.stream(
+                    self._core.list_namespaced_pod,
+                    namespace=self.namespace,
+                    label_selector=self.label_selector,
+                )
+                for event in stream:
+                    if not self._running:
+                        break
+                    self._handle_event(event)
+            except Exception as e:
+                if self._running:
+                    logger.error("Pod watch error, retrying: %s", e)
+                    time.sleep(1)
+
+    def _handle_event(self, event) -> None:
+        pod = event["object"]
+        name = pod.metadata.name
+        etype = event["type"]
+        ready = self._pod_is_ready(pod) and pod.status.pod_ip
+        if etype in ("ADDED", "MODIFIED") and ready:
+            url = f"http://{pod.status.pod_ip}:{self.port}"
+            with self._lock:
+                known = self._endpoints.get(name)
+            if known is None or known.url != url:
+                models = self._probe_models(url)
+                with self._lock:
+                    self._endpoints[name] = EndpointInfo(
+                        url=url, model_names=models, pod_name=name
+                    )
+                logger.info("Engine pod up: %s -> %s (%s)", name, url, models)
+        elif etype == "DELETED" or not ready:
+            with self._lock:
+                if self._endpoints.pop(name, None) is not None:
+                    logger.info("Engine pod removed: %s", name)
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        with self._lock:
+            return list(self._endpoints.values())
+
+    def get_health(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._watch.stop()
+        except Exception:
+            pass
+
+
+class _DiscoveryHolder(metaclass=SingletonMeta):
+    def __init__(self):
+        self.instance: Optional[ServiceDiscovery] = None
+
+
+def initialize_service_discovery(discovery_type: str,
+                                 **kwargs) -> ServiceDiscovery:
+    holder = _DiscoveryHolder()
+    dtype = ServiceDiscoveryType(discovery_type)
+    if dtype == ServiceDiscoveryType.STATIC:
+        holder.instance = StaticServiceDiscovery(
+            urls=kwargs["urls"], models=kwargs.get("models")
+        )
+    else:
+        holder.instance = K8sServiceDiscovery(
+            namespace=kwargs.get("namespace", "default"),
+            port=int(kwargs.get("port", 8000)),
+            label_selector=kwargs.get("label_selector", ""),
+        )
+    return holder.instance
+
+
+def reconfigure_service_discovery(discovery_type: str,
+                                  **kwargs) -> ServiceDiscovery:
+    holder = _DiscoveryHolder()
+    old = holder.instance
+    new = initialize_service_discovery(discovery_type, **kwargs)
+    if old is not None and old is not new:
+        old.close()
+    return new
+
+
+def get_service_discovery() -> ServiceDiscovery:
+    holder = _DiscoveryHolder()
+    if holder.instance is None:
+        raise ValueError("Service discovery has not been initialized")
+    return holder.instance

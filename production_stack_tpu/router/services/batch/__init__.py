@@ -1,0 +1,16 @@
+from production_stack_tpu.router.services.batch.batch import (
+    BatchInfo,
+    BatchStatus,
+)
+from production_stack_tpu.router.services.batch.local_processor import (
+    LocalBatchProcessor,
+)
+from production_stack_tpu.router.services.batch.processor import (
+    BatchProcessor,
+    initialize_batch_processor,
+)
+
+__all__ = [
+    "BatchInfo", "BatchStatus", "BatchProcessor", "LocalBatchProcessor",
+    "initialize_batch_processor",
+]

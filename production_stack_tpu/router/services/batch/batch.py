@@ -1,0 +1,56 @@
+"""OpenAI Batch API wire objects (parity: batch_service/batch.py:6-91)."""
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+class BatchStatus(str, enum.Enum):
+    VALIDATING = "validating"
+    FAILED = "failed"
+    IN_PROGRESS = "in_progress"
+    FINALIZING = "finalizing"
+    COMPLETED = "completed"
+    EXPIRED = "expired"
+    CANCELLING = "cancelling"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class BatchInfo:
+    id: str
+    input_file_id: str
+    endpoint: str
+    completion_window: str = "24h"
+    status: BatchStatus = BatchStatus.VALIDATING
+    created_at: int = field(default_factory=lambda: int(time.time()))
+    output_file_id: Optional[str] = None
+    error_file_id: Optional[str] = None
+    completed_at: Optional[int] = None
+    failed_at: Optional[int] = None
+    metadata: Optional[Dict[str, Any]] = None
+    total_requests: int = 0
+    completed_requests: int = 0
+    failed_requests: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "object": "batch",
+            "endpoint": self.endpoint,
+            "input_file_id": self.input_file_id,
+            "completion_window": self.completion_window,
+            "status": self.status.value,
+            "created_at": self.created_at,
+            "output_file_id": self.output_file_id,
+            "error_file_id": self.error_file_id,
+            "completed_at": self.completed_at,
+            "failed_at": self.failed_at,
+            "metadata": self.metadata or {},
+            "request_counts": {
+                "total": self.total_requests,
+                "completed": self.completed_requests,
+                "failed": self.failed_requests,
+            },
+        }

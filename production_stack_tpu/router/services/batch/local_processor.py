@@ -1,0 +1,276 @@
+"""SQLite-backed local batch processor.
+
+Capability parity with reference batch_service/local_processor.py, with
+two deliberate upgrades: (1) the reference's processing loop is a stub
+(local_processor.py:157-208 TODO) — ours actually executes each JSONL line
+against a discovered engine and writes the OpenAI-format output file;
+(2) sqlite access goes through ``asyncio.to_thread`` (no aiosqlite in the
+environment) with a single serialized connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import threading
+import time
+import uuid
+from typing import List, Optional
+
+import aiohttp
+
+from production_stack_tpu.router.services.batch.batch import (
+    BatchInfo,
+    BatchStatus,
+)
+from production_stack_tpu.router.services.batch.processor import (
+    BatchProcessor,
+)
+from production_stack_tpu.router.services.files.storage import Storage
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS batches (
+    id TEXT PRIMARY KEY,
+    user_id TEXT NOT NULL,
+    payload TEXT NOT NULL
+);
+"""
+
+
+class LocalBatchProcessor(BatchProcessor):
+    def __init__(self, storage: Storage,
+                 db_path: str = "/tmp/pstpu_batch.db",
+                 poll_interval_s: float = 2.0):
+        super().__init__(storage)
+        self.db_path = db_path
+        self.poll_interval_s = poll_interval_s
+        self._conn: Optional[sqlite3.Connection] = None
+        self._db_lock = threading.Lock()
+        self._worker: Optional[asyncio.Task] = None
+
+    # ---- persistence ------------------------------------------------------
+
+    def _db(self) -> sqlite3.Connection:
+        if self._conn is None:
+            self._conn = sqlite3.connect(
+                self.db_path, check_same_thread=False
+            )
+            self._conn.execute(_SCHEMA)
+            self._conn.commit()
+        return self._conn
+
+    def _store_sync(self, user_id: str, info: BatchInfo) -> None:
+        with self._db_lock:
+            db = self._db()
+            db.execute(
+                "INSERT OR REPLACE INTO batches (id, user_id, payload) "
+                "VALUES (?, ?, ?)",
+                (info.id, user_id, json.dumps(info.to_dict())),
+            )
+            db.commit()
+
+    def _load_sync(self, user_id: str,
+                   batch_id: Optional[str] = None) -> List[BatchInfo]:
+        with self._db_lock:
+            db = self._db()
+            if batch_id is not None:
+                rows = db.execute(
+                    "SELECT payload FROM batches WHERE user_id=? AND id=?",
+                    (user_id, batch_id),
+                ).fetchall()
+            else:
+                rows = db.execute(
+                    "SELECT payload FROM batches WHERE user_id=?",
+                    (user_id,),
+                ).fetchall()
+        return [self._from_dict(json.loads(r[0])) for r in rows]
+
+    @staticmethod
+    def _from_dict(d: dict) -> BatchInfo:
+        counts = d.get("request_counts", {})
+        return BatchInfo(
+            id=d["id"],
+            input_file_id=d["input_file_id"],
+            endpoint=d["endpoint"],
+            completion_window=d.get("completion_window", "24h"),
+            status=BatchStatus(d["status"]),
+            created_at=d["created_at"],
+            output_file_id=d.get("output_file_id"),
+            error_file_id=d.get("error_file_id"),
+            completed_at=d.get("completed_at"),
+            failed_at=d.get("failed_at"),
+            metadata=d.get("metadata"),
+            total_requests=counts.get("total", 0),
+            completed_requests=counts.get("completed", 0),
+            failed_requests=counts.get("failed", 0),
+        )
+
+    # ---- BatchProcessor API ----------------------------------------------
+
+    async def initialize(self) -> None:
+        await asyncio.to_thread(self._db)
+        if self._worker is None:
+            self._worker = asyncio.create_task(self._work_loop())
+
+    async def close(self) -> None:
+        if self._worker is not None:
+            self._worker.cancel()
+            try:
+                await self._worker
+            except asyncio.CancelledError:
+                pass
+            self._worker = None
+
+    async def create_batch(self, user_id: str, input_file_id: str,
+                           endpoint: str, completion_window: str = "24h",
+                           metadata: Optional[dict] = None) -> BatchInfo:
+        info = BatchInfo(
+            id=f"batch-{uuid.uuid4().hex[:24]}",
+            input_file_id=input_file_id,
+            endpoint=endpoint,
+            completion_window=completion_window,
+            metadata=dict(metadata or {}, user_id=user_id),
+        )
+        await asyncio.to_thread(self._store_sync, user_id, info)
+        return info
+
+    async def retrieve_batch(self, user_id: str, batch_id: str) -> BatchInfo:
+        found = await asyncio.to_thread(self._load_sync, user_id, batch_id)
+        if not found:
+            raise FileNotFoundError(f"Batch {batch_id} not found")
+        return found[0]
+
+    async def list_batches(self, user_id: str) -> List[BatchInfo]:
+        return await asyncio.to_thread(self._load_sync, user_id)
+
+    async def cancel_batch(self, user_id: str, batch_id: str) -> BatchInfo:
+        info = await self.retrieve_batch(user_id, batch_id)
+        if info.status in (BatchStatus.VALIDATING, BatchStatus.IN_PROGRESS):
+            info.status = BatchStatus.CANCELLED
+            await asyncio.to_thread(self._store_sync, user_id, info)
+        return info
+
+    # ---- execution --------------------------------------------------------
+
+    async def _work_loop(self) -> None:
+        while True:
+            try:
+                await self._process_pending()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.error("Batch worker error: %s", e)
+            await asyncio.sleep(self.poll_interval_s)
+
+    async def _process_pending(self) -> None:
+        for user_id, info in await self._pending_list():
+            await self._run_batch(user_id, info)
+
+    async def _pending_list(self) -> List[tuple[str, BatchInfo]]:
+        def load():
+            with self._db_lock:
+                return self._db().execute(
+                    "SELECT user_id, payload FROM batches"
+                ).fetchall()
+        rows = await asyncio.to_thread(load)
+        return [
+            (u, info) for u, p in rows
+            if (info := self._from_dict(json.loads(p))).status
+            == BatchStatus.VALIDATING
+        ]
+
+    async def _is_cancelled(self, user_id: str, batch_id: str) -> bool:
+        try:
+            current = await self.retrieve_batch(user_id, batch_id)
+        except FileNotFoundError:
+            return True
+        return current.status == BatchStatus.CANCELLED
+
+    def _pick_backend(self, model: str) -> Optional[str]:
+        from production_stack_tpu.router.service_discovery import (
+            get_service_discovery,
+        )
+        try:
+            eps = get_service_discovery().get_endpoint_info()
+        except ValueError:
+            return None
+        for ep in eps:
+            if ep.serves_model(model):
+                return ep.url
+        return None
+
+    async def _run_batch(self, user_id: str, info: BatchInfo) -> None:
+        logger.info("Processing batch %s", info.id)
+        info.status = BatchStatus.IN_PROGRESS
+        await asyncio.to_thread(self._store_sync, user_id, info)
+        try:
+            raw = await self.storage.get_file_content(
+                user_id, info.input_file_id
+            )
+        except FileNotFoundError:
+            info.status = BatchStatus.FAILED
+            await asyncio.to_thread(self._store_sync, user_id, info)
+            return
+
+        lines = [ln for ln in raw.decode().splitlines() if ln.strip()]
+        info.total_requests = len(lines)
+        outputs, errors = [], []
+        async with aiohttp.ClientSession() as session:
+            for line in lines:
+                if await self._is_cancelled(user_id, info.id):
+                    logger.info("Batch %s cancelled mid-run", info.id)
+                    return
+                try:
+                    req = json.loads(line)
+                    body = req.get("body", {})
+                    backend = self._pick_backend(body.get("model", ""))
+                    if backend is None:
+                        raise RuntimeError("no backend serves this model")
+                    async with session.post(
+                        f"{backend}{info.endpoint}", json=body,
+                        timeout=aiohttp.ClientTimeout(total=600),
+                    ) as resp:
+                        result = await resp.json()
+                        status = resp.status
+                    outputs.append(json.dumps({
+                        "id": f"batch_req_{uuid.uuid4().hex[:16]}",
+                        "custom_id": req.get("custom_id"),
+                        "response": {
+                            "status_code": status, "body": result,
+                        },
+                        "error": None,
+                    }))
+                    info.completed_requests += 1
+                except Exception as e:
+                    errors.append(json.dumps({
+                        "custom_id": (req.get("custom_id")
+                                      if isinstance(req, dict) else None),
+                        "error": {"message": str(e)},
+                    }))
+                    info.failed_requests += 1
+
+        if await self._is_cancelled(user_id, info.id):
+            logger.info("Batch %s cancelled before finalize", info.id)
+            return
+        info.status = BatchStatus.FINALIZING
+        await asyncio.to_thread(self._store_sync, user_id, info)
+        out_file = await self.storage.save_file(
+            user_id, f"{info.id}_output.jsonl",
+            ("\n".join(outputs)).encode(), purpose="batch_output",
+        )
+        info.output_file_id = out_file.id
+        if errors:
+            err_file = await self.storage.save_file(
+                user_id, f"{info.id}_errors.jsonl",
+                ("\n".join(errors)).encode(), purpose="batch_output",
+            )
+            info.error_file_id = err_file.id
+        info.status = BatchStatus.COMPLETED
+        info.completed_at = int(time.time())
+        await asyncio.to_thread(self._store_sync, user_id, info)
+        logger.info("Batch %s completed: %d ok, %d failed",
+                    info.id, info.completed_requests, info.failed_requests)

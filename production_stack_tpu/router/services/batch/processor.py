@@ -1,0 +1,39 @@
+"""Batch processor abstraction (parity: batch_service/processor.py)."""
+
+import abc
+from typing import List, Optional
+
+from production_stack_tpu.router.services.batch.batch import BatchInfo
+from production_stack_tpu.router.services.files.storage import Storage
+
+
+class BatchProcessor(abc.ABC):
+    def __init__(self, storage: Storage):
+        self.storage = storage
+
+    @abc.abstractmethod
+    async def initialize(self) -> None: ...
+
+    @abc.abstractmethod
+    async def create_batch(self, user_id: str, input_file_id: str,
+                           endpoint: str, completion_window: str = "24h",
+                           metadata: Optional[dict] = None) -> BatchInfo: ...
+
+    @abc.abstractmethod
+    async def retrieve_batch(self, user_id: str,
+                             batch_id: str) -> BatchInfo: ...
+
+    @abc.abstractmethod
+    async def list_batches(self, user_id: str) -> List[BatchInfo]: ...
+
+    @abc.abstractmethod
+    async def cancel_batch(self, user_id: str, batch_id: str) -> BatchInfo: ...
+
+
+def initialize_batch_processor(kind: str, storage: Storage,
+                               **kwargs) -> BatchProcessor:
+    if kind == "local":
+        from production_stack_tpu.router.services.batch.local_processor \
+            import LocalBatchProcessor
+        return LocalBatchProcessor(storage, **kwargs)
+    raise ValueError(f"Unknown batch processor: {kind}")

@@ -1,0 +1,12 @@
+from production_stack_tpu.router.services.files.file_storage import (
+    FileStorage,
+)
+from production_stack_tpu.router.services.files.openai_files import (
+    OpenAIFile,
+)
+from production_stack_tpu.router.services.files.storage import (
+    Storage,
+    initialize_storage,
+)
+
+__all__ = ["FileStorage", "OpenAIFile", "Storage", "initialize_storage"]

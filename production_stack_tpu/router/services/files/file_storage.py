@@ -1,0 +1,98 @@
+"""Local-disk file storage (parity: files_service/file_storage.py).
+
+Layout: ``<base>/<user_id>/<file_id>`` for content plus a ``.meta.json``
+sidecar holding the OpenAI file metadata.
+"""
+
+import json
+import os
+import re
+import uuid
+from typing import List
+
+import aiofiles
+import aiofiles.os as aio_os
+
+from production_stack_tpu.router.services.files.openai_files import OpenAIFile
+from production_stack_tpu.router.services.files.storage import (
+    DEFAULT_STORAGE_PATH,
+    Storage,
+)
+
+
+class FileStorage(Storage):
+    def __init__(self, base_path: str = DEFAULT_STORAGE_PATH):
+        self.base_path = base_path
+        os.makedirs(base_path, exist_ok=True)
+
+    @staticmethod
+    def _sanitize(component: str) -> str:
+        """One path component: no separators, no traversal."""
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", component)
+        if safe.strip(".") == "":  # '', '.', '..', '...'
+            return "anonymous"
+        return safe
+
+    def _user_dir(self, user_id: str) -> str:
+        path = os.path.join(self.base_path, self._sanitize(user_id))
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _paths(self, user_id: str, file_id: str) -> tuple[str, str]:
+        d = self._user_dir(user_id)
+        file_id = self._sanitize(file_id)
+        return os.path.join(d, file_id), os.path.join(
+            d, f"{file_id}.meta.json"
+        )
+
+    async def save_file(self, user_id: str, filename: str, content: bytes,
+                        purpose: str = "batch") -> OpenAIFile:
+        file_id = f"file-{uuid.uuid4().hex[:24]}"
+        content_path, meta_path = self._paths(user_id, file_id)
+        file = OpenAIFile(
+            id=file_id, filename=filename, bytes=len(content),
+            purpose=purpose, user_id=user_id,
+        )
+        async with aiofiles.open(content_path, "wb") as f:
+            await f.write(content)
+        async with aiofiles.open(meta_path, "w") as f:
+            await f.write(json.dumps(file.metadata()))
+        return file
+
+    async def get_file(self, user_id: str, file_id: str) -> OpenAIFile:
+        _, meta_path = self._paths(user_id, file_id)
+        try:
+            async with aiofiles.open(meta_path, "r") as f:
+                meta = json.loads(await f.read())
+        except FileNotFoundError:
+            raise FileNotFoundError(f"File {file_id} not found") from None
+        return OpenAIFile(
+            id=meta["id"], filename=meta["filename"], bytes=meta["bytes"],
+            purpose=meta["purpose"], created_at=meta["created_at"],
+            user_id=user_id,
+        )
+
+    async def get_file_content(self, user_id: str, file_id: str) -> bytes:
+        content_path, _ = self._paths(user_id, file_id)
+        try:
+            async with aiofiles.open(content_path, "rb") as f:
+                return await f.read()
+        except FileNotFoundError:
+            raise FileNotFoundError(f"File {file_id} not found") from None
+
+    async def list_files(self, user_id: str) -> List[OpenAIFile]:
+        d = self._user_dir(user_id)
+        files = []
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".meta.json"):
+                files.append(
+                    await self.get_file(user_id, name[: -len(".meta.json")])
+                )
+        return files
+
+    async def delete_file(self, user_id: str, file_id: str) -> None:
+        for path in self._paths(user_id, file_id):
+            try:
+                await aio_os.remove(path)
+            except FileNotFoundError:
+                pass

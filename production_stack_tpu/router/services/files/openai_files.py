@@ -1,0 +1,26 @@
+"""OpenAI Files API wire object (parity: files_service/openai_files.py)."""
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class OpenAIFile:
+    id: str
+    filename: str
+    bytes: int
+    purpose: str = "batch"
+    created_at: int = field(default_factory=lambda: int(time.time()))
+    object: str = "file"
+    user_id: Optional[str] = None
+
+    def metadata(self) -> dict:
+        return {
+            "id": self.id,
+            "object": self.object,
+            "bytes": self.bytes,
+            "created_at": self.created_at,
+            "filename": self.filename,
+            "purpose": self.purpose,
+        }

@@ -1,0 +1,41 @@
+"""File storage abstraction (parity: files_service/storage.py)."""
+
+import abc
+from typing import List
+
+from production_stack_tpu.router.services.files.openai_files import OpenAIFile
+
+DEFAULT_STORAGE_PATH = "/tmp/pstpu_files"
+
+
+class Storage(abc.ABC):
+    @abc.abstractmethod
+    async def save_file(self, user_id: str, filename: str, content: bytes,
+                        purpose: str = "batch") -> OpenAIFile:
+        ...
+
+    @abc.abstractmethod
+    async def get_file(self, user_id: str, file_id: str) -> OpenAIFile:
+        ...
+
+    @abc.abstractmethod
+    async def get_file_content(self, user_id: str, file_id: str) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    async def list_files(self, user_id: str) -> List[OpenAIFile]:
+        ...
+
+    @abc.abstractmethod
+    async def delete_file(self, user_id: str, file_id: str) -> None:
+        ...
+
+
+def initialize_storage(storage_type: str = "local_file",
+                       base_path: str = DEFAULT_STORAGE_PATH) -> Storage:
+    if storage_type == "local_file":
+        from production_stack_tpu.router.services.files.file_storage import (
+            FileStorage,
+        )
+        return FileStorage(base_path)
+    raise ValueError(f"Unknown storage type: {storage_type}")

@@ -1,0 +1,77 @@
+"""Router-side Prometheus gauges.
+
+Exposition names match the reference
+(src/vllm_router/services/metrics_service/__init__.py) so the shipped
+Grafana dashboard and prometheus-adapter HPA rules keep working unchanged.
+"""
+
+import time
+
+from prometheus_client import CONTENT_TYPE_LATEST, Gauge, generate_latest
+
+_LBL = ["server"]
+
+num_requests_running = Gauge(
+    "vllm:num_requests_running", "Number of running requests", _LBL)
+num_requests_waiting = Gauge(
+    "vllm:num_requests_waiting", "Number of waiting requests", _LBL)
+current_qps = Gauge("vllm:current_qps", "Current Queries Per Second", _LBL)
+avg_decoding_length = Gauge(
+    "vllm:avg_decoding_length", "Average Decoding Length", _LBL)
+num_prefill_requests = Gauge(
+    "vllm:num_prefill_requests", "Number of Prefill Requests", _LBL)
+num_decoding_requests = Gauge(
+    "vllm:num_decoding_requests", "Number of Decoding Requests", _LBL)
+healthy_pods_total = Gauge(
+    "vllm:healthy_pods_total", "Number of healthy engine pods", _LBL)
+avg_latency = Gauge(
+    "vllm:avg_latency", "Average end-to-end request latency", _LBL)
+avg_itl = Gauge("vllm:avg_itl", "Average Inter-Token Latency", _LBL)
+num_requests_swapped = Gauge(
+    "vllm:num_requests_swapped", "Number of swapped requests", _LBL)
+allocated_blocks = Gauge(
+    "vllm:allocated_blocks", "Number of allocated KV blocks", _LBL)
+pending_reserved_blocks = Gauge(
+    "vllm:pending_reserved_blocks", "Number of pending reserved KV blocks",
+    _LBL)
+num_free_blocks = Gauge(
+    "vllm:num_free_blocks", "Number of free KV blocks", _LBL)
+
+
+def refresh_gauges() -> None:
+    """Pull the latest snapshots into the gauge registry."""
+    from production_stack_tpu.router.service_discovery import (
+        get_service_discovery,
+    )
+    from production_stack_tpu.router.stats.request_stats import (
+        get_request_stats_monitor,
+    )
+
+    stats = get_request_stats_monitor().get_request_stats(time.time())
+    for server, stat in stats.items():
+        current_qps.labels(server=server).set(stat.qps)
+        avg_decoding_length.labels(server=server).set(stat.avg_decoding_length)
+        num_prefill_requests.labels(server=server).set(
+            stat.in_prefill_requests)
+        num_decoding_requests.labels(server=server).set(
+            stat.in_decoding_requests)
+        num_requests_running.labels(server=server).set(
+            stat.in_prefill_requests + stat.in_decoding_requests)
+        avg_latency.labels(server=server).set(stat.avg_latency)
+        avg_itl.labels(server=server).set(stat.avg_itl)
+        num_requests_swapped.labels(server=server).set(
+            stat.num_swapped_requests)
+        allocated_blocks.labels(server=server).set(stat.allocated_blocks)
+        pending_reserved_blocks.labels(server=server).set(
+            stat.pending_reserved_blocks)
+        num_free_blocks.labels(server=server).set(stat.num_free_blocks)
+    try:
+        for ep in get_service_discovery().get_endpoint_info():
+            healthy_pods_total.labels(server=ep.url).set(1)
+    except ValueError:
+        pass
+
+
+def render_exposition() -> tuple[bytes, str]:
+    refresh_gauges()
+    return generate_latest(), CONTENT_TYPE_LATEST

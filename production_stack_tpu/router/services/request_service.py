@@ -1,0 +1,224 @@
+"""The proxy hot path: pick a backend, stream the response through.
+
+Capability parity with reference
+src/vllm_router/services/request_service/request.py (route_general_request
+L137, process_request L46): body parse + model filter, rewriter hook,
+stats lifecycle events per streamed chunk, fork's ``x-prefill-tokens``
+hint header (L199-203), HRA future await (L210-213), cleanup on
+disconnect. Implemented on aiohttp: the backend stream is forwarded
+chunk-by-chunk into a ``web.StreamResponse`` with no buffering.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router.service_discovery import (
+    get_service_discovery,
+)
+from production_stack_tpu.router.services.rewriter import (
+    get_request_rewriter,
+)
+from production_stack_tpu.router.stats.engine_stats import (
+    get_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.request_stats import (
+    get_request_stats_monitor,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+# Fork feature: clients may pre-declare prompt size for admission control.
+PREFILL_TOKENS_HEADER = "x-prefill-tokens"
+
+# Hop-by-hop headers never forwarded in either direction.
+_HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate",
+    "proxy-authorization", "te", "trailers", "transfer-encoding",
+    "upgrade", "host", "content-length",
+}
+# aiohttp auto-decompresses the backend body, so advertising the
+# backend's encoding downstream would corrupt every response.
+_RESPONSE_DROP_HEADERS = _HOP_HEADERS | {"content-encoding"}
+
+# Cap on response bytes buffered for the semantic cache store path.
+_CACHE_STORE_MAX_BYTES = 4 * 1024 * 1024
+
+
+def _client_session(app: web.Application) -> aiohttp.ClientSession:
+    return app["backend_session"]
+
+
+def _estimate_prefill_tokens(request: web.Request, body: bytes) -> int:
+    hint = request.headers.get(PREFILL_TOKENS_HEADER)
+    if hint is not None:
+        try:
+            return max(0, int(hint))
+        except ValueError:
+            logger.warning("Bad %s header: %r", PREFILL_TOKENS_HEADER, hint)
+    # ~4 bytes/token heuristic when the client does not hint.
+    return len(body) // 4
+
+
+def _error(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error"}},
+        status=status,
+    )
+
+
+async def route_general_request(request: web.Request,
+                                endpoint_path: str) -> web.StreamResponse:
+    """Proxy one OpenAI-API request to a chosen engine, streaming back."""
+    from production_stack_tpu.router.routing.logic import get_routing_logic
+
+    in_router_time = time.time()
+    request_id = request.headers.get("x-request-id") or str(uuid.uuid4())
+    body = await request.read()
+    try:
+        payload = json.loads(body) if body else {}
+    except json.JSONDecodeError:
+        return _error(400, "Request body is not valid JSON")
+    model = payload.get("model")
+    if not model:
+        return _error(400, "Request body must contain a 'model' field")
+
+    rewriter = get_request_rewriter()
+    rewritten = rewriter.rewrite_request(body, model, endpoint_path)
+    if rewritten is not body:
+        body = rewritten
+
+    endpoints = [
+        ep for ep in get_service_discovery().get_endpoint_info()
+        if ep.serves_model(model)
+    ]
+    if not endpoints:
+        return _error(
+            400, f"Model {model} not found on any serving engine"
+        )
+
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    monitor = get_request_stats_monitor()
+    request_stats = monitor.get_request_stats(time.time())
+    monitor.on_request_arrival(request_id, in_router_time)
+
+    num_prefill_tokens = _estimate_prefill_tokens(request, body)
+
+    policy = get_routing_logic()
+    choice = policy.route_request(
+        endpoints, engine_stats, request_stats, request.headers,
+        request_id, num_prefill_tokens,
+    )
+    if hasattr(choice, "__await__"):
+        try:
+            server_url = await choice
+        except Exception as e:  # admission rejected (e.g. can never fit)
+            monitor.on_request_kill("<unrouted>", request_id)
+            return _error(429, f"Request not admitted: {e}")
+    else:
+        server_url = choice
+    queue_delay = time.time() - in_router_time
+    logger.debug("Routing %s to %s (queued %.1f ms)",
+                 request_id, server_url, queue_delay * 1e3)
+
+    store_callback = _semantic_cache_store_callback(endpoint_path, payload)
+    return await _proxy_stream(
+        request, server_url, endpoint_path, body, request_id, policy,
+        store_callback,
+    )
+
+
+def _semantic_cache_store_callback(endpoint_path: str, payload: dict):
+    """Build a response-store hook when the semantic cache should learn
+    from this request (non-streaming chat completions, gate enabled)."""
+    if endpoint_path != "/v1/chat/completions" or payload.get("stream"):
+        return None
+    from production_stack_tpu.router.experimental.feature_gates import (
+        SEMANTIC_CACHE_GATE,
+        get_feature_gates,
+    )
+    if not get_feature_gates().enabled(SEMANTIC_CACHE_GATE):
+        return None
+    model, messages = payload.get("model"), payload.get("messages")
+    if not model or not messages:
+        return None
+
+    def store(response_bytes: bytes) -> None:
+        from production_stack_tpu.router.experimental.semantic_cache \
+            import integration as sc
+        try:
+            sc.store_in_semantic_cache(
+                model, messages, json.loads(response_bytes)
+            )
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            pass
+
+    return store
+
+
+async def _proxy_stream(request: web.Request, server_url: str,
+                        endpoint_path: str, body: bytes, request_id: str,
+                        policy, store_callback=None) -> web.StreamResponse:
+    monitor = get_request_stats_monitor()
+    session = _client_session(request.app)
+    fwd_headers = {
+        k: v for k, v in request.headers.items()
+        if k.lower() not in _HOP_HEADERS
+    }
+    fwd_headers["x-request-id"] = request_id
+
+    start_time = time.time()
+    monitor.on_request_start(server_url, request_id, start_time)
+    completed = False
+    response: Optional[web.StreamResponse] = None
+    try:
+        async with session.request(
+            request.method, f"{server_url}{endpoint_path}",
+            data=body, headers=fwd_headers,
+        ) as backend:
+            response = web.StreamResponse(
+                status=backend.status,
+                headers={
+                    k: v for k, v in backend.headers.items()
+                    if k.lower() not in _RESPONSE_DROP_HEADERS
+                },
+            )
+            await response.prepare(request)
+            first_chunk = True
+            cache_buffer = bytearray() if store_callback else None
+            async for chunk in backend.content.iter_any():
+                if not chunk:
+                    continue
+                monitor.on_request_response(
+                    server_url, request_id, time.time(),
+                    is_first_token=first_chunk,
+                )
+                first_chunk = False
+                if (cache_buffer is not None
+                        and len(cache_buffer) < _CACHE_STORE_MAX_BYTES):
+                    cache_buffer.extend(chunk)
+                await response.write(chunk)
+            monitor.on_request_complete(server_url, request_id, time.time())
+            completed = True
+            await response.write_eof()
+            if (cache_buffer is not None and backend.status == 200
+                    and len(cache_buffer) < _CACHE_STORE_MAX_BYTES):
+                store_callback(bytes(cache_buffer))
+            return response
+    except Exception as e:
+        logger.warning("Proxy error for %s via %s: %s",
+                       request_id, server_url, e)
+        if response is None:
+            return _error(502, f"Upstream engine error: {e}")
+        raise
+    finally:
+        if not completed:
+            monitor.on_request_kill(server_url, request_id)
+        policy.on_request_complete(server_url)

@@ -1,0 +1,41 @@
+"""Request-body rewriting hook (parity: request_service/rewriter.py).
+
+Rewriters run before the request is forwarded; useful for prompt
+injection-hardening, model aliasing, or header-driven overrides.
+"""
+
+import abc
+from typing import Optional
+
+
+class RequestRewriter(abc.ABC):
+    @abc.abstractmethod
+    def rewrite_request(self, request_body: bytes, model: str,
+                        endpoint: str) -> bytes:
+        raise NotImplementedError
+
+
+class NoopRequestRewriter(RequestRewriter):
+    def rewrite_request(self, request_body: bytes, model: str,
+                        endpoint: str) -> bytes:
+        return request_body
+
+
+_REWRITERS = {"noop": NoopRequestRewriter}
+_active: Optional[RequestRewriter] = None
+
+
+def initialize_request_rewriter(kind: str, **kwargs) -> RequestRewriter:
+    global _active
+    try:
+        _active = _REWRITERS[kind](**kwargs)
+    except KeyError:
+        raise ValueError(f"Unknown request rewriter: {kind}") from None
+    return _active
+
+
+def get_request_rewriter() -> RequestRewriter:
+    global _active
+    if _active is None:
+        _active = NoopRequestRewriter()
+    return _active

@@ -1,0 +1,125 @@
+"""Engine /metrics scraper.
+
+Capability parity with reference src/vllm_router/stats/engine_stats.py:
+a daemon thread polls every serving engine's Prometheus ``/metrics``
+endpoint and keeps the latest physical-load numbers per engine URL.
+
+Metric names are the vLLM exposition names, which our TPU engine also
+emits (engine/metrics.py), so the router works against either backend.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import requests
+from prometheus_client.parser import text_string_to_metric_families
+
+from production_stack_tpu.utils import SingletonMeta
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+_SCRAPE_TIMEOUT_S = 5.0
+
+# Exposition name -> EngineStats attribute.
+_METRIC_MAP = {
+    "vllm:num_requests_running": "num_running_requests",
+    "vllm:num_requests_waiting": "num_queuing_requests",
+    "vllm:gpu_prefix_cache_hit_rate": "kv_cache_hit_rate",
+    "vllm:gpu_cache_usage_perc": "kv_usage_perc",
+}
+
+
+@dataclass
+class EngineStats:
+    num_running_requests: int = 0
+    num_queuing_requests: int = 0
+    kv_cache_hit_rate: float = 0.0
+    kv_usage_perc: float = 0.0
+
+    @classmethod
+    def from_prometheus_text(cls, text: str) -> "EngineStats":
+        stats = cls()
+        for family in text_string_to_metric_families(text):
+            for sample in family.samples:
+                attr = _METRIC_MAP.get(sample.name)
+                if attr is not None:
+                    current = getattr(stats, attr)
+                    setattr(stats, attr, type(current)(sample.value))
+        return stats
+
+
+class EngineStatsScraper(metaclass=SingletonMeta):
+    """Daemon thread scraping every discovered engine at a fixed interval."""
+
+    def __init__(self, scrape_interval: Optional[float] = None):
+        if getattr(self, "_initialized", False):
+            return
+        if scrape_interval is None:
+            raise ValueError("EngineStatsScraper needs scrape_interval")
+        self.scrape_interval = float(scrape_interval)
+        self._stats: Dict[str, EngineStats] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="engine-stats-scraper"
+        )
+        self._thread.start()
+        self._initialized = True
+
+    def _engine_urls(self):
+        # Imported lazily to avoid a circular import at module load.
+        from production_stack_tpu.router.service_discovery import (
+            get_service_discovery,
+        )
+        try:
+            discovery = get_service_discovery()
+        except ValueError:
+            return []
+        return [ep.url for ep in discovery.get_endpoint_info()]
+
+    def _scrape_one(self, url: str) -> Optional[EngineStats]:
+        try:
+            resp = requests.get(f"{url}/metrics", timeout=_SCRAPE_TIMEOUT_S)
+            resp.raise_for_status()
+            return EngineStats.from_prometheus_text(resp.text)
+        except Exception as e:
+            logger.warning("Failed to scrape %s/metrics: %s", url, e)
+            return None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.scrape_interval):
+            urls = self._engine_urls()
+            fresh: Dict[str, EngineStats] = {}
+            for url in urls:
+                stats = self._scrape_one(url)
+                if stats is not None:
+                    fresh[url] = stats
+            with self._lock:
+                # Drop engines that disappeared from discovery.
+                self._stats = {
+                    u: fresh.get(u, self._stats.get(u, EngineStats()))
+                    for u in urls
+                }
+
+    def get_engine_stats(self) -> Dict[str, EngineStats]:
+        with self._lock:
+            return dict(self._stats)
+
+    def get_health(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+def initialize_engine_stats_scraper(scrape_interval: float) -> EngineStatsScraper:
+    return EngineStatsScraper(scrape_interval)
+
+
+def get_engine_stats_scraper() -> EngineStatsScraper:
+    return EngineStatsScraper()

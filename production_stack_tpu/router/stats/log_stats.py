@@ -1,0 +1,64 @@
+"""Periodic human-readable stats dump (parity: stats/log_stats.py)."""
+
+import threading
+import time
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger("production_stack_tpu.stats")
+
+
+def format_stats_report() -> str:
+    from production_stack_tpu.router.service_discovery import (
+        get_service_discovery,
+    )
+    from production_stack_tpu.router.stats.engine_stats import (
+        get_engine_stats_scraper,
+    )
+    from production_stack_tpu.router.stats.request_stats import (
+        get_request_stats_monitor,
+    )
+
+    lines = ["", "==== Router Stats ===="]
+    try:
+        endpoints = get_service_discovery().get_endpoint_info()
+    except ValueError:
+        endpoints = []
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    for ep in endpoints:
+        lines.append(f"{ep.url} (models={ep.model_names})")
+        es = engine_stats.get(ep.url)
+        if es:
+            lines.append(
+                f"  engine: running={es.num_running_requests} "
+                f"waiting={es.num_queuing_requests} "
+                f"kv_usage={es.kv_usage_perc:.1%} "
+                f"prefix_hit={es.kv_cache_hit_rate:.1%}"
+            )
+        rs = request_stats.get(ep.url)
+        if rs:
+            lines.append(
+                f"  requests: qps={rs.qps:.2f} ttft={rs.ttft:.3f}s "
+                f"prefill={rs.in_prefill_requests} "
+                f"decode={rs.in_decoding_requests} "
+                f"finished={rs.finished_requests} "
+                f"blocks(alloc/reserved/free)={rs.allocated_blocks}/"
+                f"{rs.pending_reserved_blocks}/{rs.num_free_blocks}"
+            )
+    lines.append("======================")
+    return "\n".join(lines)
+
+
+def log_stats(interval_s: float = 10.0) -> threading.Thread:
+    def _loop():
+        while True:
+            time.sleep(interval_s)
+            try:
+                logger.info(format_stats_report())
+            except Exception as e:  # keep the reporter alive
+                logger.warning("Stats report failed: %s", e)
+
+    thread = threading.Thread(target=_loop, daemon=True, name="stats-logger")
+    thread.start()
+    return thread

@@ -1,0 +1,303 @@
+"""Per-engine request-level statistics.
+
+Re-implements the capability of reference
+src/vllm_router/stats/request_stats.py (lifecycle events L144-315, sliding
+window monitors L61-100, fork's KV-block accounting L399-457) with:
+
+- a single coarse lock (the reference relies on the GIL; we are explicit),
+- TPU-calibrated block-budget defaults, overridable via environment
+  (``PSTPU_KV_BLOCK_SIZE``, ``PSTPU_KV_TOTAL_BLOCKS``, ...). The defaults
+  model a v5e chip (16 GiB HBM) serving Llama-3-8B bf16 KV.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Set, Tuple
+
+from production_stack_tpu.utils import SingletonMeta
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+# KV block-budget model used by HRA admission control.
+# Reference constants (request_stats.py:9-12) model an A10 GPU; ours model a
+# TPU v5e chip: 16 GiB HBM - ~16 GiB model/weights budget split leaves
+# ~4 GiB KV for Llama-3-8B bf16 (8 kv-heads * 128 dim * 2 bytes * 2 (k+v)
+# * 32 layers = 128 KiB/token -> ~2048 tokens/GiB). With page size 16:
+BLOCK_SIZE = int(os.environ.get("PSTPU_KV_BLOCK_SIZE", 16))
+TOTAL_NUMBER_OF_BLOCKS = int(os.environ.get("PSTPU_KV_TOTAL_BLOCKS", 2048))
+DECODE_TO_PREFILL_RATIO = float(os.environ.get("PSTPU_DECODE_PREFILL_RATIO", 0.25))
+SAFETY_FRACTION = float(os.environ.get("PSTPU_KV_SAFETY_FRACTION", 0.05))
+
+
+@dataclass
+class RequestStats:
+    """Snapshot of request-level performance of one engine."""
+
+    qps: float = -1.0
+    ttft: float = -1.0
+    in_prefill_requests: int = 0
+    in_decoding_requests: int = 0
+    # Ages (seconds) of requests currently in prefill / decode.
+    ts_prefill_enqueue: List[float] = field(default_factory=list)
+    ts_decoding_enqueue: List[float] = field(default_factory=list)
+    finished_requests: int = 0
+    uptime: float = 0.0
+    avg_decoding_length: float = -1.0
+    avg_latency: float = -1.0
+    avg_itl: float = -1.0
+    num_swapped_requests: int = 0
+    # KV block accounting (fork feature).
+    allocated_blocks: int = 0
+    pending_reserved_blocks: int = 0
+    num_free_blocks: int = TOTAL_NUMBER_OF_BLOCKS
+
+
+class SlidingWindow:
+    """Time-windowed series supporting average and sum."""
+
+    def __init__(self, window_s: float):
+        self.window_s = window_s
+        self._ts: Deque[float] = deque()
+        self._vals: Deque[float] = deque()
+
+    def observe(self, timestamp: float, value: float) -> None:
+        self._ts.append(timestamp)
+        self._vals.append(value)
+        self._evict(timestamp)
+
+    def advance(self, timestamp: float) -> None:
+        self._evict(timestamp)
+
+    def _evict(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._ts and self._ts[0] < cutoff:
+            self._ts.popleft()
+            self._vals.popleft()
+
+    def average(self) -> float:
+        return sum(self._vals) / len(self._vals) if self._vals else -1.0
+
+    def total(self) -> float:
+        return sum(self._vals)
+
+
+class RequestStatsMonitor(metaclass=SingletonMeta):
+    """Tracks the lifecycle of every proxied request, per engine.
+
+    Event flow (mirrors reference request_stats.py event API):
+    arrival -> routed (prefill set + reserved blocks) -> start (qps)
+    -> response(first_token) (prefill->decode, ttft) -> response(...) per
+    token chunk (decode token count -> allocated blocks) -> complete
+    (latency, decode length) | kill (cleanup on disconnect/error).
+    """
+
+    def __init__(self, sliding_window_size: Optional[float] = None):
+        if getattr(self, "_initialized", False):
+            return
+        if sliding_window_size is None:
+            raise ValueError("RequestStatsMonitor needs sliding_window_size")
+        self.window_s = float(sliding_window_size)
+        self._lock = threading.Lock()
+
+        self._qps: Dict[str, SlidingWindow] = {}
+        self._ttft: Dict[str, SlidingWindow] = {}
+        self._latency: Dict[str, SlidingWindow] = {}
+        self._decode_len: Dict[str, SlidingWindow] = {}
+
+        self._arrival_time: Dict[str, float] = {}
+        self._first_token_time: Dict[Tuple[str, str], float] = {}
+        self._in_prefill: Dict[str, Set[str]] = {}
+        self._in_decode: Dict[str, Set[str]] = {}
+        self._finished: Dict[str, int] = {}
+        self._swapped: Dict[str, int] = {}
+        # engine_url -> request_id -> token counts
+        self._decode_tokens: Dict[str, Dict[str, int]] = {}
+        self._prefill_tokens: Dict[str, Dict[str, int]] = {}
+
+        self._first_query_time: Optional[float] = None
+        self._initialized = True
+
+    # ---- lifecycle events -------------------------------------------------
+
+    def on_request_arrival(self, request_id: str, timestamp: float) -> None:
+        with self._lock:
+            self._arrival_time[request_id] = timestamp
+            if self._first_query_time is None:
+                self._first_query_time = timestamp
+
+    def on_request_routed(self, engine_url: str, request_id: str,
+                          prefill_tokens: int) -> None:
+        """Admission decision made: account reserved prefill tokens."""
+        with self._lock:
+            self._prefill_tokens.setdefault(engine_url, {})[request_id] = (
+                prefill_tokens
+            )
+            self._in_prefill.setdefault(engine_url, set()).add(request_id)
+
+    def on_request_start(self, engine_url: str, request_id: str,
+                         timestamp: float) -> None:
+        with self._lock:
+            self._qps.setdefault(
+                engine_url, SlidingWindow(self.window_s)
+            ).observe(timestamp, 1.0)
+
+    def on_request_response(self, engine_url: str, request_id: str,
+                            timestamp: float, is_first_token: bool) -> None:
+        with self._lock:
+            toks = self._decode_tokens.setdefault(engine_url, {})
+            toks[request_id] = toks.get(request_id, 0) + 1
+            if not is_first_token:
+                return
+            if request_id not in self._arrival_time:
+                self._cleanup_locked(engine_url, request_id)
+                return
+            self._in_prefill.setdefault(engine_url, set()).discard(request_id)
+            self._in_decode.setdefault(engine_url, set()).add(request_id)
+            self._first_token_time[(engine_url, request_id)] = timestamp
+            ttft = timestamp - self._arrival_time[request_id]
+            self._ttft.setdefault(
+                engine_url, SlidingWindow(self.window_s)
+            ).observe(timestamp, ttft)
+
+    def on_request_complete(self, engine_url: str, request_id: str,
+                            timestamp: float) -> None:
+        with self._lock:
+            if (request_id not in self._arrival_time
+                    or (engine_url, request_id) not in self._first_token_time):
+                self._cleanup_locked(engine_url, request_id)
+                return
+            self._in_decode.setdefault(engine_url, set()).discard(request_id)
+            self._finished[engine_url] = self._finished.get(engine_url, 0) + 1
+            lat = timestamp - self._arrival_time[request_id]
+            self._latency.setdefault(
+                engine_url, SlidingWindow(self.window_s)
+            ).observe(timestamp, lat)
+            dec = timestamp - self._first_token_time[(engine_url, request_id)]
+            self._decode_len.setdefault(
+                engine_url, SlidingWindow(self.window_s)
+            ).observe(timestamp, dec)
+            self._cleanup_locked(engine_url, request_id)
+
+    def on_request_kill(self, engine_url: str, request_id: str) -> None:
+        """Request died mid-flight (client disconnect, engine error)."""
+        with self._lock:
+            self._cleanup_locked(engine_url, request_id)
+
+    def on_request_swapped(self, engine_url: str, request_id: str,
+                           timestamp: float) -> None:
+        with self._lock:
+            self._swapped[engine_url] = self._swapped.get(engine_url, 0) + 1
+
+    def _cleanup_locked(self, engine_url: str, request_id: str) -> None:
+        self._arrival_time.pop(request_id, None)
+        self._first_token_time.pop((engine_url, request_id), None)
+        if engine_url in self._in_prefill:
+            self._in_prefill[engine_url].discard(request_id)
+        if engine_url in self._in_decode:
+            self._in_decode[engine_url].discard(request_id)
+        for table in (self._decode_tokens, self._prefill_tokens):
+            if engine_url in table:
+                table[engine_url].pop(request_id, None)
+                if not table[engine_url]:
+                    del table[engine_url]
+
+    # ---- KV block model (fork parity, request_stats.py:399-457) -----------
+
+    def estimate_allocated_blocks(self, engine_url: str) -> int:
+        """Blocks held by requests actively decoding on *engine_url*."""
+        with self._lock:
+            return self._allocated_locked(engine_url)
+
+    def _allocated_locked(self, engine_url: str) -> int:
+        decode_ids = self._in_decode.get(engine_url, set())
+        toks = self._decode_tokens.get(engine_url, {})
+        prefills = self._prefill_tokens.get(engine_url, {})
+        total = 0
+        for rid in decode_ids:
+            n = prefills.get(rid, 0) + toks.get(rid, 0)
+            total += math.ceil(n / BLOCK_SIZE)
+        return total
+
+    def estimate_pending_reserved_blocks(self, engine_url: str) -> int:
+        """Blocks to reserve for requests still in prefill (pessimistic)."""
+        with self._lock:
+            return self._reserved_locked(engine_url)
+
+    def _reserved_locked(self, engine_url: str) -> int:
+        prefill_ids = self._in_prefill.get(engine_url, set())
+        prefills = self._prefill_tokens.get(engine_url, {})
+        total_prefill = sum(prefills.get(rid, 0) for rid in prefill_ids)
+        expected = total_prefill * (1 + DECODE_TO_PREFILL_RATIO)
+        return math.ceil(expected / BLOCK_SIZE)
+
+    # ---- snapshot ---------------------------------------------------------
+
+    def get_request_stats(self, current_time: float) -> Dict[str, RequestStats]:
+        with self._lock:
+            out: Dict[str, RequestStats] = {}
+            urls = set(self._in_prefill) | set(self._in_decode)
+            for url in urls:
+                qps = -1.0
+                if url in self._qps:
+                    self._qps[url].advance(current_time)
+                    qps = self._qps[url].total() / self.window_s
+                ttft = -1.0
+                if url in self._ttft:
+                    self._ttft[url].advance(current_time)
+                    ttft = self._ttft[url].average()
+                avg_dec = -1.0
+                if url in self._decode_len:
+                    self._decode_len[url].advance(current_time)
+                    avg_dec = self._decode_len[url].average()
+                avg_lat = -1.0
+                if url in self._latency:
+                    self._latency[url].advance(current_time)
+                    avg_lat = self._latency[url].average()
+
+                prefill_ids = self._in_prefill.get(url, set())
+                decode_ids = self._in_decode.get(url, set())
+                allocated = self._allocated_locked(url)
+                reserved = self._reserved_locked(url)
+                out[url] = RequestStats(
+                    qps=qps,
+                    ttft=ttft,
+                    in_prefill_requests=len(prefill_ids),
+                    in_decoding_requests=len(decode_ids),
+                    ts_prefill_enqueue=[
+                        current_time - self._arrival_time[r]
+                        for r in prefill_ids if r in self._arrival_time
+                    ],
+                    ts_decoding_enqueue=[
+                        current_time - self._first_token_time[(url, r)]
+                        for r in decode_ids
+                        if (url, r) in self._first_token_time
+                    ],
+                    finished_requests=self._finished.get(url, 0),
+                    uptime=(current_time - self._first_query_time
+                            if self._first_query_time else 0.0),
+                    avg_decoding_length=avg_dec,
+                    avg_latency=avg_lat,
+                    avg_itl=-1.0,
+                    num_swapped_requests=self._swapped.get(url, 0),
+                    allocated_blocks=allocated,
+                    pending_reserved_blocks=reserved,
+                    num_free_blocks=(
+                        TOTAL_NUMBER_OF_BLOCKS - allocated - reserved
+                    ),
+                )
+            return out
+
+
+def initialize_request_stats_monitor(
+        sliding_window_size: float) -> RequestStatsMonitor:
+    return RequestStatsMonitor(sliding_window_size)
+
+
+def get_request_stats_monitor() -> RequestStatsMonitor:
+    return RequestStatsMonitor()

@@ -1,0 +1,197 @@
+"""Fake serving engine for router tests and perf rigs.
+
+Capability parity with reference src/tests/perftest/fake-openai-server.py:
+an OpenAI-compatible HTTP server that streams chat-completion chunks at a
+configurable tokens/sec rate (``--speed``) after a configurable first-token
+delay (``--ttft``), and exposes a synthetic vLLM-style ``/metrics``
+exposition — so the full router stack can be exercised with zero TPUs.
+
+Run: ``python -m production_stack_tpu.testing.fake_engine --port 9001``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+
+class FakeEngineState:
+    def __init__(self, model: str, speed: float, ttft: float,
+                 max_tokens_default: int = 32):
+        self.model = model
+        self.speed = speed  # tokens per second
+        self.ttft = ttft  # seconds before first token
+        self.max_tokens_default = max_tokens_default
+        self.running = 0
+        self.waiting = 0
+        self.total_served = 0
+
+
+def _sse(payload: dict) -> bytes:
+    return f"data: {json.dumps(payload)}\n\n".encode()
+
+
+def _chunk(request_id: str, model: str, text: Optional[str],
+           finish: Optional[str] = None, role: Optional[str] = None) -> dict:
+    delta = {}
+    if role:
+        delta["role"] = role
+    if text is not None:
+        delta["content"] = text
+    return {
+        "id": request_id,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {"index": 0, "delta": delta, "finish_reason": finish}
+        ],
+    }
+
+
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    state: FakeEngineState = request.app["state"]
+    body = await request.json()
+    n_tokens = int(
+        body.get("max_tokens")
+        or body.get("max_completion_tokens")
+        or state.max_tokens_default
+    )
+    stream = bool(body.get("stream", False))
+    request_id = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+    model = body.get("model", state.model)
+    words = [f"tok{i} " for i in range(n_tokens)]
+
+    state.running += 1
+    try:
+        await asyncio.sleep(state.ttft)
+        if not stream:
+            await asyncio.sleep(n_tokens / state.speed)
+            state.total_served += 1
+            return web.json_response({
+                "id": request_id,
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": model,
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant",
+                                "content": "".join(words)},
+                    "finish_reason": "stop",
+                }],
+                "usage": {
+                    "prompt_tokens": 0,
+                    "completion_tokens": n_tokens,
+                    "total_tokens": n_tokens,
+                },
+            })
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        await resp.write(_sse(_chunk(request_id, model, None,
+                                     role="assistant")))
+        for word in words:
+            await asyncio.sleep(1.0 / state.speed)
+            await resp.write(_sse(_chunk(request_id, model, word)))
+        await resp.write(_sse(_chunk(request_id, model, None,
+                                     finish="stop")))
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        state.total_served += 1
+        return resp
+    finally:
+        state.running -= 1
+
+
+async def completions(request: web.Request) -> web.Response:
+    state: FakeEngineState = request.app["state"]
+    body = await request.json()
+    n_tokens = int(body.get("max_tokens") or state.max_tokens_default)
+    state.running += 1
+    try:
+        await asyncio.sleep(state.ttft + n_tokens / state.speed)
+        state.total_served += 1
+        return web.json_response({
+            "id": f"cmpl-{uuid.uuid4().hex[:16]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": body.get("model", state.model),
+            "choices": [{
+                "index": 0,
+                "text": " ".join(f"tok{i}" for i in range(n_tokens)),
+                "finish_reason": "length",
+            }],
+            "usage": {"prompt_tokens": 0, "completion_tokens": n_tokens,
+                      "total_tokens": n_tokens},
+        })
+    finally:
+        state.running -= 1
+
+
+async def models(request: web.Request) -> web.Response:
+    state: FakeEngineState = request.app["state"]
+    return web.json_response({
+        "object": "list",
+        "data": [{
+            "id": state.model, "object": "model",
+            "created": int(time.time()), "owned_by": "fake-engine",
+        }],
+    })
+
+
+async def health(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+async def metrics(request: web.Request) -> web.Response:
+    state: FakeEngineState = request.app["state"]
+    text = "\n".join([
+        "# TYPE vllm:num_requests_running gauge",
+        f"vllm:num_requests_running {float(state.running)}",
+        "# TYPE vllm:num_requests_waiting gauge",
+        f"vllm:num_requests_waiting {float(state.waiting)}",
+        "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
+        "vllm:gpu_prefix_cache_hit_rate 0.0",
+        "# TYPE vllm:gpu_cache_usage_perc gauge",
+        f"vllm:gpu_cache_usage_perc {min(1.0, state.running / 16)}",
+        "",
+    ])
+    return web.Response(text=text, content_type="text/plain")
+
+
+def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
+                      ttft: float = 0.02) -> web.Application:
+    app = web.Application()
+    app["state"] = FakeEngineState(model=model, speed=speed, ttft=ttft)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_get("/v1/models", models)
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="Fake OpenAI engine")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9001)
+    parser.add_argument("--model", default="fake/model")
+    parser.add_argument("--speed", type=float, default=100.0,
+                        help="tokens per second")
+    parser.add_argument("--ttft", type=float, default=0.02,
+                        help="seconds before first token")
+    args = parser.parse_args(argv)
+    app = build_fake_engine(args.model, args.speed, args.ttft)
+    web.run_app(app, host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

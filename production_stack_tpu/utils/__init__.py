@@ -1,0 +1,77 @@
+"""Shared utilities for the router and engine.
+
+Capability parity with reference src/vllm_router/utils.py (SingletonMeta L10,
+validate_url L42, set_ulimit L64, static list parsers L83-96), re-implemented.
+"""
+
+import abc
+import re
+import resource
+import threading
+from typing import Any, Dict, List, Optional
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+_URL_RE = re.compile(
+    r"^(https?)://"  # scheme
+    r"([a-zA-Z0-9.\-_]+|\[[0-9a-fA-F:]+\])"  # host or ipv6
+    r"(:\d{1,5})?"  # optional port
+    r"(/.*)?$"  # optional path
+)
+
+
+class SingletonMeta(type):
+    """Metaclass giving each class a single process-wide instance.
+
+    Thread-safe; tests may clear ``SingletonMeta._instances`` to reset state.
+    """
+
+    _instances: Dict[type, Any] = {}
+    _lock = threading.Lock()
+
+    def __call__(cls, *args, **kwargs):
+        with SingletonMeta._lock:
+            if cls not in SingletonMeta._instances:
+                SingletonMeta._instances[cls] = super().__call__(*args, **kwargs)
+        return SingletonMeta._instances[cls]
+
+
+class SingletonABCMeta(abc.ABCMeta, SingletonMeta):
+    """Singleton metaclass for abstract base classes."""
+
+
+def validate_url(url: str) -> bool:
+    """Return True iff *url* is a well-formed http(s) URL."""
+    return bool(_URL_RE.match(url))
+
+
+def parse_comma_separated_urls(arg: Optional[str]) -> List[str]:
+    """Parse ``--static-backends http://a:1,http://b:2`` style flags."""
+    if not arg:
+        return []
+    urls = [u.strip().rstrip("/") for u in arg.split(",") if u.strip()]
+    for url in urls:
+        if not validate_url(url):
+            raise ValueError(f"Invalid backend URL: {url!r}")
+    return urls
+
+
+def parse_comma_separated_values(arg: Optional[str]) -> List[str]:
+    """Parse comma-separated plain values (model names, labels, ...)."""
+    if not arg:
+        return []
+    return [v.strip() for v in arg.split(",") if v.strip()]
+
+
+def set_ulimit(target_soft: int = 65535) -> None:
+    """Raise RLIMIT_NOFILE soft limit so high-QPS proxying doesn't EMFILE."""
+    try:
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < target_soft:
+            resource.setrlimit(
+                resource.RLIMIT_NOFILE, (min(target_soft, hard), hard)
+            )
+    except (ValueError, OSError) as e:  # pragma: no cover - platform dependent
+        logger.warning("Could not raise ulimit -n: %s", e)

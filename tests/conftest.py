@@ -1,0 +1,47 @@
+"""Test harness configuration.
+
+- Forces JAX onto a virtual 8-device CPU mesh so every sharding/parallel
+  test runs without TPU hardware (the driver dry-runs the real multi-chip
+  path separately via __graft_entry__.dryrun_multichip).
+- Runs ``async def`` tests via asyncio.run (no pytest-asyncio in env).
+- Resets all process-wide singletons between tests.
+"""
+
+import asyncio
+import inspect
+import os
+import sys
+
+# Must happen before any jax import anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Execute coroutine test functions with asyncio.run."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture(autouse=True)
+def reset_singletons():
+    """Each test gets fresh router singletons."""
+    from production_stack_tpu.utils import SingletonMeta
+    SingletonMeta._instances.clear()
+    yield
+    SingletonMeta._instances.clear()

@@ -1,0 +1,72 @@
+"""Async FileStorage round-trip (test model: reference
+src/tests/test_file_storage.py)."""
+
+import pytest
+
+from production_stack_tpu.router.services.files import (
+    FileStorage,
+    initialize_storage,
+)
+
+
+@pytest.fixture
+def storage(tmp_path):
+    return FileStorage(str(tmp_path))
+
+
+async def test_save_and_get_roundtrip(storage):
+    file = await storage.save_file("alice", "data.jsonl", b"hello world")
+    assert file.bytes == 11
+    assert file.filename == "data.jsonl"
+
+    meta = await storage.get_file("alice", file.id)
+    assert meta.id == file.id
+    assert meta.bytes == 11
+
+    content = await storage.get_file_content("alice", file.id)
+    assert content == b"hello world"
+
+
+async def test_user_isolation(storage):
+    file = await storage.save_file("alice", "a.txt", b"secret")
+    with pytest.raises(FileNotFoundError):
+        await storage.get_file("bob", file.id)
+
+
+async def test_list_and_delete(storage):
+    f1 = await storage.save_file("u", "one.txt", b"1")
+    f2 = await storage.save_file("u", "two.txt", b"22")
+    files = await storage.list_files("u")
+    assert {f.id for f in files} == {f1.id, f2.id}
+
+    await storage.delete_file("u", f1.id)
+    files = await storage.list_files("u")
+    assert {f.id for f in files} == {f2.id}
+    with pytest.raises(FileNotFoundError):
+        await storage.get_file_content("u", f1.id)
+
+
+async def test_missing_file_raises(storage):
+    with pytest.raises(FileNotFoundError):
+        await storage.get_file("u", "file-nope")
+
+
+def test_initialize_storage_factory(tmp_path):
+    s = initialize_storage("local_file", str(tmp_path))
+    assert isinstance(s, FileStorage)
+    with pytest.raises(ValueError):
+        initialize_storage("s3", str(tmp_path))
+
+
+async def test_path_traversal_blocked(tmp_path):
+    storage = FileStorage(str(tmp_path / "base"))
+    file = await storage.save_file("..", "evil.txt", b"x")
+    # Content must land inside the base dir, not its parent.
+    import os
+    for root, _, files in os.walk(str(tmp_path / "base")):
+        if file.id in files:
+            break
+    else:
+        raise AssertionError("file not stored under base dir")
+    with pytest.raises(FileNotFoundError):
+        await storage.get_file_content("victim", "../../etc/passwd")

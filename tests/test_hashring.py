@@ -1,0 +1,77 @@
+"""Consistent-hash ring behavior (test model: reference
+src/tests/test_session_router.py minimal-remap assertions)."""
+
+from collections import Counter
+
+from production_stack_tpu.router.routing.hashring import ConsistentHashRing
+
+
+def test_empty_ring_returns_none():
+    ring = ConsistentHashRing()
+    assert ring.get_node("key") is None
+
+
+def test_single_node_gets_everything():
+    ring = ConsistentHashRing()
+    ring.add_node("http://a")
+    assert all(ring.get_node(f"k{i}") == "http://a" for i in range(50))
+
+
+def test_distribution_is_roughly_uniform():
+    ring = ConsistentHashRing()
+    nodes = [f"http://node{i}" for i in range(4)]
+    for n in nodes:
+        ring.add_node(n)
+    counts = Counter(ring.get_node(f"session-{i}") for i in range(4000))
+    for n in nodes:
+        assert 0.10 < counts[n] / 4000 < 0.45, counts
+
+
+def test_stickiness():
+    ring = ConsistentHashRing()
+    for n in ("http://a", "http://b", "http://c"):
+        ring.add_node(n)
+    first = {f"s{i}": ring.get_node(f"s{i}") for i in range(100)}
+    again = {f"s{i}": ring.get_node(f"s{i}") for i in range(100)}
+    assert first == again
+
+
+def test_minimal_remap_on_node_removal():
+    ring = ConsistentHashRing()
+    nodes = [f"http://node{i}" for i in range(4)]
+    for n in nodes:
+        ring.add_node(n)
+    keys = [f"session-{i}" for i in range(1000)]
+    before = {k: ring.get_node(k) for k in keys}
+    ring.remove_node(nodes[0])
+    after = {k: ring.get_node(k) for k in keys}
+    # Keys not on the removed node must not move.
+    for k in keys:
+        if before[k] != nodes[0]:
+            assert after[k] == before[k]
+        else:
+            assert after[k] != nodes[0]
+
+
+def test_minimal_remap_on_node_addition():
+    ring = ConsistentHashRing()
+    for i in range(3):
+        ring.add_node(f"http://node{i}")
+    keys = [f"session-{i}" for i in range(1000)]
+    before = {k: ring.get_node(k) for k in keys}
+    ring.add_node("http://node3")
+    after = {k: ring.get_node(k) for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    # Only keys remapping onto the new node may move (~1/4 of keys).
+    for k in keys:
+        if before[k] != after[k]:
+            assert after[k] == "http://node3"
+    assert moved < 500
+
+
+def test_sync_converges():
+    ring = ConsistentHashRing()
+    ring.sync(["http://a", "http://b"])
+    assert set(ring.get_nodes()) == {"http://a", "http://b"}
+    ring.sync(["http://b", "http://c"])
+    assert set(ring.get_nodes()) == {"http://b", "http://c"}

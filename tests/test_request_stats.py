@@ -1,0 +1,114 @@
+"""RequestStatsMonitor lifecycle accounting (test model: reference
+src/tests/test_singleton.py + request_stats semantics)."""
+
+import pytest
+
+from production_stack_tpu.router.stats.request_stats import (
+    BLOCK_SIZE,
+    DECODE_TO_PREFILL_RATIO,
+    TOTAL_NUMBER_OF_BLOCKS,
+    RequestStatsMonitor,
+    get_request_stats_monitor,
+    initialize_request_stats_monitor,
+)
+
+URL = "http://engine:8000"
+
+
+def make_monitor(window=60.0):
+    return initialize_request_stats_monitor(window)
+
+
+def test_singleton_semantics():
+    with pytest.raises(ValueError):
+        RequestStatsMonitor()  # not initialized yet
+    m1 = initialize_request_stats_monitor(10.0)
+    m2 = get_request_stats_monitor()
+    assert m1 is m2
+    # Second init with different args returns same instance.
+    assert initialize_request_stats_monitor(99.0) is m1
+    assert m1.window_s == 10.0
+
+
+def test_full_lifecycle_counts():
+    m = make_monitor()
+    t = 1000.0
+    m.on_request_arrival("r1", t)
+    m.on_request_routed(URL, "r1", prefill_tokens=64)
+    m.on_request_start(URL, "r1", t + 0.01)
+
+    stats = m.get_request_stats(t + 0.05)
+    assert stats[URL].in_prefill_requests == 1
+    assert stats[URL].in_decoding_requests == 0
+
+    # First token: prefill -> decode, TTFT recorded.
+    m.on_request_response(URL, "r1", t + 0.5, is_first_token=True)
+    stats = m.get_request_stats(t + 0.6)
+    assert stats[URL].in_prefill_requests == 0
+    assert stats[URL].in_decoding_requests == 1
+    assert abs(stats[URL].ttft - 0.5) < 1e-6
+
+    for i in range(4):
+        m.on_request_response(URL, "r1", t + 0.6 + i * 0.1,
+                              is_first_token=False)
+    m.on_request_complete(URL, "r1", t + 1.5)
+    stats = m.get_request_stats(t + 1.6)
+    assert stats[URL].in_decoding_requests == 0
+    assert stats[URL].finished_requests == 1
+    assert abs(stats[URL].avg_latency - 1.5) < 1e-6
+    assert abs(stats[URL].avg_decoding_length - 1.0) < 1e-6
+
+
+def test_block_accounting():
+    m = make_monitor()
+    t = 0.0
+    m.on_request_arrival("r1", t)
+    m.on_request_routed(URL, "r1", prefill_tokens=160)
+    # In prefill: reserved = ceil(160 * 1.25 / 16)
+    expected_reserved = -(-int(160 * (1 + DECODE_TO_PREFILL_RATIO))
+                          // BLOCK_SIZE)
+    assert m.estimate_pending_reserved_blocks(URL) == expected_reserved
+    assert m.estimate_allocated_blocks(URL) == 0
+
+    # Move to decode with 5 generated tokens: allocated =
+    # ceil((160 + 5)/16), reserved drops to 0.
+    m.on_request_response(URL, "r1", t + 1, is_first_token=True)
+    for i in range(4):
+        m.on_request_response(URL, "r1", t + 1.1, is_first_token=False)
+    assert m.estimate_pending_reserved_blocks(URL) == 0
+    assert m.estimate_allocated_blocks(URL) == -(-165 // BLOCK_SIZE)
+
+    stats = m.get_request_stats(t + 2)
+    assert stats[URL].num_free_blocks == (
+        TOTAL_NUMBER_OF_BLOCKS - stats[URL].allocated_blocks
+    )
+
+    m.on_request_complete(URL, "r1", t + 3)
+    assert m.estimate_allocated_blocks(URL) == 0
+
+
+def test_kill_cleans_up():
+    m = make_monitor()
+    m.on_request_arrival("r1", 0.0)
+    m.on_request_routed(URL, "r1", 32)
+    m.on_request_response(URL, "r1", 1.0, is_first_token=True)
+    m.on_request_kill(URL, "r1")
+    stats = m.get_request_stats(2.0)
+    assert stats[URL].in_prefill_requests == 0
+    assert stats[URL].in_decoding_requests == 0
+    assert m.estimate_allocated_blocks(URL) == 0
+    # A completion after the kill must not crash or double count.
+    m.on_request_complete(URL, "r1", 3.0)
+    assert m.get_request_stats(4.0)[URL].finished_requests == 0
+
+
+def test_qps_sliding_window():
+    m = make_monitor(window=10.0)
+    for i in range(20):
+        rid = f"r{i}"
+        m.on_request_arrival(rid, float(i))
+        m.on_request_routed(URL, rid, 16)
+        m.on_request_start(URL, rid, float(i))
+    # At t=20, only arrivals in (10, 20] remain: 10 requests over 10 s.
+    stats = m.get_request_stats(20.0)
+    assert abs(stats[URL].qps - 1.0) < 0.11

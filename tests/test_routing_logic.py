@@ -1,0 +1,223 @@
+"""Routing policy behavior with stub endpoints/stats (test model:
+reference src/tests/test_session_router.py stub-object pattern)."""
+
+import asyncio
+
+import pytest
+
+from production_stack_tpu.router.routing.logic import (
+    HeadRoomAdmissionPolicy,
+    LeastLoadedPolicy,
+    RoundRobinPolicy,
+    SessionPolicy,
+    WorkEstimatePolicy,
+    get_routing_logic,
+    initialize_routing_logic,
+    reconfigure_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.router.stats.request_stats import (
+    BLOCK_SIZE,
+    SAFETY_FRACTION,
+    TOTAL_NUMBER_OF_BLOCKS,
+    RequestStats,
+    initialize_request_stats_monitor,
+)
+
+EPS = [EndpointInfo(url=f"http://e{i}:8000") for i in range(3)]
+
+
+@pytest.fixture(autouse=True)
+def stats_monitor():
+    return initialize_request_stats_monitor(60.0)
+
+
+def test_round_robin_cycles_sorted():
+    policy = initialize_routing_logic("roundrobin")
+    urls = [
+        policy.route_request(EPS, {}, {}, {}, f"r{i}", 0) for i in range(6)
+    ]
+    expected = sorted(ep.url for ep in EPS)
+    assert urls == expected + expected
+
+
+def test_session_policy_sticky_and_fallback():
+    policy = initialize_routing_logic("session", session_key="x-user-id")
+    h = {"x-user-id": "alice"}
+    first = policy.route_request(EPS, {}, {}, h, "r1", 0)
+    for i in range(5):
+        assert policy.route_request(EPS, {}, {}, h, f"r{i+2}", 0) == first
+
+    # No session header: lowest QPS wins.
+    stats = {
+        "http://e0:8000": RequestStats(qps=5.0),
+        "http://e1:8000": RequestStats(qps=0.5),
+        "http://e2:8000": RequestStats(qps=2.0),
+    }
+    assert policy.route_request(EPS, {}, stats, {}, "r9", 0) == \
+        "http://e1:8000"
+
+
+def test_session_policy_requires_key():
+    with pytest.raises(ValueError):
+        initialize_routing_logic("session")
+
+
+def test_llq_picks_least_inflight():
+    policy = initialize_routing_logic("llq")
+    stats = {
+        "http://e0:8000": RequestStats(
+            in_prefill_requests=3, in_decoding_requests=4),
+        "http://e1:8000": RequestStats(
+            in_prefill_requests=0, in_decoding_requests=2),
+        "http://e2:8000": RequestStats(
+            in_prefill_requests=5, in_decoding_requests=0),
+    }
+    assert policy.route_request(EPS, {}, stats, {}, "r1", 0) == \
+        "http://e1:8000"
+
+
+def test_custom_work_estimate():
+    policy = initialize_routing_logic("custom")
+    stats = {
+        # 2 queued prefills * 2s + decode ages -> busy
+        "http://e0:8000": RequestStats(
+            avg_decoding_length=2.0,
+            ts_prefill_enqueue=[0.1, 0.2],
+            ts_decoding_enqueue=[3.0],
+        ),
+        # idle
+        "http://e1:8000": RequestStats(
+            avg_decoding_length=2.0,
+            ts_prefill_enqueue=[],
+            ts_decoding_enqueue=[],
+        ),
+    }
+    eps = EPS[:2]
+    assert policy.route_request(eps, {}, stats, {}, "r1", 0) == \
+        "http://e1:8000"
+
+
+async def _route_hra(policy, eps, rid, tokens):
+    result = policy.route_request(eps, {}, {}, {}, rid, tokens)
+    if hasattr(result, "__await__"):
+        return await asyncio.wait_for(result, timeout=2.0)
+    return result
+
+
+def test_hra_admits_when_capacity_available():
+    async def run():
+        policy = initialize_routing_logic("hra")
+        url = await _route_hra(policy, EPS[:1], "r1", 64)
+        assert url == EPS[0].url
+    asyncio.run(run())
+
+
+def test_hra_queues_oversized_then_admits_on_completion():
+    async def run():
+        monitor = initialize_request_stats_monitor(60.0)
+        policy = initialize_routing_logic("hra")
+        ep = EPS[:1]
+        # Fill the engine close to budget with one huge admitted request.
+        huge_tokens = int(
+            TOTAL_NUMBER_OF_BLOCKS * (1 - SAFETY_FRACTION) * BLOCK_SIZE
+            / 1.25
+        ) - BLOCK_SIZE
+        monitor.on_request_arrival("big", 0.0)
+        url = await _route_hra(policy, ep, "big", huge_tokens)
+        assert url == ep[0].url
+
+        # Second request cannot fit while 'big' holds reservations.
+        fut = policy.route_request(ep, {}, {}, {}, "small", 512)
+        assert hasattr(fut, "__await__")
+        await asyncio.sleep(0)
+        assert not fut.done()
+
+        # Completing 'big' releases blocks; 'small' gets admitted.
+        monitor.on_request_response(ep[0].url, "big", 1.0,
+                                    is_first_token=True)
+        monitor.on_request_complete(ep[0].url, "big", 2.0)
+        policy.on_request_complete(ep[0].url)
+        assert await asyncio.wait_for(fut, timeout=2.0) == ep[0].url
+    asyncio.run(run())
+
+
+def test_hra_sjf_ordering():
+    async def run():
+        monitor = initialize_request_stats_monitor(60.0)
+        policy = initialize_routing_logic("hra")
+        ep = EPS[:1]
+        huge_tokens = int(
+            TOTAL_NUMBER_OF_BLOCKS * (1 - SAFETY_FRACTION) * BLOCK_SIZE
+            / 1.25
+        ) - BLOCK_SIZE
+        monitor.on_request_arrival("big", 0.0)
+        await _route_hra(policy, ep, "big", huge_tokens)
+
+        admitted = []
+        futs = {}
+        for rid, tokens in (("long", 2048), ("short", 128)):
+            fut = policy.route_request(ep, {}, {}, {}, rid, tokens)
+            fut.add_done_callback(
+                lambda f, rid=rid: admitted.append(rid))
+            futs[rid] = fut
+        # Release capacity: shortest job should be admitted first.
+        monitor.on_request_response(ep[0].url, "big", 1.0,
+                                    is_first_token=True)
+        monitor.on_request_complete(ep[0].url, "big", 2.0)
+        policy.on_request_complete(ep[0].url)
+        await asyncio.gather(*futs.values())
+        await asyncio.sleep(0)  # flush done-callbacks
+        assert admitted[0] == "short"
+    asyncio.run(run())
+
+
+def test_initialize_and_get_and_reconfigure():
+    with pytest.raises(ValueError):
+        get_routing_logic()
+    p1 = initialize_routing_logic("roundrobin")
+    assert get_routing_logic() is p1
+    p2 = reconfigure_routing_logic("llq")
+    assert isinstance(p2, LeastLoadedPolicy)
+    assert get_routing_logic() is p2
+
+
+def test_hra_rejects_never_fitting_request():
+    async def run():
+        initialize_request_stats_monitor(60.0)
+        policy = initialize_routing_logic("hra")
+        impossible_tokens = TOTAL_NUMBER_OF_BLOCKS * BLOCK_SIZE * 2
+        fut = policy.route_request(EPS[:1], {}, {}, {}, "r1",
+                                   impossible_tokens)
+        with pytest.raises(Exception):
+            await asyncio.wait_for(fut, timeout=1.0)
+        # The queue must not be wedged for subsequent requests.
+        assert await _route_hra(policy, EPS[:1], "r2", 64) == EPS[0].url
+    asyncio.run(run())
+
+
+def test_hra_drops_cancelled_waiters_without_reserving():
+    async def run():
+        monitor = initialize_request_stats_monitor(60.0)
+        policy = initialize_routing_logic("hra")
+        ep = EPS[:1]
+        huge_tokens = int(
+            TOTAL_NUMBER_OF_BLOCKS * (1 - SAFETY_FRACTION) * BLOCK_SIZE
+            / 1.25
+        ) - BLOCK_SIZE
+        monitor.on_request_arrival("big", 0.0)
+        await _route_hra(policy, ep, "big", huge_tokens)
+
+        fut = policy.route_request(ep, {}, {}, {}, "ghost", 512)
+        fut.cancel()
+
+        monitor.on_request_response(ep[0].url, "big", 1.0,
+                                    is_first_token=True)
+        monitor.on_request_complete(ep[0].url, "big", 2.0)
+        policy.on_request_complete(ep[0].url)
+        # Ghost must not have reserved anything.
+        assert monitor.estimate_pending_reserved_blocks(ep[0].url) == 0
+        # And new traffic flows normally.
+        monitor.on_request_arrival("r3", 3.0)
+        assert await _route_hra(policy, ep, "r3", 64) == ep[0].url
+    asyncio.run(run())
