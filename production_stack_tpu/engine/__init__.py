@@ -1,0 +1,9 @@
+"""TPU-native serving engine.
+
+The piece the reference outsources to vLLM (SURVEY.md §7 step 3): a
+JAX/XLA engine with a paged KV cache, continuous batching under XLA's
+static-shape constraint (bucketed prefill + fixed-width decode batch),
+Pallas attention kernels, and an OpenAI-compatible HTTP front end whose
+``/metrics`` exposition matches the names the router scrapes
+(reference src/vllm_router/stats/engine_stats.py:46-55).
+"""

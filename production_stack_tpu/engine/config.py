@@ -1,0 +1,156 @@
+"""Engine configuration objects.
+
+These play the role of the ``vllm serve`` flags the reference's Helm chart
+renders (reference helm/templates/deployment-vllm-multi.yaml:57-103:
+--max-model-len, --dtype, --tensor-parallel-size, --enable-chunked-prefill,
+--enable-prefix-caching), re-expressed for a JAX engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+_DTYPE_MAP = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+}
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Architecture hyperparameters (HF-config compatible field names)."""
+
+    name: str = "tiny-llama"
+    architecture: str = "llama"  # llama | opt | gpt2 | mistral | qwen2
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_hidden_layers: int = 22
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 4
+    head_dim: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # OPT/GPT-2 specifics
+    do_layer_norm_before: bool = True
+    activation: str = "silu"  # silu (llama) | relu (opt) | gelu (gpt2)
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+    @property
+    def jax_dtype(self):
+        return _DTYPE_MAP[self.dtype]
+
+    @classmethod
+    def from_hf_config(cls, hf: dict, name: str = "") -> "ModelConfig":
+        """Build from a HuggingFace config.json dict."""
+        arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0].lower()
+        if "opt" in arch:
+            return cls(
+                name=name or hf.get("_name_or_path", "opt"),
+                architecture="opt",
+                vocab_size=hf["vocab_size"],
+                hidden_size=hf["hidden_size"],
+                intermediate_size=hf.get("ffn_dim", 4 * hf["hidden_size"]),
+                num_hidden_layers=hf["num_hidden_layers"],
+                num_attention_heads=hf["num_attention_heads"],
+                num_key_value_heads=hf["num_attention_heads"],
+                max_position_embeddings=hf["max_position_embeddings"],
+                tie_word_embeddings=hf.get("tie_word_embeddings", True),
+                do_layer_norm_before=hf.get("do_layer_norm_before", True),
+                activation="relu",
+                dtype="bfloat16",
+            )
+        return cls(
+            name=name or hf.get("_name_or_path", "llama"),
+            architecture="llama",
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get(
+                "num_key_value_heads", hf["num_attention_heads"]
+            ),
+            head_dim=hf.get("head_dim"),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            activation="silu",
+            dtype="bfloat16",
+        )
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    """Paged KV cache geometry."""
+
+    page_size: int = 16  # tokens per page
+    num_pages: int = 1024  # total pages in HBM (per shard)
+    enable_prefix_caching: bool = True
+
+    def max_tokens(self) -> int:
+        return self.page_size * self.num_pages
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    """Continuous-batching shape budget (all static under jit)."""
+
+    max_num_seqs: int = 8  # decode batch width (padded)
+    max_model_len: int = 2048
+    prefill_chunk_size: int = 512  # chunked prefill unit
+    max_queue_len: int = 1024
+
+    def max_pages_per_seq(self, page_size: int) -> int:
+        return math.ceil(self.max_model_len / page_size)
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """Device-mesh shape; tensor parallel maps to the 'tp' mesh axis over
+    ICI (reference passes --tensor-parallel-size to vLLM + /dev/shm for
+    NCCL, deployment-vllm-multi.yaml:84-87,226-233 — XLA needs neither)."""
+
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    scheduler: SchedulerConfig = dataclasses.field(
+        default_factory=SchedulerConfig)
+    parallel: ParallelConfig = dataclasses.field(
+        default_factory=ParallelConfig)
+    seed: int = 0
+
+
+def tiny_model_config(architecture: str = "llama") -> ModelConfig:
+    """A tiny model for tests/benchmarks that runs anywhere."""
+    return ModelConfig(
+        name=f"tiny-{architecture}",
+        architecture=architecture,
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2 if architecture == "llama" else 4,
+        max_position_embeddings=512,
+        activation={"llama": "silu", "opt": "relu",
+                    "gpt2": "gelu"}[architecture],
+        dtype="float32",
+    )

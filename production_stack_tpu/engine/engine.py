@@ -1,0 +1,167 @@
+"""LLMEngine: ties scheduler + cache manager + model runner together.
+
+Synchronous core (one ``step()`` = one compiled device program) with a
+``generate()`` convenience for tests/benchmarks; the HTTP server
+(engine/server.py) drives the same core from a background thread and
+streams per-token outputs through asyncio queues.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.kv_cache import PagedCacheManager
+from production_stack_tpu.engine.model_runner import ModelRunner
+from production_stack_tpu.engine.scheduler import Scheduler
+from production_stack_tpu.engine.sequence import (
+    SamplingParams,
+    Sequence,
+    SequenceState,
+)
+from production_stack_tpu.engine.tokenizer import (
+    BaseTokenizer,
+    get_tokenizer,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class StepOutput:
+    seq_id: str
+    new_token: Optional[int]
+    finished: bool
+    finish_reason: Optional[str]
+
+
+class LLMEngine:
+    def __init__(self, config: EngineConfig, mesh=None, params=None,
+                 tokenizer: Optional[BaseTokenizer] = None):
+        self.config = config
+        self.tokenizer = tokenizer or get_tokenizer(None)
+        self.cache_manager = PagedCacheManager(config.cache)
+        self.scheduler = Scheduler(
+            config.scheduler, config.cache, self.cache_manager
+        )
+        self.runner = ModelRunner(config, mesh=mesh, params=params)
+        self.sequences: Dict[str, Sequence] = {}
+        self._lock = threading.Lock()
+
+    # ---- request API ------------------------------------------------------
+
+    def add_request(self, prompt_token_ids: List[int],
+                    sampling: Optional[SamplingParams] = None,
+                    seq_id: Optional[str] = None,
+                    output_sink=None) -> str:
+        sampling = sampling or SamplingParams()
+        stop_ids = list(sampling.stop_token_ids)
+        if (not sampling.ignore_eos
+                and self.tokenizer.eos_token_id is not None
+                and self.tokenizer.eos_token_id not in stop_ids):
+            stop_ids.append(self.tokenizer.eos_token_id)
+        sampling.stop_token_ids = stop_ids
+        seq = Sequence(
+            seq_id=seq_id or f"seq-{uuid.uuid4().hex[:16]}",
+            prompt_token_ids=list(prompt_token_ids),
+            sampling=sampling,
+            output_sink=output_sink,
+        )
+        with self._lock:
+            self.sequences[seq.seq_id] = seq
+            self.scheduler.add_sequence(seq)
+        return seq.seq_id
+
+    def abort_request(self, seq_id: str) -> None:
+        with self._lock:
+            seq = self.sequences.get(seq_id)
+            if seq is not None:
+                self.scheduler.abort_sequence(seq)
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    # ---- engine step ------------------------------------------------------
+
+    def step(self) -> List[StepOutput]:
+        """Plan + execute one device program; returns per-seq deltas."""
+        with self._lock:
+            plan = self.scheduler.plan_step()
+        if plan.empty:
+            return []
+        outputs: List[StepOutput] = []
+        if plan.prefill is not None:
+            sampled = self.runner.run_prefill(plan.prefill)
+            with self._lock:
+                self.scheduler.on_prefill_executed(plan.prefill, sampled)
+                seq = plan.prefill.seq
+                if plan.prefill.is_last_chunk:
+                    outputs.append(self._delta(seq, sampled))
+        else:
+            tokens = self.runner.run_decode(plan.decode)
+            with self._lock:
+                self.scheduler.on_decode_executed(plan.decode, tokens)
+                for seq, tok in zip(plan.decode.seqs, tokens):
+                    outputs.append(self._delta(seq, tok))
+        for out in outputs:
+            if out.finished:
+                self.sequences.pop(out.seq_id, None)
+        return outputs
+
+    def _delta(self, seq: Sequence, token: Optional[int]) -> StepOutput:
+        finished = seq.state in (
+            SequenceState.FINISHED, SequenceState.ABORTED
+        )
+        return StepOutput(
+            seq_id=seq.seq_id,
+            new_token=token,
+            finished=finished,
+            finish_reason=(seq.finish_reason.value
+                           if seq.finish_reason else None),
+        )
+
+    # ---- metrics ----------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "num_requests_running": self.scheduler.num_running,
+            "num_requests_waiting": self.scheduler.num_waiting,
+            "gpu_cache_usage_perc": self.cache_manager.usage_perc(),
+            "gpu_prefix_cache_hit_rate":
+                self.cache_manager.prefix_hit_rate(),
+        }
+
+    # ---- convenience ------------------------------------------------------
+
+    def generate(self, prompt_token_ids: List[int],
+                 sampling: Optional[SamplingParams] = None,
+                 ) -> Sequence:
+        """Blocking single-prompt generation (tests/benchmarks)."""
+        seq_id = self.add_request(prompt_token_ids, sampling)
+        seq = self.sequences[seq_id]
+        while seq.state not in (SequenceState.FINISHED,
+                                SequenceState.ABORTED):
+            if not self.step():
+                time.sleep(0)
+        return seq
+
+    def generate_batch(self, prompts: List[List[int]],
+                       sampling: Optional[SamplingParams] = None,
+                       ) -> List[Sequence]:
+        seqs = []
+        for p in prompts:
+            sp = (SamplingParams(**vars(sampling))
+                  if sampling else SamplingParams())
+            seq_id = self.add_request(p, sp)
+            seqs.append(self.sequences[seq_id])
+        while any(s.state not in (SequenceState.FINISHED,
+                                  SequenceState.ABORTED) for s in seqs):
+            self.step()
+        return seqs

@@ -1,0 +1,199 @@
+"""Model runner: owns device state and the compiled step functions.
+
+Compilation strategy (SURVEY.md §7 hard part (a)): prefill chunks are
+padded to power-of-two buckets and decode runs at a fixed slot width, so
+the engine touches a small closed set of shapes; each shape jit-compiles
+once and is cached by XLA thereafter. KV caches are donated through
+every step so the arrays are updated in place in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.scheduler import DecodePlan, PrefillPlan
+from production_stack_tpu.engine.sequence import Sequence
+from production_stack_tpu.models.registry import get_model
+from production_stack_tpu.ops.sampling import sample_tokens
+from production_stack_tpu.parallel.mesh import (
+    shard_cache,
+    shard_params,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+def prefill_buckets(chunk_size: int) -> List[int]:
+    buckets, b = [], 16
+    while b < chunk_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(chunk_size)
+    return buckets
+
+
+class ModelRunner:
+    def __init__(self, config: EngineConfig, mesh=None,
+                 params=None):
+        self.config = config
+        self.mesh = mesh
+        model_config = config.model
+        self._init_fn, self._forward = get_model(model_config)
+
+        if params is None:
+            logger.info("Initializing random weights for %s",
+                        model_config.name)
+            params = self._init_fn(
+                model_config, jax.random.PRNGKey(config.seed)
+            )
+        self.params = shard_params(params, model_config, mesh)
+
+        cache_shape = (
+            model_config.num_hidden_layers,
+            config.cache.num_pages,
+            config.cache.page_size,
+            model_config.num_key_value_heads,
+            model_config.head_dim,
+        )
+        dtype = model_config.jax_dtype
+        self.k_cache = shard_cache(jnp.zeros(cache_shape, dtype), mesh)
+        self.v_cache = shard_cache(jnp.zeros(cache_shape, dtype), mesh)
+
+        self.max_pages_per_seq = config.scheduler.max_pages_per_seq(
+            config.cache.page_size
+        )
+        self.decode_width = config.scheduler.max_num_seqs
+        self._buckets = prefill_buckets(
+            config.scheduler.prefill_chunk_size
+        )
+        self._rng = jax.random.PRNGKey(config.seed + 1)
+
+        self._step_jit = jax.jit(
+            self._step_impl,
+            static_argnames=("sample_index_mode",),
+            donate_argnums=(1, 2),  # k_cache, v_cache
+        )
+
+    # ---- compiled step ----------------------------------------------------
+
+    def _step_impl(self, params, k_cache, v_cache, tokens, positions,
+                   page_table, kv_lens, valid, last_index, temperature,
+                   top_p, top_k, rng, sample_index_mode: str):
+        logits, k_cache, v_cache = self._forward(
+            params, self.config.model, tokens, positions, page_table,
+            kv_lens, valid, k_cache, v_cache,
+        )
+        if sample_index_mode == "last":
+            # Prefill: sample only from the final prompt position.
+            row_logits = logits[jnp.arange(tokens.shape[0]), last_index]
+        else:
+            # Decode: T == 1.
+            row_logits = logits[:, 0, :]
+        sampled = sample_tokens(row_logits, temperature, top_p, top_k, rng)
+        return sampled, k_cache, v_cache
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._buckets[-1]
+
+    # ---- prefill ----------------------------------------------------------
+
+    def run_prefill(self, plan: PrefillPlan) -> Optional[int]:
+        """Execute one prefill chunk; returns sampled token on last chunk."""
+        seq = plan.seq
+        n = len(plan.chunk_tokens)
+        t = self._bucket_for(n)
+
+        tokens = np.zeros((1, t), np.int32)
+        tokens[0, :n] = plan.chunk_tokens
+        positions = np.zeros((1, t), np.int32)
+        positions[0, :n] = np.arange(
+            plan.chunk_start, plan.chunk_start + n
+        )
+        valid = np.zeros((1, t), bool)
+        valid[0, :n] = True
+        page_table = self._page_table_rows([seq])
+        kv_lens = np.asarray([plan.chunk_start + n], np.int32)
+        last_index = np.asarray([n - 1], np.int32)
+
+        sp = seq.sampling
+        temperature = np.asarray([sp.temperature], np.float32)
+        top_p = np.asarray([sp.top_p], np.float32)
+        top_k = np.asarray([sp.top_k], np.int32)
+
+        sampled, self.k_cache, self.v_cache = self._step_jit(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(page_table), jnp.asarray(kv_lens),
+            jnp.asarray(valid), jnp.asarray(last_index),
+            jnp.asarray(temperature), jnp.asarray(top_p),
+            jnp.asarray(top_k), self._next_rng(),
+            sample_index_mode="last",
+        )
+        if plan.is_last_chunk:
+            return int(jax.device_get(sampled)[0])
+        return None
+
+    # ---- decode -----------------------------------------------------------
+
+    def run_decode(self, plan: DecodePlan) -> List[int]:
+        """One decode step over all running sequences (padded batch)."""
+        seqs = plan.seqs[: self.decode_width]
+        b = self.decode_width
+
+        tokens = np.zeros((b, 1), np.int32)
+        positions = np.zeros((b, 1), np.int32)
+        valid = np.zeros((b, 1), bool)
+        kv_lens = np.zeros((b,), np.int32)
+        temperature = np.ones((b,), np.float32)
+        top_p = np.ones((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
+
+        for i, seq in enumerate(seqs):
+            last_token = (seq.output_token_ids[-1]
+                          if seq.output_token_ids
+                          else seq.prompt_token_ids[-1])
+            tokens[i, 0] = last_token
+            positions[i, 0] = seq.total_len - 1
+            valid[i, 0] = True
+            kv_lens[i] = seq.total_len
+            temperature[i] = seq.sampling.temperature
+            top_p[i] = seq.sampling.top_p
+            top_k[i] = seq.sampling.top_k
+
+        page_table = self._page_table_rows(seqs, pad_to=b)
+        last_index = np.zeros((b,), np.int32)
+
+        sampled, self.k_cache, self.v_cache = self._step_jit(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(page_table), jnp.asarray(kv_lens),
+            jnp.asarray(valid), jnp.asarray(last_index),
+            jnp.asarray(temperature), jnp.asarray(top_p),
+            jnp.asarray(top_k), self._next_rng(),
+            sample_index_mode="first",
+        )
+        host = jax.device_get(sampled)
+        return [int(host[i]) for i in range(len(seqs))]
+
+    def _page_table_rows(self, seqs: List[Sequence],
+                         pad_to: Optional[int] = None) -> np.ndarray:
+        rows = pad_to or len(seqs)
+        table = np.zeros((rows, self.max_pages_per_seq), np.int32)
+        for i, seq in enumerate(seqs):
+            n = min(len(seq.pages), self.max_pages_per_seq)
+            table[i, :n] = seq.pages[:n]
+        return table

@@ -1,0 +1,258 @@
+"""Continuous-batching scheduler.
+
+The TPU twist (SURVEY.md §7 "hard parts" (a)): vLLM's scheduler emits
+dynamically-shaped batches because CUDA kernels launch per step; under
+XLA every shape is a compiled program, so this scheduler plans work in
+*fixed* shapes — prefill chunks padded to buckets, decode as a constant-
+width slot batch — and the runner caches one executable per shape.
+
+A step is either one prefill chunk (chunked prefill, reference flag
+--enable-chunked-prefill, deployment-vllm-multi.yaml:69-71) or one
+decode batch over all running sequences; the two alternate when both
+have work so neither starves.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.kv_cache import (
+    OutOfPagesError,
+    PagedCacheManager,
+)
+from production_stack_tpu.engine.sequence import (
+    FinishReason,
+    Sequence,
+    SequenceState,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class PrefillPlan:
+    seq: Sequence
+    chunk_start: int  # absolute position of first token in chunk
+    chunk_tokens: List[int]
+    is_last_chunk: bool
+
+
+@dataclass
+class DecodePlan:
+    seqs: List[Sequence]
+
+
+@dataclass
+class StepPlan:
+    prefill: Optional[PrefillPlan] = None
+    decode: Optional[DecodePlan] = None
+
+    @property
+    def empty(self) -> bool:
+        return self.prefill is None and self.decode is None
+
+
+class Scheduler:
+    def __init__(self, config: SchedulerConfig, cache_config: CacheConfig,
+                 cache_manager: PagedCacheManager):
+        self.config = config
+        self.page_size = cache_config.page_size
+        self.cache = cache_manager
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []
+        self._last_was_prefill = False
+
+    # ---- queue management -------------------------------------------------
+
+    def add_sequence(self, seq: Sequence) -> None:
+        if len(self.waiting) >= self.config.max_queue_len:
+            seq.state = SequenceState.ABORTED
+            seq.finish_reason = FinishReason.ABORT
+            raise RuntimeError("Scheduler queue full")
+        if seq.num_prompt_tokens + seq.sampling.max_tokens > \
+                self.config.max_model_len:
+            # Clamp generation to fit the model length budget.
+            seq.sampling.max_tokens = max(
+                1, self.config.max_model_len - seq.num_prompt_tokens
+            )
+        self.waiting.append(seq)
+
+    def abort_sequence(self, seq: Sequence) -> None:
+        self._finish(seq, FinishReason.ABORT)
+        if seq in self.running:
+            self.running.remove(seq)
+        try:
+            self.waiting.remove(seq)
+        except ValueError:
+            pass
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ---- planning ---------------------------------------------------------
+
+    def plan_step(self) -> StepPlan:
+        want_prefill = bool(
+            self.waiting and len(self.running) < self.config.max_num_seqs
+        )
+        want_decode = bool(self.running)
+        if want_prefill and want_decode:
+            # Alternate so neither side starves.
+            do_prefill = not self._last_was_prefill
+        else:
+            do_prefill = want_prefill
+        if do_prefill:
+            plan = self._plan_prefill()
+            if plan is not None:
+                self._last_was_prefill = True
+                return StepPlan(prefill=plan)
+            want_decode = bool(self.running)
+        if want_decode:
+            self._last_was_prefill = False
+            self._ensure_decode_capacity()
+            if self.running:
+                return StepPlan(decode=DecodePlan(seqs=list(self.running)))
+        return StepPlan()
+
+    def _plan_prefill(self) -> Optional[PrefillPlan]:
+        while self.waiting:
+            seq = self.waiting[0]
+            if seq.state == SequenceState.ABORTED:
+                self.waiting.popleft()
+                continue
+            if seq.num_computed_tokens == 0 and not seq.pages:
+                # First touch: reuse cached prefix pages, then allocate
+                # the remainder for the whole prompt up front.
+                matched = self.cache.match_prefix(seq.prompt_token_ids)
+                seq.pages = matched
+                seq.num_hashed_pages = len(matched)
+                seq.num_computed_tokens = len(matched) * self.page_size
+                needed = self._pages_needed(seq, seq.num_prompt_tokens)
+                try:
+                    seq.pages.extend(self.cache.allocate_pages(needed))
+                except OutOfPagesError:
+                    self.cache.free_sequence(seq.pages)
+                    seq.pages = []
+                    seq.num_computed_tokens = 0
+                    logger.warning(
+                        "KV cache full: request %s waits", seq.seq_id
+                    )
+                    return None
+            start = seq.num_computed_tokens
+            end = min(start + self.config.prefill_chunk_size,
+                      seq.num_prompt_tokens)
+            return PrefillPlan(
+                seq=seq,
+                chunk_start=start,
+                chunk_tokens=seq.prompt_token_ids[start:end],
+                is_last_chunk=(end == seq.num_prompt_tokens),
+            )
+        return None
+
+    def _pages_needed(self, seq: Sequence, target_tokens: int) -> int:
+        have = len(seq.pages) * self.page_size
+        if target_tokens <= have:
+            return 0
+        return -(-(target_tokens - have) // self.page_size)
+
+    def _ensure_decode_capacity(self) -> None:
+        """Every running sequence needs a page slot for its next token."""
+        for seq in list(self.running):
+            needed = self._pages_needed(seq, seq.total_len + 1)
+            if needed == 0:
+                continue
+            try:
+                seq.pages.extend(self.cache.allocate_pages(needed))
+            except OutOfPagesError:
+                # Preempt: drop the newest sequence back to waiting,
+                # recomputing later (simple, correct v1 policy).
+                victim = self.running[-1]
+                self._preempt(victim)
+                if victim is seq:
+                    continue
+                try:
+                    seq.pages.extend(self.cache.allocate_pages(needed))
+                except OutOfPagesError:
+                    self._preempt(seq)
+
+    def _preempt(self, seq: Sequence) -> None:
+        logger.warning("Preempting %s (KV cache pressure)", seq.seq_id)
+        self.running.remove(seq)
+        self.cache.free_sequence(seq.pages)
+        seq.pages = []
+        seq.num_hashed_pages = 0
+        # Recompute everything including generated tokens as "prompt".
+        seq.prompt_token_ids = seq.all_token_ids
+        seq.output_token_ids = []
+        seq.num_computed_tokens = 0
+        seq.state = SequenceState.WAITING
+        self.waiting.appendleft(seq)
+
+    # ---- completion callbacks (driven by the engine) ----------------------
+
+    def on_prefill_executed(self, plan: PrefillPlan,
+                            sampled_token: Optional[int]) -> None:
+        seq = plan.seq
+        seq.num_computed_tokens = plan.chunk_start + len(plan.chunk_tokens)
+        self.cache.commit_full_pages(
+            seq.prompt_token_ids[:seq.num_computed_tokens],
+            seq.pages, seq.num_hashed_pages,
+        )
+        seq.num_hashed_pages = min(
+            len(seq.pages),
+            seq.num_computed_tokens // self.page_size,
+        )
+        if plan.is_last_chunk:
+            assert sampled_token is not None
+            self.waiting.popleft()
+            seq.state = SequenceState.RUNNING
+            seq.first_token_time = time.time()
+            self.running.append(seq)
+            self._append_token(seq, sampled_token)
+
+    def on_decode_executed(self, plan: DecodePlan,
+                           sampled_tokens: List[int]) -> None:
+        for seq, token in zip(plan.seqs, sampled_tokens):
+            if seq.state != SequenceState.RUNNING:
+                continue  # aborted mid-step
+            self._append_token(seq, token)
+
+    def _append_token(self, seq: Sequence, token: int) -> None:
+        seq.output_token_ids.append(token)
+        stop_ids = seq.sampling.stop_token_ids
+        if not seq.sampling.ignore_eos and token in stop_ids:
+            self._finish(seq, FinishReason.STOP)
+            self.running.remove(seq)
+        elif len(seq.output_token_ids) >= seq.sampling.max_tokens:
+            self._finish(seq, FinishReason.LENGTH)
+            self.running.remove(seq)
+        elif seq.total_len >= self.config.max_model_len:
+            self._finish(seq, FinishReason.LENGTH)
+            self.running.remove(seq)
+
+    def _finish(self, seq: Sequence, reason: FinishReason) -> None:
+        if seq.state in (SequenceState.FINISHED, SequenceState.ABORTED):
+            return
+        seq.state = (SequenceState.ABORTED if reason == FinishReason.ABORT
+                     else SequenceState.FINISHED)
+        seq.finish_reason = reason
+        seq.finish_time = time.time()
+        if seq.pages:
+            self.cache.free_sequence(seq.pages)
+            seq.pages = []

@@ -1,0 +1,75 @@
+"""Request/sequence state for the continuous-batching engine."""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+
+@dataclass
+class SamplingParams:
+    max_tokens: int = 128
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    stop_token_ids: List[int] = field(default_factory=list)
+    ignore_eos: bool = False
+    seed: Optional[int] = None
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+class SequenceState(enum.Enum):
+    WAITING = "waiting"  # queued, prompt not (fully) prefilled
+    RUNNING = "running"  # decoding
+    FINISHED = "finished"
+    ABORTED = "aborted"
+
+
+class FinishReason(str, enum.Enum):
+    STOP = "stop"
+    LENGTH = "length"
+    ABORT = "abort"
+
+
+@dataclass
+class Sequence:
+    seq_id: str
+    prompt_token_ids: List[int]
+    sampling: SamplingParams
+    arrival_time: float = field(default_factory=time.time)
+
+    state: SequenceState = SequenceState.WAITING
+    output_token_ids: List[int] = field(default_factory=list)
+    # How many prompt tokens have been prefilled (incl. prefix-cache hits).
+    num_computed_tokens: int = 0
+    pages: List[int] = field(default_factory=list)
+    num_hashed_pages: int = 0
+    finish_reason: Optional[FinishReason] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    # Server-side stream hook (asyncio queue or callable), opaque here.
+    output_sink: Any = None
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def total_len(self) -> int:
+        return self.num_prompt_tokens + len(self.output_token_ids)
+
+    @property
+    def all_token_ids(self) -> List[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.num_computed_tokens >= self.num_prompt_tokens
+
+    def remaining_prompt(self) -> int:
+        return self.num_prompt_tokens - self.num_computed_tokens

@@ -1,0 +1,92 @@
+"""Tokenizer abstraction.
+
+HF tokenizers load from a local path (this environment has no network
+egress; in production the Helm chart mounts the model PVC, reference
+deployment-vllm-multi.yaml:110-115 HF_HOME). The ByteTokenizer is a
+dependency-free fallback used by tests/benchmarks with tiny models.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class BaseTokenizer:
+    eos_token_id: int
+
+    def encode(self, text: str) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, token_ids: List[int]) -> str:
+        raise NotImplementedError
+
+    @property
+    def vocab_size(self) -> int:
+        raise NotImplementedError
+
+
+class ByteTokenizer(BaseTokenizer):
+    """UTF-8 bytes + <bos>=256, <eos>=257. Vocab 512 (room for specials)."""
+
+    BOS = 256
+    EOS = 257
+
+    def __init__(self):
+        self.eos_token_id = self.EOS
+
+    def encode(self, text: str) -> List[int]:
+        return [self.BOS] + list(text.encode("utf-8"))
+
+    def decode(self, token_ids: List[int]) -> str:
+        data = bytes(t for t in token_ids if 0 <= t < 256)
+        return data.decode("utf-8", errors="replace")
+
+    @property
+    def vocab_size(self) -> int:
+        return 512
+
+
+class HFTokenizer(BaseTokenizer):
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+        self._tok = AutoTokenizer.from_pretrained(path)
+        self.eos_token_id = self._tok.eos_token_id
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, token_ids: List[int]) -> str:
+        return self._tok.decode(token_ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages) -> Optional[List[int]]:
+        try:
+            return self._tok.apply_chat_template(
+                messages, add_generation_prompt=True
+            )
+        except Exception:
+            return None
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+
+def get_tokenizer(spec: Optional[str]) -> BaseTokenizer:
+    """spec: None/'byte' -> ByteTokenizer; otherwise a local HF path."""
+    if spec in (None, "byte"):
+        return ByteTokenizer()
+    return HFTokenizer(spec)
+
+
+def render_chat_prompt(tokenizer: BaseTokenizer, messages) -> List[int]:
+    """Messages -> prompt token ids, via the model's chat template when
+    available, else a simple role-tagged rendering."""
+    if isinstance(tokenizer, HFTokenizer):
+        ids = tokenizer.apply_chat_template(messages)
+        if ids is not None:
+            return ids
+    text = "".join(
+        f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}\n"
+        for m in messages
+    ) + "<|assistant|>\n"
+    return tokenizer.encode(text)

@@ -1,0 +1,150 @@
+"""HF checkpoint loading: safetensors/torch-bin -> stacked JAX pytrees.
+
+Weight names follow the HF conventions for Llama
+(model.layers.N.self_attn.q_proj.weight, ...) and OPT
+(model.decoder.layers.N....). Per-layer tensors are stacked along a
+leading L axis to match the scanned-layer model layout
+(models/llama.py). Linear weights are transposed: HF stores [out, in],
+our matmuls use [in, out].
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+def _load_raw_tensors(model_dir: str) -> Dict[str, np.ndarray]:
+    tensors: Dict[str, np.ndarray] = {}
+    st_files = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors.numpy import load_file
+        for f in st_files:
+            tensors.update(load_file(os.path.join(model_dir, f)))
+        return tensors
+    bin_files = sorted(
+        f for f in os.listdir(model_dir)
+        if f.endswith(".bin") and f.startswith("pytorch_model")
+    )
+    if bin_files:
+        import torch
+        for f in bin_files:
+            state = torch.load(
+                os.path.join(model_dir, f), map_location="cpu",
+                weights_only=True,
+            )
+            for k, v in state.items():
+                tensors[k] = v.float().numpy()
+        return tensors
+    raise FileNotFoundError(
+        f"No safetensors/pytorch_model.bin found in {model_dir}"
+    )
+
+
+def load_model_config(model_dir: str,
+                      name: str = "") -> ModelConfig:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf = json.load(f)
+    return ModelConfig.from_hf_config(hf, name=name or model_dir)
+
+
+def _stack(tensors: Dict[str, np.ndarray], template: str, layers: int,
+           transpose: bool = False) -> np.ndarray:
+    parts = []
+    for i in range(layers):
+        t = tensors[template.format(i)]
+        parts.append(t.T if transpose else t)
+    return np.stack(parts)
+
+
+def load_llama_weights(model_dir: str, config: ModelConfig,
+                       dtype=None) -> Dict[str, jnp.ndarray]:
+    raw = _load_raw_tensors(model_dir)
+    raw = {k.removeprefix("model."): v for k, v in raw.items()}
+    L = config.num_hidden_layers
+    dtype = dtype or config.jax_dtype
+
+    def lt(template, transpose=True):
+        return jnp.asarray(
+            _stack(raw, template, L, transpose=transpose), dtype
+        )
+
+    params = {
+        "embed": jnp.asarray(raw["embed_tokens.weight"], dtype),
+        "final_norm": jnp.asarray(raw["norm.weight"], dtype),
+        "attn_norm": lt("layers.{}.input_layernorm.weight",
+                        transpose=False),
+        "wq": lt("layers.{}.self_attn.q_proj.weight"),
+        "wk": lt("layers.{}.self_attn.k_proj.weight"),
+        "wv": lt("layers.{}.self_attn.v_proj.weight"),
+        "wo": lt("layers.{}.self_attn.o_proj.weight"),
+        "mlp_norm": lt("layers.{}.post_attention_layernorm.weight",
+                       transpose=False),
+        "w_gate": lt("layers.{}.mlp.gate_proj.weight"),
+        "w_up": lt("layers.{}.mlp.up_proj.weight"),
+        "w_down": lt("layers.{}.mlp.down_proj.weight"),
+    }
+    if not config.tie_word_embeddings:
+        head = raw.get("lm_head.weight")
+        if head is None:
+            config.tie_word_embeddings = True
+        else:
+            params["lm_head"] = jnp.asarray(head.T, dtype)
+    return params
+
+
+def load_opt_weights(model_dir: str, config: ModelConfig,
+                     dtype=None) -> Dict[str, jnp.ndarray]:
+    raw = _load_raw_tensors(model_dir)
+    raw = {
+        k.removeprefix("model.").removeprefix("decoder."): v
+        for k, v in raw.items()
+    }
+    L = config.num_hidden_layers
+    dtype = dtype or config.jax_dtype
+
+    def lt(template, transpose=True):
+        return jnp.asarray(
+            _stack(raw, template, L, transpose=transpose), dtype
+        )
+
+    return {
+        "embed": jnp.asarray(raw["embed_tokens.weight"], dtype),
+        "pos_embed": jnp.asarray(raw["embed_positions.weight"], dtype),
+        "final_norm_w": jnp.asarray(raw["final_layer_norm.weight"], dtype),
+        "final_norm_b": jnp.asarray(raw["final_layer_norm.bias"], dtype),
+        "attn_norm_w": lt("layers.{}.self_attn_layer_norm.weight", False),
+        "attn_norm_b": lt("layers.{}.self_attn_layer_norm.bias", False),
+        "wq": lt("layers.{}.self_attn.q_proj.weight"),
+        "bq": lt("layers.{}.self_attn.q_proj.bias", False),
+        "wk": lt("layers.{}.self_attn.k_proj.weight"),
+        "bk": lt("layers.{}.self_attn.k_proj.bias", False),
+        "wv": lt("layers.{}.self_attn.v_proj.weight"),
+        "bv": lt("layers.{}.self_attn.v_proj.bias", False),
+        "wo": lt("layers.{}.self_attn.out_proj.weight"),
+        "bo": lt("layers.{}.self_attn.out_proj.bias", False),
+        "mlp_norm_w": lt("layers.{}.final_layer_norm.weight", False),
+        "mlp_norm_b": lt("layers.{}.final_layer_norm.bias", False),
+        "fc1": lt("layers.{}.fc1.weight"),
+        "fc1_b": lt("layers.{}.fc1.bias", False),
+        "fc2": lt("layers.{}.fc2.weight"),
+        "fc2_b": lt("layers.{}.fc2.bias", False),
+    }
+
+
+def load_weights(model_dir: str, config: ModelConfig,
+                 dtype=None) -> Dict[str, jnp.ndarray]:
+    if config.architecture == "opt":
+        return load_opt_weights(model_dir, config, dtype)
+    return load_llama_weights(model_dir, config, dtype)

@@ -1,0 +1,14 @@
+"""JAX model definitions.
+
+Pure-functional models: parameters are pytrees of jnp arrays with the
+layer dimension stacked so the transformer body is a single
+``lax.scan`` — one layer gets traced/compiled regardless of depth, and
+tensor-parallel sharding annotations apply uniformly across layers.
+"""
+
+from production_stack_tpu.models.registry import (
+    get_model,
+    list_architectures,
+)
+
+__all__ = ["get_model", "list_architectures"]

@@ -1,0 +1,119 @@
+"""OPT-family model (facebook/opt-*; the reference's minimal-install
+model is opt-125m, tutorials/assets/values-01-minimal-example.yaml).
+
+Differences from Llama handled here: learned positional embeddings with
+HF's +2 offset, biased projections, LayerNorm (not RMSNorm), ReLU MLP,
+tied LM head. Same scanned-layer + paged-cache structure as
+models/llama.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.ops.attention import (
+    paged_attention,
+    write_to_pages,
+)
+
+Params = Dict[str, jnp.ndarray]
+
+# HF OPT reserves the first two positional-embedding rows.
+_POS_OFFSET = 2
+
+
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray,
+               bias: jnp.ndarray) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+    return (normed * weight + bias).astype(x.dtype)
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> Params:
+    h = config.hidden_size
+    ffn = config.intermediate_size
+    nh, d = config.num_attention_heads, config.head_dim
+    layers = config.num_hidden_layers
+    dtype = config.jax_dtype
+
+    def dense(key, shape, scale=0.02):
+        return (scale * jax.random.normal(key, shape, jnp.float32)
+                ).astype(dtype)
+
+    keys = iter(jax.random.split(key, 16))
+    return {
+        "embed": dense(next(keys), (config.vocab_size, h)),
+        "pos_embed": dense(
+            next(keys),
+            (config.max_position_embeddings + _POS_OFFSET, h)),
+        "final_norm_w": jnp.ones((h,), dtype),
+        "final_norm_b": jnp.zeros((h,), dtype),
+        "attn_norm_w": jnp.ones((layers, h), dtype),
+        "attn_norm_b": jnp.zeros((layers, h), dtype),
+        "wq": dense(next(keys), (layers, h, nh * d)),
+        "bq": jnp.zeros((layers, nh * d), dtype),
+        "wk": dense(next(keys), (layers, h, nh * d)),
+        "bk": jnp.zeros((layers, nh * d), dtype),
+        "wv": dense(next(keys), (layers, h, nh * d)),
+        "bv": jnp.zeros((layers, nh * d), dtype),
+        "wo": dense(next(keys), (layers, nh * d, h)),
+        "bo": jnp.zeros((layers, h), dtype),
+        "mlp_norm_w": jnp.ones((layers, h), dtype),
+        "mlp_norm_b": jnp.zeros((layers, h), dtype),
+        "fc1": dense(next(keys), (layers, h, ffn)),
+        "fc1_b": jnp.zeros((layers, ffn), dtype),
+        "fc2": dense(next(keys), (layers, ffn, h)),
+        "fc2_b": jnp.zeros((layers, h), dtype),
+    }
+
+
+def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, page_table: jnp.ndarray,
+            kv_lens: jnp.ndarray, valid: jnp.ndarray,
+            k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Same contract as models.llama.forward."""
+    nh, d = config.num_attention_heads, config.head_dim
+    b, t = tokens.shape
+
+    x = params["embed"][tokens]
+    x = x + params["pos_embed"][positions + _POS_OFFSET]
+
+    layer_params = {
+        k: params[k] for k in (
+            "attn_norm_w", "attn_norm_b", "wq", "bq", "wk", "bk",
+            "wv", "bv", "wo", "bo", "mlp_norm_w", "mlp_norm_b",
+            "fc1", "fc1_b", "fc2", "fc2_b",
+        )
+    }
+
+    def layer_step(x, scanned):
+        lp, k_layer, v_layer = scanned
+        a_in = layer_norm(x, lp["attn_norm_w"], lp["attn_norm_b"])
+        q = (a_in @ lp["wq"] + lp["bq"]).reshape(b, t, nh, d)
+        k = (a_in @ lp["wk"] + lp["bk"]).reshape(b, t, nh, d)
+        v = (a_in @ lp["wv"] + lp["bv"]).reshape(b, t, nh, d)
+        k_layer = write_to_pages(k_layer, k, page_table, positions, valid)
+        v_layer = write_to_pages(v_layer, v, page_table, positions, valid)
+        attn = paged_attention(
+            q, k_layer, v_layer, page_table, positions, kv_lens
+        )
+        x = x + (attn.reshape(b, t, nh * d) @ lp["wo"] + lp["bo"])
+        m_in = layer_norm(x, lp["mlp_norm_w"], lp["mlp_norm_b"])
+        hidden = jax.nn.relu(m_in @ lp["fc1"] + lp["fc1_b"])
+        x = x + (hidden @ lp["fc2"] + lp["fc2_b"])
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (layer_params, k_cache, v_cache)
+    )
+
+    x = layer_norm(x, params["final_norm_w"], params["final_norm_b"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return logits, new_k, new_v

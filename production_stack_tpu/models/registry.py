@@ -1,0 +1,21 @@
+"""Architecture registry mapping config.architecture -> (init, forward)."""
+
+from typing import Callable, Tuple
+
+from production_stack_tpu.engine.config import ModelConfig
+
+
+def get_model(config: ModelConfig) -> Tuple[Callable, Callable]:
+    """Returns (init_params, forward) for the configured architecture."""
+    arch = config.architecture
+    if arch in ("llama", "mistral", "qwen2"):
+        from production_stack_tpu.models import llama
+        return llama.init_params, llama.forward
+    if arch == "opt":
+        from production_stack_tpu.models import opt
+        return opt.init_params, opt.forward
+    raise ValueError(f"Unknown architecture: {arch}")
+
+
+def list_architectures():
+    return ["llama", "mistral", "qwen2", "opt"]

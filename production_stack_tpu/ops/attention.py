@@ -1,0 +1,102 @@
+"""Attention over the paged KV cache — XLA reference implementation.
+
+One unified primitive serves prefill chunks and decode steps: queries at
+absolute positions attend to everything already written to their
+sequence's pages, with causal masking. Decode is the T=1 special case, so
+there is exactly one numerics path to test. A Pallas kernel
+(ops/paged_attention_pallas.py) implements the same contract for the
+decode hot loop; this module is the ground truth it is tested against.
+
+Replaces: vLLM's PagedAttention CUDA kernels (external to the reference
+repo; provisioned via helm/templates/deployment-vllm-multi.yaml engine
+image) — re-designed for TPU: gather whole pages (contiguous HBM reads),
+mask in-register, let XLA tile the batched matmuls onto the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def gather_pages(cache_layer: jnp.ndarray,
+                 page_table: jnp.ndarray) -> jnp.ndarray:
+    """[num_pages, page, kv, d] gathered to [B, max_pages*page, kv, d]."""
+    gathered = cache_layer[page_table]  # [B, P, page, kv, d]
+    b, p, page, kv, d = gathered.shape
+    return gathered.reshape(b, p * page, kv, d)
+
+
+def write_to_pages(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
+                   page_table: jnp.ndarray, positions: jnp.ndarray,
+                   valid: jnp.ndarray) -> jnp.ndarray:
+    """Scatter new KV entries into their pages.
+
+    Page 0 is the engine's trash page (the allocator never hands it out),
+    so padded slots write there harmlessly instead of needing predication.
+
+    Args:
+      cache_layer: [num_pages, page_size, kv_heads, head_dim]
+      new_kv:      [B, T, kv_heads, head_dim]
+      page_table:  [B, max_pages] int32 physical page ids
+      positions:   [B, T] absolute token positions
+      valid:       [B, T] bool; False entries are redirected to page 0
+    """
+    page_size = cache_layer.shape[1]
+    b, t = positions.shape
+    logical_page = positions // page_size  # [B, T]
+    offset = positions % page_size  # [B, T]
+    physical_page = jnp.take_along_axis(
+        page_table, logical_page, axis=1
+    )  # [B, T]
+    physical_page = jnp.where(valid, physical_page, 0)
+    flat_pages = physical_page.reshape(-1)
+    flat_offsets = offset.reshape(-1)
+    flat_kv = new_kv.reshape(b * t, *new_kv.shape[2:])
+    return cache_layer.at[flat_pages, flat_offsets].set(flat_kv)
+
+
+def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
+                    v_cache_layer: jnp.ndarray, page_table: jnp.ndarray,
+                    q_positions: jnp.ndarray,
+                    kv_lens: jnp.ndarray) -> jnp.ndarray:
+    """Causal attention of q against a sequence's cached pages.
+
+    Args:
+      q:           [B, T, num_q_heads, head_dim]
+      k/v_cache_layer: [num_pages, page_size, num_kv_heads, head_dim]
+      page_table:  [B, max_pages]
+      q_positions: [B, T] absolute positions of the queries
+      kv_lens:     [B] number of valid cached tokens (>= max position + 1)
+
+    Returns [B, T, num_q_heads, head_dim].
+    """
+    b, t, num_q_heads, head_dim = q.shape
+    num_kv_heads = k_cache_layer.shape[2]
+    group = num_q_heads // num_kv_heads
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, dtype=jnp.float32))
+
+    k = gather_pages(k_cache_layer, page_table)  # [B, S, kv, d]
+    v = gather_pages(v_cache_layer, page_table)
+    s = k.shape[1]
+
+    qg = q.reshape(b, t, num_kv_heads, group, head_dim)
+    # scores: [B, kv, group, T, S]
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32),
+        k.astype(jnp.float32),
+    ) * scale
+
+    kv_positions = jnp.arange(s)[None, :]  # [1, S]
+    causal = kv_positions[:, None, :] <= q_positions[:, :, None]  # [B,T,S]
+    in_len = kv_positions < kv_lens[:, None]  # [B, S]
+    mask = causal & in_len[:, None, :]  # [B, T, S]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgts,bskd->btkgd", probs, v.astype(jnp.float32)
+    )
+    return out.reshape(b, t, num_q_heads, head_dim).astype(q.dtype)

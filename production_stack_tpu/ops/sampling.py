@@ -1,0 +1,59 @@
+"""Token sampling, fully vectorized in-graph (no host round-trip of
+logits): temperature, top-k, top-p and greedy, per-slot parameters so one
+decode batch mixes sampling configs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
+                  top_p: jnp.ndarray, top_k: jnp.ndarray,
+                  key: jax.Array) -> jnp.ndarray:
+    """Sample one token per row.
+
+    Args:
+      logits:      [B, vocab] float32
+      temperature: [B] (0 => greedy)
+      top_p:       [B] (1.0 => disabled)
+      top_k:       [B] int32 (0 => disabled)
+      key:         PRNG key
+
+    Returns [B] int32 token ids.
+    """
+    b, vocab = logits.shape
+    greedy_tokens = jnp.argmax(logits, axis=-1)
+
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_temp[:, None]
+
+    # Rank of each logit within its row (0 = largest).
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+
+    # top-k: keep ranks < k (k==0 disables).
+    ranks = jnp.arange(vocab)[None, :]
+    k = jnp.where(top_k > 0, top_k, vocab)
+    topk_mask = ranks < k[:, None]
+
+    # top-p: keep the smallest prefix with cumulative prob >= top_p,
+    # always including the most likely token.
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+    topp_mask = (cumprobs - sorted_probs) < top_p[:, None]
+
+    keep_sorted = topk_mask & topp_mask
+    masked_sorted = jnp.where(keep_sorted, sorted_logits, NEG_INF)
+    # Scatter the mask back to vocab order.
+    masked = jnp.zeros_like(scaled).at[
+        jnp.arange(b)[:, None], sort_idx
+    ].set(masked_sorted)
+
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy_tokens).astype(
+        jnp.int32
+    )

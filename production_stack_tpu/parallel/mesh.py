@@ -1,0 +1,103 @@
+"""Device mesh + sharding layout for tensor/data parallel serving.
+
+The reference delegates tensor parallelism to vLLM/NCCL and provisions
+/dev/shm for it (deployment-vllm-multi.yaml:84-87,226-233). Here TP is a
+first-class mesh axis: weights carry NamedShardings over the ``tp`` axis
+(attention heads / MLP columns), the KV cache shards its kv-head dim,
+and XLA/GSPMD inserts the ICI collectives — we write layouts, not
+communication code. ``dp`` is the replica axis for batch sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from production_stack_tpu.engine.config import ModelConfig
+
+
+def build_mesh(tensor_parallel_size: int = 1,
+               data_parallel_size: int = 1,
+               devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    needed = tensor_parallel_size * data_parallel_size
+    if len(devices) < needed:
+        raise ValueError(
+            f"Mesh needs {needed} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[:needed]).reshape(
+        data_parallel_size, tensor_parallel_size
+    )
+    return Mesh(grid, axis_names=("dp", "tp"))
+
+
+# PartitionSpecs per parameter name. Layer-stacked params have a leading
+# L dim (never sharded). Column-parallel projections shard their output
+# dim; row-parallel shard their input dim; GSPMD places the psum.
+_LLAMA_SPECS: Dict[str, P] = {
+    "embed": P(None, None),
+    "final_norm": P(None),
+    "attn_norm": P(None, None),
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),
+    "mlp_norm": P(None, None),
+    "w_gate": P(None, None, "tp"),
+    "w_up": P(None, None, "tp"),
+    "w_down": P(None, "tp", None),
+    "lm_head": P(None, "tp"),
+}
+
+_OPT_SPECS: Dict[str, P] = {
+    "embed": P(None, None),
+    "pos_embed": P(None, None),
+    "final_norm_w": P(None), "final_norm_b": P(None),
+    "attn_norm_w": P(None, None), "attn_norm_b": P(None, None),
+    "wq": P(None, None, "tp"), "bq": P(None, "tp"),
+    "wk": P(None, None, "tp"), "bk": P(None, "tp"),
+    "wv": P(None, None, "tp"), "bv": P(None, "tp"),
+    "wo": P(None, "tp", None), "bo": P(None, None),
+    "mlp_norm_w": P(None, None), "mlp_norm_b": P(None, None),
+    "fc1": P(None, None, "tp"), "fc1_b": P(None, "tp"),
+    "fc2": P(None, "tp", None), "fc2_b": P(None, None),
+}
+
+
+def param_specs(config: ModelConfig) -> Dict[str, P]:
+    if config.architecture == "opt":
+        return dict(_OPT_SPECS)
+    return dict(_LLAMA_SPECS)
+
+
+def shard_params(params: Dict[str, jax.Array], config: ModelConfig,
+                 mesh: Optional[Mesh]) -> Dict[str, jax.Array]:
+    if mesh is None:
+        return params
+    specs = param_specs(config)
+    return {
+        name: jax.device_put(
+            value, NamedSharding(mesh, specs.get(name, P()))
+        )
+        for name, value in params.items()
+    }
+
+
+def cache_spec() -> P:
+    """KV cache [L, pages, page_size, kv_heads, head_dim]: shard heads."""
+    return P(None, None, None, "tp", None)
+
+
+def shard_cache(cache: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
+    if mesh is None:
+        return cache
+    return jax.device_put(cache, NamedSharding(mesh, cache_spec()))
+
+
+def replicated(mesh: Optional[Mesh]):
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P())

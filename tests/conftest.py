@@ -12,13 +12,29 @@ import inspect
 import os
 import sys
 
-# Must happen before any jax import anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before any jax backend init anywhere in the test session.
+# Hard override (not setdefault): the environment ships JAX_PLATFORMS=axon
+# (the tunneled TPU); tests must run hermetically on the virtual CPU mesh
+# regardless of TPU/relay health.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# If a PJRT plugin for the TPU tunnel was registered by sitecustomize,
+# drop its factory and undo its jax_platforms config override so no test
+# can accidentally dial the tunnel (sitecustomize runs register(), which
+# does jax.config.update("jax_platforms", "axon,cpu") — config beats env).
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    for _name in ("axon", "tpu"):
+        _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
