@@ -1,0 +1,157 @@
+"""Driver benchmark: serving throughput of the TPU engine on one chip.
+
+Workload models the reference's multi-round-QA harness
+(benchmarks/multi-round-qa.py: closed-loop users, prompt + growing
+history, fixed output length): N requests with ~512-token prompts and
+64-token outputs run through the full engine (chunked prefill,
+continuous batching, paged attention, sampling). Weights are random — a
+1B-class Llama architecture is used because no checkpoints can be
+downloaded in this environment and throughput does not depend on weight
+values.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = requests/second. The reference publishes no absolute numbers
+(BASELINE.md), so vs_baseline is vs. the recorded target of 1.0 until a
+measured baseline lands in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _tpu_available() -> bool:
+    """Probe TPU init in a subprocess so a wedged tunnel can't hang us."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform != 'cpu'"],
+            timeout=120, capture_output=True,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _bench_config(tpu: bool):
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        ModelConfig,
+        SchedulerConfig,
+    )
+    if tpu:
+        model = ModelConfig(
+            name="llama-1b-class",
+            architecture="llama",
+            vocab_size=32128,
+            hidden_size=2048,
+            intermediate_size=5632,
+            num_hidden_layers=16,
+            num_attention_heads=32,
+            num_key_value_heads=8,
+            head_dim=64,
+            max_position_embeddings=2048,
+            dtype="bfloat16",
+        )
+        cache = CacheConfig(page_size=16, num_pages=2048)
+        sched = SchedulerConfig(max_num_seqs=8, max_model_len=1024,
+                                prefill_chunk_size=512)
+        n_requests, prompt_len, out_len = 24, 512, 64
+    else:  # CPU fallback: tiny model, same code path
+        from production_stack_tpu.engine.config import tiny_model_config
+        model = tiny_model_config("llama")
+        cache = CacheConfig(page_size=16, num_pages=256)
+        sched = SchedulerConfig(max_num_seqs=4, max_model_len=512,
+                                prefill_chunk_size=128)
+        n_requests, prompt_len, out_len = 8, 128, 16
+    return (EngineConfig(model=model, cache=cache, scheduler=sched),
+            n_requests, prompt_len, out_len)
+
+
+def main() -> None:
+    tpu = _tpu_available()
+    if not tpu:
+        # Hermetic CPU path: drop the tunnel plugin entirely.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if os.environ.get("PYTHONPATH", "").find("axon") != -1:
+            os.environ["PYTHONPATH"] = ""
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import numpy as np
+
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import (
+        SamplingParams,
+        SequenceState,
+    )
+
+    config, n_requests, prompt_len, out_len = _bench_config(tpu)
+    engine = LLMEngine(config)
+    rng = np.random.RandomState(0)
+
+    def make_prompt(i):
+        # Shared "system prompt" prefix (exercises the prefix cache, as
+        # the reference workload's shared system prompt does) + unique
+        # user history.
+        shared = list(range(100, 100 + prompt_len // 4))
+        unique = [int(x) for x in rng.randint(
+            1, config.model.vocab_size - 1, size=prompt_len * 3 // 4
+        )]
+        return shared + unique
+
+    sampling = lambda: SamplingParams(  # noqa: E731
+        max_tokens=out_len, temperature=0.0, ignore_eos=True
+    )
+
+    # Warmup: compile all shapes (prefill buckets + decode).
+    warm = engine.generate(make_prompt(-1), sampling())
+    assert len(warm.output_token_ids) == out_len
+
+    # Closed-loop timed run.
+    t0 = time.time()
+    seqs = []
+    submit_times = {}
+    for i in range(n_requests):
+        sp = sampling()
+        seq_id = engine.add_request(make_prompt(i), sp)
+        seqs.append(engine.sequences[seq_id])
+        submit_times[seq_id] = time.time()
+    while any(s.state not in (SequenceState.FINISHED,
+                              SequenceState.ABORTED) for s in seqs):
+        engine.step()
+    wall = time.time() - t0
+
+    ttfts = sorted(
+        s.first_token_time - submit_times[s.seq_id]
+        for s in seqs if s.first_token_time
+    )
+    p50_ttft = ttfts[len(ttfts) // 2] if ttfts else -1.0
+    total_tokens = sum(len(s.output_token_ids) for s in seqs)
+    req_per_s = n_requests / wall
+
+    print(json.dumps({
+        "metric": ("multi-round-qa-style req/s, 1B-class llama, "
+                   "1 TPU chip" if tpu else
+                   "multi-round-qa-style req/s, tiny llama, CPU fallback"),
+        "value": round(req_per_s, 3),
+        "unit": "req/s",
+        "vs_baseline": round(req_per_s / 1.0, 3),
+        "extra": {
+            "p50_ttft_s": round(p50_ttft, 4),
+            "gen_tokens_per_s": round(total_tokens / wall, 1),
+            "n_requests": n_requests,
+            "prompt_len": prompt_len,
+            "output_len": out_len,
+            "platform": "tpu" if tpu else "cpu",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

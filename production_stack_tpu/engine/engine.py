@@ -76,12 +76,16 @@ class LLMEngine:
         )
         with self._lock:
             self.sequences[seq.seq_id] = seq
-            self.scheduler.add_sequence(seq)
+            try:
+                self.scheduler.add_sequence(seq)
+            except Exception:
+                self.sequences.pop(seq.seq_id, None)
+                raise
         return seq.seq_id
 
     def abort_request(self, seq_id: str) -> None:
         with self._lock:
-            seq = self.sequences.get(seq_id)
+            seq = self.sequences.pop(seq_id, None)
             if seq is not None:
                 self.scheduler.abort_sequence(seq)
 
@@ -92,11 +96,16 @@ class LLMEngine:
 
     def step(self) -> List[StepOutput]:
         """Plan + execute one device program; returns per-seq deltas."""
+        outputs: List[StepOutput] = []
         with self._lock:
             plan = self.scheduler.plan_step()
+            for seq in self.scheduler.newly_aborted:
+                outputs.append(self._delta(seq, None))
+            self.scheduler.newly_aborted.clear()
         if plan.empty:
-            return []
-        outputs: List[StepOutput] = []
+            for out in outputs:
+                self.sequences.pop(out.seq_id, None)
+            return outputs
         if plan.prefill is not None:
             sampled = self.runner.run_prefill(plan.prefill)
             with self._lock:

@@ -69,6 +69,10 @@ class Scheduler:
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self._last_was_prefill = False
+        # Sequences aborted by the scheduler itself (oversized prompts,
+        # permanent cache starvation); the engine drains this to emit
+        # terminal outputs to their clients.
+        self.newly_aborted: List[Sequence] = []
 
     # ---- queue management -------------------------------------------------
 
@@ -77,6 +81,24 @@ class Scheduler:
             seq.state = SequenceState.ABORTED
             seq.finish_reason = FinishReason.ABORT
             raise RuntimeError("Scheduler queue full")
+        if seq.num_prompt_tokens >= self.config.max_model_len:
+            seq.state = SequenceState.ABORTED
+            seq.finish_reason = FinishReason.ABORT
+            raise ValueError(
+                f"Prompt is {seq.num_prompt_tokens} tokens but "
+                f"max_model_len is {self.config.max_model_len}"
+            )
+        max_prompt_pages = (self.config.max_pages_per_seq(self.page_size)
+                            * self.page_size)
+        if seq.num_prompt_tokens >= min(
+                max_prompt_pages,
+                (self.cache.config.num_pages - 1) * self.page_size):
+            seq.state = SequenceState.ABORTED
+            seq.finish_reason = FinishReason.ABORT
+            raise ValueError(
+                f"Prompt of {seq.num_prompt_tokens} tokens cannot fit "
+                "in the KV cache"
+            )
         if seq.num_prompt_tokens + seq.sampling.max_tokens > \
                 self.config.max_model_len:
             # Clamp generation to fit the model length budget.
@@ -150,6 +172,16 @@ class Scheduler:
                     self.cache.free_sequence(seq.pages)
                     seq.pages = []
                     seq.num_computed_tokens = 0
+                    if not self.running:
+                        # Nothing will ever free pages: permanent.
+                        logger.error(
+                            "Request %s can never fit in the KV cache; "
+                            "aborting", seq.seq_id
+                        )
+                        self.waiting.popleft()
+                        self._finish(seq, FinishReason.ABORT)
+                        self.newly_aborted.append(seq)
+                        continue
                     logger.warning(
                         "KV cache full: request %s waits", seq.seq_id
                     )
@@ -209,6 +241,8 @@ class Scheduler:
     def on_prefill_executed(self, plan: PrefillPlan,
                             sampled_token: Optional[int]) -> None:
         seq = plan.seq
+        if seq.state in (SequenceState.ABORTED, SequenceState.FINISHED):
+            return  # aborted while the chunk was in flight on device
         seq.num_computed_tokens = plan.chunk_start + len(plan.chunk_tokens)
         self.cache.commit_full_pages(
             seq.prompt_token_ids[:seq.num_computed_tokens],
@@ -220,7 +254,10 @@ class Scheduler:
         )
         if plan.is_last_chunk:
             assert sampled_token is not None
-            self.waiting.popleft()
+            try:
+                self.waiting.remove(seq)
+            except ValueError:
+                return  # raced with an abort that already dequeued it
             seq.state = SequenceState.RUNNING
             seq.first_token_time = time.time()
             self.running.append(seq)

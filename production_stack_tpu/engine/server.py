@@ -1,0 +1,492 @@
+"""OpenAI-compatible HTTP front end for the TPU engine.
+
+This is the process the Helm chart's engine pods run (the counterpart of
+``vllm serve`` in reference deployment-vllm-multi.yaml:57-103). Surface:
+
+  POST /v1/chat/completions | /v1/completions   (stream + non-stream)
+  GET  /v1/models | /health | /version
+  GET  /metrics  -- vLLM exposition names the router scrapes
+                    (reference engine_stats.py:46-55):
+                    vllm:num_requests_running, vllm:num_requests_waiting,
+                    vllm:gpu_cache_usage_perc, vllm:gpu_prefix_cache_hit_rate
+
+Threading model: the device loop runs in one dedicated thread (JAX
+dispatch is blocking); HTTP handlers submit requests through a
+thread-safe queue and receive per-token deltas via asyncio queues.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+from production_stack_tpu.engine.tokenizer import (
+    get_tokenizer,
+    render_chat_prompt,
+)
+from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+
+class AsyncEngine:
+    """Background-thread engine loop with asyncio streaming outputs."""
+
+    def __init__(self, engine: LLMEngine):
+        self.engine = engine
+        self._submit_q: "queue.Queue" = queue.Queue()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._streams: Dict[str, asyncio.Queue] = {}
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="engine-loop"
+        )
+        self._started = threading.Event()
+        self.uptime_start = time.time()
+
+    def start(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._loop = loop
+        self._thread.start()
+        self._started.set()
+
+    def _run(self) -> None:
+        from production_stack_tpu.engine.engine import StepOutput
+        self._started.wait()
+        while True:
+            # Drain submissions (non-blocking when engine has work).
+            block = not self.engine.has_work()
+            try:
+                item = self._submit_q.get(
+                    block=block, timeout=1.0 if block else None
+                )
+            except queue.Empty:
+                item = None
+            if item is not None:
+                prompt, sampling, seq_id = item
+                try:
+                    self.engine.add_request(
+                        prompt, sampling, seq_id=seq_id
+                    )
+                except Exception as e:
+                    # Queue full / invalid request: fail THIS request,
+                    # never the engine loop.
+                    logger.warning("Rejecting %s: %s", seq_id, e)
+                    self._emit(seq_id, StepOutput(
+                        seq_id=seq_id, new_token=None, finished=True,
+                        finish_reason="abort",
+                    ))
+                continue  # admit as many as possible before stepping
+            if not self.engine.has_work():
+                continue
+            try:
+                outputs = self.engine.step()
+            except Exception as e:
+                logger.exception("Engine step failed: %s", e)
+                time.sleep(0.05)
+                continue
+            if not outputs:
+                # Planner produced no executable work (e.g. transient
+                # KV-cache starvation): don't busy-spin.
+                time.sleep(0.002)
+            for out in outputs:
+                self._emit(out.seq_id, out)
+
+    def _emit(self, seq_id: str, item) -> None:
+        stream = self._streams.get(seq_id)
+        if stream is None or self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(stream.put_nowait, item)
+
+    async def submit(self, prompt: List[int],
+                     sampling: SamplingParams) -> tuple[str, asyncio.Queue]:
+        seq_id = f"seq-{uuid.uuid4().hex[:16]}"
+        stream: asyncio.Queue = asyncio.Queue()
+        self._streams[seq_id] = stream
+        self._submit_q.put((prompt, sampling, seq_id))
+        return seq_id, stream
+
+    def finish_stream(self, seq_id: str) -> None:
+        self._streams.pop(seq_id, None)
+
+    def abort(self, seq_id: str) -> None:
+        self.engine.abort_request(seq_id)
+        self.finish_stream(seq_id)
+
+
+# ---- request handling ------------------------------------------------------
+
+
+def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
+    max_tokens = body.get("max_tokens") or body.get(
+        "max_completion_tokens"
+    ) or 256
+    # JSON null must fall back to the OpenAI defaults, not to 0.
+    temperature = body.get("temperature")
+    top_p = body.get("top_p")
+    top_k = body.get("top_k")
+    return SamplingParams(
+        max_tokens=min(int(max_tokens), max_model_len),
+        temperature=1.0 if temperature is None else float(temperature),
+        top_p=1.0 if top_p is None else float(top_p),
+        top_k=0 if top_k is None else int(top_k),
+        ignore_eos=bool(body.get("ignore_eos", False)),
+    )
+
+
+def _usage(prompt_len: int, completion_len: int) -> dict:
+    return {
+        "prompt_tokens": prompt_len,
+        "completion_tokens": completion_len,
+        "total_tokens": prompt_len + completion_len,
+    }
+
+
+class EngineServer:
+    def __init__(self, engine: LLMEngine, served_model_name: str):
+        self.async_engine = AsyncEngine(engine)
+        self.engine = engine
+        self.model_name = served_model_name
+        self.tokenizer = engine.tokenizer
+
+    # -- decoding helpers ---------------------------------------------------
+
+    def _delta_decoder(self):
+        """Incremental detokenizer: feed token ids, get new text.
+
+        ``push(tok)`` returns newly-decoded text (holding back a tail
+        that may be an incomplete UTF-8/BPE run); ``push(None,
+        flush=True)`` force-emits whatever is still held back (stream
+        end).
+        """
+        tokens: List[int] = []
+        base = 0  # tokens[:base] are already emitted
+
+        def push(token_id: Optional[int], flush: bool = False) -> str:
+            nonlocal base
+            if token_id is not None:
+                tokens.append(token_id)
+            # Decode only the pending tail (O(1) per token, not O(n)).
+            tail = self.tokenizer.decode(tokens[base:])
+            if not flush and tail.endswith("�"):
+                return ""  # likely an incomplete UTF-8/BPE run
+            base = len(tokens)
+            return tail
+
+        return push
+
+    # -- handlers -----------------------------------------------------------
+
+    @staticmethod
+    async def _json_body(request: web.Request) -> dict:
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(
+                text='{"error": {"message": "Request body is not valid '
+                     'JSON"}}',
+                content_type="application/json",
+            )
+        if not isinstance(body, dict):
+            raise web.HTTPBadRequest(
+                text='{"error": {"message": "Request body must be a '
+                     'JSON object"}}',
+                content_type="application/json",
+            )
+        return body
+
+    async def chat_completions(self, request: web.Request):
+        body = await self._json_body(request)
+        messages = body.get("messages")
+        if not isinstance(messages, list):
+            return web.json_response(
+                {"error": {"message": "'messages' must be a list"}},
+                status=400,
+            )
+        prompt = render_chat_prompt(self.tokenizer, messages)
+        return await self._generate_response(
+            request, body, prompt, chat=True
+        )
+
+    async def completions(self, request: web.Request):
+        body = await self._json_body(request)
+        prompt_in = body.get("prompt", "")
+        if isinstance(prompt_in, list) and prompt_in and isinstance(
+                prompt_in[0], int):
+            prompt = list(prompt_in)
+        elif isinstance(prompt_in, list):
+            prompt = self.tokenizer.encode("".join(prompt_in))
+        else:
+            prompt = self.tokenizer.encode(str(prompt_in))
+        return await self._generate_response(
+            request, body, prompt, chat=False
+        )
+
+    async def _generate_response(self, request: web.Request, body: dict,
+                                 prompt: List[int], chat: bool):
+        sampling = _sampling_from_body(
+            body, self.engine.config.scheduler.max_model_len
+        )
+        stream_mode = bool(body.get("stream", False))
+        created = int(time.time())
+        rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:16]
+
+        max_prompt = self.engine.config.scheduler.max_model_len - 1
+        if len(prompt) > max_prompt:
+            return web.json_response(
+                {"error": {"message": (
+                    f"Prompt is {len(prompt)} tokens; maximum is "
+                    f"{max_prompt} (max_model_len "
+                    f"{self.engine.config.scheduler.max_model_len})"
+                ), "type": "invalid_request_error"}},
+                status=400,
+            )
+
+        seq_id, stream = await self.async_engine.submit(prompt, sampling)
+        decoder = self._delta_decoder()
+
+        if not stream_mode:
+            pieces: List[str] = []
+            n_tokens = 0
+            finish_reason = "stop"
+            try:
+                while True:
+                    out = await stream.get()
+                    if out.new_token is not None:
+                        n_tokens += 1
+                        pieces.append(decoder(out.new_token))
+                    if out.finished:
+                        finish_reason = out.finish_reason or "stop"
+                        pieces.append(decoder(None, flush=True))
+                        break
+            except asyncio.CancelledError:
+                self.async_engine.abort(seq_id)
+                raise
+            finally:
+                self.async_engine.finish_stream(seq_id)
+            text = "".join(pieces)
+            if chat:
+                payload = {
+                    "id": rid, "object": "chat.completion",
+                    "created": created, "model": self.model_name,
+                    "choices": [{
+                        "index": 0,
+                        "message": {"role": "assistant", "content": text},
+                        "finish_reason": finish_reason,
+                    }],
+                    "usage": _usage(len(prompt), n_tokens),
+                }
+            else:
+                payload = {
+                    "id": rid, "object": "text_completion",
+                    "created": created, "model": self.model_name,
+                    "choices": [{
+                        "index": 0, "text": text,
+                        "finish_reason": finish_reason,
+                    }],
+                    "usage": _usage(len(prompt), n_tokens),
+                }
+            return web.json_response(payload)
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+
+        def sse(payload: dict) -> bytes:
+            return f"data: {json.dumps(payload)}\n\n".encode()
+
+        def chunk(delta: Optional[str], finish: Optional[str],
+                  first: bool = False) -> dict:
+            if chat:
+                d: Dict[str, Any] = {}
+                if first:
+                    d["role"] = "assistant"
+                if delta:
+                    d["content"] = delta
+                choice = {"index": 0, "delta": d,
+                          "finish_reason": finish}
+                obj = "chat.completion.chunk"
+            else:
+                choice = {"index": 0, "text": delta or "",
+                          "finish_reason": finish}
+                obj = "text_completion"
+            return {"id": rid, "object": obj, "created": created,
+                    "model": self.model_name, "choices": [choice]}
+
+        try:
+            if chat:
+                await resp.write(sse(chunk(None, None, first=True)))
+            while True:
+                out = await stream.get()
+                if out.new_token is not None:
+                    delta = decoder(out.new_token)
+                    if delta:
+                        await resp.write(sse(chunk(delta, None)))
+                if out.finished:
+                    tail = decoder(None, flush=True)
+                    if tail:
+                        await resp.write(sse(chunk(tail, None)))
+                    await resp.write(
+                        sse(chunk(None, out.finish_reason or "stop"))
+                    )
+                    break
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except (ConnectionResetError, asyncio.CancelledError):
+            self.async_engine.abort(seq_id)
+            raise
+        finally:
+            self.async_engine.finish_stream(seq_id)
+        return resp
+
+    async def models(self, request: web.Request):
+        return web.json_response({
+            "object": "list",
+            "data": [{
+                "id": self.model_name, "object": "model",
+                "created": int(self.async_engine.uptime_start),
+                "owned_by": "production-stack-tpu",
+            }],
+        })
+
+    async def health(self, request: web.Request):
+        return web.json_response({"status": "ok"})
+
+    async def version(self, request: web.Request):
+        return web.json_response({"version": __version__})
+
+    async def metrics(self, request: web.Request):
+        stats = self.engine.stats()
+        lines = []
+        for name, value in (
+            ("vllm:num_requests_running",
+             stats["num_requests_running"]),
+            ("vllm:num_requests_waiting",
+             stats["num_requests_waiting"]),
+            ("vllm:gpu_cache_usage_perc",
+             stats["gpu_cache_usage_perc"]),
+            ("vllm:gpu_prefix_cache_hit_rate",
+             stats["gpu_prefix_cache_hit_rate"]),
+        ):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {float(value)}")
+        lines.append("")
+        return web.Response(text="\n".join(lines),
+                            content_type="text/plain")
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1024 ** 3)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/version", self.version)
+        app.router.add_get("/metrics", self.metrics)
+
+        async def on_startup(app):
+            self.async_engine.start(asyncio.get_event_loop())
+
+        app.on_startup.append(on_startup)
+        return app
+
+
+# ---- CLI -------------------------------------------------------------------
+
+
+def build_engine_from_args(args) -> tuple[LLMEngine, str]:
+    mesh = None
+    if args.model in ("tiny-llama", "tiny-opt"):
+        model_config = tiny_model_config(args.model.split("-")[1])
+        params = None
+        tokenizer = get_tokenizer("byte")
+        served_name = args.served_model_name or args.model
+    else:
+        from production_stack_tpu.engine.weights import (
+            load_model_config,
+            load_weights,
+        )
+        model_config = load_model_config(args.model)
+        if args.dtype:
+            model_config.dtype = args.dtype
+        params = (None if args.random_weights
+                  else load_weights(args.model, model_config))
+        tokenizer = get_tokenizer(args.tokenizer or args.model)
+        served_name = args.served_model_name or args.model
+
+    if args.tensor_parallel_size > 1:
+        from production_stack_tpu.parallel.mesh import build_mesh
+        mesh = build_mesh(args.tensor_parallel_size)
+
+    config = EngineConfig(
+        model=model_config,
+        cache=CacheConfig(
+            page_size=args.page_size,
+            num_pages=args.num_pages,
+            enable_prefix_caching=not args.disable_prefix_caching,
+        ),
+        scheduler=SchedulerConfig(
+            max_num_seqs=args.max_num_seqs,
+            max_model_len=args.max_model_len,
+            prefill_chunk_size=args.prefill_chunk_size,
+        ),
+        parallel=ParallelConfig(
+            tensor_parallel_size=args.tensor_parallel_size,
+        ),
+    )
+    engine = LLMEngine(config, mesh=mesh, params=params,
+                       tokenizer=tokenizer)
+    return engine, served_name
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(prog="tpu-engine")
+    parser.add_argument("--model", default="tiny-llama",
+                        help="HF model dir, or tiny-llama/tiny-opt")
+    parser.add_argument("--served-model-name", default=None)
+    parser.add_argument("--tokenizer", default=None)
+    parser.add_argument("--random-weights", action="store_true")
+    parser.add_argument("--dtype", default=None,
+                        choices=[None, "bfloat16", "float32", "float16"])
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--num-pages", type=int, default=512)
+    parser.add_argument("--max-num-seqs", type=int, default=8)
+    parser.add_argument("--max-model-len", type=int, default=2048)
+    parser.add_argument("--prefill-chunk-size", type=int, default=512)
+    parser.add_argument("--tensor-parallel-size", type=int, default=1)
+    parser.add_argument("--disable-prefix-caching", action="store_true")
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    engine, served_name = build_engine_from_args(args)
+    server = EngineServer(engine, served_name)
+    logger.info("tpu-engine %s serving %s on %s:%d",
+                __version__, served_name, args.host, args.port)
+    web.run_app(server.build_app(), host=args.host, port=args.port,
+                print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -128,3 +128,57 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
         head = params["embed"].T
     logits = (x @ head).astype(jnp.float32)
     return logits, new_k, new_v
+
+
+def forward_train(params: Params, config: ModelConfig,
+                  tokens: jnp.ndarray) -> jnp.ndarray:
+    """Cache-free dense causal forward for training/fine-tuning flows.
+
+    Same weights/numerics as the serving path but attends within the
+    batch (no paged cache), so it is cleanly differentiable.
+    Returns logits [B, T, vocab].
+    """
+    nh, nkv, d = (config.num_attention_heads, config.num_key_value_heads,
+                  config.head_dim)
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    x = params["embed"][tokens]
+
+    layer_params = {
+        k: params[k] for k in (
+            "attn_norm", "wq", "wk", "wv", "wo",
+            "mlp_norm", "w_gate", "w_up", "w_down",
+        )
+    }
+    causal = jnp.tril(jnp.ones((t, t), bool))
+
+    def layer_step(x, lp):
+        a_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
+        q = apply_rope((a_in @ lp["wq"]).reshape(b, t, nh, d),
+                       positions, config.rope_theta)
+        k = apply_rope((a_in @ lp["wk"]).reshape(b, t, nkv, d),
+                       positions, config.rope_theta)
+        v = (a_in @ lp["wv"]).reshape(b, t, nkv, d)
+        group = nh // nkv
+        qg = q.reshape(b, t, nkv, group, d)
+        scores = jnp.einsum(
+            "btkgd,bskd->bkgts", qg.astype(jnp.float32),
+            k.astype(jnp.float32),
+        ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        scores = jnp.where(causal[None, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "bkgts,bskd->btkgd", probs, v.astype(jnp.float32)
+        ).reshape(b, t, nh * d).astype(x.dtype)
+        x = x + attn @ lp["wo"]
+        m_in = rms_norm(x, lp["mlp_norm"], config.rms_norm_eps)
+        x = x + (jax.nn.silu(m_in @ lp["w_gate"])
+                 * (m_in @ lp["w_up"])) @ lp["w_down"]
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, layer_params)
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (x @ head).astype(jnp.float32)

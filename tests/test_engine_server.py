@@ -1,0 +1,187 @@
+"""HTTP-level tests of the engine server (OpenAI surface + /metrics).
+
+Test model: the reference's fake-openai-server-based e2e rig
+(src/tests/perftest + router-e2e-test.yml), but against the REAL engine
+with a tiny model — no TPU required.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.server import EngineServer
+
+
+def make_server() -> EngineServer:
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=128),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=256,
+                                  prefill_chunk_size=64),
+    )
+    engine = LLMEngine(config)
+    return EngineServer(engine, "tiny-llama")
+
+
+async def _with_client(fn):
+    server = make_server()
+    client = TestClient(TestServer(server.build_app()))
+    await client.start_server()
+    try:
+        await fn(client)
+    finally:
+        await client.close()
+
+
+def test_models_health_version():
+    async def run(client):
+        resp = await client.get("/v1/models")
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["data"][0]["id"] == "tiny-llama"
+        assert (await client.get("/health")).status == 200
+        assert (await client.get("/version")).status == 200
+    asyncio.run(_with_client(run))
+
+
+def test_chat_completion_non_streaming():
+    async def run(client):
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 8,
+            "temperature": 0,
+            "ignore_eos": True,
+        })
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["object"] == "chat.completion"
+        assert data["choices"][0]["finish_reason"] == "length"
+        assert data["usage"]["completion_tokens"] == 8
+        assert isinstance(
+            data["choices"][0]["message"]["content"], str
+        )
+    asyncio.run(_with_client(run))
+
+
+def test_chat_completion_streaming():
+    async def run(client):
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 6,
+            "temperature": 0,
+            "ignore_eos": True,
+            "stream": True,
+        })
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith(
+            "text/event-stream"
+        )
+        events = []
+        async for line in resp.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                events.append(line[len("data: "):])
+        assert events[-1] == "[DONE]"
+        first = json.loads(events[0])
+        assert first["choices"][0]["delta"].get("role") == "assistant"
+        finishes = [json.loads(e)["choices"][0]["finish_reason"]
+                    for e in events[:-1]]
+        assert finishes[-1] == "length"
+    asyncio.run(_with_client(run))
+
+
+def test_completions_endpoint():
+    async def run(client):
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama",
+            "prompt": "abc",
+            "max_tokens": 4,
+            "temperature": 0,
+            "ignore_eos": True,
+        })
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["object"] == "text_completion"
+        assert data["usage"]["completion_tokens"] == 4
+    asyncio.run(_with_client(run))
+
+
+def test_metrics_exposition_names():
+    async def run(client):
+        # Generate some load first.
+        await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 2, "temperature": 0, "ignore_eos": True,
+        })
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        # The names the router scrapes (engine_stats.py contract).
+        for name in (
+            "vllm:num_requests_running",
+            "vllm:num_requests_waiting",
+            "vllm:gpu_cache_usage_perc",
+            "vllm:gpu_prefix_cache_hit_rate",
+        ):
+            assert name in text, f"missing {name}"
+    asyncio.run(_with_client(run))
+
+
+def test_concurrent_requests_batched():
+    async def run(client):
+        async def one(i):
+            resp = await client.post("/v1/chat/completions", json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": f"req {i}"}],
+                "max_tokens": 5, "temperature": 0, "ignore_eos": True,
+            })
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["usage"]["completion_tokens"] == 5
+        await asyncio.gather(*(one(i) for i in range(6)))
+    asyncio.run(_with_client(run))
+
+
+def test_oversized_prompt_rejected_with_400():
+    async def run(client):
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama",
+            "prompt": list(range(1, 400)),  # > max_model_len=256
+            "max_tokens": 4,
+        })
+        assert resp.status == 400
+        data = await resp.json()
+        assert "max_model_len" in data["error"]["message"]
+    asyncio.run(_with_client(run))
+
+
+def test_malformed_json_rejected_with_400():
+    async def run(client):
+        resp = await client.post(
+            "/v1/chat/completions", data=b"{nope",
+            headers={"content-type": "application/json"},
+        )
+        assert resp.status == 400
+    asyncio.run(_with_client(run))
+
+
+def test_null_sampling_params_use_openai_defaults():
+    from production_stack_tpu.engine.server import _sampling_from_body
+    sp = _sampling_from_body(
+        {"temperature": None, "top_p": None, "max_tokens": 4}, 256
+    )
+    assert sp.temperature == 1.0
+    assert sp.top_p == 1.0
+    sp = _sampling_from_body({"temperature": 0, "max_tokens": 4}, 256)
+    assert sp.temperature == 0.0
