@@ -42,6 +42,13 @@ class ModelConfig:
     # OPT/GPT-2 specifics
     do_layer_norm_before: bool = True
     activation: str = "silu"  # silu (llama) | relu (opt) | gelu (gpt2)
+    # Decode attention implementation:
+    #   auto            -> pallas on TPU, xla elsewhere (resolved by the
+    #                      model runner at init)
+    #   xla             -> gather-based reference (ops/attention.py)
+    #   pallas          -> Pallas kernel (ops/paged_attention_pallas.py)
+    #   pallas-interpret-> Pallas interpreter mode (CPU testing)
+    attention_impl: str = "auto"
 
     def __post_init__(self):
         if self.head_dim is None:

@@ -45,6 +45,12 @@ class ModelRunner:
         self.config = config
         self.mesh = mesh
         model_config = config.model
+        if model_config.attention_impl == "auto":
+            model_config.attention_impl = (
+                "xla" if jax.default_backend() == "cpu" else "pallas"
+            )
+            logger.info("Decode attention impl: %s",
+                        model_config.attention_impl)
         self._init_fn, self._forward = get_model(model_config)
 
         if params is None:

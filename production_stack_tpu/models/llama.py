@@ -27,6 +27,28 @@ from production_stack_tpu.ops.rope import apply_rope
 Params = Dict[str, jnp.ndarray]
 
 
+def dispatch_attention(config: ModelConfig, q, k_layer, v_layer,
+                       page_table, positions, kv_lens):
+    """Pick the attention implementation for this step shape.
+
+    Decode (T==1) can use the Pallas paged-attention kernel; prefill
+    chunks and the CPU path use the XLA reference implementation.
+    """
+    impl = config.attention_impl
+    if q.shape[1] == 1 and impl.startswith("pallas"):
+        from production_stack_tpu.ops.paged_attention_pallas import (
+            paged_decode_attention,
+        )
+        out = paged_decode_attention(
+            q[:, 0], k_layer, v_layer, page_table, kv_lens,
+            interpret=(impl == "pallas-interpret"),
+        )
+        return out[:, None]
+    return paged_attention(
+        q, k_layer, v_layer, page_table, positions, kv_lens
+    )
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray,
              eps: float) -> jnp.ndarray:
     x32 = x.astype(jnp.float32)
@@ -108,8 +130,8 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
         k = apply_rope(k, positions, config.rope_theta)
         k_layer = write_to_pages(k_layer, k, page_table, positions, valid)
         v_layer = write_to_pages(v_layer, v, page_table, positions, valid)
-        attn = paged_attention(
-            q, k_layer, v_layer, page_table, positions, kv_lens
+        attn = dispatch_attention(
+            config, q, k_layer, v_layer, page_table, positions, kv_lens
         )
         x = x + attn.reshape(b, t, nh * d) @ lp["wo"]
         # MLP block (SwiGLU)

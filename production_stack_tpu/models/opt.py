@@ -16,9 +16,10 @@ import jax.numpy as jnp
 
 from production_stack_tpu.engine.config import ModelConfig
 from production_stack_tpu.ops.attention import (
-    paged_attention,
+    paged_attention,  # noqa: F401 (re-export for tests)
     write_to_pages,
 )
+from production_stack_tpu.models.llama import dispatch_attention
 
 Params = Dict[str, jnp.ndarray]
 
@@ -101,8 +102,8 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
         v = (a_in @ lp["wv"] + lp["bv"]).reshape(b, t, nh, d)
         k_layer = write_to_pages(k_layer, k, page_table, positions, valid)
         v_layer = write_to_pages(v_layer, v, page_table, positions, valid)
-        attn = paged_attention(
-            q, k_layer, v_layer, page_table, positions, kv_lens
+        attn = dispatch_attention(
+            config, q, k_layer, v_layer, page_table, positions, kv_lens
         )
         x = x + (attn.reshape(b, t, nh * d) @ lp["wo"] + lp["bo"])
         m_in = layer_norm(x, lp["mlp_norm_w"], lp["mlp_norm_b"])

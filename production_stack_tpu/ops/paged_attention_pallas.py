@@ -1,0 +1,159 @@
+"""Pallas TPU kernel: paged decode attention.
+
+The decode hot loop reads a sequence's KV pages from HBM and attends a
+single query token against them. The XLA reference implementation
+(ops/attention.py) gathers the *whole* padded context per step; this
+kernel instead walks the page list with flash-style online softmax:
+
+- grid (batch, pages): page blocks are DMA'd HBM->VMEM one at a time,
+  selected by the scalar-prefetched page table (the Pallas BlockSpec
+  index_map does the "paging" — no materialized gather),
+- running (max, denom, acc) in VMEM scratch across the page walk,
+- pages past the sequence length are masked (they DMA the trash page
+  0, which the allocator never hands out, so the reads are harmless).
+
+Contract matches ops.attention.paged_attention at T=1; the parity test
+(tests/test_pallas_attention.py) checks the two against each other.
+
+Replaces: vLLM's paged_attention CUDA kernels (external to the
+reference repo), re-thought for TPU's DMA+VMEM model.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(page_table_ref, kv_lens_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
+                   num_kv_heads: int, group: int):
+    p = pl.program_id(1)
+    num_page_steps = pl.num_programs(1)
+    b = pl.program_id(0)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # q: [H, D] viewed as [KV, G, D]
+    q = q_ref[0].astype(jnp.float32)
+    head_dim = q.shape[-1]
+    qg = q.reshape(num_kv_heads, group, head_dim)
+    k = k_ref[0].astype(jnp.float32)  # [page, KV, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    scale = 1.0 / (head_dim ** 0.5)
+    # scores: [KV, G, page]
+    scores = jax.lax.dot_general(
+        qg, k,
+        dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    kv_len = kv_lens_ref[b]
+    token_pos = p * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, page_size), 2
+    )
+    scores = jnp.where(token_pos < kv_len, scores, NEG_INF)
+
+    # Online softmax update.
+    m_prev = m_ref[:]  # [KV, G]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    probs = jnp.exp(scores - m_new[..., None])  # [KV, G, page]
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1)
+    # pv: [KV, G, D]
+    pv = jax.lax.dot_general(
+        probs, v,
+        dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[:] = acc_ref[:] * alpha[..., None] + pv
+    m_ref[:] = m_new
+
+    @pl.when(p == num_page_steps - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:], 1e-30)[..., None]
+        out = (acc_ref[:] / denom).reshape(
+            num_kv_heads * group, head_dim
+        )
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
+                           v_cache_layer: jnp.ndarray,
+                           page_table: jnp.ndarray,
+                           kv_lens: jnp.ndarray,
+                           interpret: bool = False) -> jnp.ndarray:
+    """Single-token paged attention.
+
+    Args:
+      q:           [B, num_q_heads, head_dim]
+      k/v_cache_layer: [num_pages, page_size, num_kv_heads, head_dim]
+      page_table:  [B, max_pages] int32 physical page ids
+      kv_lens:     [B] int32 valid cached tokens per sequence
+      interpret:   run in interpreter mode (CPU testing)
+
+    Returns [B, num_q_heads, head_dim].
+    """
+    b, num_q_heads, head_dim = q.shape
+    _, page_size, num_kv_heads, _ = k_cache_layer.shape
+    max_pages = page_table.shape[1]
+    group = num_q_heads // num_kv_heads
+
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=page_size,
+        num_kv_heads=num_kv_heads,
+        group=group,
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, kv_lens
+        grid=(b, max_pages),
+        in_specs=[
+            # q block: one sequence's heads.
+            pl.BlockSpec(
+                (1, num_q_heads, head_dim),
+                lambda bi, pi, pt, kl: (bi, 0, 0),
+            ),
+            # k/v block: ONE physical page, chosen via the page table.
+            pl.BlockSpec(
+                (1, page_size, num_kv_heads, head_dim),
+                lambda bi, pi, pt, kl: (pt[bi, pi], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, num_kv_heads, head_dim),
+                lambda bi, pi, pt, kl: (pt[bi, pi], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_q_heads, head_dim),
+            lambda bi, pi, pt, kl: (bi, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv_heads, group), jnp.float32),  # m
+            pltpu.VMEM((num_kv_heads, group), jnp.float32),  # l
+            pltpu.VMEM((num_kv_heads, group, head_dim),
+                       jnp.float32),  # acc
+        ],
+    )
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (b, num_q_heads, head_dim), q.dtype
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table, kv_lens, q, k_cache_layer, v_cache_layer)

@@ -23,16 +23,12 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# If a PJRT plugin for the TPU tunnel was registered by sitecustomize,
-# drop its factory and undo its jax_platforms config override so no test
+# Undo the TPU-tunnel plugin's jax_platforms config override so no test
 # can accidentally dial the tunnel (sitecustomize runs register(), which
 # does jax.config.update("jax_platforms", "axon,cpu") — config beats env).
 try:
     import jax
     jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge as _xb
-    for _name in ("axon", "tpu"):
-        _xb._backend_factories.pop(_name, None)
 except Exception:
     pass
 

@@ -1,0 +1,117 @@
+"""Pallas paged decode attention vs the XLA reference implementation.
+
+Runs the kernel in interpreter mode (CPU); the same code path compiles
+for real TPU. Ground truth is ops.attention.paged_attention at T=1.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from production_stack_tpu.ops.attention import (  # noqa: E402
+    paged_attention,
+)
+from production_stack_tpu.ops.paged_attention_pallas import (  # noqa: E402
+    paged_decode_attention,
+)
+
+
+def _setup(b=3, num_pages=16, page_size=8, kv_heads=2, q_heads=8,
+           head_dim=64, max_pages=6, seed=0):
+    rng = np.random.RandomState(seed)
+    q = rng.randn(b, q_heads, head_dim).astype(np.float32)
+    k_cache = rng.randn(
+        num_pages, page_size, kv_heads, head_dim
+    ).astype(np.float32)
+    v_cache = rng.randn(
+        num_pages, page_size, kv_heads, head_dim
+    ).astype(np.float32)
+    # Distinct physical pages per sequence (1.. reserved pool).
+    page_table = np.zeros((b, max_pages), np.int32)
+    next_page = 1
+    kv_lens = np.zeros((b,), np.int32)
+    for i in range(b):
+        n_tokens = rng.randint(1, max_pages * page_size)
+        kv_lens[i] = n_tokens
+        n_pages = -(-n_tokens // page_size)
+        for j in range(n_pages):
+            page_table[i, j] = next_page % num_pages or 1
+            next_page += 1
+    return (jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+            jnp.asarray(page_table), jnp.asarray(kv_lens))
+
+
+def test_matches_xla_reference():
+    q, k_cache, v_cache, page_table, kv_lens = _setup()
+    out = paged_decode_attention(
+        q, k_cache, v_cache, page_table, kv_lens, interpret=True
+    )
+    # Reference: T=1 queries positioned at the last cached token.
+    ref = paged_attention(
+        q[:, None], k_cache, v_cache, page_table,
+        (kv_lens - 1)[:, None], kv_lens,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_single_token_sequence():
+    q, k_cache, v_cache, page_table, kv_lens = _setup(b=2, seed=3)
+    kv_lens = jnp.asarray([1, 1], jnp.int32)
+    out = paged_decode_attention(
+        q, k_cache, v_cache, page_table, kv_lens, interpret=True
+    )
+    ref = paged_attention(
+        q[:, None], k_cache, v_cache, page_table,
+        (kv_lens - 1)[:, None], kv_lens,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_gqa_grouping():
+    q, k_cache, v_cache, page_table, kv_lens = _setup(
+        kv_heads=4, q_heads=16, seed=7
+    )
+    out = paged_decode_attention(
+        q, k_cache, v_cache, page_table, kv_lens, interpret=True
+    )
+    ref = paged_attention(
+        q[:, None], k_cache, v_cache, page_table,
+        (kv_lens - 1)[:, None], kv_lens,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_engine_generates_identically_with_pallas_decode(tmp_path):
+    """Greedy generation with the pallas decode path (interpret mode)
+    must match the XLA decode path token for token."""
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    prompt = list(range(1, 40))
+
+    def gen(impl):
+        model = tiny_model_config("llama")
+        model.attention_impl = impl
+        config = EngineConfig(
+            model=model,
+            cache=CacheConfig(page_size=16, num_pages=64),
+            scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
+                                      prefill_chunk_size=64),
+        )
+        engine = LLMEngine(config)
+        seq = engine.generate(prompt, SamplingParams(
+            max_tokens=8, temperature=0.0, ignore_eos=True))
+        return seq.output_token_ids
+
+    assert gen("pallas-interpret") == gen("xla")
