@@ -1,0 +1,137 @@
+"""Remote shared KV cache server (the LMCache server analogue).
+
+The reference deploys ``lmcache_experimental_server`` as a standalone
+Deployment that multiple vLLM pods share KV through
+(helm/templates/deployment-cache-server.yaml:1-52, tutorial 06). This is
+our DCN-tier equivalent: a content-addressed page store over HTTP with
+msgpack framing, LRU-bounded, shared by every engine pod configured
+with ``--kv-remote-url``.
+
+Run: ``python -m production_stack_tpu.engine.cache_server --port 8100``
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+from collections import OrderedDict
+
+from aiohttp import web
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class BlobStore:
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._store: "OrderedDict[str, bytes]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def put(self, key: str, blob: bytes) -> None:
+        with self._lock:
+            old = self._store.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            while self._bytes + len(blob) > self.max_bytes and self._store:
+                _, evicted = self._store.popitem(last=False)
+                self._bytes -= len(evicted)
+            if len(blob) <= self.max_bytes:
+                self._store[key] = blob
+                self._bytes += len(blob)
+
+    def get(self, key: str):
+        with self._lock:
+            blob = self._store.get(key)
+            if blob is not None:
+                self._store.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return blob
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._store
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._store),
+                "bytes": self._bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
+    store = BlobStore(max_bytes)
+
+    async def put_kv(request: web.Request) -> web.Response:
+        blob = await request.read()
+        store.put(request.match_info["key"], blob)
+        return web.Response(status=200)
+
+    async def get_kv(request: web.Request) -> web.Response:
+        blob = store.get(request.match_info["key"])
+        if blob is None:
+            return web.Response(status=404)
+        return web.Response(
+            body=blob, content_type="application/octet-stream"
+        )
+
+    async def head_kv(request: web.Request) -> web.Response:
+        if store.contains(request.match_info["key"]):
+            return web.Response(status=200)
+        return web.Response(status=404)
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def stats(request: web.Request) -> web.Response:
+        return web.json_response(store.stats())
+
+    async def metrics(request: web.Request) -> web.Response:
+        s = store.stats()
+        total = s["hits"] + s["misses"]
+        lines = [
+            "# TYPE kvcache:entries gauge",
+            f"kvcache:entries {s['entries']}",
+            "# TYPE kvcache:bytes gauge",
+            f"kvcache:bytes {s['bytes']}",
+            "# TYPE kvcache:hit_rate gauge",
+            f"kvcache:hit_rate {(s['hits'] / total) if total else 0.0}",
+            "",
+        ]
+        return web.Response(text="\n".join(lines),
+                            content_type="text/plain")
+
+    app = web.Application(client_max_size=256 * 1024 ** 2)
+    app["store"] = store
+    app.router.add_put("/kv/{key}", put_kv)
+    app.router.add_head("/kv/{key}", head_kv)
+    app.router.add_get("/kv/{key}", get_kv, allow_head=False)
+    app.router.add_get("/health", health)
+    app.router.add_get("/stats", stats)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="tpu-kv-cache-server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8100)
+    parser.add_argument("--max-bytes", type=int, default=8 * 1024 ** 3)
+    args = parser.parse_args(argv)
+    logger.info("KV cache server on %s:%d (budget %d MiB)",
+                args.host, args.port, args.max_bytes // 2 ** 20)
+    web.run_app(build_cache_server(args.max_bytes), host=args.host,
+                port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -135,6 +135,15 @@ class ParallelConfig:
 
 
 @dataclasses.dataclass
+class OffloadConfig:
+    """KV offload tiers (the LMCache analogue; see engine/offload.py)."""
+
+    enable: bool = False
+    host_pool_bytes: int = 2 * 1024 ** 3
+    remote_url: Optional[str] = None
+
+
+@dataclasses.dataclass
 class EngineConfig:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
@@ -142,6 +151,8 @@ class EngineConfig:
         default_factory=SchedulerConfig)
     parallel: ParallelConfig = dataclasses.field(
         default_factory=ParallelConfig)
+    offload: OffloadConfig = dataclasses.field(
+        default_factory=OffloadConfig)
     seed: int = 0
 
 

@@ -54,6 +54,71 @@ class LLMEngine:
         self.runner = ModelRunner(config, mesh=mesh, params=params)
         self.sequences: Dict[str, Sequence] = {}
         self._lock = threading.Lock()
+        self.offload = None
+        if config.offload.enable:
+            self._init_offload()
+
+    def _init_offload(self) -> None:
+        from production_stack_tpu.engine.offload import (
+            HostKVPool,
+            KVOffloadManager,
+            RemoteKVClient,
+        )
+        remote = (RemoteKVClient(self.config.offload.remote_url)
+                  if self.config.offload.remote_url else None)
+        self.offload = KVOffloadManager(
+            host_pool=HostKVPool(self.config.offload.host_pool_bytes),
+            remote=remote,
+        )
+        self.cache_manager.evict_listener = self._on_page_evicted
+        self.scheduler.restore_hook = self._restore_offloaded_prefix
+        logger.info("KV offload enabled (host pool %d MiB%s)",
+                    self.config.offload.host_pool_bytes // 2 ** 20,
+                    ", remote tier" if remote else "")
+
+    def _on_page_evicted(self, page_id: int, page_hash) -> None:
+        k_page, v_page = self.runner.read_page(page_id)
+        self.offload.offload_page(page_hash, k_page, v_page)
+
+    def _restore_offloaded_prefix(self, prompt_token_ids,
+                                  matched_pages):
+        """After an in-HBM prefix miss, pull further pages from the
+        host/remote tiers into freshly allocated HBM pages."""
+        from production_stack_tpu.engine.kv_cache import (
+            OutOfPagesError,
+            PagedCacheManager,
+        )
+        usable = len(prompt_token_ids) - 1
+        hashes = PagedCacheManager.chain_hashes(
+            prompt_token_ids[:usable], self.cache_manager.page_size
+        )
+        remaining = hashes[len(matched_pages):]
+        n = self.offload.lookup_chain(remaining)
+        if n == 0:
+            return []
+        try:
+            pages = self.cache_manager.allocate_pages(n)
+        except OutOfPagesError:
+            return []
+        restored = []
+        for page_id, page_hash in zip(pages, remaining[:n]):
+            payload = self.offload.fetch(page_hash)
+            if payload is None:  # tier raced an eviction: stop here
+                self.cache_manager.free_sequence(
+                    pages[len(restored):]
+                )
+                break
+            self.runner.write_page(page_id, *payload)
+            self.cache_manager.register_restored_page(
+                page_id, page_hash
+            )
+            restored.append(page_id)
+        self.offload.restored_pages += len(restored)
+        if restored:
+            self.cache_manager.prefix_hit_tokens += (
+                len(restored) * self.cache_manager.page_size
+            )
+        return restored
 
     # ---- request API ------------------------------------------------------
 
@@ -139,13 +204,19 @@ class LLMEngine:
     # ---- metrics ----------------------------------------------------------
 
     def stats(self) -> Dict[str, float]:
-        return {
+        out = {
             "num_requests_running": self.scheduler.num_running,
             "num_requests_waiting": self.scheduler.num_waiting,
             "gpu_cache_usage_perc": self.cache_manager.usage_perc(),
             "gpu_prefix_cache_hit_rate":
                 self.cache_manager.prefix_hit_rate(),
         }
+        if self.offload is not None:
+            out.update({
+                f"kv_offload_{k}": v
+                for k, v in self.offload.stats().items()
+            })
+        return out
 
     # ---- convenience ------------------------------------------------------
 

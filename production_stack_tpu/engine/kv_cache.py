@@ -57,6 +57,9 @@ class PagedCacheManager:
         self._hash_to_page: Dict[PageHash, int] = {}
         # Zero-ref pages still holding reusable content, LRU order.
         self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        # Fired with (page_id, page_hash) just before a hashed page's
+        # HBM slot is reused — the offload tier's capture point.
+        self.evict_listener = None
         # Stats
         self.prefix_hit_tokens = 0
         self.prefix_query_tokens = 0
@@ -90,6 +93,11 @@ class PagedCacheManager:
             info = self._pages.pop(page_id)
             if info.page_hash is not None:
                 self._hash_to_page.pop(info.page_hash, None)
+                if self.evict_listener is not None:
+                    try:
+                        self.evict_listener(page_id, info.page_hash)
+                    except Exception as e:  # offload is best-effort
+                        logger.warning("KV evict listener failed: %s", e)
         else:
             raise OutOfPagesError("KV cache out of pages")
         self._pages[page_id] = PageInfo(page_id=page_id, ref_count=1)
@@ -182,6 +190,17 @@ class PagedCacheManager:
                 self._hash_to_page[hashes[i]] = page_id
             # If another page already owns this hash we simply leave this
             # page private; dedup happens for future sequences.
+
+    def register_restored_page(self, page_id: int,
+                               page_hash: PageHash) -> None:
+        """A page restored from an offload tier becomes a cached,
+        hash-addressable page (future prompts hit it in HBM)."""
+        info = self._pages.get(page_id)
+        if info is None or info.page_hash is not None:
+            return
+        if page_hash not in self._hash_to_page:
+            info.page_hash = page_hash
+            self._hash_to_page[page_hash] = page_id
 
     def free_sequence(self, pages: List[int]) -> None:
         for page_id in pages:

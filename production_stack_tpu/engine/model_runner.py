@@ -195,6 +195,30 @@ class ModelRunner:
         host = jax.device_get(sampled)
         return [int(host[i]) for i in range(len(seqs))]
 
+    # ---- page-granular IO (offload tiers) ---------------------------------
+
+    def read_page(self, page_id: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Copy one page's KV out of HBM: [L, page_size, kv, d] each."""
+        k = jax.device_get(self.k_cache[:, page_id])
+        v = jax.device_get(self.v_cache[:, page_id])
+        return k, v
+
+    def write_page(self, page_id: int, k_page: np.ndarray,
+                   v_page: np.ndarray) -> None:
+        """Restore one page's KV into HBM (donated in-place update)."""
+        if not hasattr(self, "_write_page_jit"):
+            self._write_page_jit = jax.jit(
+                lambda cache, page, pid:
+                    cache.at[:, pid].set(page.astype(cache.dtype)),
+                donate_argnums=(0,),
+            )
+        self.k_cache = self._write_page_jit(
+            self.k_cache, jnp.asarray(k_page), page_id
+        )
+        self.v_cache = self._write_page_jit(
+            self.v_cache, jnp.asarray(v_page), page_id
+        )
+
     def _page_table_rows(self, seqs: List[Sequence],
                          pad_to: Optional[int] = None) -> np.ndarray:
         rows = pad_to or len(seqs)

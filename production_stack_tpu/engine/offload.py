@@ -1,0 +1,216 @@
+"""KV cache offload tiers: TPU HBM -> host RAM -> remote cache server.
+
+Capability parity with the reference's LMCache integration
+(deployment-vllm-multi.yaml:158-182 env plumbing: LMCACHE_LOCAL_CPU,
+LMCACHE_MAX_LOCAL_CPU_SIZE, LMCACHE_REMOTE_URL/SERDE; tutorials 05/06),
+re-designed for TPU: KV pages move across tiers with
+``jax.device_get``/``jax.device_put`` on page granularity — the JAX
+device API is the DMA path, no CUDA pointers.
+
+Tiers:
+  1. HBM: the paged cache itself (kv_cache.PagedCacheManager).
+  2. Host RAM: ``HostKVPool`` — content-hash-keyed numpy pages with an
+     LRU byte budget (the LMCache "local_cpu" analogue).
+  3. Remote: ``RemoteKVClient`` speaking the cache-server protocol
+     (engine/cache_server.py) over DCN — the shared-KV tier multiple
+     engine pods can hit (tutorial 06 analogue).
+
+Pages are keyed by the same chain hash the prefix cache uses, so a
+page restored from any tier is byte-identical to recomputing prefill.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from production_stack_tpu.engine.kv_cache import PageHash
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+# A page's KV payload: (k, v), each [L, page_size, kv_heads, head_dim].
+PagePayload = Tuple[np.ndarray, np.ndarray]
+
+
+def _stable_key(page_hash: PageHash) -> str:
+    """Serializable, process-independent key for a chain hash."""
+    import hashlib
+    parent, tokens = page_hash
+    raw = f"{parent}:{','.join(map(str, tokens))}".encode()
+    return hashlib.sha256(raw).hexdigest()
+
+
+class HostKVPool:
+    """LRU pool of KV pages in host RAM."""
+
+    def __init__(self, max_bytes: int = 2 * 1024 ** 3):
+        self.max_bytes = max_bytes
+        self._pool: "OrderedDict[str, PagePayload]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._pool)
+
+    @property
+    def used_bytes(self) -> int:
+        return self._bytes
+
+    def put(self, key: str, payload: PagePayload) -> None:
+        k, v = payload
+        size = k.nbytes + v.nbytes
+        with self._lock:
+            if key in self._pool:
+                self._pool.move_to_end(key)
+                return
+            while self._bytes + size > self.max_bytes and self._pool:
+                _, (ek, ev) = self._pool.popitem(last=False)
+                self._bytes -= ek.nbytes + ev.nbytes
+            if size <= self.max_bytes:
+                self._pool[key] = payload
+                self._bytes += size
+
+    def get(self, key: str) -> Optional[PagePayload]:
+        with self._lock:
+            payload = self._pool.get(key)
+            if payload is not None:
+                self._pool.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return payload
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._pool
+
+
+class RemoteKVClient:
+    """Client for the remote shared KV cache server (DCN tier).
+
+    Wire format (engine/cache_server.py): msgpack-framed binary over
+    HTTP — PUT /kv/<key>, GET /kv/<key>, HEAD /kv/<key>.
+    """
+
+    def __init__(self, base_url: str, timeout_s: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        import requests
+        self._session = requests.Session()
+
+    def put(self, key: str, payload: PagePayload) -> bool:
+        import msgpack
+        k, v = payload
+        body = msgpack.packb({
+            "k": k.tobytes(), "v": v.tobytes(),
+            "shape": list(k.shape), "dtype": str(k.dtype),
+        })
+        try:
+            resp = self._session.put(
+                f"{self.base_url}/kv/{key}", data=body,
+                timeout=self.timeout_s,
+            )
+            return resp.status_code == 200
+        except Exception as e:
+            logger.warning("Remote KV put failed: %s", e)
+            return False
+
+    def get(self, key: str) -> Optional[PagePayload]:
+        import msgpack
+        try:
+            resp = self._session.get(
+                f"{self.base_url}/kv/{key}", timeout=self.timeout_s
+            )
+            if resp.status_code != 200:
+                return None
+            obj = msgpack.unpackb(resp.content)
+            shape = tuple(obj["shape"])
+            dtype = np.dtype(obj["dtype"])
+            k = np.frombuffer(obj["k"], dtype).reshape(shape)
+            v = np.frombuffer(obj["v"], dtype).reshape(shape)
+            return k, v
+        except Exception as e:
+            logger.warning("Remote KV get failed: %s", e)
+            return None
+
+    def contains(self, key: str) -> bool:
+        try:
+            resp = self._session.head(
+                f"{self.base_url}/kv/{key}", timeout=self.timeout_s
+            )
+            return resp.status_code == 200
+        except Exception:
+            return False
+
+
+class KVOffloadManager:
+    """Moves KV pages between HBM and the offload tiers.
+
+    Engine integration points:
+    - ``offload_page(page_hash, k_page, v_page)``: called when a hashed
+      page is evicted from HBM (numpy arrays, already device_get).
+    - ``lookup_chain(hashes)``: longest prefix of page hashes available
+      in host/remote tiers (after the in-HBM prefix match misses).
+    - ``fetch(page_hash)``: payload for restoration (device_put done by
+      the model runner, which owns the device arrays).
+    """
+
+    def __init__(self, host_pool: Optional[HostKVPool] = None,
+                 remote: Optional[RemoteKVClient] = None,
+                 write_through_remote: bool = True):
+        self.host = host_pool or HostKVPool()
+        self.remote = remote
+        self.write_through_remote = write_through_remote
+        self.restored_pages = 0
+        self.offloaded_pages = 0
+
+    def offload_page(self, page_hash: PageHash, k_page: np.ndarray,
+                     v_page: np.ndarray) -> None:
+        key = _stable_key(page_hash)
+        self.host.put(key, (k_page, v_page))
+        self.offloaded_pages += 1
+        if self.remote is not None and self.write_through_remote:
+            self.remote.put(key, (k_page, v_page))
+
+    def lookup_chain(self, hashes: List[PageHash]) -> int:
+        """How many leading pages of *hashes* can be restored."""
+        n = 0
+        for page_hash in hashes:
+            key = _stable_key(page_hash)
+            if self.host.contains(key):
+                n += 1
+                continue
+            if self.remote is not None and self.remote.contains(key):
+                n += 1
+                continue
+            break
+        return n
+
+    def fetch(self, page_hash: PageHash) -> Optional[PagePayload]:
+        key = _stable_key(page_hash)
+        payload = self.host.get(key)
+        if payload is not None:
+            return payload
+        if self.remote is not None:
+            payload = self.remote.get(key)
+            if payload is not None:
+                # Promote to the host tier for future hits.
+                self.host.put(key, payload)
+                return payload
+        return None
+
+    def stats(self) -> Dict[str, float]:
+        total = self.host.hits + self.host.misses
+        return {
+            "host_pages": len(self.host),
+            "host_bytes": self.host.used_bytes,
+            "host_hit_rate": (self.host.hits / total) if total else 0.0,
+            "offloaded_pages": self.offloaded_pages,
+            "restored_pages": self.restored_pages,
+        }

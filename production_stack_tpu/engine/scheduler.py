@@ -69,6 +69,9 @@ class Scheduler:
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self._last_was_prefill = False
+        # Optional offload-tier restore hook:
+        # (prompt_token_ids, matched_pages) -> extra restored page ids.
+        self.restore_hook = None
         # Sequences aborted by the scheduler itself (oversized prompts,
         # permanent cache starvation); the engine drains this to emit
         # terminal outputs to their clients.
@@ -162,6 +165,10 @@ class Scheduler:
                 # First touch: reuse cached prefix pages, then allocate
                 # the remainder for the whole prompt up front.
                 matched = self.cache.match_prefix(seq.prompt_token_ids)
+                if self.restore_hook is not None:
+                    matched = matched + self.restore_hook(
+                        seq.prompt_token_ids, matched
+                    )
                 seq.pages = matched
                 seq.num_hashed_pages = len(matched)
                 seq.num_computed_tokens = len(matched) * self.page_size

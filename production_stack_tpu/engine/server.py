@@ -32,6 +32,7 @@ from production_stack_tpu.engine.config import (
     CacheConfig,
     EngineConfig,
     ModelConfig,
+    OffloadConfig,
     ParallelConfig,
     SchedulerConfig,
     tiny_model_config,
@@ -451,6 +452,11 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
         ),
+        offload=OffloadConfig(
+            enable=args.enable_kv_offload or bool(args.kv_remote_url),
+            host_pool_bytes=args.kv_host_pool_bytes,
+            remote_url=args.kv_remote_url,
+        ),
     )
     engine = LLMEngine(config, mesh=mesh, params=params,
                        tokenizer=tokenizer)
@@ -475,6 +481,12 @@ def parse_args(argv=None):
     parser.add_argument("--prefill-chunk-size", type=int, default=512)
     parser.add_argument("--tensor-parallel-size", type=int, default=1)
     parser.add_argument("--disable-prefix-caching", action="store_true")
+    parser.add_argument("--enable-kv-offload", action="store_true",
+                        help="HBM->host-RAM KV offload tier")
+    parser.add_argument("--kv-host-pool-bytes", type=int,
+                        default=2 * 1024 ** 3)
+    parser.add_argument("--kv-remote-url", default=None,
+                        help="Remote shared KV cache server URL")
     return parser.parse_args(argv)
 
 
