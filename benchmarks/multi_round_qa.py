@@ -1,0 +1,300 @@
+"""Multi-round QA load generator (capability parity with reference
+benchmarks/multi-round-qa.py:1-661, asyncio-native rebuild).
+
+Simulated users arrive with lognormal inter-arrival gaps; each runs R
+chat rounds against an OpenAI-compatible endpoint, replaying its growing
+history, streaming the answer and recording TTFT (first chunk), ITL and
+generation throughput. Session affinity and admission hints ride the
+same headers the reference uses: ``x-user-id`` and ``x-prefill-tokens``.
+
+Outputs a console summary + optional per-request CSV. ShareGPT mode
+replays real conversations with optional length inflation.
+
+Example:
+  python benchmarks/multi_round_qa.py \\
+      --base-url http://localhost:8001 --model tiny-llama \\
+      --num-users 10 --num-rounds 3 --qps 1.0 --answer-len 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import aiohttp
+
+
+@dataclass
+class RequestRecord:
+    user_id: str
+    round_idx: int
+    start_time: float
+    ttft: float = -1.0
+    finish_time: float = -1.0
+    prompt_tokens: int = 0
+    gen_tokens: int = 0
+    error: Optional[str] = None
+
+    @property
+    def latency(self) -> float:
+        return self.finish_time - self.start_time
+
+    @property
+    def gen_time(self) -> float:
+        return self.finish_time - (self.start_time + self.ttft)
+
+
+@dataclass
+class Workload:
+    base_url: str
+    model: str
+    num_users: int = 10
+    num_rounds: int = 3
+    qps: float = 1.0  # user arrival rate
+    system_prompt_len: int = 100  # words
+    chat_history_len: int = 200  # words per round of context growth
+    answer_len: int = 64  # max_tokens per round
+    sharegpt_path: Optional[str] = None
+    inflation_ratio: float = 0.0  # fraction of rounds inflated
+    inflation_factor: int = 10
+    ignore_eos: bool = True
+    seed: int = 0
+
+
+def _words(rng: random.Random, n: int) -> str:
+    return " ".join(f"w{rng.randint(0, 9999)}" for _ in range(n))
+
+
+class UserSession:
+    def __init__(self, workload: Workload, user_id: str,
+                 session: aiohttp.ClientSession,
+                 records: List[RequestRecord],
+                 conversation: Optional[List[dict]] = None):
+        self.w = workload
+        self.user_id = user_id
+        self.http = session
+        self.records = records
+        self.rng = random.Random(hash(user_id) ^ workload.seed)
+        self.messages: List[dict] = [{
+            "role": "system",
+            "content": _words(self.rng, workload.system_prompt_len),
+        }]
+        self.conversation = conversation  # ShareGPT turns, if any
+
+    def _next_question(self, round_idx: int) -> str:
+        if self.conversation is not None:
+            text = self.conversation[
+                round_idx % len(self.conversation)
+            ]
+        else:
+            text = _words(self.rng, self.w.chat_history_len)
+        if (self.w.inflation_ratio > 0
+                and self.rng.random() < self.w.inflation_ratio):
+            text = " ".join([text] * self.w.inflation_factor)
+        return text
+
+    async def run(self) -> None:
+        for round_idx in range(self.w.num_rounds):
+            self.messages.append({
+                "role": "user",
+                "content": self._next_question(round_idx),
+            })
+            record = RequestRecord(
+                user_id=self.user_id, round_idx=round_idx,
+                start_time=time.time(),
+            )
+            self.records.append(record)
+            prefill_estimate = sum(
+                len(m["content"].split()) for m in self.messages
+            ) * 2  # crude words->tokens
+            record.prompt_tokens = prefill_estimate
+            try:
+                answer = await self._stream_round(
+                    record, prefill_estimate
+                )
+                self.messages.append(
+                    {"role": "assistant", "content": answer}
+                )
+            except Exception as e:
+                record.error = str(e)
+                record.finish_time = time.time()
+                return
+
+    async def _stream_round(self, record: RequestRecord,
+                            prefill_estimate: int) -> str:
+        payload = {
+            "model": self.w.model,
+            "messages": self.messages,
+            "max_tokens": self.w.answer_len,
+            "stream": True,
+            "temperature": 0.0,
+        }
+        if self.w.ignore_eos:
+            payload["ignore_eos"] = True
+        headers = {
+            "x-user-id": self.user_id,
+            "x-prefill-tokens": str(prefill_estimate),
+        }
+        pieces: List[str] = []
+        async with self.http.post(
+            f"{self.w.base_url}/v1/chat/completions",
+            json=payload, headers=headers,
+            timeout=aiohttp.ClientTimeout(total=600),
+        ) as resp:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"HTTP {resp.status}: {(await resp.text())[:200]}"
+                )
+            async for raw_line in resp.content:
+                line = raw_line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data == "[DONE]":
+                    break
+                try:
+                    chunk = json.loads(data)
+                except json.JSONDecodeError:
+                    continue
+                delta = chunk["choices"][0].get("delta", {})
+                content = delta.get("content")
+                if content:
+                    if record.ttft < 0:
+                        record.ttft = time.time() - record.start_time
+                    record.gen_tokens += 1
+                    pieces.append(content)
+        record.finish_time = time.time()
+        if record.ttft < 0:  # no content chunks (very short answers)
+            record.ttft = record.finish_time - record.start_time
+        return "".join(pieces)
+
+
+async def run_benchmark(workload: Workload) -> List[RequestRecord]:
+    records: List[RequestRecord] = []
+    rng = random.Random(workload.seed)
+    conversations = None
+    if workload.sharegpt_path:
+        with open(workload.sharegpt_path) as f:
+            conversations = json.load(f)
+
+    async with aiohttp.ClientSession() as http:
+        tasks = []
+        for i in range(workload.num_users):
+            conv = None
+            if conversations:
+                entry = conversations[i % len(conversations)]
+                conv = [t["value"] for t in entry.get(
+                    "conversations", []
+                ) if t.get("from") == "human"] or ["hello"]
+            user = UserSession(
+                workload, f"user-{i}", http, records, conv
+            )
+            tasks.append(asyncio.create_task(user.run()))
+            # Lognormal inter-arrival gaps with mean 1/qps (matches the
+            # reference's arrival process shape).
+            if workload.qps > 0 and i < workload.num_users - 1:
+                mean_gap = 1.0 / workload.qps
+                gap = rng.lognormvariate(0, 0.5)
+                await asyncio.sleep(gap * mean_gap / 1.13)  # E[ln N]
+        await asyncio.gather(*tasks)
+    return records
+
+
+def summarize(records: List[RequestRecord],
+              wall_time: float) -> dict:
+    ok = [r for r in records if r.error is None and r.finish_time > 0]
+    errors = [r for r in records if r.error is not None]
+    if not ok:
+        return {"completed": 0, "errors": len(errors)}
+    ttfts = sorted(r.ttft for r in ok)
+    latencies = sorted(r.latency for r in ok)
+    gen_tokens = sum(r.gen_tokens for r in ok)
+    prompt_tokens = sum(r.prompt_tokens for r in ok)
+
+    def pct(values, p):
+        return values[min(len(values) - 1, int(p * len(values)))]
+
+    return {
+        "completed": len(ok),
+        "errors": len(errors),
+        "wall_time_s": round(wall_time, 2),
+        "req_per_s": round(len(ok) / wall_time, 3),
+        "avg_ttft_s": round(sum(ttfts) / len(ttfts), 4),
+        "p50_ttft_s": round(pct(ttfts, 0.50), 4),
+        "p90_ttft_s": round(pct(ttfts, 0.90), 4),
+        "p99_ttft_s": round(pct(ttfts, 0.99), 4),
+        "avg_latency_s": round(
+            sum(latencies) / len(latencies), 4),
+        "gen_tokens_per_s": round(gen_tokens / wall_time, 1),
+        "prompt_tokens_per_s": round(prompt_tokens / wall_time, 1),
+        "avg_gen_throughput_per_req": round(
+            sum(r.gen_tokens / max(r.gen_time, 1e-6) for r in ok)
+            / len(ok), 1),
+    }
+
+
+def write_csv(records: List[RequestRecord], path: str) -> None:
+    import csv
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow([
+            "user_id", "round", "start_time", "ttft", "latency",
+            "prompt_tokens", "gen_tokens", "error",
+        ])
+        for r in records:
+            writer.writerow([
+                r.user_id, r.round_idx, r.start_time, r.ttft,
+                r.latency if r.finish_time > 0 else -1,
+                r.prompt_tokens, r.gen_tokens, r.error or "",
+            ])
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--base-url", required=True)
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--num-users", type=int, default=10)
+    parser.add_argument("--num-rounds", type=int, default=3)
+    parser.add_argument("--qps", type=float, default=1.0)
+    parser.add_argument("--system-prompt-len", type=int, default=100)
+    parser.add_argument("--chat-history-len", type=int, default=200)
+    parser.add_argument("--answer-len", type=int, default=64)
+    parser.add_argument("--sharegpt", default=None)
+    parser.add_argument("--inflation-ratio", type=float, default=0.0)
+    parser.add_argument("--inflation-factor", type=int, default=10)
+    parser.add_argument("--no-ignore-eos", action="store_true")
+    parser.add_argument("--output-csv", default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    workload = Workload(
+        base_url=args.base_url.rstrip("/"),
+        model=args.model,
+        num_users=args.num_users,
+        num_rounds=args.num_rounds,
+        qps=args.qps,
+        system_prompt_len=args.system_prompt_len,
+        chat_history_len=args.chat_history_len,
+        answer_len=args.answer_len,
+        sharegpt_path=args.sharegpt,
+        inflation_ratio=args.inflation_ratio,
+        inflation_factor=args.inflation_factor,
+        ignore_eos=not args.no_ignore_eos,
+        seed=args.seed,
+    )
+    t0 = time.time()
+    records = asyncio.run(run_benchmark(workload))
+    summary = summarize(records, time.time() - t0)
+    print(json.dumps(summary, indent=2))
+    if args.output_csv:
+        write_csv(records, args.output_csv)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Plot TTFT / throughput vs offered QPS from sweep.sh outputs
+(parity: reference benchmarks/plot_pretty.py / plot_single.py)."""
+
+import argparse
+import glob
+import json
+import os
+
+
+def load_points(directory):
+    points = []
+    for path in sorted(glob.glob(os.path.join(directory, "qps_*.json"))):
+        qps = float(
+            os.path.basename(path)[len("qps_"):-len(".json")]
+        )
+        with open(path) as f:
+            summary = json.load(f)
+        if summary.get("completed"):
+            points.append((qps, summary))
+    return points
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dir", default="sweep-results")
+    parser.add_argument("--output", default=None)
+    args = parser.parse_args(argv)
+
+    points = load_points(args.dir)
+    if not points:
+        print("No sweep results found in", args.dir)
+        return
+
+    print(f"{'QPS':>6} {'req/s':>8} {'p50 TTFT':>10} "
+          f"{'p90 TTFT':>10} {'gen tok/s':>10}")
+    for qps, s in points:
+        print(f"{qps:>6} {s['req_per_s']:>8} {s['p50_ttft_s']:>10} "
+              f"{s['p90_ttft_s']:>10} {s['gen_tokens_per_s']:>10}")
+
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("(matplotlib unavailable; table only)")
+        return
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4))
+    xs = [p[0] for p in points]
+    ax1.plot(xs, [p[1]["p50_ttft_s"] for p in points],
+             marker="o", label="p50")
+    ax1.plot(xs, [p[1]["p90_ttft_s"] for p in points],
+             marker="s", label="p90")
+    ax1.set_xlabel("offered QPS")
+    ax1.set_ylabel("TTFT (s)")
+    ax1.legend()
+    ax1.grid(alpha=0.3)
+    ax2.plot(xs, [p[1]["gen_tokens_per_s"] for p in points],
+             marker="o")
+    ax2.set_xlabel("offered QPS")
+    ax2.set_ylabel("generation tokens/s")
+    ax2.grid(alpha=0.3)
+    out = args.output or os.path.join(args.dir, "sweep.png")
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print("Plot saved to", out)
+
+
+if __name__ == "__main__":
+    main()

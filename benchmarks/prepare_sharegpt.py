@@ -1,0 +1,62 @@
+"""ShareGPT dataset preparation (parity: benchmarks/cleanup_sharegpt.py).
+
+Filters a ShareGPT JSON dump to conversations whose turns fit a token
+budget, using whitespace-token counts (no tokenizer download needed) or
+an HF tokenizer from a local path.
+
+  python benchmarks/prepare_sharegpt.py --input sharegpt.json \\
+      --output sharegpt_clean.json --max-tokens 4096 --min-rounds 2
+"""
+
+import argparse
+import json
+
+
+def count_tokens(text: str, tokenizer=None) -> int:
+    if tokenizer is not None:
+        return len(tokenizer.encode(text))
+    return max(1, int(len(text.split()) * 1.3))
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--input", required=True)
+    parser.add_argument("--output", required=True)
+    parser.add_argument("--max-tokens", type=int, default=4096)
+    parser.add_argument("--min-rounds", type=int, default=2)
+    parser.add_argument("--max-conversations", type=int, default=None)
+    parser.add_argument("--tokenizer", default=None,
+                        help="Local HF tokenizer path (optional)")
+    args = parser.parse_args(argv)
+
+    tokenizer = None
+    if args.tokenizer:
+        from production_stack_tpu.engine.tokenizer import HFTokenizer
+        tokenizer = HFTokenizer(args.tokenizer)
+
+    with open(args.input) as f:
+        data = json.load(f)
+
+    kept = []
+    for entry in data:
+        turns = entry.get("conversations", [])
+        human_turns = [t for t in turns if t.get("from") == "human"]
+        if len(human_turns) < args.min_rounds:
+            continue
+        total = sum(
+            count_tokens(t.get("value", ""), tokenizer) for t in turns
+        )
+        if total > args.max_tokens:
+            continue
+        kept.append(entry)
+        if (args.max_conversations
+                and len(kept) >= args.max_conversations):
+            break
+
+    with open(args.output, "w") as f:
+        json.dump(kept, f)
+    print(f"Kept {len(kept)}/{len(data)} conversations")
+
+
+if __name__ == "__main__":
+    main()

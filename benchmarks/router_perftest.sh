@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Router-overhead perf rig (parity: reference src/tests/perftest/*):
+# N fake engines at a configurable token rate, the router in front,
+# multi-round load through it. Measures pure router overhead with zero
+# accelerators.
+#
+# Usage: ./router_perftest.sh [num-engines] [speed-tok/s] [qps]
+set -euo pipefail
+
+N="${1:-4}"
+SPEED="${2:-500}"
+QPS="${3:-5}"
+MODEL="perf/model"
+BASE_PORT=9100
+ROUTER_PORT=8201
+DIR="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$DIR"
+
+PIDS=()
+cleanup() { kill "${PIDS[@]}" 2>/dev/null || true; }
+trap cleanup EXIT
+
+BACKENDS=""
+MODELS=""
+for i in $(seq 0 $((N - 1))); do
+  port=$((BASE_PORT + i))
+  python -m production_stack_tpu.testing.fake_engine \
+    --port "$port" --model "$MODEL" --speed "$SPEED" --ttft 0.02 &
+  PIDS+=($!)
+  BACKENDS+="http://127.0.0.1:${port},"
+  MODELS+="${MODEL},"
+done
+
+python -m production_stack_tpu.router.app --port "$ROUTER_PORT" \
+  --service-discovery static \
+  --static-backends "${BACKENDS%,}" \
+  --static-models "${MODELS%,}" \
+  --routing-logic session --session-key x-user-id \
+  --engine-stats-interval 5 &
+PIDS+=($!)
+sleep 3
+
+python benchmarks/multi_round_qa.py \
+  --base-url "http://127.0.0.1:${ROUTER_PORT}" --model "$MODEL" \
+  --num-users 20 --num-rounds 3 --qps "$QPS" \
+  --system-prompt-len 100 --chat-history-len 100 --answer-len 50

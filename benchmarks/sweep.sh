@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# QPS sweep driver (parity: reference benchmarks/run.sh / run_single.sh).
+# Runs multi_round_qa.py at increasing offered QPS against a serving
+# endpoint and collects one summary JSON per point.
+#
+# Usage: ./sweep.sh <base-url> <model> [output-dir]
+set -euo pipefail
+
+BASE_URL="${1:?usage: sweep.sh <base-url> <model> [output-dir]}"
+MODEL="${2:?usage: sweep.sh <base-url> <model> [output-dir]}"
+OUT="${3:-sweep-results}"
+mkdir -p "$OUT"
+
+# Reference workload shape (run.sh:14-60): long shared system prompt,
+# growing per-user history, fixed answer length, rising QPS.
+QPS_POINTS=(0.1 0.5 1.1 2.1 3.1 4.1)
+NUM_USERS=20
+NUM_ROUNDS=5
+SYSTEM_PROMPT=500   # words
+CHAT_HISTORY=200    # words
+ANSWER_LEN=100
+
+# Warmup: long-history users to populate caches (run.sh warmup phase).
+python "$(dirname "$0")/multi_round_qa.py" \
+  --base-url "$BASE_URL" --model "$MODEL" \
+  --num-users 5 --num-rounds 2 --qps 2 \
+  --system-prompt-len "$SYSTEM_PROMPT" \
+  --chat-history-len "$CHAT_HISTORY" \
+  --answer-len 16 > "$OUT/warmup.json"
+
+for qps in "${QPS_POINTS[@]}"; do
+  echo "=== sweep point qps=$qps ==="
+  python "$(dirname "$0")/multi_round_qa.py" \
+    --base-url "$BASE_URL" --model "$MODEL" \
+    --num-users "$NUM_USERS" --num-rounds "$NUM_ROUNDS" \
+    --qps "$qps" \
+    --system-prompt-len "$SYSTEM_PROMPT" \
+    --chat-history-len "$CHAT_HISTORY" \
+    --answer-len "$ANSWER_LEN" \
+    --output-csv "$OUT/qps_${qps}.csv" \
+    | tee "$OUT/qps_${qps}.json"
+done
+
+python "$(dirname "$0")/plot_sweep.py" --dir "$OUT" || true
+echo "Results in $OUT/"
