@@ -1,0 +1,21 @@
+{{/* Common labels */}}
+{{- define "tpu-stack.labels" -}}
+helm.sh/chart: {{ .Chart.Name }}-{{ .Chart.Version }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+release: {{ .Release.Name }}
+{{- end }}
+
+{{/* Engine pod selector labels (the router's discovery matches these) */}}
+{{- define "tpu-stack.engineLabels" -}}
+environment: serving
+release: {{ .Release.Name }}
+{{- end }}
+
+{{- define "tpu-stack.serviceAccountName" -}}
+{{- if .Values.serviceAccount.name }}
+{{- .Values.serviceAccount.name }}
+{{- else }}
+{{- printf "%s-sa" .Release.Name }}
+{{- end }}
+{{- end }}

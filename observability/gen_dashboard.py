@@ -1,0 +1,84 @@
+"""Generates the Grafana dashboard JSON (tpu-stack-dashboard.json).
+
+Panel set mirrors the reference's vllm-dashboard.json capability
+(available instances, latency/TTFT, QPS, prefill/decode counts,
+running/waiting, KV usage + prefix hit rate, block accounting) with
+TPU naming (HBM KV instead of "GPU KV").
+
+Run: python observability/gen_dashboard.py > observability/tpu-stack-dashboard.json
+"""
+
+import json
+
+
+def target(expr, legend="{{server}}"):
+    return {"expr": expr, "legendFormat": legend}
+
+
+def panel(panel_id, title, targets, x, y, w=8, h=7, unit=None,
+          kind="timeseries"):
+    p = {
+        "id": panel_id,
+        "title": title,
+        "type": kind,
+        "datasource": {"type": "prometheus", "uid": "prometheus"},
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "targets": targets,
+        "fieldConfig": {"defaults": {}, "overrides": []},
+    }
+    if unit:
+        p["fieldConfig"]["defaults"]["unit"] = unit
+    return p
+
+
+def build():
+    panels = [
+        panel(1, "Healthy Serving Engines",
+              [target('sum(vllm:healthy_pods_total)', "engines")],
+              0, 0, w=6, kind="stat"),
+        panel(2, "Router QPS per Engine",
+              [target('vllm:current_qps')], 6, 0, w=9, unit="reqps"),
+        panel(3, "Average Request Latency",
+              [target('vllm:avg_latency')], 15, 0, w=9, unit="s"),
+        panel(4, "Prefill Requests (router view)",
+              [target('vllm:num_prefill_requests')], 0, 7),
+        panel(5, "Decoding Requests (router view)",
+              [target('vllm:num_decoding_requests')], 8, 7),
+        panel(6, "Average Decoding Length",
+              [target('vllm:avg_decoding_length')], 16, 7, unit="s"),
+        panel(7, "Engine Running Requests",
+              [target('vllm:num_requests_running')], 0, 14),
+        panel(8, "Engine Waiting Requests",
+              [target('vllm:num_requests_waiting')], 8, 14),
+        panel(9, "HBM KV Cache Usage",
+              [target('vllm:gpu_cache_usage_perc')], 16, 14,
+              unit="percentunit"),
+        panel(10, "Prefix Cache Hit Rate",
+              [target('vllm:gpu_prefix_cache_hit_rate')], 0, 21,
+              unit="percentunit"),
+        panel(11, "KV Blocks (allocated / reserved / free)",
+              [target('vllm:allocated_blocks', "alloc {{server}}"),
+               target('vllm:pending_reserved_blocks',
+                      "reserved {{server}}"),
+               target('vllm:num_free_blocks', "free {{server}}")],
+              8, 21),
+        panel(12, "Swapped Requests",
+              [target('vllm:num_requests_swapped')], 16, 21),
+        panel(13, "Inter-Token Latency",
+              [target('vllm:avg_itl')], 0, 28, unit="s"),
+    ]
+    return {
+        "title": "TPU Stack — Serving Overview",
+        "uid": "tpu-stack-overview",
+        "schemaVersion": 39,
+        "version": 1,
+        "refresh": "15s",
+        "time": {"from": "now-30m", "to": "now"},
+        "tags": ["tpu-stack", "llm"],
+        "panels": panels,
+        "templating": {"list": []},
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(build(), indent=2))

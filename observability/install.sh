@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Install kube-prometheus-stack + prometheus-adapter wired for the
+# tpu-stack metrics (parity: reference observability/install.sh).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+helm repo add prometheus-community \
+  https://prometheus-community.github.io/helm-charts || true
+helm repo update
+
+helm upgrade --install kube-prom-stack \
+  prometheus-community/kube-prometheus-stack \
+  --namespace monitoring --create-namespace \
+  -f kube-prom-stack.yaml
+
+helm upgrade --install prometheus-adapter \
+  prometheus-community/prometheus-adapter \
+  --namespace monitoring \
+  -f prom-adapter.yaml
+
+echo "Grafana dashboard: import tpu-stack-dashboard.json"
