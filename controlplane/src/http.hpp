@@ -1,0 +1,223 @@
+// Minimal blocking HTTP/1.1 client over POSIX sockets.
+//
+// Used for (a) router /health probes — parity with the reference operator's
+// checkRouterHealth (src/router-controller/internal/controller/
+// staticroute_controller.go:186+) — and (b) Kubernetes API calls through a
+// kubectl-proxy sidecar (plain HTTP on localhost), which keeps the agent
+// free of TLS dependencies. Supports GET/POST/PUT/PATCH with bodies,
+// Content-Length and chunked responses, and per-request timeouts.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cerrno>
+#include <cstring>
+#include <ctime>
+#include <sstream>
+#include <string>
+
+namespace cphttp {
+
+struct Url {
+  std::string host;
+  std::string port = "80";
+  std::string path = "/";
+  bool valid = false;
+};
+
+inline Url parse_url(const std::string& url) {
+  Url out;
+  std::string rest = url;
+  const std::string scheme = "http://";
+  if (rest.rfind(scheme, 0) != 0) return out;  // https is not supported
+  rest = rest.substr(scheme.size());
+  size_t slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest
+                                                    : rest.substr(0, slash);
+  out.path = slash == std::string::npos ? "/" : rest.substr(slash);
+  size_t colon = hostport.rfind(':');
+  if (colon != std::string::npos) {
+    out.host = hostport.substr(0, colon);
+    out.port = hostport.substr(colon + 1);
+  } else {
+    out.host = hostport;
+  }
+  out.valid = !out.host.empty();
+  return out;
+}
+
+struct Response {
+  bool ok = false;          // transport-level success
+  int status = 0;           // HTTP status code
+  std::string body;
+  std::string error;        // transport error description when !ok
+};
+
+class Connection {
+ public:
+  ~Connection() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool connect(const Url& url, int timeout_s, std::string* error) {
+    struct addrinfo hints;
+    std::memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    int rc = ::getaddrinfo(url.host.c_str(), url.port.c_str(), &hints, &res);
+    if (rc != 0) {
+      *error = std::string("resolve: ") + gai_strerror(rc);
+      return false;
+    }
+    for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+      fd_ = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd_ < 0) continue;
+      set_timeouts(timeout_s);
+      if (::connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) {
+        ::freeaddrinfo(res);
+        return true;
+      }
+      ::close(fd_);
+      fd_ = -1;
+    }
+    ::freeaddrinfo(res);
+    *error = "connect: " + std::string(std::strerror(errno));
+    return false;
+  }
+
+  bool send_all(const std::string& data, std::string* error) {
+    size_t off = 0;
+    while (off < data.size()) {
+      ssize_t n = ::send(fd_, data.data() + off, data.size() - off, 0);
+      if (n <= 0) {
+        *error = "send: " + std::string(std::strerror(errno));
+        return false;
+      }
+      off += size_t(n);
+    }
+    return true;
+  }
+
+  // Reads until EOF (responses use Connection: close), bounded by an
+  // overall deadline: SO_RCVTIMEO alone is per-recv(), so a peer dripping
+  // bytes slower than the timeout would otherwise stall the reconcile
+  // loop forever.
+  bool recv_all(std::string* out, int timeout_s, std::string* error) {
+    char buf[8192];
+    std::time_t deadline = std::time(nullptr) + timeout_s;
+    while (true) {
+      if (std::time(nullptr) >= deadline) {
+        *error = "recv: overall deadline exceeded";
+        return false;
+      }
+      ssize_t n = ::recv(fd_, buf, sizeof(buf), 0);
+      if (n < 0) {
+        *error = "recv: " + std::string(std::strerror(errno));
+        return false;
+      }
+      if (n == 0) return true;
+      out->append(buf, size_t(n));
+    }
+  }
+
+ private:
+  int fd_ = -1;
+
+  void set_timeouts(int timeout_s) {
+    struct timeval tv;
+    tv.tv_sec = timeout_s;
+    tv.tv_usec = 0;
+    ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+};
+
+inline std::string dechunk(const std::string& body) {
+  std::string out;
+  size_t pos = 0;
+  while (pos < body.size()) {
+    size_t eol = body.find("\r\n", pos);
+    if (eol == std::string::npos) break;
+    unsigned long len = 0;
+    try {
+      len = std::stoul(body.substr(pos, eol - pos), nullptr, 16);
+    } catch (const std::exception&) {
+      break;
+    }
+    if (len == 0) break;
+    out.append(body, eol + 2, len);
+    pos = eol + 2 + len + 2;  // skip chunk + trailing CRLF
+  }
+  return out;
+}
+
+inline Response request(const std::string& method, const std::string& url_str,
+                        const std::string& body = "",
+                        const std::string& content_type = "application/json",
+                        int timeout_s = 5) {
+  Response resp;
+  Url url = parse_url(url_str);
+  if (!url.valid) {
+    resp.error = "bad url (only http:// is supported): " + url_str;
+    return resp;
+  }
+
+  std::ostringstream req;
+  req << method << ' ' << url.path << " HTTP/1.1\r\n"
+      << "Host: " << url.host << ':' << url.port << "\r\n"
+      << "Connection: close\r\n"
+      << "Accept: application/json\r\n";
+  if (!body.empty() || method == "POST" || method == "PUT" ||
+      method == "PATCH") {
+    req << "Content-Type: " << content_type << "\r\n"
+        << "Content-Length: " << body.size() << "\r\n";
+  }
+  req << "\r\n" << body;
+
+  Connection conn;
+  if (!conn.connect(url, timeout_s, &resp.error)) return resp;
+  if (!conn.send_all(req.str(), &resp.error)) return resp;
+  std::string raw;
+  if (!conn.recv_all(&raw, timeout_s, &resp.error)) return resp;
+
+  size_t header_end = raw.find("\r\n\r\n");
+  if (header_end == std::string::npos) {
+    resp.error = "malformed response";
+    return resp;
+  }
+  std::string headers = raw.substr(0, header_end);
+  resp.body = raw.substr(header_end + 4);
+
+  size_t sp = headers.find(' ');
+  if (sp == std::string::npos) {
+    resp.error = "malformed status line";
+    return resp;
+  }
+  try {
+    resp.status = std::stoi(headers.substr(sp + 1, 3));
+  } catch (const std::exception&) {
+    resp.error = "malformed status code";
+    return resp;
+  }
+
+  // Lower-case the header block once for case-insensitive matching.
+  std::string lower = headers;
+  for (char& c : lower) c = char(tolower((unsigned char)c));
+  if (lower.find("transfer-encoding: chunked") != std::string::npos)
+    resp.body = dechunk(resp.body);
+
+  resp.ok = true;
+  return resp;
+}
+
+inline Response get(const std::string& url, int timeout_s = 5) {
+  return request("GET", url, "", "", timeout_s);
+}
+
+}  // namespace cphttp

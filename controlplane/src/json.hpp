@@ -1,0 +1,428 @@
+// Minimal JSON parse/serialize for the control-plane agent.
+//
+// Parity note: the reference operator (Go) marshals its DynamicConfig with
+// encoding/json (src/router-controller/internal/controller/
+// staticroute_controller.go:146-150). We need the same round-trip in C++
+// with zero external dependencies, so this header implements the subset of
+// JSON the agent exchanges with the router and the Kubernetes API:
+// objects, arrays, strings (with escapes), numbers, booleans, null.
+#pragma once
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace cpjson {
+
+class Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+enum class Type { Null, Bool, Number, String, Array, Object };
+
+class Value {
+ public:
+  Type type = Type::Null;
+  bool boolean = false;
+  double number = 0.0;
+  std::string str;
+  std::vector<ValuePtr> arr;
+  // std::map keeps keys sorted -> deterministic serialization, which the
+  // reconciler relies on for change detection via content digests.
+  std::map<std::string, ValuePtr> obj;
+
+  static ValuePtr make_null() { return std::make_shared<Value>(); }
+  static ValuePtr make_bool(bool b) {
+    auto v = std::make_shared<Value>();
+    v->type = Type::Bool;
+    v->boolean = b;
+    return v;
+  }
+  static ValuePtr make_number(double d) {
+    auto v = std::make_shared<Value>();
+    v->type = Type::Number;
+    v->number = d;
+    return v;
+  }
+  static ValuePtr make_string(const std::string& s) {
+    auto v = std::make_shared<Value>();
+    v->type = Type::String;
+    v->str = s;
+    return v;
+  }
+  static ValuePtr make_array() {
+    auto v = std::make_shared<Value>();
+    v->type = Type::Array;
+    return v;
+  }
+  static ValuePtr make_object() {
+    auto v = std::make_shared<Value>();
+    v->type = Type::Object;
+    return v;
+  }
+
+  bool is_null() const { return type == Type::Null; }
+  bool is_object() const { return type == Type::Object; }
+  bool is_array() const { return type == Type::Array; }
+  bool is_string() const { return type == Type::String; }
+
+  // Object accessors with defaults (missing key or wrong type -> default).
+  ValuePtr get(const std::string& key) const {
+    auto it = obj.find(key);
+    return it == obj.end() ? nullptr : it->second;
+  }
+  std::string get_string(const std::string& key,
+                         const std::string& dflt = "") const {
+    auto v = get(key);
+    return (v && v->type == Type::String) ? v->str : dflt;
+  }
+  double get_number(const std::string& key, double dflt = 0.0) const {
+    auto v = get(key);
+    return (v && v->type == Type::Number) ? v->number : dflt;
+  }
+  bool get_bool(const std::string& key, bool dflt = false) const {
+    auto v = get(key);
+    return (v && v->type == Type::Bool) ? v->boolean : dflt;
+  }
+  void set(const std::string& key, ValuePtr v) { obj[key] = v; }
+  void set_string(const std::string& key, const std::string& s) {
+    obj[key] = make_string(s);
+  }
+  void set_number(const std::string& key, double d) {
+    obj[key] = make_number(d);
+  }
+  void set_bool(const std::string& key, bool b) { obj[key] = make_bool(b); }
+};
+
+// ---------------------------------------------------------------- parsing
+
+class ParseError : public std::runtime_error {
+ public:
+  explicit ParseError(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : text_(text) {}
+
+  ValuePtr parse() {
+    skip_ws();
+    ValuePtr v = parse_value();
+    skip_ws();
+    if (pos_ != text_.size()) throw ParseError("trailing data");
+    return v;
+  }
+
+ private:
+  const std::string& text_;
+  size_t pos_ = 0;
+
+  [[noreturn]] void fail(const std::string& what) {
+    throw ParseError(what + " at offset " + std::to_string(pos_));
+  }
+  char peek() {
+    if (pos_ >= text_.size()) fail("unexpected end");
+    return text_[pos_];
+  }
+  char next() {
+    char c = peek();
+    ++pos_;
+    return c;
+  }
+  void skip_ws() {
+    while (pos_ < text_.size()) {
+      char c = text_[pos_];
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r')
+        ++pos_;
+      else
+        break;
+    }
+  }
+  void expect(char c) {
+    if (next() != c) fail(std::string("expected '") + c + "'");
+  }
+  void expect_word(const char* w) {
+    for (const char* p = w; *p; ++p)
+      if (pos_ >= text_.size() || text_[pos_++] != *p)
+        fail(std::string("expected '") + w + "'");
+  }
+
+  ValuePtr parse_value() {
+    switch (peek()) {
+      case '{':
+        return parse_object();
+      case '[':
+        return parse_array();
+      case '"':
+        return Value::make_string(parse_string());
+      case 't':
+        expect_word("true");
+        return Value::make_bool(true);
+      case 'f':
+        expect_word("false");
+        return Value::make_bool(false);
+      case 'n':
+        expect_word("null");
+        return Value::make_null();
+      default:
+        return parse_number();
+    }
+  }
+
+  ValuePtr parse_object() {
+    expect('{');
+    auto v = Value::make_object();
+    skip_ws();
+    if (peek() == '}') {
+      ++pos_;
+      return v;
+    }
+    while (true) {
+      skip_ws();
+      std::string key = parse_string();
+      skip_ws();
+      expect(':');
+      skip_ws();
+      v->obj[key] = parse_value();
+      skip_ws();
+      char c = next();
+      if (c == '}') return v;
+      if (c != ',') fail("expected ',' or '}'");
+    }
+  }
+
+  ValuePtr parse_array() {
+    expect('[');
+    auto v = Value::make_array();
+    skip_ws();
+    if (peek() == ']') {
+      ++pos_;
+      return v;
+    }
+    while (true) {
+      skip_ws();
+      v->arr.push_back(parse_value());
+      skip_ws();
+      char c = next();
+      if (c == ']') return v;
+      if (c != ',') fail("expected ',' or ']'");
+    }
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      char c = next();
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = next();
+        switch (e) {
+          case '"':
+            out += '"';
+            break;
+          case '\\':
+            out += '\\';
+            break;
+          case '/':
+            out += '/';
+            break;
+          case 'b':
+            out += '\b';
+            break;
+          case 'f':
+            out += '\f';
+            break;
+          case 'n':
+            out += '\n';
+            break;
+          case 'r':
+            out += '\r';
+            break;
+          case 't':
+            out += '\t';
+            break;
+          case 'u': {
+            unsigned code = parse_hex4();
+            // Surrogate pair handling for non-BMP code points.
+            if (code >= 0xD800 && code <= 0xDBFF && pos_ + 1 < text_.size() &&
+                text_[pos_] == '\\' && text_[pos_ + 1] == 'u') {
+              pos_ += 2;
+              unsigned lo = parse_hex4();
+              if (lo >= 0xDC00 && lo <= 0xDFFF)
+                code = 0x10000 + ((code - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            append_utf8(out, code);
+            break;
+          }
+          default:
+            fail("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  unsigned parse_hex4() {
+    unsigned v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = next();
+      v <<= 4;
+      if (c >= '0' && c <= '9')
+        v |= unsigned(c - '0');
+      else if (c >= 'a' && c <= 'f')
+        v |= unsigned(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F')
+        v |= unsigned(c - 'A' + 10);
+      else
+        fail("bad \\u escape");
+    }
+    return v;
+  }
+
+  static void append_utf8(std::string& out, unsigned code) {
+    if (code < 0x80) {
+      out += char(code);
+    } else if (code < 0x800) {
+      out += char(0xC0 | (code >> 6));
+      out += char(0x80 | (code & 0x3F));
+    } else if (code < 0x10000) {
+      out += char(0xE0 | (code >> 12));
+      out += char(0x80 | ((code >> 6) & 0x3F));
+      out += char(0x80 | (code & 0x3F));
+    } else {
+      out += char(0xF0 | (code >> 18));
+      out += char(0x80 | ((code >> 12) & 0x3F));
+      out += char(0x80 | ((code >> 6) & 0x3F));
+      out += char(0x80 | (code & 0x3F));
+    }
+  }
+
+  ValuePtr parse_number() {
+    size_t start = pos_;
+    if (peek() == '-') ++pos_;
+    while (pos_ < text_.size() &&
+           (isdigit((unsigned char)text_[pos_]) || text_[pos_] == '.' ||
+            text_[pos_] == 'e' || text_[pos_] == 'E' || text_[pos_] == '+' ||
+            text_[pos_] == '-'))
+      ++pos_;
+    if (pos_ == start) fail("bad value");
+    try {
+      return Value::make_number(std::stod(text_.substr(start, pos_ - start)));
+    } catch (const std::exception&) {
+      fail("bad number");
+    }
+  }
+};
+
+inline ValuePtr parse(const std::string& text) { return Parser(text).parse(); }
+
+// ------------------------------------------------------------ serializing
+
+inline void write_escaped(std::ostream& os, const std::string& s) {
+  os << '"';
+  for (char c : s) {
+    switch (c) {
+      case '"':
+        os << "\\\"";
+        break;
+      case '\\':
+        os << "\\\\";
+        break;
+      case '\b':
+        os << "\\b";
+        break;
+      case '\f':
+        os << "\\f";
+        break;
+      case '\n':
+        os << "\\n";
+        break;
+      case '\r':
+        os << "\\r";
+        break;
+      case '\t':
+        os << "\\t";
+        break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          os << buf;
+        } else {
+          os << c;
+        }
+    }
+  }
+  os << '"';
+}
+
+inline void write(std::ostream& os, const ValuePtr& v) {
+  if (!v) {
+    os << "null";
+    return;
+  }
+  switch (v->type) {
+    case Type::Null:
+      os << "null";
+      break;
+    case Type::Bool:
+      os << (v->boolean ? "true" : "false");
+      break;
+    case Type::Number: {
+      double d = v->number;
+      if (std::isfinite(d) && d == std::floor(d) &&
+          std::fabs(d) < 9.0e15) {
+        os << (long long)d;
+      } else {
+        std::ostringstream tmp;
+        tmp.precision(17);
+        tmp << d;
+        os << tmp.str();
+      }
+      break;
+    }
+    case Type::String:
+      write_escaped(os, v->str);
+      break;
+    case Type::Array: {
+      os << '[';
+      bool first = true;
+      for (const auto& e : v->arr) {
+        if (!first) os << ',';
+        first = false;
+        write(os, e);
+      }
+      os << ']';
+      break;
+    }
+    case Type::Object: {
+      os << '{';
+      bool first = true;
+      for (const auto& kv : v->obj) {
+        if (!first) os << ',';
+        first = false;
+        write_escaped(os, kv.first);
+        os << ':';
+        write(os, kv.second);
+      }
+      os << '}';
+      break;
+    }
+  }
+}
+
+inline std::string dump(const ValuePtr& v) {
+  std::ostringstream os;
+  write(os, v);
+  return os.str();
+}
+
+}  // namespace cpjson

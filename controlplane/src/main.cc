@@ -1,0 +1,121 @@
+// tpu-stack-controlplane: native control-plane agent for the TPU serving
+// stack.
+//
+// The reference implements this layer as a Go/kubebuilder operator
+// (src/router-controller/cmd/main.go). This agent provides the same
+// contract — StaticRoute spec -> dynamic_config.json -> router
+// DynamicConfigWatcher, plus router health probing — as a single static
+// C++ binary with no library dependencies, so it can run as a plain
+// sidecar, a systemd unit on bare metal, or a Deployment next to a
+// kubectl-proxy container.
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+
+#include "reconciler.hpp"
+
+namespace {
+
+volatile std::sig_atomic_t g_stop = 0;
+void handle_signal(int) { g_stop = 1; }
+
+void usage(const char* prog) {
+  std::fprintf(stderr,
+               "usage: %s [--spec-dir DIR --out-dir DIR | --kube-api URL "
+               "[--namespace NS]]\n"
+               "          [--period SECONDS] [--once]\n"
+               "\n"
+               "File mode (default): reconcile *.json StaticRoute specs in\n"
+               "--spec-dir into <out-dir>/<configName>/dynamic_config.json\n"
+               "and statuses into <out-dir>/status/.\n"
+               "\n"
+               "K8s mode: reconcile StaticRoute custom resources\n"
+               "(apis/%s/%s) via a kubectl-proxy base URL into ConfigMaps\n"
+               "and CR status subresources.\n",
+               prog, cpagent::Reconciler::kGroup,
+               cpagent::Reconciler::kVersion);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string spec_dir;
+  std::string out_dir;
+  std::string kube_api;
+  std::string ns;
+  int period_s = 10;
+  bool once = false;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto need_value = [&](const char* flag) -> const char* {
+      if (i + 1 >= argc) {
+        std::fprintf(stderr, "%s requires a value\n", flag);
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (arg == "--spec-dir") {
+      spec_dir = need_value("--spec-dir");
+    } else if (arg == "--out-dir") {
+      out_dir = need_value("--out-dir");
+    } else if (arg == "--kube-api") {
+      kube_api = need_value("--kube-api");
+    } else if (arg == "--namespace") {
+      ns = need_value("--namespace");
+    } else if (arg == "--period") {
+      period_s = std::atoi(need_value("--period"));
+      if (period_s < 1) period_s = 1;
+    } else if (arg == "--once") {
+      once = true;
+    } else if (arg == "--help" || arg == "-h") {
+      usage(argv[0]);
+      return 0;
+    } else {
+      std::fprintf(stderr, "unknown flag: %s\n", arg.c_str());
+      usage(argv[0]);
+      return 2;
+    }
+  }
+
+  bool file_mode = !spec_dir.empty();
+  bool k8s_mode = !kube_api.empty();
+  if (file_mode == k8s_mode) {  // neither or both
+    usage(argv[0]);
+    return 2;
+  }
+  if (file_mode && out_dir.empty()) {
+    std::fprintf(stderr, "--spec-dir requires --out-dir\n");
+    return 2;
+  }
+
+  std::signal(SIGINT, handle_signal);
+  std::signal(SIGTERM, handle_signal);
+
+  cpagent::Reconciler reconciler;
+  std::fprintf(stderr, "[controlplane] starting in %s mode (period %ds)\n",
+               file_mode ? "file" : "k8s", period_s);
+
+  while (!g_stop) {
+    std::vector<cpagent::RouteStatus> statuses =
+        file_mode ? reconciler.reconcile_dir(spec_dir, out_dir)
+                  : reconciler.reconcile_k8s(kube_api, ns);
+    for (const auto& st : statuses) {
+      std::fprintf(stderr,
+                   "[controlplane] route=%s ready=%s reason=%s%s%s\n",
+                   st.name.c_str(), st.ready ? "true" : "false",
+                   st.reason.c_str(),
+                   st.health.ever_probed ? " routerHealthy=" : "",
+                   st.health.ever_probed
+                       ? (st.health.healthy ? "true" : "false")
+                       : "");
+    }
+    if (once) break;
+    for (int slept = 0; slept < period_s && !g_stop; ++slept)
+      std::this_thread::sleep_for(std::chrono::seconds(1));
+  }
+  std::fprintf(stderr, "[controlplane] exiting\n");
+  return 0;
+}

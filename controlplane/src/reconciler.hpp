@@ -1,0 +1,536 @@
+// Reconcile loop: StaticRoute specs -> rendered dynamic config + router
+// health probing + status reporting.
+//
+// Behavior parity with the reference operator's Reconcile
+// (src/router-controller/internal/controller/staticroute_controller.go:71-132):
+//   fetch spec -> render config (CreateOrUpdate) -> update status
+//   (ConfigMapRef, LastAppliedTime, Conditions) -> probe router health with
+//   the spec's thresholds -> requeue on the health-check period.
+//
+// Two backends:
+//  * file mode — specs are *.json files in --spec-dir (the ConfigMap-mount
+//    equivalent); rendered configs land at
+//    <out>/<configName>/dynamic_config.json for the router's
+//    DynamicConfigWatcher; status at <out>/status/<name>.json.
+//  * k8s mode — specs are StaticRoute custom resources fetched from the
+//    Kubernetes API through a kubectl-proxy sidecar (plain HTTP, no TLS
+//    stack needed); rendered configs become ConfigMaps; status is written
+//    to the CR's /status subresource.
+#pragma once
+
+#include <dirent.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <ctime>
+#include <fstream>
+#include <functional>
+#include <map>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "http.hpp"
+#include "json.hpp"
+#include "spec.hpp"
+
+namespace cpagent {
+
+inline std::string now_iso8601() {
+  std::time_t t = std::time(nullptr);
+  char buf[32];
+  std::strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", std::gmtime(&t));
+  return buf;
+}
+
+inline bool mkdir_p(const std::string& path) {
+  std::string cur;
+  std::istringstream ss(path);
+  std::string part;
+  if (!path.empty() && path[0] == '/') cur = "/";
+  while (std::getline(ss, part, '/')) {
+    if (part.empty()) continue;
+    cur += part + "/";
+    if (::mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST) return false;
+  }
+  return true;
+}
+
+inline bool read_file(const std::string& path, std::string* out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  return true;
+}
+
+// Write-then-rename so the router's watcher never sees a half-written file.
+inline bool write_file_atomic(const std::string& path,
+                              const std::string& content) {
+  std::string tmp = path + ".tmp";
+  {
+    std::ofstream f(tmp, std::ios::binary | std::ios::trunc);
+    if (!f) return false;
+    f << content;
+    if (!f.good()) return false;
+  }
+  return ::rename(tmp.c_str(), path.c_str()) == 0;
+}
+
+// Per-route health probe state, carried across reconcile ticks exactly like
+// the reference's consecutive success/failure threshold logic.
+struct HealthState {
+  int consecutive_successes = 0;
+  int consecutive_failures = 0;
+  bool healthy = false;
+  bool ever_probed = false;
+  std::string last_probe_time;
+  std::string last_detail;
+
+  void observe(bool success, const HealthCheckConfig& cfg,
+               const std::string& detail) {
+    ever_probed = true;
+    last_probe_time = now_iso8601();
+    last_detail = detail;
+    if (success) {
+      consecutive_successes++;
+      consecutive_failures = 0;
+      if (consecutive_successes >= cfg.success_threshold) healthy = true;
+    } else {
+      consecutive_failures++;
+      consecutive_successes = 0;
+      if (consecutive_failures >= cfg.failure_threshold) healthy = false;
+    }
+  }
+};
+
+struct RouteStatus {
+  std::string name;
+  bool ready = false;
+  std::string reason;
+  std::string message;
+  std::string config_ref;
+  std::string last_applied_time;
+  HealthState health;
+
+  cpjson::ValuePtr to_json() const {
+    auto v = cpjson::Value::make_object();
+    v->set_string("name", name);
+    auto conds = cpjson::Value::make_array();
+    auto ready_cond = cpjson::Value::make_object();
+    ready_cond->set_string("type", "Ready");
+    ready_cond->set_string("status", ready ? "True" : "False");
+    ready_cond->set_string("reason", reason);
+    ready_cond->set_string("message", message);
+    ready_cond->set_string("lastTransitionTime", now_iso8601());
+    conds->arr.push_back(ready_cond);
+    v->set("conditions", conds);
+    v->set_string("configMapRef", config_ref);
+    if (!last_applied_time.empty())
+      v->set_string("lastAppliedTime", last_applied_time);
+    if (health.ever_probed) {
+      auto h = cpjson::Value::make_object();
+      h->set_bool("healthy", health.healthy);
+      h->set_number("consecutiveSuccesses", health.consecutive_successes);
+      h->set_number("consecutiveFailures", health.consecutive_failures);
+      h->set_string("lastProbeTime", health.last_probe_time);
+      h->set_string("detail", health.last_detail);
+      v->set("routerHealth", h);
+    }
+    return v;
+  }
+};
+
+class Reconciler {
+ public:
+  // Probe hook is injectable for tests; default does a real HTTP GET.
+  using ProbeFn = std::function<bool(const std::string& url, int timeout_s,
+                                     std::string* detail)>;
+
+  Reconciler() {
+    probe_ = [](const std::string& url, int timeout_s, std::string* detail) {
+      cphttp::Response r = cphttp::get(url, timeout_s);
+      if (!r.ok) {
+        *detail = r.error;
+        return false;
+      }
+      *detail = "HTTP " + std::to_string(r.status);
+      return r.status >= 200 && r.status < 300;
+    };
+  }
+
+  void set_probe(ProbeFn fn) { probe_ = std::move(fn); }
+
+  // ------------------------------------------------------------ file mode
+
+  // One pass over --spec-dir. Returns per-route statuses (also persisted
+  // under <out>/status/).
+  std::vector<RouteStatus> reconcile_dir(const std::string& spec_dir,
+                                         const std::string& out_dir) {
+    std::vector<RouteStatus> statuses;
+    std::set<std::string> seen;
+    mkdir_p(out_dir + "/status");
+    for (const std::string& fname : list_json_files(spec_dir)) {
+      std::string name = fname.substr(0, fname.size() - 5);  // strip .json
+      RouteStatus st;
+      st.name = name;
+
+      std::string text;
+      if (!read_file(spec_dir + "/" + fname, &text)) {
+        st.reason = "ReadError";
+        st.message = "cannot read spec file";
+        finish_file_status(out_dir, st);
+        statuses.push_back(st);
+        seen.insert(st.name);
+        continue;
+      }
+      ParseResult parsed = try_parse(name, text);
+      if (!parsed.ok) {
+        st.reason = "InvalidSpec";
+        st.message = parsed.error;
+        finish_file_status(out_dir, st);
+        statuses.push_back(st);
+        seen.insert(st.name);
+        continue;
+      }
+      const StaticRouteSpec& spec = parsed.spec;
+      // metadata.name (when present) is the resource identity, not the
+      // file name — status and health state key off it.
+      st.name = spec.name;
+      st.config_ref = spec.config_name();
+      recover_state(out_dir, spec.name);
+      st.health = health_[spec.name];
+
+      std::string rendered = render_dynamic_config(spec);
+      std::string cfg_dir = out_dir + "/" + spec.config_name();
+      std::string cfg_path = cfg_dir + "/dynamic_config.json";
+      std::string existing;
+      bool changed = !read_file(cfg_path, &existing) || existing != rendered;
+      if (changed) {
+        mkdir_p(cfg_dir);
+        if (!write_file_atomic(cfg_path, rendered)) {
+          st.reason = "WriteError";
+          st.message = "cannot write " + cfg_path;
+          finish_file_status(out_dir, st);
+          statuses.push_back(st);
+          // Still seen: a transient write failure must not let
+          // collect_garbage tear down the live config.
+          seen.insert(st.name);
+          continue;
+        }
+        applied_time_[spec.name] = now_iso8601();
+      }
+      st.last_applied_time = applied_time_[spec.name];
+
+      probe_router(spec, spec.name, &st);
+      st.ready = true;
+      st.reason = "Reconciled";
+      st.message = changed ? "config updated" : "config up to date";
+      health_[spec.name] = st.health;
+      finish_file_status(out_dir, st);
+      statuses.push_back(st);
+      seen.insert(st.name);
+    }
+    collect_garbage(out_dir, seen);
+    return statuses;
+  }
+
+  // ------------------------------------------------------------- k8s mode
+
+  // One pass against the Kubernetes API (via kubectl-proxy base URL).
+  // Group/version mirrors the reference's
+  // production-stack.vllm.ai/v1alpha1 StaticRoute CRD.
+  std::vector<RouteStatus> reconcile_k8s(const std::string& api_base,
+                                         const std::string& ns) {
+    std::vector<RouteStatus> statuses;
+    std::string list_url =
+        ns.empty()
+            ? api_base + "/apis/" + kGroup + "/" + kVersion + "/staticroutes"
+            : api_base + "/apis/" + kGroup + "/" + kVersion +
+                  "/namespaces/" + ns + "/staticroutes";
+    cphttp::Response resp = cphttp::get(list_url, 10);
+    if (!resp.ok || resp.status != 200) {
+      RouteStatus st;
+      st.name = "<list>";
+      st.reason = "ApiError";
+      st.message = resp.ok ? "HTTP " + std::to_string(resp.status)
+                           : resp.error;
+      statuses.push_back(st);
+      return statuses;
+    }
+    cpjson::ValuePtr list;
+    try {
+      list = cpjson::parse(resp.body);
+    } catch (const cpjson::ParseError& e) {
+      RouteStatus st;
+      st.name = "<list>";
+      st.reason = "ApiError";
+      st.message = std::string("bad list body: ") + e.what();
+      statuses.push_back(st);
+      return statuses;
+    }
+    auto items = list->get("items");
+    if (!items || !items->is_array()) return statuses;
+
+    for (const auto& item : items->arr) {
+      RouteStatus st;
+      ParseResult parsed = parse_spec("", item);
+      if (!parsed.ok) {
+        auto meta = item->get("metadata");
+        st.name = meta && meta->is_object() ? meta->get_string("name")
+                                            : "<unknown>";
+        st.reason = "InvalidSpec";
+        st.message = parsed.error;
+        statuses.push_back(st);
+        continue;
+      }
+      StaticRouteSpec& spec = parsed.spec;
+      st.name = spec.name;
+      // CRs are namespaced: same-named routes in different namespaces
+      // must not share probe/applied state.
+      std::string key = spec.namespace_ + "/" + spec.name;
+      st.health = health_[key];
+      st.config_ref = spec.config_name();
+
+      // Recover lastAppliedTime from the CR's existing status so an
+      // agent restart (or repeated --once run) doesn't clobber it.
+      if (applied_time_[key].empty()) {
+        auto prev = item->get("status");
+        if (prev && prev->is_object())
+          applied_time_[key] = prev->get_string("lastAppliedTime");
+      }
+
+      if (!upsert_configmap(api_base, item, spec, key, &st)) {
+        statuses.push_back(st);
+        continue;
+      }
+      st.last_applied_time = applied_time_[key];
+      probe_router(spec, key, &st);
+      st.ready = true;
+      st.reason = "Reconciled";
+      st.message = "config map reconciled";
+      health_[key] = st.health;
+      update_cr_status(api_base, item, spec, st);
+      statuses.push_back(st);
+    }
+    return statuses;
+  }
+
+  static constexpr const char* kGroup = "production-stack.tpu";
+  static constexpr const char* kVersion = "v1alpha1";
+
+ private:
+  ProbeFn probe_;
+  std::map<std::string, HealthState> health_;
+  std::map<std::string, std::string> applied_time_;
+  std::map<std::string, std::time_t> last_probe_;
+
+  static std::vector<std::string> list_json_files(const std::string& dir) {
+    std::vector<std::string> out;
+    DIR* d = ::opendir(dir.c_str());
+    if (!d) return out;
+    while (struct dirent* e = ::readdir(d)) {
+      std::string n = e->d_name;
+      if (n.size() > 5 && n.substr(n.size() - 5) == ".json")
+        out.push_back(n);
+    }
+    ::closedir(d);
+    std::sort(out.begin(), out.end());
+    return out;
+  }
+
+  static ParseResult try_parse(const std::string& name,
+                               const std::string& text) {
+    try {
+      return parse_spec(name, cpjson::parse(text));
+    } catch (const cpjson::ParseError& e) {
+      ParseResult r;
+      r.error = std::string("bad JSON: ") + e.what();
+      return r;
+    }
+  }
+
+  void probe_router(const StaticRouteSpec& spec, const std::string& key,
+                    RouteStatus* st) {
+    if (spec.router_url.empty()) return;
+    // Honor the spec's own healthCheck.periodSeconds (the reference
+    // requeues on it); the process --period only sets the outer tick.
+    std::time_t now = std::time(nullptr);
+    auto it = last_probe_.find(key);
+    if (it != last_probe_.end() &&
+        now - it->second < spec.health.period_s)
+      return;
+    last_probe_[key] = now;
+
+    // Append /health based on the URL's *path* component; a substring
+    // test would misfire on hosts like http://healthy-router:8001.
+    std::string url = spec.router_url;
+    cphttp::Url parsed = cphttp::parse_url(url);
+    std::string path = parsed.path;
+    bool has_health = path == "/health" ||
+                      (path.size() >= 7 &&
+                       path.compare(path.size() - 7, 7, "/health") == 0);
+    if (!has_health) {
+      if (!url.empty() && url.back() == '/') url.pop_back();
+      url += "/health";
+    }
+    std::string detail;
+    bool up = probe_(url, spec.health.timeout_s, &detail);
+    st->health.observe(up, spec.health, detail);
+  }
+
+  // A fresh process (e.g. --once runs) must not reset lastAppliedTime or
+  // the health-probe state machine; recover both from the persisted
+  // status file so file mode is stateless-process-safe.
+  void recover_state(const std::string& out_dir, const std::string& name) {
+    if (!applied_time_[name].empty() || health_[name].ever_probed) return;
+    std::string text;
+    if (!read_file(out_dir + "/status/" + name + ".json", &text)) return;
+    try {
+      auto prev = cpjson::parse(text);
+      if (applied_time_[name].empty())
+        applied_time_[name] = prev->get_string("lastAppliedTime");
+      auto h = prev->get("routerHealth");
+      if (h && h->is_object() && !health_[name].ever_probed) {
+        HealthState& hs = health_[name];
+        hs.ever_probed = true;
+        hs.healthy = h->get_bool("healthy");
+        hs.consecutive_successes =
+            int(h->get_number("consecutiveSuccesses"));
+        hs.consecutive_failures =
+            int(h->get_number("consecutiveFailures"));
+        hs.last_probe_time = h->get_string("lastProbeTime");
+        hs.last_detail = h->get_string("detail");
+        std::time_t t = parse_iso8601(hs.last_probe_time);
+        if (t > 0) last_probe_[name] = t;
+      }
+    } catch (const cpjson::ParseError&) {
+    }
+  }
+
+  static std::time_t parse_iso8601(const std::string& s) {
+    struct tm tm;
+    std::memset(&tm, 0, sizeof(tm));
+    if (s.empty() || !strptime(s.c_str(), "%Y-%m-%dT%H:%M:%SZ", &tm))
+      return 0;
+    return timegm(&tm);
+  }
+
+  void finish_file_status(const std::string& out_dir, const RouteStatus& st) {
+    write_file_atomic(out_dir + "/status/" + st.name + ".json",
+                      cpjson::dump(st.to_json()));
+  }
+
+  // Deleting a spec must take its rendered config out of service — the
+  // file-mode analogue of the reference's ownerReference-based GC.
+  void collect_garbage(const std::string& out_dir,
+                       const std::set<std::string>& seen) {
+    std::string status_dir = out_dir + "/status";
+    for (const std::string& fname : list_json_files(status_dir)) {
+      std::string name = fname.substr(0, fname.size() - 5);
+      if (seen.count(name)) continue;
+      std::string text;
+      std::string config_ref;
+      if (read_file(status_dir + "/" + fname, &text)) {
+        try {
+          config_ref = cpjson::parse(text)->get_string("configMapRef");
+        } catch (const cpjson::ParseError&) {
+        }
+      }
+      if (!config_ref.empty() && config_ref.find('/') == std::string::npos) {
+        std::string cfg_dir = out_dir + "/" + config_ref;
+        ::remove((cfg_dir + "/dynamic_config.json").c_str());
+        ::rmdir(cfg_dir.c_str());
+      }
+      ::remove((status_dir + "/" + fname).c_str());
+      health_.erase(name);
+      applied_time_.erase(name);
+      last_probe_.erase(name);
+    }
+  }
+
+  bool upsert_configmap(const std::string& api_base,
+                        const cpjson::ValuePtr& owner,
+                        const StaticRouteSpec& spec,
+                        const std::string& key, RouteStatus* st) {
+    std::string rendered = render_dynamic_config(spec);
+    std::string cm_url = api_base + "/api/v1/namespaces/" + spec.namespace_ +
+                         "/configmaps/" + spec.config_name();
+    cphttp::Response existing = cphttp::get(cm_url, 10);
+    if (existing.ok && existing.status == 200) {
+      try {
+        auto cm = cpjson::parse(existing.body);
+        auto data = cm->get("data");
+        if (data && data->is_object() &&
+            data->get_string("dynamic_config.json") == rendered)
+          return true;  // up to date
+      } catch (const cpjson::ParseError&) {
+        // fall through to rewrite
+      }
+    }
+    auto cm = cpjson::Value::make_object();
+    cm->set_string("apiVersion", "v1");
+    cm->set_string("kind", "ConfigMap");
+    auto meta = cpjson::Value::make_object();
+    meta->set_string("name", spec.config_name());
+    meta->set_string("namespace", spec.namespace_);
+    // ownerReference -> kube GC deletes the ConfigMap with its CR, like
+    // the reference's controllerutil.SetControllerReference.
+    auto owner_meta = owner->get("metadata");
+    std::string uid = owner_meta && owner_meta->is_object()
+                          ? owner_meta->get_string("uid")
+                          : "";
+    if (!uid.empty()) {
+      auto refs = cpjson::Value::make_array();
+      auto ref = cpjson::Value::make_object();
+      ref->set_string("apiVersion",
+                      std::string(kGroup) + "/" + kVersion);
+      ref->set_string("kind", "StaticRoute");
+      ref->set_string("name", spec.name);
+      ref->set_string("uid", uid);
+      ref->set_bool("controller", true);
+      ref->set_bool("blockOwnerDeletion", true);
+      refs->arr.push_back(ref);
+      meta->set("ownerReferences", refs);
+    }
+    cm->set("metadata", meta);
+    auto data = cpjson::Value::make_object();
+    data->set_string("dynamic_config.json", rendered);
+    cm->set("data", data);
+
+    cphttp::Response put;
+    if (existing.ok && existing.status == 200) {
+      put = cphttp::request("PUT", cm_url, cpjson::dump(cm));
+    } else {
+      std::string create_url = api_base + "/api/v1/namespaces/" +
+                               spec.namespace_ + "/configmaps";
+      put = cphttp::request("POST", create_url, cpjson::dump(cm));
+    }
+    if (!put.ok || put.status >= 300) {
+      st->reason = "ConfigMapError";
+      st->message = put.ok ? "HTTP " + std::to_string(put.status) : put.error;
+      return false;
+    }
+    applied_time_[key] = now_iso8601();
+    return true;
+  }
+
+  void update_cr_status(const std::string& api_base,
+                        const cpjson::ValuePtr& item,
+                        const StaticRouteSpec& spec, const RouteStatus& st) {
+    // PUT the fetched object back with .status set (needs resourceVersion,
+    // which the fetched item carries).
+    auto obj = item;  // shared structure; we only mutate .status
+    obj->set("status", st.to_json());
+    std::string url = api_base + "/apis/" + std::string(kGroup) + "/" +
+                      kVersion + "/namespaces/" + spec.namespace_ +
+                      "/staticroutes/" + spec.name + "/status";
+    cphttp::request("PUT", url, cpjson::dump(obj));
+  }
+};
+
+}  // namespace cpagent

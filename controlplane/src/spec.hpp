@@ -1,0 +1,226 @@
+// StaticRoute spec model: parse, validate, render dynamic config.
+//
+// Capability parity with the reference CRD
+// (src/router-controller/api/v1alpha1/staticroute_types.go:28-107):
+// serviceDiscovery, routingLogic, staticBackends/staticModels, routerRef
+// (flattened to routerUrl — the agent probes an URL, not a k8s object),
+// healthCheck{timeout,period,successThreshold,failureThreshold}, and
+// configMapName. Rendering matches the Go reconcileConfigMap output
+// (staticroute_controller.go:134-184) and the router's
+// DynamicRouterConfig.from_json contract
+// (production_stack_tpu/router/dynamic_config.py).
+#pragma once
+
+#include <cctype>
+#include <set>
+#include <string>
+
+#include "json.hpp"
+
+namespace cpagent {
+
+struct HealthCheckConfig {
+  int timeout_s = 5;
+  int period_s = 10;
+  int success_threshold = 1;
+  int failure_threshold = 3;
+};
+
+struct StaticRouteSpec {
+  std::string name;                    // resource name (from file or CR)
+  std::string namespace_ = "default";  // k8s namespace (k8s mode)
+  std::string service_discovery = "static";
+  std::string routing_logic = "roundrobin";
+  std::string static_backends;  // comma-separated URLs
+  std::string static_models;    // comma-separated model names
+  std::string session_key;      // optional, for session routing
+  std::string router_url;       // optional; enables health probing
+  std::string config_map_name;  // output name; default <name>-config
+  HealthCheckConfig health;
+
+  std::string config_name() const {
+    return config_map_name.empty() ? name + "-config" : config_map_name;
+  }
+};
+
+// The routing algorithms our router actually implements
+// (production_stack_tpu/router/routing/logic.py RoutingLogic enum).
+inline const std::set<std::string>& valid_routing_logics() {
+  static const std::set<std::string> kValid = {
+      "roundrobin", "session", "llq", "hra", "custom"};
+  return kValid;
+}
+
+// Mirrors the router's _URL_RE (production_stack_tpu/utils/__init__.py:17):
+// ^(https?)://([a-zA-Z0-9.\-_]+|\[ipv6\])(:\d{1,5})?(/.*)?$ — the agent
+// must reject anything the router's parser would, or Ready=True lies.
+inline bool is_valid_backend_url(const std::string& url) {
+  size_t pos;
+  if (url.rfind("http://", 0) == 0)
+    pos = 7;
+  else if (url.rfind("https://", 0) == 0)
+    pos = 8;
+  else
+    return false;
+
+  size_t host_start = pos;
+  if (pos < url.size() && url[pos] == '[') {  // ipv6 literal
+    ++pos;
+    while (pos < url.size() &&
+           (isxdigit((unsigned char)url[pos]) || url[pos] == ':'))
+      ++pos;
+    if (pos >= url.size() || url[pos] != ']' || pos == host_start + 1)
+      return false;
+    ++pos;
+  } else {
+    while (pos < url.size()) {
+      char c = url[pos];
+      if (isalnum((unsigned char)c) || c == '.' || c == '-' || c == '_')
+        ++pos;
+      else
+        break;
+    }
+    if (pos == host_start) return false;
+  }
+  if (pos < url.size() && url[pos] == ':') {  // optional port
+    ++pos;
+    size_t digits = 0;
+    while (pos < url.size() && isdigit((unsigned char)url[pos])) {
+      ++pos;
+      ++digits;
+    }
+    if (digits < 1 || digits > 5) return false;
+  }
+  return pos == url.size() || url[pos] == '/';
+}
+
+struct ParseResult {
+  bool ok = false;
+  std::string error;
+  StaticRouteSpec spec;
+};
+
+inline ParseResult parse_spec(const std::string& name,
+                              const cpjson::ValuePtr& root) {
+  ParseResult out;
+  if (!root || !root->is_object()) {
+    out.error = "spec must be a JSON object";
+    return out;
+  }
+  // Accept both a bare spec and a CR-shaped {metadata:..., spec:...}.
+  cpjson::ValuePtr spec = root->get("spec");
+  if (!spec || !spec->is_object()) spec = root;
+
+  StaticRouteSpec& s = out.spec;
+  s.name = name;
+  auto meta = root->get("metadata");
+  if (meta && meta->is_object()) {
+    std::string n = meta->get_string("name");
+    if (!n.empty()) s.name = n;
+    std::string ns = meta->get_string("namespace");
+    if (!ns.empty()) s.namespace_ = ns;
+  }
+  if (s.name.empty()) {
+    out.error = "spec has no name";
+    return out;
+  }
+
+  s.service_discovery = spec->get_string("serviceDiscovery", "static");
+  if (s.service_discovery != "static") {
+    out.error = "serviceDiscovery must be 'static', got '" +
+                s.service_discovery + "'";
+    return out;
+  }
+  s.routing_logic = spec->get_string("routingLogic", "roundrobin");
+  // The reference CRD enum says least_loaded; our router calls it llq.
+  if (s.routing_logic == "least_loaded") s.routing_logic = "llq";
+  if (!valid_routing_logics().count(s.routing_logic)) {
+    out.error = "unknown routingLogic '" + s.routing_logic + "'";
+    return out;
+  }
+
+  // staticBackends / staticModels: comma-separated string or JSON array.
+  auto join = [](const cpjson::ValuePtr& v) {
+    std::string joined;
+    for (const auto& e : v->arr) {
+      if (!e->is_string()) continue;
+      if (!joined.empty()) joined += ',';
+      joined += e->str;
+    }
+    return joined;
+  };
+  auto backends = spec->get("staticBackends");
+  if (backends && backends->is_array())
+    s.static_backends = join(backends);
+  else
+    s.static_backends = spec->get_string("staticBackends");
+  // Validate each backend the way the router's
+  // parse_comma_separated_urls will (production_stack_tpu/utils): a
+  // Ready=True status must imply the router can actually apply the
+  // config, not silently reject and pin the bad digest.
+  {
+    std::istringstream ss(s.static_backends);
+    std::string url;
+    while (std::getline(ss, url, ',')) {
+      size_t a = url.find_first_not_of(" \t");
+      size_t b = url.find_last_not_of(" \t");
+      if (a == std::string::npos) continue;
+      url = url.substr(a, b - a + 1);
+      if (!is_valid_backend_url(url)) {
+        out.error = "invalid backend URL '" + url + "'";
+        return out;
+      }
+    }
+  }
+  auto models = spec->get("staticModels");
+  if (models && models->is_array())
+    s.static_models = join(models);
+  else
+    s.static_models = spec->get_string("staticModels");
+  if (s.static_backends.empty()) {
+    out.error = "staticBackends is required";
+    return out;
+  }
+  if (s.static_models.empty()) {
+    out.error = "staticModels is required";
+    return out;
+  }
+
+  s.session_key = spec->get_string("sessionKey");
+  if (s.routing_logic == "session" && s.session_key.empty()) {
+    out.error = "routingLogic 'session' requires sessionKey";
+    return out;
+  }
+  s.router_url = spec->get_string("routerUrl");
+  s.config_map_name = spec->get_string("configMapName");
+
+  auto hc = spec->get("healthCheck");
+  if (hc && hc->is_object()) {
+    auto clamp_pos = [](double v, int dflt) {
+      int i = int(v);
+      return i >= 1 ? i : dflt;
+    };
+    s.health.timeout_s = clamp_pos(hc->get_number("timeoutSeconds", 5), 5);
+    s.health.period_s = clamp_pos(hc->get_number("periodSeconds", 10), 10);
+    s.health.success_threshold =
+        clamp_pos(hc->get_number("successThreshold", 1), 1);
+    s.health.failure_threshold =
+        clamp_pos(hc->get_number("failureThreshold", 3), 3);
+  }
+  out.ok = true;
+  return out;
+}
+
+// Renders the dynamic_config.json payload the router's
+// DynamicConfigWatcher consumes.
+inline std::string render_dynamic_config(const StaticRouteSpec& s) {
+  auto v = cpjson::Value::make_object();
+  v->set_string("service_discovery", s.service_discovery);
+  v->set_string("routing_logic", s.routing_logic);
+  v->set_string("static_backends", s.static_backends);
+  v->set_string("static_models", s.static_models);
+  if (!s.session_key.empty()) v->set_string("session_key", s.session_key);
+  return cpjson::dump(v);
+}
+
+}  // namespace cpagent

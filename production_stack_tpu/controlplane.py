@@ -1,0 +1,113 @@
+"""Python harness for the native control-plane agent.
+
+The agent itself is a dependency-free C++ binary (``controlplane/``) that
+fills the role of the reference's Go operator
+(src/router-controller/cmd/main.go, staticroute_controller.go:71-132):
+StaticRoute specs -> rendered ``dynamic_config.json`` -> router
+DynamicConfigWatcher, plus router ``/health`` probing with configurable
+thresholds. This module builds and launches it for tests, local runs, and
+the bare-metal runbook.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+from typing import List, Optional
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+CONTROLPLANE_DIR = REPO_ROOT / "controlplane"
+BINARY = CONTROLPLANE_DIR / "bin" / "tpu-stack-controlplane"
+
+
+class BuildError(RuntimeError):
+    pass
+
+
+def ensure_built(force: bool = False) -> Path:
+    """Builds the agent with make if the binary is missing/stale."""
+    if not force and BINARY.exists():
+        sources = list((CONTROLPLANE_DIR / "src").glob("*"))
+        newest_src = max(p.stat().st_mtime for p in sources)
+        if BINARY.stat().st_mtime >= newest_src:
+            return BINARY
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        raise BuildError("make/g++ not available to build the agent")
+    proc = subprocess.run(
+        ["make", "-C", str(CONTROLPLANE_DIR)],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise BuildError(
+            f"controlplane build failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+    return BINARY
+
+
+def agent_args(
+    spec_dir: Optional[str] = None,
+    out_dir: Optional[str] = None,
+    kube_api: Optional[str] = None,
+    namespace: Optional[str] = None,
+    period_s: int = 10,
+    once: bool = False,
+) -> List[str]:
+    args = [str(BINARY)]
+    if spec_dir:
+        args += ["--spec-dir", spec_dir, "--out-dir", out_dir or ""]
+    if kube_api:
+        args += ["--kube-api", kube_api]
+        if namespace:
+            args += ["--namespace", namespace]
+    args += ["--period", str(period_s)]
+    if once:
+        args.append("--once")
+    return args
+
+
+def run_once(
+    spec_dir: Optional[str] = None,
+    out_dir: Optional[str] = None,
+    kube_api: Optional[str] = None,
+    namespace: Optional[str] = None,
+    timeout_s: float = 60.0,
+) -> subprocess.CompletedProcess:
+    """Runs a single reconcile pass and returns the completed process."""
+    ensure_built()
+    return subprocess.run(
+        agent_args(spec_dir, out_dir, kube_api, namespace, once=True),
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+    )
+
+
+def launch(
+    spec_dir: Optional[str] = None,
+    out_dir: Optional[str] = None,
+    kube_api: Optional[str] = None,
+    namespace: Optional[str] = None,
+    period_s: int = 10,
+    log_path: Optional[str] = None,
+) -> subprocess.Popen:
+    """Starts the agent as a background daemon process.
+
+    Output goes to *log_path* (or /dev/null) — never an undrained PIPE,
+    which would eventually block the agent's reconcile loop once the
+    pipe buffer fills.
+    """
+    ensure_built()
+    log = open(log_path, "ab") if log_path else subprocess.DEVNULL
+    try:
+        return subprocess.Popen(
+            agent_args(spec_dir, out_dir, kube_api, namespace, period_s),
+            stdout=log,
+            stderr=log,
+            env=os.environ.copy(),
+        )
+    finally:
+        if log is not subprocess.DEVNULL:
+            log.close()

@@ -1,0 +1,408 @@
+"""Tests for the native C++ control-plane agent.
+
+Covers the same surface the reference covers with envtest + controller
+tests (src/router-controller/internal/controller/
+staticroute_controller_test.go:1-80): spec -> rendered dynamic config,
+idempotent re-reconcile, invalid-spec status, router health probing with
+thresholds, and k8s-mode ConfigMap/status reconciliation (here against a
+fake API server instead of envtest binaries).
+"""
+
+import json
+import subprocess
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from production_stack_tpu import controlplane
+
+
+@pytest.fixture(scope="module")
+def agent_binary():
+    try:
+        return controlplane.ensure_built()
+    except controlplane.BuildError as e:
+        pytest.skip(f"cannot build controlplane agent: {e}")
+
+
+def write_spec(spec_dir, name, spec):
+    spec_dir.mkdir(parents=True, exist_ok=True)
+    (spec_dir / f"{name}.json").write_text(json.dumps(spec))
+
+
+def read_json(path):
+    return json.loads(path.read_text())
+
+
+BASE_SPEC = {
+    "routingLogic": "session",
+    "sessionKey": "x-user-id",
+    "staticBackends": "http://127.0.0.1:9001,http://127.0.0.1:9002",
+    "staticModels": ["llama-8b", "opt-125m"],
+}
+
+
+def test_file_mode_renders_dynamic_config(agent_binary, tmp_path):
+    write_spec(tmp_path / "specs", "route-a", BASE_SPEC)
+    proc = controlplane.run_once(
+        spec_dir=str(tmp_path / "specs"), out_dir=str(tmp_path / "out")
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    config = read_json(
+        tmp_path / "out" / "route-a-config" / "dynamic_config.json"
+    )
+    assert config == {
+        "service_discovery": "static",
+        "routing_logic": "session",
+        "session_key": "x-user-id",
+        "static_backends": "http://127.0.0.1:9001,http://127.0.0.1:9002",
+        "static_models": "llama-8b,opt-125m",
+    }
+    status = read_json(tmp_path / "out" / "status" / "route-a.json")
+    assert status["conditions"][0]["type"] == "Ready"
+    assert status["conditions"][0]["status"] == "True"
+    assert status["configMapRef"] == "route-a-config"
+    assert "lastAppliedTime" in status
+
+
+def test_rendered_config_loads_in_router_watcher(agent_binary, tmp_path):
+    """The agent's output must satisfy the router's from_json contract."""
+    from production_stack_tpu.router.dynamic_config import (
+        DynamicRouterConfig,
+    )
+
+    write_spec(tmp_path / "specs", "route-w", BASE_SPEC)
+    controlplane.run_once(
+        spec_dir=str(tmp_path / "specs"), out_dir=str(tmp_path / "out")
+    )
+    text = (
+        tmp_path / "out" / "route-w-config" / "dynamic_config.json"
+    ).read_text()
+    config = DynamicRouterConfig.from_json(text)
+    assert config.routing_logic == "session"
+    assert config.static_backends == [
+        "http://127.0.0.1:9001",
+        "http://127.0.0.1:9002",
+    ]
+    assert config.static_models == ["llama-8b", "opt-125m"]
+    assert config.session_key == "x-user-id"
+
+
+def test_file_mode_idempotent_and_updates_on_change(agent_binary, tmp_path):
+    specs = tmp_path / "specs"
+    out = tmp_path / "out"
+    write_spec(specs, "r", BASE_SPEC)
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    first = read_json(out / "status" / "r.json")["lastAppliedTime"]
+    cfg_path = out / "r-config" / "dynamic_config.json"
+    mtime = cfg_path.stat().st_mtime_ns
+
+    # Unchanged spec: config file is not rewritten, applied time kept.
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    assert cfg_path.stat().st_mtime_ns == mtime
+    assert read_json(out / "status" / "r.json")["lastAppliedTime"] == first
+
+    # Changed spec: re-rendered.
+    changed = dict(BASE_SPEC, routingLogic="llq")
+    changed.pop("sessionKey")
+    write_spec(specs, "r", changed)
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    assert read_json(cfg_path)["routing_logic"] == "llq"
+    assert "session_key" not in read_json(cfg_path)
+
+
+def test_least_loaded_alias_and_cr_shape(agent_binary, tmp_path):
+    """Accepts the reference CRD's least_loaded name and full CR shape."""
+    cr = {
+        "apiVersion": "production-stack.tpu/v1alpha1",
+        "kind": "StaticRoute",
+        "metadata": {"name": "cr-named", "namespace": "default"},
+        "spec": {
+            "routingLogic": "least_loaded",
+            "staticBackends": "http://e:8000",
+            "staticModels": "m",
+            "configMapName": "custom-config",
+        },
+    }
+    write_spec(tmp_path / "specs", "file-name", cr)
+    controlplane.run_once(
+        spec_dir=str(tmp_path / "specs"), out_dir=str(tmp_path / "out")
+    )
+    # metadata.name wins over the file name; configMapName wins for output.
+    config = read_json(
+        tmp_path / "out" / "custom-config" / "dynamic_config.json"
+    )
+    assert config["routing_logic"] == "llq"
+    status = read_json(tmp_path / "out" / "status" / "cr-named.json")
+    assert status["configMapRef"] == "custom-config"
+
+
+@pytest.mark.parametrize(
+    "bad_spec,reason_substr",
+    [
+        ({"staticModels": "m"}, "staticBackends"),
+        ({"staticBackends": "http://e:8000"}, "staticModels"),
+        (
+            dict(BASE_SPEC, routingLogic="banana"),
+            "routingLogic",
+        ),
+        (
+            {
+                "routingLogic": "session",
+                "staticBackends": "http://e:8000",
+                "staticModels": "m",
+            },
+            "sessionKey",
+        ),
+    ],
+)
+def test_invalid_specs_report_not_ready(
+    agent_binary, tmp_path, bad_spec, reason_substr
+):
+    write_spec(tmp_path / "specs", "bad", bad_spec)
+    proc = controlplane.run_once(
+        spec_dir=str(tmp_path / "specs"), out_dir=str(tmp_path / "out")
+    )
+    assert proc.returncode == 0
+    status = read_json(tmp_path / "out" / "status" / "bad.json")
+    cond = status["conditions"][0]
+    assert cond["status"] == "False"
+    assert cond["reason"] == "InvalidSpec"
+    assert reason_substr in cond["message"]
+    assert not (tmp_path / "out" / "bad-config").exists()
+
+
+def test_deleted_spec_garbage_collects_config(agent_binary, tmp_path):
+    """Removing a spec takes its rendered config out of service (the
+    file-mode analogue of the reference's ownerReference GC)."""
+    specs = tmp_path / "specs"
+    out = tmp_path / "out"
+    write_spec(specs, "gone", BASE_SPEC)
+    write_spec(specs, "kept", BASE_SPEC)
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    assert (out / "gone-config" / "dynamic_config.json").exists()
+
+    (specs / "gone.json").unlink()
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    assert not (out / "gone-config").exists()
+    assert not (out / "status" / "gone.json").exists()
+    assert (out / "kept-config" / "dynamic_config.json").exists()
+    assert (out / "status" / "kept.json").exists()
+
+
+def test_invalid_backend_url_rejected(agent_binary, tmp_path):
+    """A Ready=True status must imply the router can apply the config;
+    URLs the router's parser would reject fail spec validation."""
+    bad = dict(BASE_SPEC, staticBackends="engine-0:8000")
+    write_spec(tmp_path / "specs", "badurl", bad)
+    controlplane.run_once(
+        spec_dir=str(tmp_path / "specs"), out_dir=str(tmp_path / "out")
+    )
+    status = read_json(tmp_path / "out" / "status" / "badurl.json")
+    assert status["conditions"][0]["status"] == "False"
+    assert "invalid backend URL" in status["conditions"][0]["message"]
+
+
+class _HealthHandler(BaseHTTPRequestHandler):
+    healthy = True
+
+    def do_GET(self):
+        code = 200 if type(self).healthy else 503
+        body = b'{"status": "ok"}'
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def health_server():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _HealthHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    _HealthHandler.healthy = True
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def test_health_probe_success(agent_binary, tmp_path, health_server):
+    spec = dict(BASE_SPEC, routerUrl=health_server)
+    write_spec(tmp_path / "specs", "hr", spec)
+    controlplane.run_once(
+        spec_dir=str(tmp_path / "specs"), out_dir=str(tmp_path / "out")
+    )
+    health = read_json(tmp_path / "out" / "status" / "hr.json")[
+        "routerHealth"
+    ]
+    assert health["healthy"] is True
+    assert health["consecutiveSuccesses"] == 1
+    assert health["detail"] == "HTTP 200"
+
+
+def test_health_failure_threshold_across_ticks(
+    agent_binary, tmp_path, health_server
+):
+    """healthy flips to False only after failureThreshold consecutive
+    failures, tracked across reconcile ticks in one agent process."""
+    _HealthHandler.healthy = False
+    spec = dict(
+        BASE_SPEC,
+        routerUrl=health_server,
+        healthCheck={
+            "timeoutSeconds": 1,
+            "periodSeconds": 1,
+            "failureThreshold": 2,
+        },
+    )
+    write_spec(tmp_path / "specs", "ht", spec)
+    proc = controlplane.launch(
+        spec_dir=str(tmp_path / "specs"),
+        out_dir=str(tmp_path / "out"),
+        period_s=1,
+    )
+    try:
+        status_path = tmp_path / "out" / "status" / "ht.json"
+        deadline = time.time() + 15
+        health = None
+        while time.time() < deadline:
+            if status_path.exists():
+                health = read_json(status_path).get("routerHealth")
+                if health and health["consecutiveFailures"] >= 2:
+                    break
+            time.sleep(0.2)
+        assert health is not None, "agent never probed"
+        assert health["healthy"] is False
+        assert health["consecutiveFailures"] >= 2
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------- k8s mode
+
+
+class _FakeKubeApi(BaseHTTPRequestHandler):
+    """Just enough of the Kubernetes API for the agent's k8s mode:
+    list StaticRoutes, get/create/update ConfigMaps, put CR status."""
+
+    state = None  # dict injected per-test
+
+    def _send(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length)) if length else None
+
+    def do_GET(self):
+        s = type(self).state
+        if self.path.endswith("/staticroutes"):
+            self._send(200, {"kind": "StaticRouteList",
+                             "items": s["routes"]})
+        elif "/configmaps/" in self.path:
+            name = self.path.rsplit("/", 1)[1]
+            if name in s["configmaps"]:
+                self._send(200, s["configmaps"][name])
+            else:
+                self._send(404, {"kind": "Status", "code": 404})
+        else:
+            self._send(404, {"kind": "Status", "code": 404})
+
+    def do_POST(self):
+        s = type(self).state
+        if self.path.endswith("/configmaps"):
+            cm = self._body()
+            s["configmaps"][cm["metadata"]["name"]] = cm
+            self._send(201, cm)
+        else:
+            self._send(404, {})
+
+    def do_PUT(self):
+        s = type(self).state
+        if "/configmaps/" in self.path:
+            cm = self._body()
+            s["configmaps"][cm["metadata"]["name"]] = cm
+            self._send(200, cm)
+        elif self.path.endswith("/status"):
+            obj = self._body()
+            s["statuses"][obj["metadata"]["name"]] = obj.get("status")
+            self._send(200, obj)
+        else:
+            self._send(404, {})
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def fake_kube(health_server):
+    state = {
+        "routes": [
+            {
+                "apiVersion": "production-stack.tpu/v1alpha1",
+                "kind": "StaticRoute",
+                "metadata": {
+                    "name": "k8s-route",
+                    "namespace": "default",
+                    "resourceVersion": "1",
+                    "uid": "abc-123",
+                },
+                "spec": {
+                    "routingLogic": "roundrobin",
+                    "staticBackends": "http://engine-0:8000",
+                    "staticModels": "llama-8b",
+                    "routerUrl": health_server,
+                },
+            }
+        ],
+        "configmaps": {},
+        "statuses": {},
+    }
+    _FakeKubeApi.state = state
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeKubeApi)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}", state
+    server.shutdown()
+
+
+def test_k8s_mode_reconciles_configmap_and_status(agent_binary, fake_kube):
+    api, state = fake_kube
+    proc = controlplane.run_once(kube_api=api, namespace="default")
+    assert proc.returncode == 0, proc.stderr
+    assert "k8s-route" in proc.stderr
+
+    cm = state["configmaps"]["k8s-route-config"]
+    config = json.loads(cm["data"]["dynamic_config.json"])
+    assert config["routing_logic"] == "roundrobin"
+    assert config["static_backends"] == "http://engine-0:8000"
+    assert cm["metadata"]["namespace"] == "default"
+    ref = cm["metadata"]["ownerReferences"][0]
+    assert ref["kind"] == "StaticRoute" and ref["uid"] == "abc-123"
+
+    status = state["statuses"]["k8s-route"]
+    assert status["conditions"][0]["status"] == "True"
+    assert status["configMapRef"] == "k8s-route-config"
+    assert status["routerHealth"]["healthy"] is True
+
+
+def test_k8s_mode_idempotent_second_pass(agent_binary, fake_kube):
+    api, state = fake_kube
+    controlplane.run_once(kube_api=api, namespace="default")
+    first_cm = json.dumps(state["configmaps"]["k8s-route-config"],
+                          sort_keys=True)
+    controlplane.run_once(kube_api=api, namespace="default")
+    second_cm = json.dumps(state["configmaps"]["k8s-route-config"],
+                           sort_keys=True)
+    assert first_cm == second_cm
