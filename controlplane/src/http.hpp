@@ -40,14 +40,26 @@ inline Url parse_url(const std::string& url) {
   std::string hostport = slash == std::string::npos ? rest
                                                     : rest.substr(0, slash);
   out.path = slash == std::string::npos ? "/" : rest.substr(slash);
-  size_t colon = hostport.rfind(':');
-  if (colon != std::string::npos) {
-    out.host = hostport.substr(0, colon);
-    out.port = hostport.substr(colon + 1);
+  if (!hostport.empty() && hostport[0] == '[') {
+    // IPv6 literal: strip the brackets (getaddrinfo wants the bare
+    // address) and only treat a colon AFTER ']' as the port separator.
+    size_t close = hostport.find(']');
+    if (close == std::string::npos || close == 1) return out;
+    out.host = hostport.substr(1, close - 1);
+    if (close + 1 < hostport.size()) {
+      if (hostport[close + 1] != ':') return out;
+      out.port = hostport.substr(close + 2);
+    }
   } else {
-    out.host = hostport;
+    size_t colon = hostport.rfind(':');
+    if (colon != std::string::npos) {
+      out.host = hostport.substr(0, colon);
+      out.port = hostport.substr(colon + 1);
+    } else {
+      out.host = hostport;
+    }
   }
-  out.valid = !out.host.empty();
+  out.valid = !out.host.empty() && !out.port.empty();
   return out;
 }
 
@@ -168,9 +180,13 @@ inline Response request(const std::string& method, const std::string& url_str,
     return resp;
   }
 
+  // IPv6 literals must be re-bracketed in the Host header.
+  bool v6 = url.host.find(':') != std::string::npos;
+  std::string host_hdr =
+      (v6 ? "[" + url.host + "]" : url.host) + ":" + url.port;
   std::ostringstream req;
   req << method << ' ' << url.path << " HTTP/1.1\r\n"
-      << "Host: " << url.host << ':' << url.port << "\r\n"
+      << "Host: " << host_hdr << "\r\n"
       << "Connection: close\r\n"
       << "Accept: application/json\r\n";
   if (!body.empty() || method == "POST" || method == "PUT" ||

@@ -76,7 +76,15 @@ inline bool write_file_atomic(const std::string& path,
     std::ofstream f(tmp, std::ios::binary | std::ios::trunc);
     if (!f) return false;
     f << content;
-    if (!f.good()) return false;
+    // Flush + close BEFORE checking: operator<< may buffer, and a
+    // failed destructor-time flush (e.g. ENOSPC) would otherwise pass
+    // the check and rename a truncated file into place.
+    f.flush();
+    f.close();
+    if (f.fail()) {
+      ::remove(tmp.c_str());
+      return false;
+    }
   }
   return ::rename(tmp.c_str(), path.c_str()) == 0;
 }
@@ -115,6 +123,8 @@ struct RouteStatus {
   std::string message;
   std::string config_ref;
   std::string last_applied_time;
+  // k8s condition semantics: set when Ready flips, carried otherwise.
+  std::string last_transition_time;
   HealthState health;
 
   cpjson::ValuePtr to_json() const {
@@ -126,7 +136,10 @@ struct RouteStatus {
     ready_cond->set_string("status", ready ? "True" : "False");
     ready_cond->set_string("reason", reason);
     ready_cond->set_string("message", message);
-    ready_cond->set_string("lastTransitionTime", now_iso8601());
+    ready_cond->set_string("lastTransitionTime",
+                           last_transition_time.empty()
+                               ? now_iso8601()
+                               : last_transition_time);
     conds->arr.push_back(ready_cond);
     v->set("conditions", conds);
     v->set_string("configMapRef", config_ref);
@@ -173,6 +186,10 @@ class Reconciler {
                                          const std::string& out_dir) {
     std::vector<RouteStatus> statuses;
     std::set<std::string> seen;
+    // GC may only run when every spec's resource identity is known; a
+    // transiently unreadable/unparseable file whose metadata.name
+    // differs from its filename must not tear down its live config.
+    bool gc_safe = true;
     mkdir_p(out_dir + "/status");
     for (const std::string& fname : list_json_files(spec_dir)) {
       std::string name = fname.substr(0, fname.size() - 5);  // strip .json
@@ -183,16 +200,24 @@ class Reconciler {
       if (!read_file(spec_dir + "/" + fname, &text)) {
         st.reason = "ReadError";
         st.message = "cannot read spec file";
-        finish_file_status(out_dir, st);
+        gc_safe = false;  // identity unknown — protect live configs
+        finish_error_status(out_dir, &st);
         statuses.push_back(st);
         seen.insert(st.name);
         continue;
       }
       ParseResult parsed = try_parse(name, text);
       if (!parsed.ok) {
+        // parse_spec resolves metadata.name before most failures; key
+        // the status off it so GC doesn't mistake the route for gone.
+        // Never adopt an unsafe name — it becomes a path component.
+        if (is_safe_name(parsed.spec.name) && parsed.spec.name != name)
+          st.name = parsed.spec.name;
+        else if (parsed.spec.name.empty())
+          gc_safe = false;  // bad JSON: identity unknown
         st.reason = "InvalidSpec";
         st.message = parsed.error;
-        finish_file_status(out_dir, st);
+        finish_error_status(out_dir, &st);
         statuses.push_back(st);
         seen.insert(st.name);
         continue;
@@ -215,6 +240,8 @@ class Reconciler {
         if (!write_file_atomic(cfg_path, rendered)) {
           st.reason = "WriteError";
           st.message = "cannot write " + cfg_path;
+          st.last_applied_time = applied_time_[spec.name];
+          stamp_transition(st.name, &st);
           finish_file_status(out_dir, st);
           statuses.push_back(st);
           // Still seen: a transient write failure must not let
@@ -231,11 +258,12 @@ class Reconciler {
       st.reason = "Reconciled";
       st.message = changed ? "config updated" : "config up to date";
       health_[spec.name] = st.health;
+      stamp_transition(st.name, &st);
       finish_file_status(out_dir, st);
       statuses.push_back(st);
       seen.insert(st.name);
     }
-    collect_garbage(out_dir, seen);
+    if (gc_safe) collect_garbage(out_dir, seen);
     return statuses;
   }
 
@@ -276,6 +304,7 @@ class Reconciler {
     auto items = list->get("items");
     if (!items || !items->is_array()) return statuses;
 
+    std::set<std::string> seen_keys;
     for (const auto& item : items->arr) {
       RouteStatus st;
       ParseResult parsed = parse_spec("", item);
@@ -283,6 +312,13 @@ class Reconciler {
         auto meta = item->get("metadata");
         st.name = meta && meta->is_object() ? meta->get_string("name")
                                             : "<unknown>";
+        // The CR still exists — protect its probe/applied state from
+        // prune_state during a transiently invalid edit.
+        if (meta && meta->is_object() && !st.name.empty()) {
+          std::string ns_of = meta->get_string("namespace");
+          seen_keys.insert((ns_of.empty() ? "default" : ns_of) + "/" +
+                           st.name);
+        }
         st.reason = "InvalidSpec";
         st.message = parsed.error;
         statuses.push_back(st);
@@ -293,18 +329,24 @@ class Reconciler {
       // CRs are namespaced: same-named routes in different namespaces
       // must not share probe/applied state.
       std::string key = spec.namespace_ + "/" + spec.name;
+      seen_keys.insert(key);
       st.health = health_[key];
       st.config_ref = spec.config_name();
 
-      // Recover lastAppliedTime from the CR's existing status so an
-      // agent restart (or repeated --once run) doesn't clobber it.
-      if (applied_time_[key].empty()) {
-        auto prev = item->get("status");
-        if (prev && prev->is_object())
-          applied_time_[key] = prev->get_string("lastAppliedTime");
-      }
+      // Recover lastAppliedTime + the Ready transition time from the
+      // CR's existing status so an agent restart (or repeated --once
+      // run) doesn't clobber them.
+      auto prev = item->get("status");
+      if (applied_time_[key].empty() && prev && prev->is_object())
+        applied_time_[key] = prev->get_string("lastAppliedTime");
+      recover_transition(key, prev);
 
       if (!upsert_configmap(api_base, item, spec, key, &st)) {
+        // Carry the recovered lastAppliedTime so a failure-path status
+        // PUT can't clobber it in the CR.
+        st.last_applied_time = applied_time_[key];
+        stamp_transition(key, &st);
+        update_cr_status(api_base, item, spec, st);
         statuses.push_back(st);
         continue;
       }
@@ -314,9 +356,11 @@ class Reconciler {
       st.reason = "Reconciled";
       st.message = "config map reconciled";
       health_[key] = st.health;
+      stamp_transition(key, &st);
       update_cr_status(api_base, item, spec, st);
       statuses.push_back(st);
     }
+    prune_state(seen_keys, ns);
     return statuses;
   }
 
@@ -328,6 +372,56 @@ class Reconciler {
   std::map<std::string, HealthState> health_;
   std::map<std::string, std::string> applied_time_;
   std::map<std::string, std::time_t> last_probe_;
+  // Ready value + when it last flipped, per route key (k8s condition
+  // semantics: lastTransitionTime only moves on actual transitions).
+  std::map<std::string, std::pair<bool, std::string>> transition_;
+
+  // Set st->last_transition_time, stamping a fresh time only when the
+  // Ready condition actually changed value.
+  void stamp_transition(const std::string& key, RouteStatus* st) {
+    auto it = transition_.find(key);
+    if (it != transition_.end() && it->second.first == st->ready &&
+        !it->second.second.empty()) {
+      st->last_transition_time = it->second.second;
+      return;
+    }
+    st->last_transition_time = now_iso8601();
+    transition_[key] = {st->ready, st->last_transition_time};
+  }
+
+  // Seed transition_ from a previously-persisted status object.
+  void recover_transition(const std::string& key,
+                          const cpjson::ValuePtr& prev) {
+    if (transition_.count(key) || !prev || !prev->is_object()) return;
+    auto conds = prev->get("conditions");
+    if (!conds || !conds->is_array() || conds->arr.empty()) return;
+    for (const auto& c : conds->arr) {
+      if (!c->is_object() || c->get_string("type") != "Ready") continue;
+      std::string t = c->get_string("lastTransitionTime");
+      if (!t.empty())
+        transition_[key] = {c->get_string("status") == "True", t};
+      return;
+    }
+  }
+
+  // Drop per-route state for routes that no longer exist (k8s mode; the
+  // file-mode analogue lives in collect_garbage). When the reconcile is
+  // namespace-scoped, only that namespace's keys are candidates.
+  void prune_state(const std::set<std::string>& seen_keys,
+                   const std::string& ns) {
+    auto stale = [&](const std::string& key) {
+      if (seen_keys.count(key)) return false;
+      return ns.empty() || key.rfind(ns + "/", 0) == 0;
+    };
+    for (auto it = health_.begin(); it != health_.end();)
+      it = stale(it->first) ? health_.erase(it) : std::next(it);
+    for (auto it = applied_time_.begin(); it != applied_time_.end();)
+      it = stale(it->first) ? applied_time_.erase(it) : std::next(it);
+    for (auto it = last_probe_.begin(); it != last_probe_.end();)
+      it = stale(it->first) ? last_probe_.erase(it) : std::next(it);
+    for (auto it = transition_.begin(); it != transition_.end();)
+      it = stale(it->first) ? transition_.erase(it) : std::next(it);
+  }
 
   static std::vector<std::string> list_json_files(const std::string& dir) {
     std::vector<std::string> out;
@@ -394,6 +488,7 @@ class Reconciler {
       auto prev = cpjson::parse(text);
       if (applied_time_[name].empty())
         applied_time_[name] = prev->get_string("lastAppliedTime");
+      recover_transition(name, prev);
       auto h = prev->get("routerHealth");
       if (h && h->is_object() && !health_[name].ever_probed) {
         HealthState& hs = health_[name];
@@ -420,6 +515,28 @@ class Reconciler {
     return timegm(&tm);
   }
 
+  // Error-path status write: a transient failure must not erase the
+  // persisted lastAppliedTime/routerHealth/transition of a previously
+  // healthy route (the status file is the file-mode store of record).
+  void finish_error_status(const std::string& out_dir, RouteStatus* st) {
+    recover_state(out_dir, st->name);
+    st->last_applied_time = applied_time_[st->name];
+    st->health = health_[st->name];
+    if (st->config_ref.empty()) {
+      // Keep the configMapRef pointer so GC can still find the rendered
+      // config if the spec is deleted while in this error state.
+      std::string text;
+      if (read_file(out_dir + "/status/" + st->name + ".json", &text)) {
+        try {
+          st->config_ref = cpjson::parse(text)->get_string("configMapRef");
+        } catch (const cpjson::ParseError&) {
+        }
+      }
+    }
+    stamp_transition(st->name, st);
+    finish_file_status(out_dir, *st);
+  }
+
   void finish_file_status(const std::string& out_dir, const RouteStatus& st) {
     write_file_atomic(out_dir + "/status/" + st.name + ".json",
                       cpjson::dump(st.to_json()));
@@ -441,15 +558,19 @@ class Reconciler {
         } catch (const cpjson::ParseError&) {
         }
       }
-      if (!config_ref.empty() && config_ref.find('/') == std::string::npos) {
+      // is_safe_name (not just a '/'-check) so a corrupted status file
+      // can never aim the delete at e.g. ".." and escape out_dir.
+      if (is_safe_name(config_ref)) {
         std::string cfg_dir = out_dir + "/" + config_ref;
         ::remove((cfg_dir + "/dynamic_config.json").c_str());
         ::rmdir(cfg_dir.c_str());
       }
-      ::remove((status_dir + "/" + fname).c_str());
+      if (is_safe_name(name))
+        ::remove((status_dir + "/" + fname).c_str());
       health_.erase(name);
       applied_time_.erase(name);
       last_probe_.erase(name);
+      transition_.erase(name);
     }
   }
 
@@ -521,11 +642,20 @@ class Reconciler {
 
   void update_cr_status(const std::string& api_base,
                         const cpjson::ValuePtr& item,
-                        const StaticRouteSpec& spec, const RouteStatus& st) {
+                        const StaticRouteSpec& spec,
+                        const RouteStatus& st) {
     // PUT the fetched object back with .status set (needs resourceVersion,
-    // which the fetched item carries).
+    // which the fetched item carries). Skip when the CR's live status
+    // already matches — an unconditional PUT every tick would bump
+    // resourceVersion forever and wake every watcher of the CRD.
+    // Comparing against the *fetched* status (not a local cache) also
+    // repairs external edits; cpjson objects are sorted maps, so dumps
+    // are order-normalized on both sides.
+    auto status_json = st.to_json();
+    auto live = item->get("status");
+    if (live && cpjson::dump(live) == cpjson::dump(status_json)) return;
     auto obj = item;  // shared structure; we only mutate .status
-    obj->set("status", st.to_json());
+    obj->set("status", status_json);
     std::string url = api_base + "/apis/" + std::string(kGroup) + "/" +
                       kVersion + "/namespaces/" + spec.namespace_ +
                       "/staticroutes/" + spec.name + "/status";

@@ -94,6 +94,19 @@ inline bool is_valid_backend_url(const std::string& url) {
   return pos == url.size() || url[pos] == '/';
 }
 
+// Resource/ConfigMap names become path components (file mode) and URL
+// segments (k8s mode); restrict to k8s-object-name characters so a
+// malicious or mistyped name like "../.." can never escape the output
+// dir (written AND deleted by the reconciler) or splice the API path.
+inline bool is_safe_name(const std::string& n) {
+  if (n.empty() || n == "." || n == "..") return false;
+  for (char c : n) {
+    if (!(isalnum((unsigned char)c) || c == '.' || c == '-' || c == '_'))
+      return false;
+  }
+  return true;
+}
+
 struct ParseResult {
   bool ok = false;
   std::string error;
@@ -122,6 +135,14 @@ inline ParseResult parse_spec(const std::string& name,
   }
   if (s.name.empty()) {
     out.error = "spec has no name";
+    return out;
+  }
+  if (!is_safe_name(s.name)) {
+    out.error = "invalid resource name '" + s.name + "'";
+    return out;
+  }
+  if (!is_safe_name(s.namespace_)) {
+    out.error = "invalid namespace '" + s.namespace_ + "'";
     return out;
   }
 
@@ -193,6 +214,10 @@ inline ParseResult parse_spec(const std::string& name,
   }
   s.router_url = spec->get_string("routerUrl");
   s.config_map_name = spec->get_string("configMapName");
+  if (!s.config_map_name.empty() && !is_safe_name(s.config_map_name)) {
+    out.error = "invalid configMapName '" + s.config_map_name + "'";
+    return out;
+  }
 
   auto hc = spec->get("healthCheck");
   if (hc && hc->is_object()) {

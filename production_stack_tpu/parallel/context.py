@@ -22,17 +22,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.models.llama import rms_norm as _rms_norm
 from production_stack_tpu.ops.ring_attention import ring_attention
 from production_stack_tpu.ops.rope import apply_rope
 
 Params = Dict[str, jnp.ndarray]
-
-
-def _rms_norm(x, weight, eps):
-    x32 = x.astype(jnp.float32)
-    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
-    return (x32 * jax.lax.rsqrt(var + eps)
-            * weight.astype(jnp.float32)).astype(x.dtype)
 
 
 def _local_forward(params: Params, tokens: jnp.ndarray,

@@ -406,3 +406,92 @@ def test_k8s_mode_idempotent_second_pass(agent_binary, fake_kube):
     second_cm = json.dumps(state["configmaps"]["k8s-route-config"],
                            sort_keys=True)
     assert first_cm == second_cm
+
+
+# ------------------------------------------------- regression: GC safety
+
+
+def test_transient_invalid_spec_preserves_live_config(agent_binary,
+                                                      tmp_path):
+    """A spec whose metadata.name differs from its filename must keep its
+    rendered config alive through a transient validation error — the
+    error status keys off the resource identity, not the filename, so
+    GC cannot mistake the route for deleted."""
+    specs = tmp_path / "specs"
+    out = tmp_path / "out"
+    cr = {
+        "metadata": {"name": "cr-named"},
+        "spec": dict(BASE_SPEC, configMapName="custom-config"),
+    }
+    write_spec(specs, "file-name", cr)
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    live = out / "custom-config" / "dynamic_config.json"
+    assert live.exists()
+
+    # Transient bad edit: parseable JSON, invalid routingLogic.
+    bad = {
+        "metadata": {"name": "cr-named"},
+        "spec": dict(BASE_SPEC, configMapName="custom-config",
+                     routingLogic="typo"),
+    }
+    write_spec(specs, "file-name", bad)
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    assert live.exists(), "GC tore down live config on transient error"
+    status = read_json(out / "status" / "cr-named.json")
+    assert status["conditions"][0]["reason"] == "InvalidSpec"
+
+    # Fixing the spec restores Ready without ever having lost the config.
+    write_spec(specs, "file-name", cr)
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    assert read_json(
+        out / "status" / "cr-named.json"
+    )["conditions"][0]["status"] == "True"
+
+
+@pytest.mark.parametrize("field,value", [
+    ("configMapName", ".."),
+    ("configMapName", "../escape"),
+    ("metadataName", "../evil"),
+])
+def test_path_traversal_names_rejected(agent_binary, tmp_path, field,
+                                       value):
+    """metadata.name / configMapName become path components; anything
+    that could escape the output dir must fail validation."""
+    specs = tmp_path / "specs"
+    out = tmp_path / "out"
+    if field == "metadataName":
+        spec = {"metadata": {"name": value}, "spec": dict(BASE_SPEC)}
+    else:
+        spec = dict(BASE_SPEC, **{field: value})
+    write_spec(specs, "trav", spec)
+    proc = controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    assert proc.returncode == 0
+    status = read_json(out / "status" / "trav.json")
+    assert status["conditions"][0]["reason"] == "InvalidSpec"
+    # Nothing may have been written outside out_dir.
+    assert not (tmp_path / "dynamic_config.json").exists()
+    assert not (tmp_path / "escape").exists()
+    assert not (tmp_path / "evil.json").exists()
+
+
+def test_transition_time_stable_across_runs(agent_binary, tmp_path):
+    """k8s condition semantics: lastTransitionTime moves only when the
+    Ready condition flips, surviving process restarts via the persisted
+    status (the reference gets this from apimachinery's SetStatusCondition)."""
+    specs = tmp_path / "specs"
+    out = tmp_path / "out"
+    write_spec(specs, "tt", BASE_SPEC)
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    first = read_json(out / "status" / "tt.json")["conditions"][0]
+    time.sleep(1.1)
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    second = read_json(out / "status" / "tt.json")["conditions"][0]
+    assert second["lastTransitionTime"] == first["lastTransitionTime"]
+
+    # A flip to not-Ready re-stamps it.
+    time.sleep(1.1)
+    write_spec(specs, "tt", dict(BASE_SPEC, routingLogic="typo"))
+    controlplane.run_once(spec_dir=str(specs), out_dir=str(out))
+    third = read_json(out / "status" / "tt.json")["conditions"][0]
+    assert third["status"] == "False"
+    assert third["lastTransitionTime"] != first["lastTransitionTime"]
