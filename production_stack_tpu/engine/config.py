@@ -135,6 +135,16 @@ class ParallelConfig:
 
 
 @dataclasses.dataclass
+class LoRAConfig:
+    """Multi-LoRA serving (the reference's --enable-lora pass-through,
+    helm/templates/deployment-vllm-multi.yaml:66-68; see engine/lora.py)."""
+
+    enable: bool = False
+    max_loras: int = 8  # adapter slots (slot 0 is always the base model)
+    max_lora_rank: int = 16
+
+
+@dataclasses.dataclass
 class OffloadConfig:
     """KV offload tiers (the LMCache analogue; see engine/offload.py)."""
 
@@ -153,6 +163,7 @@ class EngineConfig:
         default_factory=ParallelConfig)
     offload: OffloadConfig = dataclasses.field(
         default_factory=OffloadConfig)
+    lora: LoRAConfig = dataclasses.field(default_factory=LoRAConfig)
     seed: int = 0
 
 

@@ -125,7 +125,8 @@ class LLMEngine:
     def add_request(self, prompt_token_ids: List[int],
                     sampling: Optional[SamplingParams] = None,
                     seq_id: Optional[str] = None,
-                    output_sink=None) -> str:
+                    output_sink=None,
+                    lora_name: Optional[str] = None) -> str:
         sampling = sampling or SamplingParams()
         stop_ids = list(sampling.stop_token_ids)
         if (not sampling.ignore_eos
@@ -133,11 +134,17 @@ class LLMEngine:
                 and self.tokenizer.eos_token_id not in stop_ids):
             stop_ids.append(self.tokenizer.eos_token_id)
         sampling.stop_token_ids = stop_ids
+        lora_id = 0
+        if lora_name is not None:
+            if self.runner.lora_registry is None:
+                raise ValueError("LoRA is not enabled on this engine")
+            lora_id = self.runner.lora_registry.slot_for(lora_name)
         seq = Sequence(
             seq_id=seq_id or f"seq-{uuid.uuid4().hex[:16]}",
             prompt_token_ids=list(prompt_token_ids),
             sampling=sampling,
             output_sink=output_sink,
+            lora_id=lora_id,
         )
         with self._lock:
             self.sequences[seq.seq_id] = seq
@@ -147,6 +154,24 @@ class LLMEngine:
                 self.sequences.pop(seq.seq_id, None)
                 raise
         return seq.seq_id
+
+    def register_lora(self, name_or_path: str,
+                      name: Optional[str] = None) -> int:
+        """Load + install a PEFT adapter; serve it under ``name``."""
+        if self.runner.lora_registry is None:
+            raise ValueError("LoRA is not enabled on this engine")
+        from production_stack_tpu.engine.lora import load_peft_adapter
+        adapter = load_peft_adapter(
+            name_or_path, self.config.model,
+            self.config.lora.max_lora_rank, name=name,
+        )
+        with self._lock:
+            return self.runner.lora_registry.register(adapter)
+
+    def lora_names(self) -> List[str]:
+        if self.runner.lora_registry is None:
+            return []
+        return self.runner.lora_registry.names()
 
     def abort_request(self, seq_id: str) -> None:
         with self._lock:

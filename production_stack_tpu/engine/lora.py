@@ -1,0 +1,246 @@
+"""Multi-LoRA adapter serving.
+
+The reference enables LoRA by passing ``--enable-lora`` to vLLM
+(helm/templates/deployment-vllm-multi.yaml:66-68, helm/values.yaml:56-58)
+and serves adapters under their own model names (tutorials/08-lora.md
+flow). Here LoRA is TPU-first: all adapter slots live in HBM as stacked
+arrays ``A: [L, S, in, r]`` / ``B: [L, S, r, out]`` (L = layers, S =
+slots), and a batch row selects its adapter with a gather on a per-row
+id vector — one einsum pair per projection, fully static shapes, no
+per-adapter dispatch. Slot 0 is all-zeros (the base model), so mixed
+base/adapter batches run in the same compiled step.
+
+Adapter files use the HF PEFT format (``adapter_config.json`` +
+``adapter_model.safetensors`` with ``...layers.{i}.<proj>.lora_A.weight``
+keys); ranks below ``max_lora_rank`` are zero-padded so every adapter
+fits the static stack shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+# PEFT module name -> our stacked-param name, per architecture.
+_TARGET_MAP = {
+    "llama": {
+        "q_proj": "wq", "k_proj": "wk", "v_proj": "wv", "o_proj": "wo",
+        "gate_proj": "w_gate", "up_proj": "w_up", "down_proj": "w_down",
+    },
+    "opt": {
+        "q_proj": "wq", "k_proj": "wk", "v_proj": "wv",
+        "out_proj": "wo", "fc1": "fc1", "fc2": "fc2",
+    },
+}
+
+
+def target_shapes(config: ModelConfig) -> Dict[str, Tuple[int, int]]:
+    """(in_dim, out_dim) of every LoRA-targetable projection."""
+    h = config.hidden_size
+    nh, nkv, d = (config.num_attention_heads,
+                  config.num_key_value_heads, config.head_dim)
+    ffn = config.intermediate_size
+    if config.architecture == "opt":
+        return {
+            "wq": (h, nh * d), "wk": (h, nh * d), "wv": (h, nh * d),
+            "wo": (nh * d, h), "fc1": (h, ffn), "fc2": (ffn, h),
+        }
+    return {
+        "wq": (h, nh * d), "wk": (h, nkv * d), "wv": (h, nkv * d),
+        "wo": (nh * d, h), "w_gate": (h, ffn), "w_up": (h, ffn),
+        "w_down": (ffn, h),
+    }
+
+
+@dataclasses.dataclass
+class LoRAAdapter:
+    """One loaded adapter: per-target (A [L, in, r], B [L, r, out])."""
+
+    name: str
+    rank: int
+    scaling: float
+    # target name -> (A, B) numpy arrays, already rank-padded.
+    weights: Dict[str, Tuple[np.ndarray, np.ndarray]]
+
+
+def empty_lora_stack(config: ModelConfig, max_loras: int,
+                     max_lora_rank: int) -> Dict:
+    """All-zero adapter stacks (slot 0 stays zero forever = base)."""
+    slots = max_loras + 1
+    layers = config.num_hidden_layers
+    dtype = config.jax_dtype
+    a, b = {}, {}
+    for tgt, (d_in, d_out) in target_shapes(config).items():
+        a[tgt] = jnp.zeros((layers, slots, d_in, max_lora_rank), dtype)
+        b[tgt] = jnp.zeros((layers, slots, max_lora_rank, d_out), dtype)
+    return {
+        "a": a, "b": b,
+        "scaling": jnp.zeros((slots,), jnp.float32),
+    }
+
+
+@jax.jit
+def _set_slot(stack_arr: jax.Array, slot: jax.Array,
+              value: jax.Array) -> jax.Array:
+    return stack_arr.at[:, slot].set(value.astype(stack_arr.dtype))
+
+
+def install_adapter(stack: Dict, slot: int,
+                    adapter: LoRAAdapter) -> Dict:
+    """Write one adapter into a stack slot (out-of-place pytree).
+
+    Targets the adapter does not train are zeroed, so re-registering a
+    name never leaves stale weights from the slot's previous occupant.
+    """
+    for tgt in adapter.weights:
+        if tgt not in stack["a"]:
+            raise ValueError(f"Unknown LoRA target {tgt!r}")
+    a = dict(stack["a"])
+    b = dict(stack["b"])
+    slot_arr = jnp.asarray(slot)
+    for tgt in a:
+        pair = adapter.weights.get(tgt)
+        if pair is None:
+            zero_a = jnp.zeros(a[tgt].shape[0:1] + a[tgt].shape[2:],
+                               a[tgt].dtype)
+            zero_b = jnp.zeros(b[tgt].shape[0:1] + b[tgt].shape[2:],
+                               b[tgt].dtype)
+            a[tgt] = _set_slot(a[tgt], slot_arr, zero_a)
+            b[tgt] = _set_slot(b[tgt], slot_arr, zero_b)
+        else:
+            a[tgt] = _set_slot(a[tgt], slot_arr, jnp.asarray(pair[0]))
+            b[tgt] = _set_slot(b[tgt], slot_arr, jnp.asarray(pair[1]))
+    scaling = stack["scaling"].at[slot].set(adapter.scaling)
+    return {"a": a, "b": b, "scaling": scaling}
+
+
+def lora_matmul(x: jnp.ndarray, base_w: jnp.ndarray,
+                lora_layer: Optional[Dict], target: str,
+                lora_ids: Optional[jnp.ndarray],
+                scale: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """``x @ W + scale_b * (x @ A[id_b]) @ B[id_b]`` per batch row.
+
+    Inside ``lax.scan`` the stacks arrive with the layer axis already
+    sliced off: ``lora_layer['a'][target]`` is [S, in, r]. The gather
+    over ``lora_ids`` keeps shapes static for any adapter mix.
+    """
+    out = x @ base_w
+    if lora_layer is None:
+        return out
+    a_sel = lora_layer["a"][target][lora_ids]  # [B, in, r]
+    b_sel = lora_layer["b"][target][lora_ids]  # [B, r, out]
+    delta = jnp.einsum("bti,bir->btr", x, a_sel)
+    delta = jnp.einsum("btr,bro->bto", delta, b_sel)
+    return out + delta * scale[:, None, None].astype(x.dtype)
+
+
+def load_peft_adapter(path: str, config: ModelConfig,
+                      max_lora_rank: int,
+                      name: Optional[str] = None) -> LoRAAdapter:
+    """Load a HuggingFace PEFT adapter directory.
+
+    Expects ``adapter_config.json`` (r, lora_alpha, target_modules) and
+    ``adapter_model.safetensors`` (or ``.npz`` fallback) with keys
+    ``...model.layers.{i}.self_attn.q_proj.lora_A.weight`` of shape
+    [r, in] (A) and [out, r] (B) — transposed here to row-major matmul
+    layout and zero-padded to ``max_lora_rank``.
+    """
+    cfg_path = os.path.join(path, "adapter_config.json")
+    with open(cfg_path) as f:
+        acfg = json.load(f)
+    rank = int(acfg["r"])
+    alpha = float(acfg.get("lora_alpha", rank))
+    if rank > max_lora_rank:
+        raise ValueError(
+            f"Adapter rank {rank} exceeds --max-lora-rank {max_lora_rank}"
+        )
+    st_path = os.path.join(path, "adapter_model.safetensors")
+    if os.path.exists(st_path):
+        from safetensors.numpy import load_file
+        raw = load_file(st_path)
+    else:
+        npz = np.load(os.path.join(path, "adapter_model.npz"))
+        raw = {k: npz[k] for k in npz.files}
+
+    tmap = _TARGET_MAP.get(config.architecture, _TARGET_MAP["llama"])
+    layers = config.num_hidden_layers
+    shapes = target_shapes(config)
+    per_target: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+
+    def find(template: str, i: int, proj: str, kind: str):
+        for key in raw:
+            if (f"layers.{i}." in key and f"{proj}." in key
+                    and f"lora_{kind}" in key):
+                return raw[key]
+        return None
+
+    for proj, tgt in tmap.items():
+        d_in, d_out = shapes[tgt]
+        a_stack = np.zeros((layers, d_in, max_lora_rank), np.float32)
+        b_stack = np.zeros((layers, max_lora_rank, d_out), np.float32)
+        found = False
+        for i in range(layers):
+            A = find("", i, proj, "A")  # [r, in]
+            B = find("", i, proj, "B")  # [out, r]
+            if A is None or B is None:
+                continue
+            found = True
+            r = A.shape[0]
+            a_stack[i, :, :r] = np.asarray(A, np.float32).T
+            b_stack[i, :r, :] = np.asarray(B, np.float32).T
+        if found:
+            per_target[tgt] = (a_stack, b_stack)
+    if not per_target:
+        raise ValueError(f"No LoRA weights found under {path}")
+    return LoRAAdapter(
+        name=name or os.path.basename(os.path.normpath(path)),
+        rank=rank,
+        scaling=alpha / rank,
+        weights=per_target,
+    )
+
+
+class LoRARegistry:
+    """Name -> slot bookkeeping over the device-resident stack."""
+
+    def __init__(self, config: ModelConfig, max_loras: int,
+                 max_lora_rank: int):
+        self.config = config
+        self.max_loras = max_loras
+        self.max_lora_rank = max_lora_rank
+        self.stack = empty_lora_stack(config, max_loras, max_lora_rank)
+        self.slots: Dict[str, int] = {}
+
+    def register(self, adapter: LoRAAdapter) -> int:
+        if adapter.name in self.slots:
+            slot = self.slots[adapter.name]
+        else:
+            if len(self.slots) >= self.max_loras:
+                raise ValueError(
+                    f"All {self.max_loras} LoRA slots in use"
+                )
+            slot = len(self.slots) + 1  # slot 0 = base
+            self.slots[adapter.name] = slot
+        self.stack = install_adapter(self.stack, slot, adapter)
+        logger.info("LoRA adapter %r installed in slot %d (rank %d)",
+                    adapter.name, slot, adapter.rank)
+        return slot
+
+    def slot_for(self, name: Optional[str]) -> int:
+        if name is None:
+            return 0
+        return self.slots[name]
+
+    def names(self) -> List[str]:
+        return list(self.slots)

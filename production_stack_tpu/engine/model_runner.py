@@ -61,11 +61,14 @@ class ModelRunner:
             )
         self.params = shard_params(params, model_config, mesh)
 
+        # Head-major paged cache: [L, kv_heads, pages, page_size, d].
+        # The kv axis is major so the Pallas decode kernel's per-page
+        # blocks slice only major dims, and TP shards a leading axis.
         cache_shape = (
             model_config.num_hidden_layers,
+            model_config.num_key_value_heads,
             config.cache.num_pages,
             config.cache.page_size,
-            model_config.num_key_value_heads,
             model_config.head_dim,
         )
         dtype = model_config.jax_dtype
@@ -81,20 +84,46 @@ class ModelRunner:
         )
         self._rng = jax.random.PRNGKey(config.seed + 1)
 
+        # Multi-LoRA: device-resident adapter stacks; a per-row slot-id
+        # vector selects the adapter (engine/lora.py). None when off so
+        # the base model compiles with zero LoRA overhead.
+        self.lora_registry = None
+        if config.lora.enable:
+            from production_stack_tpu.engine.lora import LoRARegistry
+            self.lora_registry = LoRARegistry(
+                model_config, config.lora.max_loras,
+                config.lora.max_lora_rank,
+            )
+
         self._step_jit = jax.jit(
             self._step_impl,
             static_argnames=("sample_index_mode",),
             donate_argnums=(1, 2),  # k_cache, v_cache
         )
 
+    @property
+    def _lora_stack(self):
+        return (None if self.lora_registry is None
+                else self.lora_registry.stack)
+
+    def _lora_ids(self, seqs, pad_to: int):
+        if self.lora_registry is None:
+            return None
+        ids = np.zeros((pad_to,), np.int32)
+        for i, seq in enumerate(seqs):
+            ids[i] = seq.lora_id
+        return jnp.asarray(ids)
+
     # ---- compiled step ----------------------------------------------------
 
     def _step_impl(self, params, k_cache, v_cache, tokens, positions,
                    page_table, kv_lens, valid, last_index, temperature,
-                   top_p, top_k, rng, sample_index_mode: str):
+                   top_p, top_k, rng, lora, lora_ids,
+                   sample_index_mode: str):
         logits, k_cache, v_cache = self._forward(
             params, self.config.model, tokens, positions, page_table,
             kv_lens, valid, k_cache, v_cache,
+            lora=lora, lora_ids=lora_ids,
         )
         if sample_index_mode == "last":
             # Prefill: sample only from the final prompt position.
@@ -147,6 +176,7 @@ class ModelRunner:
             jnp.asarray(valid), jnp.asarray(last_index),
             jnp.asarray(temperature), jnp.asarray(top_p),
             jnp.asarray(top_k), self._next_rng(),
+            self._lora_stack, self._lora_ids([seq], 1),
             sample_index_mode="last",
         )
         if plan.is_last_chunk:
@@ -190,6 +220,7 @@ class ModelRunner:
             jnp.asarray(valid), jnp.asarray(last_index),
             jnp.asarray(temperature), jnp.asarray(top_p),
             jnp.asarray(top_k), self._next_rng(),
+            self._lora_stack, self._lora_ids(seqs, b),
             sample_index_mode="first",
         )
         host = jax.device_get(sampled)
@@ -198,9 +229,9 @@ class ModelRunner:
     # ---- page-granular IO (offload tiers) ---------------------------------
 
     def read_page(self, page_id: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Copy one page's KV out of HBM: [L, page_size, kv, d] each."""
-        k = jax.device_get(self.k_cache[:, page_id])
-        v = jax.device_get(self.v_cache[:, page_id])
+        """Copy one page's KV out of HBM: [L, kv, page_size, d] each."""
+        k = jax.device_get(self.k_cache[:, :, page_id])
+        v = jax.device_get(self.v_cache[:, :, page_id])
         return k, v
 
     def write_page(self, page_id: int, k_page: np.ndarray,
@@ -209,7 +240,7 @@ class ModelRunner:
         if not hasattr(self, "_write_page_jit"):
             self._write_page_jit = jax.jit(
                 lambda cache, page, pid:
-                    cache.at[:, pid].set(page.astype(cache.dtype)),
+                    cache.at[:, :, pid].set(page.astype(cache.dtype)),
                 donate_argnums=(0,),
             )
         self.k_cache = self._write_page_jit(

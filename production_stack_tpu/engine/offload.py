@@ -32,15 +32,23 @@ from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
 
-# A page's KV payload: (k, v), each [L, page_size, kv_heads, head_dim].
+# A page's KV payload: (k, v), each [L, kv_heads, page_size, head_dim]
+# (the head-major cache layout, model_runner.read_page).
 PagePayload = Tuple[np.ndarray, np.ndarray]
+
+# Wire-format version, folded into every tier key so pods running a
+# different KV page layout (e.g. across a rolling upgrade against a
+# shared remote cache) can never restore each other's bytes into the
+# wrong axis order. Bump whenever PagePayload layout changes.
+KV_WIRE_VERSION = 2
 
 
 def _stable_key(page_hash: PageHash) -> str:
     """Serializable, process-independent key for a chain hash."""
     import hashlib
     parent, tokens = page_hash
-    raw = f"{parent}:{','.join(map(str, tokens))}".encode()
+    raw = (f"v{KV_WIRE_VERSION}:{parent}:"
+           f"{','.join(map(str, tokens))}").encode()
     return hashlib.sha256(raw).hexdigest()
 
 

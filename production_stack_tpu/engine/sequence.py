@@ -52,6 +52,8 @@ class Sequence:
     finish_reason: Optional[FinishReason] = None
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    # LoRA adapter slot (0 = base model; see engine/lora.py).
+    lora_id: int = 0
     # Server-side stream hook (asyncio queue or callable), opaque here.
     output_sink: Any = None
 

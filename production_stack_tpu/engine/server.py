@@ -31,6 +31,7 @@ from aiohttp import web
 from production_stack_tpu.engine.config import (
     CacheConfig,
     EngineConfig,
+    LoRAConfig,
     ModelConfig,
     OffloadConfig,
     ParallelConfig,
@@ -81,10 +82,11 @@ class AsyncEngine:
             except queue.Empty:
                 item = None
             if item is not None:
-                prompt, sampling, seq_id = item
+                prompt, sampling, seq_id, lora_name = item
                 try:
                     self.engine.add_request(
-                        prompt, sampling, seq_id=seq_id
+                        prompt, sampling, seq_id=seq_id,
+                        lora_name=lora_name,
                     )
                 except Exception as e:
                     # Queue full / invalid request: fail THIS request,
@@ -116,12 +118,13 @@ class AsyncEngine:
             return
         self._loop.call_soon_threadsafe(stream.put_nowait, item)
 
-    async def submit(self, prompt: List[int],
-                     sampling: SamplingParams) -> tuple[str, asyncio.Queue]:
+    async def submit(self, prompt: List[int], sampling: SamplingParams,
+                     lora_name: Optional[str] = None,
+                     ) -> tuple[str, asyncio.Queue]:
         seq_id = f"seq-{uuid.uuid4().hex[:16]}"
         stream: asyncio.Queue = asyncio.Queue()
         self._streams[seq_id] = stream
-        self._submit_q.put((prompt, sampling, seq_id))
+        self._submit_q.put((prompt, sampling, seq_id, lora_name))
         return seq_id, stream
 
     def finish_stream(self, seq_id: str) -> None:
@@ -260,7 +263,19 @@ class EngineServer:
                 status=400,
             )
 
-        seq_id, stream = await self.async_engine.submit(prompt, sampling)
+        # A request addressed to a registered adapter name runs with
+        # that adapter; anything else runs the base model (the router
+        # already filtered by served model name).
+        requested = body.get("model")
+        lora_name = (requested
+                     if requested in self.engine.lora_names() else None)
+        # Adapter-addressed requests echo the adapter name (vLLM does
+        # the same so per-model client accounting stays correct).
+        response_model = lora_name or self.model_name
+
+        seq_id, stream = await self.async_engine.submit(
+            prompt, sampling, lora_name=lora_name
+        )
         decoder = self._delta_decoder()
 
         if not stream_mode:
@@ -286,7 +301,7 @@ class EngineServer:
             if chat:
                 payload = {
                     "id": rid, "object": "chat.completion",
-                    "created": created, "model": self.model_name,
+                    "created": created, "model": response_model,
                     "choices": [{
                         "index": 0,
                         "message": {"role": "assistant", "content": text},
@@ -297,7 +312,7 @@ class EngineServer:
             else:
                 payload = {
                     "id": rid, "object": "text_completion",
-                    "created": created, "model": self.model_name,
+                    "created": created, "model": response_model,
                     "choices": [{
                         "index": 0, "text": text,
                         "finish_reason": finish_reason,
@@ -331,7 +346,7 @@ class EngineServer:
                           "finish_reason": finish}
                 obj = "text_completion"
             return {"id": rid, "object": obj, "created": created,
-                    "model": self.model_name, "choices": [choice]}
+                    "model": response_model, "choices": [choice]}
 
         try:
             if chat:
@@ -360,14 +375,20 @@ class EngineServer:
         return resp
 
     async def models(self, request: web.Request):
-        return web.json_response({
-            "object": "list",
-            "data": [{
-                "id": self.model_name, "object": "model",
-                "created": int(self.async_engine.uptime_start),
+        created = int(self.async_engine.uptime_start)
+        data = [{
+            "id": self.model_name, "object": "model",
+            "created": created,
+            "owned_by": "production-stack-tpu",
+        }]
+        # LoRA adapters are addressable models (vLLM behavior).
+        for name in self.engine.lora_names():
+            data.append({
+                "id": name, "object": "model", "created": created,
                 "owned_by": "production-stack-tpu",
-            }],
-        })
+                "parent": self.model_name,
+            })
+        return web.json_response({"object": "list", "data": data})
 
     async def health(self, request: web.Request):
         return web.json_response({"status": "ok"})
@@ -457,9 +478,21 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             host_pool_bytes=args.kv_host_pool_bytes,
             remote_url=args.kv_remote_url,
         ),
+        lora=LoRAConfig(
+            enable=args.enable_lora or bool(args.lora_modules),
+            max_loras=args.max_loras,
+            max_lora_rank=args.max_lora_rank,
+        ),
     )
     engine = LLMEngine(config, mesh=mesh, params=params,
                        tokenizer=tokenizer)
+    for module in args.lora_modules or []:
+        name, _, path = module.partition("=")
+        if not path:
+            raise ValueError(
+                f"--lora-modules entries must be name=path, got {module!r}"
+            )
+        engine.register_lora(path, name=name)
     return engine, served_name
 
 
@@ -481,6 +514,13 @@ def parse_args(argv=None):
     parser.add_argument("--prefill-chunk-size", type=int, default=512)
     parser.add_argument("--tensor-parallel-size", type=int, default=1)
     parser.add_argument("--disable-prefix-caching", action="store_true")
+    parser.add_argument("--enable-lora", action="store_true",
+                        help="Enable multi-LoRA adapter serving")
+    parser.add_argument("--lora-modules", nargs="*", default=None,
+                        metavar="NAME=PATH",
+                        help="PEFT adapter dirs to serve by name")
+    parser.add_argument("--max-loras", type=int, default=8)
+    parser.add_argument("--max-lora-rank", type=int, default=16)
     parser.add_argument("--enable-kv-offload", action="store_true",
                         help="HBM->host-RAM KV offload tier")
     parser.add_argument("--kv-host-pool-bytes", type=int,

@@ -93,6 +93,7 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, page_table: jnp.ndarray,
             kv_lens: jnp.ndarray, valid: jnp.ndarray,
             k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+            lora=None, lora_ids=None,
             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One model invocation over a (possibly padded) token block.
 
@@ -102,10 +103,14 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
       page_table: [B, max_pages] physical page ids (page 0 = trash)
       kv_lens:    [B] valid cached tokens AFTER this block is written
       valid:      [B, T] mask of real (non-padding) tokens
-      k_cache/v_cache: [L, num_pages, page_size, kv_heads, head_dim]
+      k_cache/v_cache: [L, kv_heads, num_pages, page_size, head_dim]
+      lora:       optional adapter stacks (engine/lora.py), layer-leading
+      lora_ids:   [B] adapter slot per batch row (0 = base model)
 
     Returns (logits [B, T, vocab], new_k_cache, new_v_cache).
     """
+    from production_stack_tpu.engine.lora import lora_matmul
+
     nh, nkv, d = (config.num_attention_heads, config.num_key_value_heads,
                   config.head_dim)
     b, t = tokens.shape
@@ -118,14 +123,21 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
             "mlp_norm", "w_gate", "w_up", "w_down",
         )
     }
+    lora_scale = (None if lora is None
+                  else lora["scaling"][lora_ids])  # [B]
+    lora_scanned = (None if lora is None
+                    else {"a": lora["a"], "b": lora["b"]})
 
     def layer_step(x, scanned):
-        lp, k_layer, v_layer = scanned
+        lp, ll, k_layer, v_layer = scanned
         # Attention block
         a_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
-        q = (a_in @ lp["wq"]).reshape(b, t, nh, d)
-        k = (a_in @ lp["wk"]).reshape(b, t, nkv, d)
-        v = (a_in @ lp["wv"]).reshape(b, t, nkv, d)
+        q = lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids,
+                        lora_scale).reshape(b, t, nh, d)
+        k = lora_matmul(a_in, lp["wk"], ll, "wk", lora_ids,
+                        lora_scale).reshape(b, t, nkv, d)
+        v = lora_matmul(a_in, lp["wv"], ll, "wv", lora_ids,
+                        lora_scale).reshape(b, t, nkv, d)
         q = apply_rope(q, positions, config.rope_theta)
         k = apply_rope(k, positions, config.rope_theta)
         k_layer = write_to_pages(k_layer, k, page_table, positions, valid)
@@ -133,15 +145,20 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
         attn = dispatch_attention(
             config, q, k_layer, v_layer, page_table, positions, kv_lens
         )
-        x = x + attn.reshape(b, t, nh * d) @ lp["wo"]
+        x = x + lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
+                            "wo", lora_ids, lora_scale)
         # MLP block (SwiGLU)
         m_in = rms_norm(x, lp["mlp_norm"], config.rms_norm_eps)
-        gate = jax.nn.silu(m_in @ lp["w_gate"])
-        x = x + (gate * (m_in @ lp["w_up"])) @ lp["w_down"]
+        gate = jax.nn.silu(lora_matmul(m_in, lp["w_gate"], ll, "w_gate",
+                                       lora_ids, lora_scale))
+        up = lora_matmul(m_in, lp["w_up"], ll, "w_up", lora_ids,
+                         lora_scale)
+        x = x + lora_matmul(gate * up, lp["w_down"], ll, "w_down",
+                            lora_ids, lora_scale)
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (layer_params, k_cache, v_cache)
+        layer_step, x, (layer_params, lora_scanned, k_cache, v_cache)
     )
 
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)

@@ -78,8 +78,11 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, page_table: jnp.ndarray,
             kv_lens: jnp.ndarray, valid: jnp.ndarray,
             k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+            lora=None, lora_ids=None,
             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Same contract as models.llama.forward."""
+    from production_stack_tpu.engine.lora import lora_matmul
+
     nh, d = config.num_attention_heads, config.head_dim
     b, t = tokens.shape
 
@@ -93,26 +96,37 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
             "fc1", "fc1_b", "fc2", "fc2_b",
         )
     }
+    lora_scale = (None if lora is None
+                  else lora["scaling"][lora_ids])  # [B]
+    lora_scanned = (None if lora is None
+                    else {"a": lora["a"], "b": lora["b"]})
 
     def layer_step(x, scanned):
-        lp, k_layer, v_layer = scanned
+        lp, ll, k_layer, v_layer = scanned
         a_in = layer_norm(x, lp["attn_norm_w"], lp["attn_norm_b"])
-        q = (a_in @ lp["wq"] + lp["bq"]).reshape(b, t, nh, d)
-        k = (a_in @ lp["wk"] + lp["bk"]).reshape(b, t, nh, d)
-        v = (a_in @ lp["wv"] + lp["bv"]).reshape(b, t, nh, d)
+        q = (lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids, lora_scale)
+             + lp["bq"]).reshape(b, t, nh, d)
+        k = (lora_matmul(a_in, lp["wk"], ll, "wk", lora_ids, lora_scale)
+             + lp["bk"]).reshape(b, t, nh, d)
+        v = (lora_matmul(a_in, lp["wv"], ll, "wv", lora_ids, lora_scale)
+             + lp["bv"]).reshape(b, t, nh, d)
         k_layer = write_to_pages(k_layer, k, page_table, positions, valid)
         v_layer = write_to_pages(v_layer, v, page_table, positions, valid)
         attn = dispatch_attention(
             config, q, k_layer, v_layer, page_table, positions, kv_lens
         )
-        x = x + (attn.reshape(b, t, nh * d) @ lp["wo"] + lp["bo"])
+        x = x + (lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
+                             "wo", lora_ids, lora_scale) + lp["bo"])
         m_in = layer_norm(x, lp["mlp_norm_w"], lp["mlp_norm_b"])
-        hidden = jax.nn.relu(m_in @ lp["fc1"] + lp["fc1_b"])
-        x = x + (hidden @ lp["fc2"] + lp["fc2_b"])
+        hidden = jax.nn.relu(
+            lora_matmul(m_in, lp["fc1"], ll, "fc1", lora_ids, lora_scale)
+            + lp["fc1_b"])
+        x = x + (lora_matmul(hidden, lp["fc2"], ll, "fc2", lora_ids,
+                             lora_scale) + lp["fc2_b"])
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (layer_params, k_cache, v_cache)
+        layer_step, x, (layer_params, lora_scanned, k_cache, v_cache)
     )
 
     x = layer_norm(x, params["final_norm_w"], params["final_norm_b"])

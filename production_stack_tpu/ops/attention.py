@@ -23,10 +23,15 @@ NEG_INF = -1e30
 
 def gather_pages(cache_layer: jnp.ndarray,
                  page_table: jnp.ndarray) -> jnp.ndarray:
-    """[num_pages, page, kv, d] gathered to [B, max_pages*page, kv, d]."""
-    gathered = cache_layer[page_table]  # [B, P, page, kv, d]
-    b, p, page, kv, d = gathered.shape
-    return gathered.reshape(b, p * page, kv, d)
+    """[kv, num_pages, page, d] gathered to [B, max_pages*page, kv, d].
+
+    The cache keeps the kv-head axis major (layout shared with the
+    Pallas decode kernel, whose per-page blocks must slice only major
+    dims — Mosaic requires the last two dims be full tiles).
+    """
+    gathered = cache_layer[:, page_table]  # [kv, B, P, page, d]
+    kv, b, p, page, d = gathered.shape
+    return gathered.reshape(kv, b, p * page, d).transpose(1, 2, 0, 3)
 
 
 def write_to_pages(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
@@ -38,13 +43,13 @@ def write_to_pages(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     so padded slots write there harmlessly instead of needing predication.
 
     Args:
-      cache_layer: [num_pages, page_size, kv_heads, head_dim]
+      cache_layer: [kv_heads, num_pages, page_size, head_dim]
       new_kv:      [B, T, kv_heads, head_dim]
       page_table:  [B, max_pages] int32 physical page ids
       positions:   [B, T] absolute token positions
       valid:       [B, T] bool; False entries are redirected to page 0
     """
-    page_size = cache_layer.shape[1]
+    page_size = cache_layer.shape[2]
     b, t = positions.shape
     logical_page = positions // page_size  # [B, T]
     offset = positions % page_size  # [B, T]
@@ -54,8 +59,9 @@ def write_to_pages(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     physical_page = jnp.where(valid, physical_page, 0)
     flat_pages = physical_page.reshape(-1)
     flat_offsets = offset.reshape(-1)
-    flat_kv = new_kv.reshape(b * t, *new_kv.shape[2:])
-    return cache_layer.at[flat_pages, flat_offsets].set(flat_kv)
+    # [B*T, kv, d] -> [kv, B*T, d] to match the head-major cache.
+    flat_kv = new_kv.reshape(b * t, *new_kv.shape[2:]).swapaxes(0, 1)
+    return cache_layer.at[:, flat_pages, flat_offsets].set(flat_kv)
 
 
 def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
@@ -66,7 +72,7 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
 
     Args:
       q:           [B, T, num_q_heads, head_dim]
-      k/v_cache_layer: [num_pages, page_size, num_kv_heads, head_dim]
+      k/v_cache_layer: [num_kv_heads, num_pages, page_size, head_dim]
       page_table:  [B, max_pages]
       q_positions: [B, T] absolute positions of the queries
       kv_lens:     [B] number of valid cached tokens (>= max position + 1)
@@ -74,7 +80,7 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     Returns [B, T, num_q_heads, head_dim].
     """
     b, t, num_q_heads, head_dim = q.shape
-    num_kv_heads = k_cache_layer.shape[2]
+    num_kv_heads = k_cache_layer.shape[0]
     group = num_q_heads // num_kv_heads
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, dtype=jnp.float32))
 

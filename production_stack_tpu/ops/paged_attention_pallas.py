@@ -5,9 +5,12 @@ single query token against them. The XLA reference implementation
 (ops/attention.py) gathers the *whole* padded context per step; this
 kernel instead walks the page list with flash-style online softmax:
 
-- grid (batch, pages): page blocks are DMA'd HBM->VMEM one at a time,
-  selected by the scalar-prefetched page table (the Pallas BlockSpec
-  index_map does the "paging" — no materialized gather),
+- grid (batch, kv_head, pages): page blocks are DMA'd HBM->VMEM one at
+  a time, selected by the scalar-prefetched page table (the Pallas
+  BlockSpec index_map does the "paging" — no materialized gather),
+- all matmuls are plain 2D ``[G, D] x [P, D]`` contractions (the MXU
+  form Mosaic supports; batched dot_generals with unequal batch dims
+  do not compile), with the query-head group padded to >=8 sublanes,
 - running (max, denom, acc) in VMEM scratch across the page walk,
 - pages past the sequence length are masked (they DMA the trash page
   0, which the allocator never hands out, so the reads are harmless).
@@ -30,63 +33,65 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Minimum sublane count for the query-group axis: fp32 tiles are
+# (8, 128), so G < 8 would force degenerate layouts.
+_MIN_GROUP = 8
+
 
 def _decode_kernel(page_table_ref, kv_lens_ref, q_ref, k_ref, v_ref,
-                   o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
-                   num_kv_heads: int, group: int):
-    p = pl.program_id(1)
-    num_page_steps = pl.num_programs(1)
+                   o_ref, m_ref, l_ref, acc_ref, *, page_size: int):
     b = pl.program_id(0)
+    p = pl.program_id(2)
+    num_page_steps = pl.num_programs(2)
 
     @pl.when(p == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # q: [H, D] viewed as [KV, G, D]
-    q = q_ref[0].astype(jnp.float32)
+    q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
+    k = k_ref[0, 0].astype(jnp.float32)  # [P, D]
+    v = v_ref[0, 0].astype(jnp.float32)  # [P, D]
     head_dim = q.shape[-1]
-    qg = q.reshape(num_kv_heads, group, head_dim)
-    k = k_ref[0].astype(jnp.float32)  # [page, KV, D]
-    v = v_ref[0].astype(jnp.float32)
 
     scale = 1.0 / (head_dim ** 0.5)
-    # scores: [KV, G, page]
+    # scores: [G, P] — a single 2D MXU contraction over head_dim.
     scores = jax.lax.dot_general(
-        qg, k,
-        dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
 
     kv_len = kv_lens_ref[b]
     token_pos = p * page_size + jax.lax.broadcasted_iota(
-        jnp.int32, (1, 1, page_size), 2
+        jnp.int32, scores.shape, 1
     )
     scores = jnp.where(token_pos < kv_len, scores, NEG_INF)
 
     # Online softmax update.
-    m_prev = m_ref[:]  # [KV, G]
-    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
-    alpha = jnp.exp(m_prev - m_new)
-    probs = jnp.exp(scores - m_new[..., None])  # [KV, G, page]
-    l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1)
-    # pv: [KV, G, D]
+    m_prev = m_ref[...]                                   # [G, 1]
+    m_new = jnp.maximum(
+        m_prev, jnp.max(scores, axis=-1, keepdims=True)
+    )
+    alpha = jnp.exp(m_prev - m_new)                       # [G, 1]
+    probs = jnp.exp(scores - m_new)                       # [G, P]
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(
+        probs, axis=-1, keepdims=True
+    )
+    # pv: [G, D] — second 2D MXU contraction over the page axis.
     pv = jax.lax.dot_general(
         probs, v,
-        dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+        dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    acc_ref[:] = acc_ref[:] * alpha[..., None] + pv
-    m_ref[:] = m_new
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = m_new
 
     @pl.when(p == num_page_steps - 1)
     def _finalize():
-        denom = jnp.maximum(l_ref[:], 1e-30)[..., None]
-        out = (acc_ref[:] / denom).reshape(
-            num_kv_heads * group, head_dim
-        )
-        o_ref[0] = out.astype(o_ref.dtype)
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -99,7 +104,7 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
 
     Args:
       q:           [B, num_q_heads, head_dim]
-      k/v_cache_layer: [num_pages, page_size, num_kv_heads, head_dim]
+      k/v_cache_layer: [num_kv_heads, num_pages, page_size, head_dim]
       page_table:  [B, max_pages] int32 physical page ids
       kv_lens:     [B] int32 valid cached tokens per sequence
       interpret:   run in interpreter mode (CPU testing)
@@ -107,53 +112,60 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     Returns [B, num_q_heads, head_dim].
     """
     b, num_q_heads, head_dim = q.shape
-    _, page_size, num_kv_heads, _ = k_cache_layer.shape
+    num_kv_heads, _, page_size, _ = k_cache_layer.shape
     max_pages = page_table.shape[1]
     group = num_q_heads // num_kv_heads
+    group_pad = max(group, _MIN_GROUP)
 
-    kernel = functools.partial(
-        _decode_kernel,
-        page_size=page_size,
-        num_kv_heads=num_kv_heads,
-        group=group,
-    )
+    # [B, KV, G, D] with the group axis padded up to a full sublane
+    # tile; padded rows attend to real keys and are sliced off below.
+    qg = q.reshape(b, num_kv_heads, group, head_dim)
+    if group_pad != group:
+        qg = jnp.pad(
+            qg, ((0, 0), (0, 0), (0, group_pad - group), (0, 0))
+        )
+
+    kernel = functools.partial(_decode_kernel, page_size=page_size)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page_table, kv_lens
-        grid=(b, max_pages),
+        grid=(b, num_kv_heads, max_pages),
         in_specs=[
-            # q block: one sequence's heads.
+            # q block: one sequence's query group for one kv head.
             pl.BlockSpec(
-                (1, num_q_heads, head_dim),
-                lambda bi, pi, pt, kl: (bi, 0, 0),
+                (1, 1, group_pad, head_dim),
+                lambda bi, hi, pi, pt, kl: (bi, hi, 0, 0),
             ),
-            # k/v block: ONE physical page, chosen via the page table.
+            # k/v block: ONE physical page of ONE kv head, chosen via
+            # the scalar-prefetched page table. The head-major cache
+            # layout keeps the sliced dims major so the (page, head_dim)
+            # minor dims stay full tiles.
             pl.BlockSpec(
-                (1, page_size, num_kv_heads, head_dim),
-                lambda bi, pi, pt, kl: (pt[bi, pi], 0, 0, 0),
+                (1, 1, page_size, head_dim),
+                lambda bi, hi, pi, pt, kl: (hi, pt[bi, pi], 0, 0),
             ),
             pl.BlockSpec(
-                (1, page_size, num_kv_heads, head_dim),
-                lambda bi, pi, pt, kl: (pt[bi, pi], 0, 0, 0),
+                (1, 1, page_size, head_dim),
+                lambda bi, hi, pi, pt, kl: (hi, pt[bi, pi], 0, 0),
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, num_q_heads, head_dim),
-            lambda bi, pi, pt, kl: (bi, 0, 0),
+            (1, 1, group_pad, head_dim),
+            lambda bi, hi, pi, pt, kl: (bi, hi, 0, 0),
         ),
         scratch_shapes=[
-            pltpu.VMEM((num_kv_heads, group), jnp.float32),  # m
-            pltpu.VMEM((num_kv_heads, group), jnp.float32),  # l
-            pltpu.VMEM((num_kv_heads, group, head_dim),
-                       jnp.float32),  # acc
+            pltpu.VMEM((group_pad, 1), jnp.float32),  # m
+            pltpu.VMEM((group_pad, 1), jnp.float32),  # l
+            pltpu.VMEM((group_pad, head_dim), jnp.float32),  # acc
         ],
     )
 
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(
-            (b, num_q_heads, head_dim), q.dtype
+            (b, num_kv_heads, group_pad, head_dim), q.dtype
         ),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(page_table, kv_lens, q, k_cache_layer, v_cache_layer)
+    )(page_table, kv_lens, qg, k_cache_layer, v_cache_layer)
+    return out[:, :, :group].reshape(b, num_q_heads, head_dim)

@@ -87,8 +87,8 @@ def shard_params(params: Dict[str, jax.Array], config: ModelConfig,
 
 
 def cache_spec() -> P:
-    """KV cache [L, pages, page_size, kv_heads, head_dim]: shard heads."""
-    return P(None, None, None, "tp", None)
+    """KV cache [L, kv_heads, pages, page_size, head_dim]: shard heads."""
+    return P(None, "tp", None, None, None)
 
 
 def shard_cache(cache: jax.Array, mesh: Optional[Mesh]) -> jax.Array:

@@ -1,0 +1,245 @@
+"""Multi-LoRA serving tests (reference capability: --enable-lora
+pass-through, helm/values.yaml:56-58, tutorials/08-lora flow).
+
+Covers: zero-slot == base numerics, per-row adapter isolation in one
+batch, PEFT safetensors loading, engine-level generation by adapter
+name, and the server's /v1/models + adapter routing.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    LoRAConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.lora import (
+    LoRAAdapter,
+    LoRARegistry,
+    empty_lora_stack,
+    load_peft_adapter,
+    target_shapes,
+)
+from production_stack_tpu.engine.sequence import SamplingParams
+from production_stack_tpu.models import llama
+
+
+def _tiny_forward_setup():
+    config = tiny_model_config("llama")
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    num_pages, page_size, max_pages = 8, 16, 4
+    cache_shape = (config.num_hidden_layers, config.num_key_value_heads,
+                   num_pages, page_size, config.head_dim)
+    k_cache = jnp.zeros(cache_shape, config.jax_dtype)
+    v_cache = jnp.zeros(cache_shape, config.jax_dtype)
+    b, t = 2, 8
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, config.vocab_size, (b, t)),
+        jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t)[None], (b, t))
+    page_table = jnp.asarray([[1, 2, 0, 0], [3, 4, 0, 0]], jnp.int32)
+    kv_lens = jnp.asarray([t, t], jnp.int32)
+    valid = jnp.ones((b, t), bool)
+    return (config, params, tokens, positions, page_table, kv_lens,
+            valid, k_cache, v_cache)
+
+
+def _random_adapter(config, rank, max_rank, scale=1.0, seed=7):
+    rs = np.random.RandomState(seed)
+    weights = {}
+    for tgt, (d_in, d_out) in target_shapes(config).items():
+        a = np.zeros((config.num_hidden_layers, d_in, max_rank),
+                     np.float32)
+        b = np.zeros((config.num_hidden_layers, max_rank, d_out),
+                     np.float32)
+        a[:, :, :rank] = rs.randn(
+            config.num_hidden_layers, d_in, rank).astype(np.float32)
+        b[:rank] = 0.0
+        b[:, :rank, :] = rs.randn(
+            config.num_hidden_layers, rank, d_out).astype(np.float32)
+        weights[tgt] = (a, b)
+    return LoRAAdapter(name="test-adapter", rank=rank, scaling=scale,
+                       weights=weights)
+
+
+def test_zero_stack_matches_base():
+    """An all-zero LoRA stack must not change base-model logits."""
+    (config, params, tokens, positions, page_table, kv_lens, valid,
+     k_cache, v_cache) = _tiny_forward_setup()
+    stack = empty_lora_stack(config, max_loras=2, max_lora_rank=4)
+    ids = jnp.zeros((2,), jnp.int32)
+
+    base_logits, _, _ = llama.forward(
+        params, config, tokens, positions, page_table, kv_lens, valid,
+        k_cache, v_cache)
+    lora_logits, _, _ = llama.forward(
+        params, config, tokens, positions, page_table, kv_lens, valid,
+        k_cache, v_cache, lora=stack, lora_ids=ids)
+    np.testing.assert_allclose(
+        np.asarray(base_logits), np.asarray(lora_logits), atol=1e-5)
+
+
+def test_per_row_adapter_isolation():
+    """Row with slot 0 must match base; row with an adapter must not."""
+    (config, params, tokens, positions, page_table, kv_lens, valid,
+     k_cache, v_cache) = _tiny_forward_setup()
+    registry = LoRARegistry(config, max_loras=2, max_lora_rank=4)
+    slot = registry.register(
+        _random_adapter(config, rank=4, max_rank=4, scale=0.5))
+    assert slot == 1
+    ids = jnp.asarray([0, 1], jnp.int32)
+
+    base_logits, _, _ = llama.forward(
+        params, config, tokens, positions, page_table, kv_lens, valid,
+        k_cache, v_cache)
+    mixed_logits, _, _ = llama.forward(
+        params, config, tokens, positions, page_table, kv_lens, valid,
+        k_cache, v_cache, lora=registry.stack, lora_ids=ids)
+    base = np.asarray(base_logits)
+    mixed = np.asarray(mixed_logits)
+    np.testing.assert_allclose(base[0], mixed[0], atol=1e-5)
+    assert np.abs(base[1] - mixed[1]).max() > 1e-3
+
+
+def _write_peft_dir(tmp_path, config, rank=2, alpha=4.0):
+    from safetensors.numpy import save_file
+    rs = np.random.RandomState(3)
+    raw = {}
+    for i in range(config.num_hidden_layers):
+        for proj, (d_in, d_out) in (
+            ("q_proj", (config.hidden_size,
+                        config.num_attention_heads * config.head_dim)),
+            ("v_proj", (config.hidden_size,
+                        config.num_key_value_heads * config.head_dim)),
+        ):
+            prefix = (f"base_model.model.model.layers.{i}."
+                      f"self_attn.{proj}")
+            raw[f"{prefix}.lora_A.weight"] = rs.randn(
+                rank, d_in).astype(np.float32)
+            raw[f"{prefix}.lora_B.weight"] = rs.randn(
+                d_out, rank).astype(np.float32)
+    adapter_dir = os.path.join(str(tmp_path), "adapter")
+    os.makedirs(adapter_dir, exist_ok=True)
+    save_file(raw, os.path.join(adapter_dir, "adapter_model.safetensors"))
+    with open(os.path.join(adapter_dir, "adapter_config.json"), "w") as f:
+        json.dump({"r": rank, "lora_alpha": alpha,
+                   "target_modules": ["q_proj", "v_proj"]}, f)
+    return adapter_dir, raw
+
+
+def test_peft_loader(tmp_path):
+    config = tiny_model_config("llama")
+    adapter_dir, raw = _write_peft_dir(tmp_path, config, rank=2,
+                                       alpha=4.0)
+    adapter = load_peft_adapter(adapter_dir, config, max_lora_rank=4)
+    assert adapter.rank == 2
+    assert adapter.scaling == pytest.approx(2.0)  # alpha / r
+    assert set(adapter.weights) == {"wq", "wv"}
+    A, B = adapter.weights["wq"]
+    layers = config.num_hidden_layers
+    nh_d = config.num_attention_heads * config.head_dim
+    assert A.shape == (layers, config.hidden_size, 4)  # rank-padded
+    assert B.shape == (layers, 4, nh_d)
+    # Transposition round-trip: A[i] == raw A.T, pad columns zero.
+    key = "base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight"
+    np.testing.assert_allclose(A[0, :, :2], raw[key].T)
+    assert np.all(A[0, :, 2:] == 0)
+
+
+def test_peft_loader_rejects_oversized_rank(tmp_path):
+    config = tiny_model_config("llama")
+    adapter_dir, _ = _write_peft_dir(tmp_path, config, rank=8)
+    with pytest.raises(ValueError, match="exceeds"):
+        load_peft_adapter(adapter_dir, config, max_lora_rank=4)
+
+
+def _lora_engine(tmp_path=None, modules=()):
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=128,
+                                  prefill_chunk_size=32),
+        lora=LoRAConfig(enable=True, max_loras=2, max_lora_rank=4),
+    )
+    engine = LLMEngine(config)
+    for name, path in modules:
+        engine.register_lora(path, name=name)
+    return engine
+
+
+def test_engine_generation_with_adapter(tmp_path):
+    config = tiny_model_config("llama")
+    adapter_dir, _ = _write_peft_dir(tmp_path, config, rank=2)
+    engine = _lora_engine(modules=[("my-adapter", adapter_dir)])
+    prompt = list(range(2, 20))
+    sampling = SamplingParams(max_tokens=8, temperature=0.0,
+                              ignore_eos=True)
+
+    base_id = engine.add_request(prompt, SamplingParams(**vars(sampling)))
+    base_seq = engine.sequences[base_id]
+    lora_id = engine.add_request(prompt, SamplingParams(**vars(sampling)),
+                                 lora_name="my-adapter")
+    lora_seq = engine.sequences[lora_id]
+    while engine.has_work():
+        engine.step()
+    assert len(base_seq.output_token_ids) == 8
+    assert len(lora_seq.output_token_ids) == 8
+    assert lora_seq.lora_id == 1
+
+    # Same prompt again on base must reproduce (greedy, deterministic
+    # given per-engine rng is unused at temperature 0).
+    rerun_id = engine.add_request(prompt, SamplingParams(**vars(sampling)))
+    rerun_seq = engine.sequences[rerun_id]
+    while engine.has_work():
+        engine.step()
+    assert rerun_seq.output_token_ids == base_seq.output_token_ids
+
+
+def test_engine_rejects_unknown_adapter():
+    engine = _lora_engine()
+    with pytest.raises(KeyError):
+        engine.add_request([1, 2, 3], lora_name="nope")
+
+
+def test_server_lists_and_serves_adapters(tmp_path):
+    from production_stack_tpu.engine.server import EngineServer
+
+    config = tiny_model_config("llama")
+    adapter_dir, _ = _write_peft_dir(tmp_path, config, rank=2)
+    engine = _lora_engine(modules=[("sql-lora", adapter_dir)])
+    server = EngineServer(engine, "tiny-llama")
+
+    async def run():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/v1/models")
+            data = await resp.json()
+            ids = [m["id"] for m in data["data"]]
+            assert ids == ["tiny-llama", "sql-lora"]
+            assert data["data"][1]["parent"] == "tiny-llama"
+
+            resp = await client.post("/v1/chat/completions", json={
+                "model": "sql-lora",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+            })
+            assert resp.status == 200
+            payload = await resp.json()
+            assert payload["choices"][0]["message"]["content"] is not None
+        finally:
+            await client.close()
+
+    asyncio.run(run())
