@@ -1,0 +1,131 @@
+"""Engine-side /v1/embeddings: pooled hidden states of the served model.
+
+The reference's stack routes /v1/embeddings through the router to vLLM
+pooling-model pods (src/vllm_router/routers/main_router.py:54-60,
+services/request_service/request.py proxy path); the engine itself is
+vLLM. Here the TPU engine serves the endpoint directly: a dense forward
+(models.llama.encode) produces final-norm hidden states, pooled per
+sequence and L2-normalized.
+
+TPU shape discipline: inputs are padded to power-of-two token buckets
+and a fixed batch width, so the embed step compiles once per bucket and
+is cached by XLA thereafter (same strategy as the prefill buckets,
+engine/model_runner.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+_POOLING_MODES = ("last", "mean")
+
+
+class Embedder:
+    """Jitted, bucketed embedding runner over the serving weights."""
+
+    def __init__(self, config: ModelConfig, params, max_len: int,
+                 pooling: str = "last", batch_width: int = 8):
+        if pooling not in _POOLING_MODES:
+            raise ValueError(
+                f"pooling must be one of {_POOLING_MODES}, got {pooling!r}"
+            )
+        if config.architecture != "llama":
+            raise NotImplementedError(
+                "embeddings are implemented for the llama family "
+                f"(got architecture={config.architecture!r})"
+            )
+        from production_stack_tpu.models import llama
+        self.config = config
+        self.params = params
+        self.max_len = max_len
+        self.pooling = pooling
+        self.batch_width = batch_width
+        self._encode = llama.encode
+
+        def embed(params, tokens, lengths):
+            hidden = self._encode(params, config, tokens)  # [B, T, H]
+            t = tokens.shape[1]
+            pos = jnp.arange(t)[None, :]
+            mask = pos < lengths[:, None]  # [B, T]
+            if pooling == "last":
+                idx = jnp.maximum(lengths - 1, 0)
+                pooled = hidden[jnp.arange(tokens.shape[0]), idx]
+            else:
+                m = mask[..., None].astype(hidden.dtype)
+                pooled = (hidden * m).sum(axis=1) / jnp.maximum(
+                    m.sum(axis=1), 1.0
+                )
+            pooled = pooled.astype(jnp.float32)
+            norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+            return pooled / jnp.maximum(norm, 1e-12)
+
+        self._embed_jit = jax.jit(embed)
+
+    def _bucket(self, n: int) -> int:
+        b = 16
+        while b < n:
+            b *= 2
+        return min(b, self.max_len)
+
+    def embed_batch(self, token_lists: List[List[int]]) -> np.ndarray:
+        """Embed tokenized inputs; returns [N, hidden] float32."""
+        out = np.zeros((len(token_lists), self.config.hidden_size),
+                       np.float32)
+        i = 0
+        while i < len(token_lists):
+            chunk = token_lists[i:i + self.batch_width]
+            t = self._bucket(max(len(x) for x in chunk))
+            b = self.batch_width
+            tokens = np.zeros((b, t), np.int32)
+            lengths = np.zeros((b,), np.int32)
+            for j, ids in enumerate(chunk):
+                ids = ids[:t]
+                tokens[j, :len(ids)] = ids
+                lengths[j] = len(ids)
+            pooled = self._embed_jit(
+                self.params, jnp.asarray(tokens), jnp.asarray(lengths)
+            )
+            out[i:i + len(chunk)] = np.asarray(pooled)[:len(chunk)]
+            i += len(chunk)
+        return out
+
+
+def parse_embedding_input(raw, tokenizer,
+                          max_len: Optional[int] = None
+                          ) -> List[List[int]]:
+    """OpenAI `input` field: str | [str] | [int] | [[int]] -> token lists."""
+    if isinstance(raw, str):
+        items = [raw]
+    elif isinstance(raw, list) and raw and all(
+            isinstance(x, int) for x in raw):
+        items = [raw]
+    elif isinstance(raw, list):
+        items = raw
+    else:
+        raise ValueError("'input' must be a string, list of strings, "
+                         "or token array(s)")
+    token_lists: List[List[int]] = []
+    for item in items:
+        if isinstance(item, str):
+            ids = tokenizer.encode(item)
+        elif isinstance(item, list) and all(
+                isinstance(x, int) for x in item):
+            ids = list(item)
+        else:
+            raise ValueError("'input' entries must be strings or "
+                             "integer token arrays")
+        if not ids:
+            raise ValueError("'input' entries must not be empty")
+        if max_len is not None:
+            ids = ids[:max_len]
+        token_lists.append(ids)
+    return token_lists

@@ -164,11 +164,15 @@ def _usage(prompt_len: int, completion_len: int) -> dict:
 
 
 class EngineServer:
-    def __init__(self, engine: LLMEngine, served_model_name: str):
+    def __init__(self, engine: LLMEngine, served_model_name: str,
+                 pooling: str = "last"):
         self.async_engine = AsyncEngine(engine)
         self.engine = engine
         self.model_name = served_model_name
         self.tokenizer = engine.tokenizer
+        self.pooling = pooling
+        self._embedder = None
+        self._embed_lock = asyncio.Lock()
 
     # -- decoding helpers ---------------------------------------------------
 
@@ -374,6 +378,55 @@ class EngineServer:
             self.async_engine.finish_stream(seq_id)
         return resp
 
+    async def embeddings(self, request: web.Request):
+        """OpenAI /v1/embeddings over the served model's hidden states."""
+        from production_stack_tpu.engine.embeddings import (
+            Embedder,
+            parse_embedding_input,
+        )
+        body = await self._json_body(request)
+        try:
+            token_lists = parse_embedding_input(
+                body.get("input"), self.tokenizer,
+                max_len=self.engine.config.scheduler.max_model_len,
+            )
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        if self._embedder is None:
+            try:
+                self._embedder = Embedder(
+                    self.engine.config.model,
+                    self.engine.runner.params,
+                    max_len=self.engine.config.scheduler.max_model_len,
+                    pooling=self.pooling,
+                )
+            except NotImplementedError as e:
+                return web.json_response(
+                    {"error": {"message": str(e)}}, status=501,
+                )
+        # One embed batch on-device at a time; compute off the event
+        # loop so token streaming stays live.
+        async with self._embed_lock:
+            vectors = await asyncio.to_thread(
+                self._embedder.embed_batch, token_lists
+            )
+        n_tokens = sum(len(t) for t in token_lists)
+        return web.json_response({
+            "object": "list",
+            "model": self.model_name,
+            "data": [
+                {"object": "embedding", "index": i,
+                 "embedding": vec.tolist()}
+                for i, vec in enumerate(vectors)
+            ],
+            "usage": {"prompt_tokens": n_tokens,
+                      "total_tokens": n_tokens},
+        })
+
     async def models(self, request: web.Request):
         created = int(self.async_engine.uptime_start)
         data = [{
@@ -419,6 +472,7 @@ class EngineServer:
         app = web.Application(client_max_size=1024 ** 3)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/version", self.version)
@@ -521,6 +575,9 @@ def parse_args(argv=None):
                         help="PEFT adapter dirs to serve by name")
     parser.add_argument("--max-loras", type=int, default=8)
     parser.add_argument("--max-lora-rank", type=int, default=16)
+    parser.add_argument("--pooling", default="last",
+                        choices=["last", "mean"],
+                        help="/v1/embeddings pooling mode")
     parser.add_argument("--enable-kv-offload", action="store_true",
                         help="HBM->host-RAM KV offload tier")
     parser.add_argument("--kv-host-pool-bytes", type=int,
@@ -533,7 +590,7 @@ def parse_args(argv=None):
 def main(argv=None) -> None:
     args = parse_args(argv)
     engine, served_name = build_engine_from_args(args)
-    server = EngineServer(engine, served_name)
+    server = EngineServer(engine, served_name, pooling=args.pooling)
     logger.info("tpu-engine %s serving %s on %s:%d",
                 __version__, served_name, args.host, args.port)
     web.run_app(server.build_app(), host=args.host, port=args.port,

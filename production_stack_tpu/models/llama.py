@@ -177,6 +177,24 @@ def forward_train(params: Params, config: ModelConfig,
     batch (no paged cache), so it is cleanly differentiable.
     Returns logits [B, T, vocab].
     """
+    x = encode(params, config, tokens)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (x @ head).astype(jnp.float32)
+
+
+def encode(params: Params, config: ModelConfig,
+           tokens: jnp.ndarray) -> jnp.ndarray:
+    """Dense causal forward returning final-norm hidden states.
+
+    The /v1/embeddings path (engine/embeddings.py) pools these; the
+    reference delegates embeddings to vLLM pooling models
+    (src/vllm_router/routers/main_router.py:54-60 routes
+    /v1/embeddings to engine pods).
+
+    Returns [B, T, hidden].
+    """
     nh, nkv, d = (config.num_attention_heads, config.num_key_value_heads,
                   config.head_dim)
     b, t = tokens.shape
@@ -216,8 +234,4 @@ def forward_train(params: Params, config: ModelConfig,
         return x, None
 
     x, _ = jax.lax.scan(layer_step, x, layer_params)
-    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    return (x @ head).astype(jnp.float32)
+    return rms_norm(x, params["final_norm"], config.rms_norm_eps)
