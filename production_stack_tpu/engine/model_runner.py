@@ -83,6 +83,9 @@ class ModelRunner:
             config.scheduler.prefill_chunk_size
         )
         self._rng = jax.random.PRNGKey(config.seed + 1)
+        # Multihost step broadcast (parallel/distributed.py); host 0's
+        # engine sets this so every dispatch is mirrored to workers.
+        self.bridge = None
 
         # Multi-LoRA: device-resident adapter stacks; a per-row slot-id
         # vector selects the adapter (engine/lora.py). None when off so
@@ -105,14 +108,6 @@ class ModelRunner:
     def _lora_stack(self):
         return (None if self.lora_registry is None
                 else self.lora_registry.stack)
-
-    def _lora_ids(self, seqs, pad_to: int):
-        if self.lora_registry is None:
-            return None
-        ids = np.zeros((pad_to,), np.int32)
-        for i, seq in enumerate(seqs):
-            ids[i] = seq.lora_id
-        return jnp.asarray(ids)
 
     # ---- compiled step ----------------------------------------------------
 
@@ -144,6 +139,40 @@ class ModelRunner:
                 return b
         return self._buckets[-1]
 
+    # ---- payload execution (shared by host 0 and multihost workers) -------
+
+    def execute_payload(self, kind: int, payload: dict) -> jax.Array:
+        """Run one compiled step from a numpy payload.
+
+        The payload is the complete device-program input (including the
+        rng key), so host 0 and multihost workers — which receive it
+        over the MultihostStepBridge broadcast — dispatch bit-identical
+        programs (parallel/distributed.py).
+        """
+        lora_ids = payload.get("lora_ids")
+        sampled, self.k_cache, self.v_cache = self._step_jit(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(payload["tokens"]),
+            jnp.asarray(payload["positions"]),
+            jnp.asarray(payload["page_table"]),
+            jnp.asarray(payload["kv_lens"]),
+            jnp.asarray(payload["valid"]),
+            jnp.asarray(payload["last_index"]),
+            jnp.asarray(payload["temperature"]),
+            jnp.asarray(payload["top_p"]),
+            jnp.asarray(payload["top_k"]),
+            jnp.asarray(payload["rng"]),
+            self._lora_stack,
+            None if lora_ids is None else jnp.asarray(lora_ids),
+            sample_index_mode=("last" if kind == 1 else "first"),
+        )
+        return sampled
+
+    def _dispatch(self, kind: int, t: int, payload: dict) -> jax.Array:
+        if self.bridge is not None:
+            self.bridge.publish(kind, t, payload)
+        return self.execute_payload(kind, payload)
+
     # ---- prefill ----------------------------------------------------------
 
     def run_prefill(self, plan: PrefillPlan) -> Optional[int]:
@@ -160,25 +189,24 @@ class ModelRunner:
         )
         valid = np.zeros((1, t), bool)
         valid[0, :n] = True
-        page_table = self._page_table_rows([seq])
-        kv_lens = np.asarray([plan.chunk_start + n], np.int32)
-        last_index = np.asarray([n - 1], np.int32)
 
         sp = seq.sampling
-        temperature = np.asarray([sp.temperature], np.float32)
-        top_p = np.asarray([sp.top_p], np.float32)
-        top_k = np.asarray([sp.top_k], np.int32)
+        payload = {
+            "tokens": tokens,
+            "positions": positions,
+            "valid": valid,
+            "page_table": self._page_table_rows([seq]),
+            "kv_lens": np.asarray([plan.chunk_start + n], np.int32),
+            "last_index": np.asarray([n - 1], np.int32),
+            "temperature": np.asarray([sp.temperature], np.float32),
+            "top_p": np.asarray([sp.top_p], np.float32),
+            "top_k": np.asarray([sp.top_k], np.int32),
+            "rng": np.asarray(self._next_rng()),
+        }
+        if self.lora_registry is not None:
+            payload["lora_ids"] = np.asarray([seq.lora_id], np.int32)
 
-        sampled, self.k_cache, self.v_cache = self._step_jit(
-            self.params, self.k_cache, self.v_cache,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(page_table), jnp.asarray(kv_lens),
-            jnp.asarray(valid), jnp.asarray(last_index),
-            jnp.asarray(temperature), jnp.asarray(top_p),
-            jnp.asarray(top_k), self._next_rng(),
-            self._lora_stack, self._lora_ids([seq], 1),
-            sample_index_mode="last",
-        )
+        sampled = self._dispatch(1, t, payload)
         if plan.is_last_chunk:
             return int(jax.device_get(sampled)[0])
         return None
@@ -210,19 +238,25 @@ class ModelRunner:
             top_p[i] = seq.sampling.top_p
             top_k[i] = seq.sampling.top_k
 
-        page_table = self._page_table_rows(seqs, pad_to=b)
-        last_index = np.zeros((b,), np.int32)
+        payload = {
+            "tokens": tokens,
+            "positions": positions,
+            "valid": valid,
+            "page_table": self._page_table_rows(seqs, pad_to=b),
+            "kv_lens": kv_lens,
+            "last_index": np.zeros((b,), np.int32),
+            "temperature": temperature,
+            "top_p": top_p,
+            "top_k": top_k,
+            "rng": np.asarray(self._next_rng()),
+        }
+        if self.lora_registry is not None:
+            ids = np.zeros((b,), np.int32)
+            for i, seq in enumerate(seqs):
+                ids[i] = seq.lora_id
+            payload["lora_ids"] = ids
 
-        sampled, self.k_cache, self.v_cache = self._step_jit(
-            self.params, self.k_cache, self.v_cache,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(page_table), jnp.asarray(kv_lens),
-            jnp.asarray(valid), jnp.asarray(last_index),
-            jnp.asarray(temperature), jnp.asarray(top_p),
-            jnp.asarray(top_k), self._next_rng(),
-            self._lora_stack, self._lora_ids(seqs, b),
-            sample_index_mode="first",
-        )
+        sampled = self._dispatch(2, 1, payload)
         host = jax.device_get(sampled)
         return [int(host[i]) for i in range(len(seqs))]
 

@@ -578,6 +578,13 @@ def parse_args(argv=None):
     parser.add_argument("--pooling", default="last",
                         choices=["last", "mean"],
                         help="/v1/embeddings pooling mode")
+    # Multi-host slice serving (jax.distributed; parallel/distributed.py).
+    # On GKE TPU slices the three values auto-detect — pass none of them.
+    parser.add_argument("--distributed", action="store_true",
+                        help="Join a jax.distributed multi-host slice")
+    parser.add_argument("--coordinator-address", default=None)
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
     parser.add_argument("--enable-kv-offload", action="store_true",
                         help="HBM->host-RAM KV offload tier")
     parser.add_argument("--kv-host-pool-bytes", type=int,
@@ -589,6 +596,36 @@ def parse_args(argv=None):
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    if args.distributed:
+        from production_stack_tpu.parallel.distributed import (
+            MultihostStepBridge,
+            init_distributed,
+            is_coordinator,
+        )
+        if args.enable_kv_offload or args.kv_remote_url:
+            raise ValueError(
+                "KV offload tiers are host-0-local state and are not "
+                "yet supported in multi-host mode"
+            )
+        init_distributed(args.coordinator_address, args.num_processes,
+                         args.process_id)
+        engine, served_name = build_engine_from_args(args)
+        bridge = MultihostStepBridge(engine.runner)
+        if not is_coordinator():
+            # Workers never serve HTTP; they mirror host 0's steps.
+            bridge.worker_loop()
+            return
+        engine.runner.bridge = bridge
+        server = EngineServer(engine, served_name, pooling=args.pooling)
+        logger.info("tpu-engine %s (multihost coordinator) serving %s "
+                    "on %s:%d", __version__, served_name, args.host,
+                    args.port)
+        try:
+            web.run_app(server.build_app(), host=args.host,
+                        port=args.port, print=None)
+        finally:
+            bridge.shutdown()
+        return
     engine, served_name = build_engine_from_args(args)
     server = EngineServer(engine, served_name, pooling=args.pooling)
     logger.info("tpu-engine %s serving %s on %s:%d",

@@ -1,0 +1,133 @@
+"""Multi-host serving: jax.distributed runtime + step-plan broadcast.
+
+The reference's engines scale across hosts with vLLM's NCCL/Ray stack,
+provisioned by the chart (/dev/shm, GPU resources —
+deployment-vllm-multi.yaml:84-87,226-233). The TPU equivalent is JAX's
+multi-controller model: every host of a slice runs this same program,
+``jax.distributed.initialize`` wires the slice together, and jitted
+steps over a global ``Mesh`` execute SPMD with XLA collectives riding
+ICI (intra-slice) / DCN (inter-slice).
+
+Serving needs one extra piece the SPMD model doesn't give us: the
+scheduler (request queue, page allocator) lives only on host 0, but
+every host must dispatch the SAME device program each step. The
+``MultihostStepBridge`` closes that gap: host 0 authors a step payload
+(numpy arrays) and broadcasts it; workers run a receive-execute loop.
+All hosts then enter the same compiled step with identical inputs, so
+the device programs line up without any per-step consensus protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+KIND_SHUTDOWN = 0
+KIND_PREFILL = 1
+KIND_DECODE = 2
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Join the jax.distributed runtime.
+
+    On GKE TPU slices all arguments auto-detect from the TPU metadata;
+    explicit values support bare-metal/CPU rigs (the reference's
+    bare-metal flow analogue, run_production_stack/).
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    logger.info("jax.distributed up: process %d/%d, %d local / %d "
+                "global devices", jax.process_index(),
+                jax.process_count(), jax.local_device_count(),
+                jax.device_count())
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+class MultihostStepBridge:
+    """Host-0 -> workers broadcast of per-step device-program inputs.
+
+    Protocol per step: a fixed [kind, t_bucket] int32 header, then the
+    payload pytree whose array shapes are a pure function of
+    (kind, t_bucket) and the engine config — so workers can always
+    offer a matching zero-filled structure to ``broadcast_one_to_all``.
+    """
+
+    def __init__(self, runner):
+        self.runner = runner
+
+    # -- shapes --------------------------------------------------------------
+
+    def _payload_template(self, kind: int, t: int) -> Dict[str, np.ndarray]:
+        r = self.runner
+        if kind == KIND_PREFILL:
+            b, tt = 1, t
+        else:
+            b, tt = r.decode_width, 1
+        template = {
+            "tokens": np.zeros((b, tt), np.int32),
+            "positions": np.zeros((b, tt), np.int32),
+            "valid": np.zeros((b, tt), bool),
+            "page_table": np.zeros((b, r.max_pages_per_seq), np.int32),
+            "kv_lens": np.zeros((b,), np.int32),
+            "last_index": np.zeros((b,), np.int32),
+            "temperature": np.zeros((b,), np.float32),
+            "top_p": np.zeros((b,), np.float32),
+            "top_k": np.zeros((b,), np.int32),
+            "rng": np.zeros((2,), np.uint32),
+        }
+        if r.lora_registry is not None:
+            template["lora_ids"] = np.zeros((b,), np.int32)
+        return template
+
+    # -- host 0 --------------------------------------------------------------
+
+    def publish(self, kind: int, t: int,
+                payload: Dict[str, np.ndarray]) -> None:
+        from jax.experimental import multihost_utils
+        header = np.asarray([kind, t], np.int32)
+        multihost_utils.broadcast_one_to_all(header)
+        if kind != KIND_SHUTDOWN:
+            multihost_utils.broadcast_one_to_all(payload)
+
+    def shutdown(self) -> None:
+        """Release workers from their receive loop."""
+        self.publish(KIND_SHUTDOWN, 0, {})
+
+    # -- workers -------------------------------------------------------------
+
+    def worker_loop(self) -> None:
+        """Receive-execute loop for hosts > 0. Returns on shutdown."""
+        from jax.experimental import multihost_utils
+        logger.info("worker %d entering step loop", jax.process_index())
+        while True:
+            header = multihost_utils.broadcast_one_to_all(
+                np.zeros((2,), np.int32)
+            )
+            kind, t = int(header[0]), int(header[1])
+            if kind == KIND_SHUTDOWN:
+                logger.info("worker %d shutting down",
+                            jax.process_index())
+                return
+            payload = multihost_utils.broadcast_one_to_all(
+                self._payload_template(kind, t)
+            )
+            payload = {k: np.asarray(v) for k, v in payload.items()}
+            self.runner.execute_payload(kind, payload)

@@ -1,0 +1,75 @@
+"""Subprocess body for tests/test_multihost.py.
+
+Runs one process of a 2-process jax.distributed CPU rig (4 virtual
+devices each -> 8 global). Process 0 drives a tiny engine generation
+through the MultihostStepBridge; process 1 mirrors the steps. Process 0
+prints the generated token ids as JSON on the last line.
+
+Usage: python multihost_helper.py <coordinator> <num_procs> <proc_id>
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    coordinator, num_procs, proc_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    )
+    from production_stack_tpu.parallel.distributed import (
+        MultihostStepBridge,
+        init_distributed,
+    )
+    init_distributed(coordinator, num_procs, proc_id)
+    assert jax.device_count() == 4 * num_procs
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        SchedulerConfig,
+        tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    # tp=2 spans processes (device order interleaves? either way the
+    # mesh is global); dp covers the rest.
+    mesh = build_mesh(tensor_parallel_size=2, data_parallel_size=4)
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
+                                  prefill_chunk_size=32),
+    )
+    engine = LLMEngine(config, mesh=mesh)
+    bridge = MultihostStepBridge(engine.runner)
+
+    if proc_id == 0:
+        engine.runner.bridge = bridge
+        seq = engine.generate(
+            list(range(1, 20)),
+            SamplingParams(max_tokens=6, temperature=0.0,
+                           ignore_eos=True),
+        )
+        bridge.shutdown()
+        print("TOKENS=" + json.dumps(seq.output_token_ids))
+    else:
+        bridge.worker_loop()
+        print("WORKER_DONE")
+
+
+if __name__ == "__main__":
+    main()
