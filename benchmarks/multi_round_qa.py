@@ -270,6 +270,12 @@ def main(argv=None) -> dict:
     parser.add_argument("--no-ignore-eos", action="store_true")
     parser.add_argument("--output-csv", default=None)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--log-wandb", action="store_true",
+                        help="Stream the summary to Weights & Biases "
+                             "(the fork's router-sidecar mode, reference "
+                             "deployment-router.yaml:24-63); no-op if "
+                             "wandb is not installed")
+    parser.add_argument("--wandb-project", default="tpu-stack-bench")
     args = parser.parse_args(argv)
 
     workload = Workload(
@@ -293,6 +299,16 @@ def main(argv=None) -> dict:
     print(json.dumps(summary, indent=2))
     if args.output_csv:
         write_csv(records, args.output_csv)
+    if args.log_wandb:
+        try:
+            import wandb
+        except ImportError:
+            print("wandb not installed; skipping --log-wandb")
+        else:
+            run = wandb.init(project=args.wandb_project,
+                             config=vars(args))
+            run.log(summary)
+            run.finish()
     return summary
 
 

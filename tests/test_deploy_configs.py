@@ -28,6 +28,7 @@ def schema():
     "tutorials/assets/values-01-minimal-example.yaml",
     "tutorials/assets/values-02-two-pods-session.yaml",
     "tutorials/assets/values-06-remote-shared-kv.yaml",
+    "tutorials/assets/values-08-lora.yaml",
 ])
 def test_values_match_schema(values_file, schema):
     import jsonschema
@@ -59,16 +60,34 @@ def test_engine_flags_in_chart_exist():
 
 
 def test_router_flags_in_chart_exist():
+    """Router-container flags must be real tpu-router flags; the
+    benchmark sidecar's flags must be real multi_round_qa flags."""
+    import re
     from production_stack_tpu.router.parser import parse_args
     with open(os.path.join(
             REPO, "helm/templates/deployment-router.yaml")) as f:
         text = f.read()
-    import re
-    flags = set(re.findall(r'"(--[a-z0-9-]+)"', text))
+    router_text, _, sidecar_text = text.partition("- name: benchmark")
+    flags = set(re.findall(r'"(--[a-z0-9-]+)"', router_text))
     p = parse_args(["--static-backends", "http://x:1"])
     known = {f"--{k.replace('_', '-')}" for k in vars(p)}
     unknown = flags - known
     assert not unknown, f"chart renders unknown router flags: {unknown}"
+
+    sys_path = os.path.join(REPO)
+    import sys
+    sys.path.insert(0, sys_path)
+    try:
+        import benchmarks.multi_round_qa  # noqa: F401
+    finally:
+        sys.path.remove(sys_path)
+    bench_src = open(os.path.join(
+        REPO, "benchmarks/multi_round_qa.py")).read()
+    bench_known = set(re.findall(r'add_argument\("(--[a-z0-9-]+)"',
+                                 bench_src))
+    sidecar_flags = set(re.findall(r'"(--[a-z0-9-]+)"', sidecar_text))
+    unknown = sidecar_flags - bench_known
+    assert not unknown, f"sidecar renders unknown bench flags: {unknown}"
 
 
 def test_routing_logic_enum_consistency():
