@@ -1,0 +1,33 @@
+#!/bin/bash
+# EKS bootstrap (counterpart of reference deployment_on_cloud/aws/
+# entry_point.sh, which creates an EKS GPU cluster + EFS CSI). AWS has
+# no TPUs, so this variant hosts the ROUTER + observability tiers on
+# EKS and points the router at TPU engine endpoints running elsewhere
+# (typically the GKE bootstrap in ../gcp) via static discovery over DCN.
+#
+# Usage: ./entry_point.sh CLUSTER_NAME ENGINE_URLS ENGINE_MODELS
+#   ENGINE_URLS   comma-separated http endpoints of TPU engines
+#   ENGINE_MODELS comma-separated served model names (same order)
+set -euo pipefail
+
+CLUSTER_NAME="${1:?usage: entry_point.sh CLUSTER_NAME ENGINE_URLS ENGINE_MODELS}"
+ENGINE_URLS="${2:?missing ENGINE_URLS}"
+ENGINE_MODELS="${3:?missing ENGINE_MODELS}"
+REGION="${REGION:-us-east-1}"
+
+echo "==> Creating EKS cluster $CLUSTER_NAME"
+eksctl create cluster \
+    --name "$CLUSTER_NAME" \
+    --region "$REGION" \
+    --node-type m6i.xlarge \
+    --nodes 2
+
+echo "==> Installing router tier (static discovery to TPU engines)"
+helm install tpu-stack "$(dirname "$0")/../../helm" \
+    --set servingEngineSpec.enableEngine=false \
+    --set routerSpec.serviceDiscovery=static \
+    --set routerSpec.staticBackends="$ENGINE_URLS" \
+    --set routerSpec.staticModels="$ENGINE_MODELS" \
+    --set routerSpec.serviceType=LoadBalancer
+
+kubectl get svc tpu-stack-router-service

@@ -1,0 +1,33 @@
+#!/bin/bash
+# AKS bootstrap (counterpart of reference deployment_on_cloud/azure/
+# entry_point.sh). Azure has no TPUs; like the AWS variant this hosts
+# the router + observability tiers and fronts remote TPU engines via
+# static discovery.
+#
+# Usage: ./entry_point.sh RESOURCE_GROUP CLUSTER_NAME ENGINE_URLS ENGINE_MODELS
+set -euo pipefail
+
+RESOURCE_GROUP="${1:?usage: entry_point.sh RG CLUSTER ENGINE_URLS ENGINE_MODELS}"
+CLUSTER_NAME="${2:?usage: entry_point.sh RG CLUSTER ENGINE_URLS ENGINE_MODELS}"
+ENGINE_URLS="${3:?missing ENGINE_URLS}"
+ENGINE_MODELS="${4:?missing ENGINE_MODELS}"
+LOCATION="${LOCATION:-eastus}"
+
+az group create --name "$RESOURCE_GROUP" --location "$LOCATION"
+az aks create \
+    --resource-group "$RESOURCE_GROUP" \
+    --name "$CLUSTER_NAME" \
+    --node-count 2 \
+    --node-vm-size Standard_D4s_v5 \
+    --generate-ssh-keys
+az aks get-credentials --resource-group "$RESOURCE_GROUP" \
+    --name "$CLUSTER_NAME"
+
+helm install tpu-stack "$(dirname "$0")/../../helm" \
+    --set servingEngineSpec.enableEngine=false \
+    --set routerSpec.serviceDiscovery=static \
+    --set routerSpec.staticBackends="$ENGINE_URLS" \
+    --set routerSpec.staticModels="$ENGINE_MODELS" \
+    --set routerSpec.serviceType=LoadBalancer
+
+kubectl get svc tpu-stack-router-service

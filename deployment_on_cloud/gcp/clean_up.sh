@@ -1,0 +1,12 @@
+#!/bin/bash
+# Tear down everything entry_point.sh created (reference
+# deployment_on_cloud/gcp cleanup flow).
+set -euo pipefail
+
+PROJECT_ID="${1:?usage: clean_up.sh PROJECT_ID CLUSTER_NAME}"
+CLUSTER_NAME="${2:?usage: clean_up.sh PROJECT_ID CLUSTER_NAME}"
+ZONE="${ZONE:-${REGION:-us-central2}-b}"
+
+gcloud config set project "$PROJECT_ID"
+helm uninstall tpu-stack || true
+gcloud container clusters delete "$CLUSTER_NAME" --zone "$ZONE" --quiet

@@ -1,0 +1,47 @@
+#!/bin/bash
+# GKE bootstrap for the TPU serving stack (counterpart of reference
+# deployment_on_cloud/gcp/entry_point.sh, which creates a GPU cluster +
+# Filestore CSI). This variant creates a CPU default pool for the
+# router/observability tiers and a TPU v5e pod-slice node pool for the
+# engines, then installs the chart.
+#
+# Usage: ./entry_point.sh PROJECT_ID CLUSTER_NAME [values.yaml]
+set -euo pipefail
+
+PROJECT_ID="${1:?usage: entry_point.sh PROJECT_ID CLUSTER_NAME [values.yaml]}"
+CLUSTER_NAME="${2:?usage: entry_point.sh PROJECT_ID CLUSTER_NAME [values.yaml]}"
+VALUES_FILE="${3:-$(dirname "$0")/production_stack_specification.yaml}"
+
+REGION="${REGION:-us-central2}"
+ZONE="${ZONE:-${REGION}-b}"
+# v5e 2x4 slice (8 chips) matches the chart default
+# (helm/values.yaml tpu.topology: 2x4).
+TPU_TYPE="${TPU_TYPE:-ct5lp-hightpu-8t}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
+NUM_TPU_NODES="${NUM_TPU_NODES:-1}"
+
+gcloud config set project "$PROJECT_ID"
+
+echo "==> Creating GKE cluster $CLUSTER_NAME ($ZONE)"
+gcloud container clusters create "$CLUSTER_NAME" \
+    --zone "$ZONE" \
+    --machine-type e2-standard-8 \
+    --num-nodes 2 \
+    --addons GcpFilestoreCsiDriver
+
+echo "==> Adding TPU v5e node pool ($TPU_TYPE, topology $TPU_TOPOLOGY)"
+gcloud container node-pools create tpu-pool \
+    --cluster "$CLUSTER_NAME" \
+    --zone "$ZONE" \
+    --machine-type "$TPU_TYPE" \
+    --tpu-topology "$TPU_TOPOLOGY" \
+    --num-nodes "$NUM_TPU_NODES" \
+    --node-taints google.com/tpu=present:NoSchedule
+
+gcloud container clusters get-credentials "$CLUSTER_NAME" --zone "$ZONE"
+
+echo "==> Installing tpu-stack chart"
+helm install tpu-stack "$(dirname "$0")/../../helm" -f "$VALUES_FILE"
+
+echo "==> Done. Router endpoint:"
+kubectl get svc tpu-stack-router-service

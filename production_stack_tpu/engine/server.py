@@ -595,6 +595,18 @@ def parse_args(argv=None):
 
 
 def main(argv=None) -> None:
+    import os
+    # Honor an explicit JAX_PLATFORMS request. The TPU-tunnel image's
+    # sitecustomize overrides jax_platforms via jax.config (config
+    # beats env), which would make `JAX_PLATFORMS=cpu tpu-engine ...`
+    # silently dial the tunnel anyway — and hang if it is down.
+    requested = os.environ.get("JAX_PLATFORMS", "").strip()
+    if requested:
+        try:
+            import jax
+            jax.config.update("jax_platforms", requested)
+        except Exception:
+            pass
     args = parse_args(argv)
     if args.distributed:
         from production_stack_tpu.parallel.distributed import (

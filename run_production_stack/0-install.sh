@@ -1,0 +1,10 @@
+#!/bin/bash
+# Install the stack on a TPU VM (fork 0-*.sh analogue: environment
+# prep; TPU VMs need only the Python package + jax[tpu]).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+pip install -e .
+python -c "import jax; print('devices:', jax.devices())"
+mkdir -p /tmp/tpu-stack
+echo "OK"

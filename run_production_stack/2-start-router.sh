@@ -1,0 +1,28 @@
+#!/bin/bash
+# Launch the router with llq routing + the dynamic-config watcher
+# (fork's router setup with config/dynamic.json). The watcher is the
+# same contract the K8s control-plane agent drives (SURVEY.md §3.4).
+# Usage: ./2-start-router.sh [port] [dynamic.json]
+set -euo pipefail
+cd "$(dirname "$0")"
+PORT="${1:-8001}"
+DYNAMIC="${2:-config/dynamic.json}"
+
+mkdir -p /tmp/tpu-stack
+cp "$DYNAMIC" /tmp/tpu-stack/dynamic_config.json
+ROUTER_CMD="tpu-router"
+if ! command -v tpu-router >/dev/null; then
+    ROUTER_CMD="python -m production_stack_tpu.router.app"
+    export PYTHONPATH="$(cd .. && pwd):${PYTHONPATH:-}"
+fi
+nohup $ROUTER_CMD \
+    --port "$PORT" \
+    --service-discovery static \
+    --static-backends "$(python -c "import json;print(json.load(open('$DYNAMIC'))['static_backends'])")" \
+    --static-models "$(python -c "import json;print(json.load(open('$DYNAMIC'))['static_models'])")" \
+    --routing-logic llq \
+    --dynamic-config-json /tmp/tpu-stack/dynamic_config.json \
+    >/tmp/tpu-stack/router.log 2>&1 &
+echo $! > /tmp/tpu-stack/router.pid
+echo "router :$PORT (log /tmp/tpu-stack/router.log)"
+echo "edit /tmp/tpu-stack/dynamic_config.json to re-point it live"

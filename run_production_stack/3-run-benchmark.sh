@@ -1,0 +1,16 @@
+#!/bin/bash
+# Multi-round-QA load against the local router (fork benchmark step).
+# Usage: ./3-run-benchmark.sh [model] [qps] [num_users]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+MODEL="${1:-meta-llama/Meta-Llama-3-8B-Instruct}"
+QPS="${2:-1.0}"
+USERS="${3:-10}"
+
+python -m benchmarks.multi_round_qa \
+    --base-url "http://127.0.0.1:8001/v1" \
+    --model "$MODEL" \
+    --qps "$QPS" \
+    --num-users "$USERS" \
+    --num-rounds 3 \
+    --output-csv /tmp/tpu-stack/bench.csv

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Health/metrics snapshot of every stack process.
+set -uo pipefail
+
+for pidfile in /tmp/tpu-stack/*.pid; do
+    [ -e "$pidfile" ] || continue
+    name=$(basename "$pidfile" .pid)
+    pid=$(cat "$pidfile")
+    if kill -0 "$pid" 2>/dev/null; then
+        echo "$name: running (pid $pid)"
+    else
+        echo "$name: DEAD"
+    fi
+done
+
+echo "--- router health ---"
+curl -s http://127.0.0.1:8001/health || echo "(router unreachable)"
+echo
+echo "--- router metrics (engine gauges) ---"
+curl -s http://127.0.0.1:8001/metrics | grep -E "^vllm:" | head -20

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Stop every stack process (fork cluster-off analogue).
+set -uo pipefail
+
+for pidfile in /tmp/tpu-stack/*.pid; do
+    [ -e "$pidfile" ] || continue
+    pid=$(cat "$pidfile")
+    name=$(basename "$pidfile" .pid)
+    if kill "$pid" 2>/dev/null; then
+        echo "stopped $name (pid $pid)"
+    fi
+    rm -f "$pidfile"
+done

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Single-node minikube rig for CPU-only development of the stack
+# (counterpart of reference utils/install-minikube-cluster.sh, which
+# installs minikube + the NVIDIA GPU operator; a TPU stack needs no
+# device operator — engines run tiny models on CPU XLA in this rig,
+# matching the values-01 minimal example).
+set -euo pipefail
+
+if ! command -v minikube >/dev/null; then
+    echo "==> Installing minikube"
+    curl -LO https://storage.googleapis.com/minikube/releases/latest/minikube-linux-amd64
+    sudo install minikube-linux-amd64 /usr/local/bin/minikube
+    rm minikube-linux-amd64
+fi
+
+if ! command -v kubectl >/dev/null; then
+    echo "==> Installing kubectl"
+    curl -LO "https://dl.k8s.io/release/$(curl -Ls https://dl.k8s.io/release/stable.txt)/bin/linux/amd64/kubectl"
+    sudo install -o root -g root -m 0755 kubectl /usr/local/bin/kubectl
+    rm kubectl
+fi
+
+if ! command -v helm >/dev/null; then
+    echo "==> Installing helm"
+    curl -fsSL https://raw.githubusercontent.com/helm/helm/main/scripts/get-helm-3 | bash
+fi
+
+echo "==> Starting minikube"
+minikube start --cpus 4 --memory 8g
+
+echo "==> Installing tpu-stack (CPU-only tiny model)"
+helm install tpu-stack "$(dirname "$0")/../helm" \
+    -f "$(dirname "$0")/../tutorials/assets/values-01-minimal-example.yaml"
+
+kubectl get pods -w
