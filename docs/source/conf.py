@@ -1,0 +1,21 @@
+# Sphinx configuration for the TPU serving stack docs
+# (counterpart of reference docs/source/conf.py).
+
+project = "production-stack-tpu"
+copyright = "2026, production-stack-tpu contributors"
+author = "production-stack-tpu contributors"
+release = "0.1.0"
+
+extensions = [
+    "myst_parser",
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+source_suffix = {".rst": "restructuredtext", ".md": "markdown"}
+master_doc = "index"
+exclude_patterns = []
+
+html_theme = "sphinx_rtd_theme"
+html_static_path = []

@@ -1,0 +1,55 @@
+"""OpenAI Batch API walkthrough against the router (counterpart of
+reference examples/openai_api_client_batch.py): upload a JSONL batch
+file, create a batch, poll it, download results.
+
+Run a stack first (e.g. run_production_stack/ runbook or the helm
+minimal example with --enable-batch-api on the router), then:
+
+    python examples/openai_api_client_batch.py --base-url http://localhost:8001
+"""
+
+import argparse
+import os
+import time
+
+from openai import OpenAI
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--base-url", default="http://localhost:8001")
+    parser.add_argument("--file", default=os.path.join(
+        os.path.dirname(__file__), "batch.jsonl"))
+    args = parser.parse_args()
+
+    client = OpenAI(base_url=f"{args.base_url}/v1", api_key="none")
+
+    print("== uploading", args.file)
+    with open(args.file, "rb") as f:
+        uploaded = client.files.create(file=f, purpose="batch")
+    print("file id:", uploaded.id)
+
+    print("== creating batch")
+    batch = client.batches.create(
+        input_file_id=uploaded.id,
+        endpoint="/v1/chat/completions",
+        completion_window="24h",
+    )
+    print("batch id:", batch.id, "status:", batch.status)
+
+    while batch.status not in ("completed", "failed", "cancelled",
+                               "expired"):
+        time.sleep(2)
+        batch = client.batches.retrieve(batch.id)
+        print("  status:", batch.status)
+
+    if batch.status == "completed" and batch.output_file_id:
+        content = client.files.content(batch.output_file_id)
+        print("== results")
+        print(content.text)
+    else:
+        print("batch ended with status", batch.status)
+
+
+if __name__ == "__main__":
+    main()
