@@ -381,7 +381,6 @@ class EngineServer:
     async def embeddings(self, request: web.Request):
         """OpenAI /v1/embeddings over the served model's hidden states."""
         from production_stack_tpu.engine.embeddings import (
-            Embedder,
             parse_embedding_input,
         )
         body = await self._json_body(request)
@@ -396,18 +395,12 @@ class EngineServer:
                            "type": "invalid_request_error"}},
                 status=400,
             )
-        if self._embedder is None:
-            try:
-                self._embedder = Embedder(
-                    self.engine.config.model,
-                    self.engine.runner.params,
-                    max_len=self.engine.config.scheduler.max_model_len,
-                    pooling=self.pooling,
-                )
-            except NotImplementedError as e:
-                return web.json_response(
-                    {"error": {"message": str(e)}}, status=501,
-                )
+        try:
+            await self._ensure_embedder()
+        except NotImplementedError as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=501,
+            )
         # One embed batch on-device at a time; compute off the event
         # loop so token streaming stays live.
         async with self._embed_lock:
@@ -425,6 +418,110 @@ class EngineServer:
             ],
             "usage": {"prompt_tokens": n_tokens,
                       "total_tokens": n_tokens},
+        })
+
+    async def _ensure_embedder(self):
+        from production_stack_tpu.engine.embeddings import Embedder
+        if self._embedder is None:
+            self._embedder = Embedder(
+                self.engine.config.model,
+                self.engine.runner.params,
+                max_len=self.engine.config.scheduler.max_model_len,
+                pooling=self.pooling,
+            )
+        return self._embedder
+
+    async def _pair_scores(self, query: str, documents: List[str]):
+        """Bi-encoder relevance: cosine of pooled embeddings (the
+        engine-side backend for the router's /score and /rerank proxy
+        paths, reference main_router.py:42-84)."""
+        import numpy as np
+        embedder = await self._ensure_embedder()
+        max_len = self.engine.config.scheduler.max_model_len
+        token_lists = [self.tokenizer.encode(query)[:max_len]] + [
+            self.tokenizer.encode(d)[:max_len] for d in documents
+        ]
+        for ids in token_lists:
+            if not ids:
+                raise ValueError("texts must not be empty")
+        async with self._embed_lock:
+            vectors = await asyncio.to_thread(
+                embedder.embed_batch, token_lists
+            )
+        q_vec, d_vecs = vectors[0], vectors[1:]
+        # Embeddings are L2-normalized: dot == cosine.
+        scores = d_vecs @ q_vec
+        n_tokens = sum(len(t) for t in token_lists)
+        return [float(s) for s in scores], n_tokens
+
+    async def score(self, request: web.Request):
+        """/v1/score: relevance of text_2 document(s) to text_1."""
+        body = await self._json_body(request)
+        text_1 = body.get("text_1") or body.get("query")
+        text_2 = body.get("text_2") or body.get("documents")
+        if not isinstance(text_1, str) or text_2 is None:
+            return web.json_response(
+                {"error": {"message": "'text_1' (string) and 'text_2' "
+                                      "(string or list) are required"}},
+                status=400,
+            )
+        docs = [text_2] if isinstance(text_2, str) else list(text_2)
+        try:
+            scores, n_tokens = await self._pair_scores(text_1, docs)
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=400)
+        except NotImplementedError as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=501)
+        return web.json_response({
+            "id": "score-" + uuid.uuid4().hex[:16],
+            "object": "list",
+            "model": self.model_name,
+            "data": [
+                {"object": "score", "index": i, "score": s}
+                for i, s in enumerate(scores)
+            ],
+            "usage": {"prompt_tokens": n_tokens,
+                      "total_tokens": n_tokens},
+        })
+
+    async def rerank(self, request: web.Request):
+        """/v1/rerank: order documents by relevance to the query."""
+        body = await self._json_body(request)
+        query = body.get("query")
+        documents = body.get("documents")
+        if not isinstance(query, str) or not isinstance(documents, list):
+            return web.json_response(
+                {"error": {"message": "'query' (string) and 'documents'"
+                                      " (list of strings) are required"}},
+                status=400,
+            )
+        try:
+            scores, n_tokens = await self._pair_scores(
+                query, [str(d) for d in documents])
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=400)
+        except NotImplementedError as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=501)
+        order = sorted(range(len(scores)), key=lambda i: -scores[i])
+        top_n = body.get("top_n")
+        if isinstance(top_n, int) and top_n > 0:
+            order = order[:top_n]
+        return web.json_response({
+            "id": "rerank-" + uuid.uuid4().hex[:16],
+            "model": self.model_name,
+            "usage": {"total_tokens": n_tokens},
+            "results": [
+                {
+                    "index": i,
+                    "document": {"text": documents[i]},
+                    "relevance_score": scores[i],
+                }
+                for i in order
+            ],
         })
 
     async def models(self, request: web.Request):
@@ -473,6 +570,10 @@ class EngineServer:
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/v1/score", self.score)
+        app.router.add_post("/score", self.score)
+        app.router.add_post("/v1/rerank", self.rerank)
+        app.router.add_post("/rerank", self.rerank)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/version", self.version)

@@ -1,0 +1,90 @@
+"""Engine-side /v1/score and /v1/rerank (reference surface:
+src/vllm_router/routers/main_router.py:42-84 proxies both; our engine
+serves them natively as bi-encoder pooled-embedding relevance)."""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.server import EngineServer
+
+
+def _server():
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
+                                  prefill_chunk_size=32),
+    )
+    return EngineServer(LLMEngine(config), "tiny-llama")
+
+
+def _run(fn):
+    async def wrapper():
+        server = _server()
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+    asyncio.run(wrapper())
+
+
+def test_score_single_and_list():
+    async def run(client):
+        resp = await client.post("/v1/score", json={
+            "model": "tiny-llama",
+            "text_1": "the quick brown fox",
+            "text_2": ["the quick brown fox", "completely different"],
+        })
+        assert resp.status == 200
+        data = await resp.json()
+        scores = [d["score"] for d in data["data"]]
+        assert len(scores) == 2
+        # Identical text must score (near) 1.0 and beat a different one.
+        assert scores[0] > 0.999
+        assert scores[0] > scores[1]
+
+        resp = await client.post("/score", json={
+            "text_1": "abc", "text_2": "abc"})
+        assert resp.status == 200
+
+        resp = await client.post("/v1/score", json={"text_1": "x"})
+        assert resp.status == 400
+    _run(run)
+
+
+def test_rerank_orders_by_relevance():
+    async def run(client):
+        docs = ["zzz unrelated text", "alpha beta gamma", "alpha beta"]
+        resp = await client.post("/v1/rerank", json={
+            "model": "tiny-llama",
+            "query": "alpha beta gamma",
+            "documents": docs,
+        })
+        assert resp.status == 200
+        data = await resp.json()
+        results = data["results"]
+        assert len(results) == 3
+        # Exact match ranks first; scores are non-increasing.
+        assert results[0]["index"] == 1
+        rel = [r["relevance_score"] for r in results]
+        assert rel == sorted(rel, reverse=True)
+        assert results[0]["document"]["text"] == docs[1]
+
+        resp = await client.post("/rerank", json={
+            "query": "q", "documents": docs, "top_n": 1})
+        data = await resp.json()
+        assert len(data["results"]) == 1
+
+        resp = await client.post("/v1/rerank", json={"query": "x"})
+        assert resp.status == 400
+    _run(run)
