@@ -42,6 +42,8 @@ class ModelConfig:
     # OPT/GPT-2 specifics
     do_layer_norm_before: bool = True
     activation: str = "silu"  # silu (llama) | relu (opt) | gelu (gpt2)
+    # Qwen2-style q/k/v projection biases on the llama-family body.
+    attention_bias: bool = False
     # Decode attention implementation:
     #   auto            -> pallas on TPU, xla elsewhere (resolved by the
     #                      model runner at init)
@@ -62,6 +64,21 @@ class ModelConfig:
     def from_hf_config(cls, hf: dict, name: str = "") -> "ModelConfig":
         """Build from a HuggingFace config.json dict."""
         arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0].lower()
+        if "gpt2" in arch:
+            return cls(
+                name=name or hf.get("_name_or_path", "gpt2"),
+                architecture="gpt2",
+                vocab_size=hf["vocab_size"],
+                hidden_size=hf["n_embd"],
+                intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+                num_hidden_layers=hf["n_layer"],
+                num_attention_heads=hf["n_head"],
+                num_key_value_heads=hf["n_head"],
+                max_position_embeddings=hf["n_positions"],
+                tie_word_embeddings=True,
+                activation="gelu",
+                dtype="bfloat16",
+            )
         if "opt" in arch:
             return cls(
                 name=name or hf.get("_name_or_path", "opt"),
@@ -78,9 +95,14 @@ class ModelConfig:
                 activation="relu",
                 dtype="bfloat16",
             )
+        qwen = "qwen2" in arch
         return cls(
             name=name or hf.get("_name_or_path", "llama"),
-            architecture="llama",
+            architecture="qwen2" if qwen else "llama",
+            # Qwen2 puts biases on q/k/v (HF Qwen2Attention); plain
+            # Llama exposes the same switch via attention_bias.
+            attention_bias=(True if qwen
+                            else hf.get("attention_bias", False)),
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
             intermediate_size=hf["intermediate_size"],

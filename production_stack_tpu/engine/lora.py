@@ -51,7 +51,7 @@ def target_shapes(config: ModelConfig) -> Dict[str, Tuple[int, int]]:
     nh, nkv, d = (config.num_attention_heads,
                   config.num_key_value_heads, config.head_dim)
     ffn = config.intermediate_size
-    if config.architecture == "opt":
+    if config.architecture in ("opt", "gpt2"):
         return {
             "wq": (h, nh * d), "wk": (h, nh * d), "wv": (h, nh * d),
             "wo": (nh * d, h), "fc1": (h, ffn), "fc2": (ffn, h),

@@ -95,6 +95,10 @@ def load_llama_weights(model_dir: str, config: ModelConfig,
         "w_up": lt("layers.{}.mlp.up_proj.weight"),
         "w_down": lt("layers.{}.mlp.down_proj.weight"),
     }
+    if config.attention_bias:  # Qwen2-style q/k/v biases
+        params["bq"] = lt("layers.{}.self_attn.q_proj.bias", False)
+        params["bk"] = lt("layers.{}.self_attn.k_proj.bias", False)
+        params["bv"] = lt("layers.{}.self_attn.v_proj.bias", False)
     if not config.tie_word_embeddings:
         head = raw.get("lm_head.weight")
         if head is None:
@@ -102,6 +106,48 @@ def load_llama_weights(model_dir: str, config: ModelConfig,
         else:
             params["lm_head"] = jnp.asarray(head.T, dtype)
     return params
+
+
+def load_gpt2_weights(model_dir: str, config: ModelConfig,
+                      dtype=None) -> Dict[str, jnp.ndarray]:
+    """HF GPT-2 checkpoints use Conv1D layout ([in, out], no transpose)
+    and a fused qkv projection (``c_attn``), split here so the runtime
+    shares the llama-family attention path."""
+    raw = _load_raw_tensors(model_dir)
+    raw = {k.removeprefix("transformer."): v for k, v in raw.items()}
+    L = config.num_hidden_layers
+    h = config.hidden_size
+    dtype = dtype or config.jax_dtype
+
+    def lt(template, transpose=False):
+        return jnp.asarray(
+            _stack(raw, template, L, transpose=transpose), dtype
+        )
+
+    qkv_w = _stack(raw, "h.{}.attn.c_attn.weight", L)   # [L, h, 3h]
+    qkv_b = _stack(raw, "h.{}.attn.c_attn.bias", L)     # [L, 3h]
+    return {
+        "embed": jnp.asarray(raw["wte.weight"], dtype),
+        "pos_embed": jnp.asarray(raw["wpe.weight"], dtype),
+        "final_norm_w": jnp.asarray(raw["ln_f.weight"], dtype),
+        "final_norm_b": jnp.asarray(raw["ln_f.bias"], dtype),
+        "attn_norm_w": lt("h.{}.ln_1.weight"),
+        "attn_norm_b": lt("h.{}.ln_1.bias"),
+        "wq": jnp.asarray(qkv_w[:, :, 0 * h:1 * h], dtype),
+        "bq": jnp.asarray(qkv_b[:, 0 * h:1 * h], dtype),
+        "wk": jnp.asarray(qkv_w[:, :, 1 * h:2 * h], dtype),
+        "bk": jnp.asarray(qkv_b[:, 1 * h:2 * h], dtype),
+        "wv": jnp.asarray(qkv_w[:, :, 2 * h:3 * h], dtype),
+        "bv": jnp.asarray(qkv_b[:, 2 * h:3 * h], dtype),
+        "wo": lt("h.{}.attn.c_proj.weight"),
+        "bo": lt("h.{}.attn.c_proj.bias"),
+        "mlp_norm_w": lt("h.{}.ln_2.weight"),
+        "mlp_norm_b": lt("h.{}.ln_2.bias"),
+        "fc1": lt("h.{}.mlp.c_fc.weight"),
+        "fc1_b": lt("h.{}.mlp.c_fc.bias"),
+        "fc2": lt("h.{}.mlp.c_proj.weight"),
+        "fc2_b": lt("h.{}.mlp.c_proj.bias"),
+    }
 
 
 def load_opt_weights(model_dir: str, config: ModelConfig,
@@ -147,4 +193,6 @@ def load_weights(model_dir: str, config: ModelConfig,
                  dtype=None) -> Dict[str, jnp.ndarray]:
     if config.architecture == "opt":
         return load_opt_weights(model_dir, config, dtype)
+    if config.architecture == "gpt2":
+        return load_gpt2_weights(model_dir, config, dtype)
     return load_llama_weights(model_dir, config, dtype)

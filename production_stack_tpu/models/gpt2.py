@@ -1,0 +1,121 @@
+"""GPT-2 family (gpt2, gpt2-medium/large/xl, distilgpt2).
+
+Completes the decoder-family coverage the reference gets from vLLM's
+model zoo (engines are external images there —
+helm/templates/deployment-vllm-multi.yaml:55-64). Differences from OPT
+handled here: positional embeddings with no offset, gelu(tanh) MLP,
+always-tied LM head. Same scanned-layer + paged-cache structure as
+models/llama.py; the HF checkpoint's fused ``c_attn`` is split into
+q/k/v at load time (engine/weights.py) so the attention path is shared.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.models.llama import dispatch_attention
+from production_stack_tpu.models.opt import layer_norm
+from production_stack_tpu.ops.attention import write_to_pages
+
+Params = Dict[str, jnp.ndarray]
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> Params:
+    h = config.hidden_size
+    ffn = config.intermediate_size
+    nh, d = config.num_attention_heads, config.head_dim
+    layers = config.num_hidden_layers
+    dtype = config.jax_dtype
+
+    def dense(key, shape, scale=0.02):
+        return (scale * jax.random.normal(key, shape, jnp.float32)
+                ).astype(dtype)
+
+    keys = iter(jax.random.split(key, 16))
+    return {
+        "embed": dense(next(keys), (config.vocab_size, h)),
+        "pos_embed": dense(
+            next(keys), (config.max_position_embeddings, h)),
+        "final_norm_w": jnp.ones((h,), dtype),
+        "final_norm_b": jnp.zeros((h,), dtype),
+        "attn_norm_w": jnp.ones((layers, h), dtype),
+        "attn_norm_b": jnp.zeros((layers, h), dtype),
+        "wq": dense(next(keys), (layers, h, nh * d)),
+        "bq": jnp.zeros((layers, nh * d), dtype),
+        "wk": dense(next(keys), (layers, h, nh * d)),
+        "bk": jnp.zeros((layers, nh * d), dtype),
+        "wv": dense(next(keys), (layers, h, nh * d)),
+        "bv": jnp.zeros((layers, nh * d), dtype),
+        "wo": dense(next(keys), (layers, nh * d, h)),
+        "bo": jnp.zeros((layers, h), dtype),
+        "mlp_norm_w": jnp.ones((layers, h), dtype),
+        "mlp_norm_b": jnp.zeros((layers, h), dtype),
+        "fc1": dense(next(keys), (layers, h, ffn)),
+        "fc1_b": jnp.zeros((layers, ffn), dtype),
+        "fc2": dense(next(keys), (layers, ffn, h)),
+        "fc2_b": jnp.zeros((layers, h), dtype),
+    }
+
+
+def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, page_table: jnp.ndarray,
+            kv_lens: jnp.ndarray, valid: jnp.ndarray,
+            k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+            lora=None, lora_ids=None,
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Same contract as models.llama.forward."""
+    from production_stack_tpu.engine.lora import lora_matmul
+
+    nh, d = config.num_attention_heads, config.head_dim
+    b, t = tokens.shape
+
+    x = params["embed"][tokens] + params["pos_embed"][positions]
+
+    layer_params = {
+        k: params[k] for k in (
+            "attn_norm_w", "attn_norm_b", "wq", "bq", "wk", "bk",
+            "wv", "bv", "wo", "bo", "mlp_norm_w", "mlp_norm_b",
+            "fc1", "fc1_b", "fc2", "fc2_b",
+        )
+    }
+    lora_scale = (None if lora is None
+                  else lora["scaling"][lora_ids])
+    lora_scanned = (None if lora is None
+                    else {"a": lora["a"], "b": lora["b"]})
+
+    def layer_step(x, scanned):
+        lp, ll, k_layer, v_layer = scanned
+        a_in = layer_norm(x, lp["attn_norm_w"], lp["attn_norm_b"])
+        q = (lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids, lora_scale)
+             + lp["bq"]).reshape(b, t, nh, d)
+        k = (lora_matmul(a_in, lp["wk"], ll, "wk", lora_ids, lora_scale)
+             + lp["bk"]).reshape(b, t, nh, d)
+        v = (lora_matmul(a_in, lp["wv"], ll, "wv", lora_ids, lora_scale)
+             + lp["bv"]).reshape(b, t, nh, d)
+        k_layer = write_to_pages(k_layer, k, page_table, positions, valid)
+        v_layer = write_to_pages(v_layer, v, page_table, positions, valid)
+        attn = dispatch_attention(
+            config, q, k_layer, v_layer, page_table, positions, kv_lens
+        )
+        x = x + (lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
+                             "wo", lora_ids, lora_scale) + lp["bo"])
+        m_in = layer_norm(x, lp["mlp_norm_w"], lp["mlp_norm_b"])
+        # HF GPT-2 uses gelu_new == tanh-approximated gelu.
+        hidden = jax.nn.gelu(
+            lora_matmul(m_in, lp["fc1"], ll, "fc1", lora_ids, lora_scale)
+            + lp["fc1_b"], approximate=True)
+        x = x + (lora_matmul(hidden, lp["fc2"], ll, "fc2", lora_ids,
+                             lora_scale) + lp["fc2_b"])
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (layer_params, lora_scanned, k_cache, v_cache)
+    )
+
+    x = layer_norm(x, params["final_norm_w"], params["final_norm_b"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return logits, new_k, new_v

@@ -84,9 +84,21 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         "w_up": dense(next(keys), (layers, h, ffn)),
         "w_down": dense(next(keys), (layers, ffn, h)),
     }
+    if config.attention_bias:  # Qwen2-style q/k/v biases
+        params["bq"] = jnp.zeros((layers, nh * d), dtype)
+        params["bk"] = jnp.zeros((layers, nkv * d), dtype)
+        params["bv"] = jnp.zeros((layers, nkv * d), dtype)
     if not config.tie_word_embeddings:
         params["lm_head"] = dense(next(keys), (h, config.vocab_size))
     return params
+
+
+def _layer_param_names(config: ModelConfig):
+    names = ["attn_norm", "wq", "wk", "wv", "wo",
+             "mlp_norm", "w_gate", "w_up", "w_down"]
+    if config.attention_bias:
+        names += ["bq", "bk", "bv"]
+    return names
 
 
 def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
@@ -118,10 +130,7 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
     x = params["embed"][tokens]  # [B, T, H]
 
     layer_params = {
-        k: params[k] for k in (
-            "attn_norm", "wq", "wk", "wv", "wo",
-            "mlp_norm", "w_gate", "w_up", "w_down",
-        )
+        k: params[k] for k in _layer_param_names(config)
     }
     lora_scale = (None if lora is None
                   else lora["scaling"][lora_ids])  # [B]
@@ -132,12 +141,14 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
         lp, ll, k_layer, v_layer = scanned
         # Attention block
         a_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
-        q = lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids,
-                        lora_scale).reshape(b, t, nh, d)
-        k = lora_matmul(a_in, lp["wk"], ll, "wk", lora_ids,
-                        lora_scale).reshape(b, t, nkv, d)
-        v = lora_matmul(a_in, lp["wv"], ll, "wv", lora_ids,
-                        lora_scale).reshape(b, t, nkv, d)
+        q = lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids, lora_scale)
+        k = lora_matmul(a_in, lp["wk"], ll, "wk", lora_ids, lora_scale)
+        v = lora_matmul(a_in, lp["wv"], ll, "wv", lora_ids, lora_scale)
+        if config.attention_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(b, t, nh, d)
+        k = k.reshape(b, t, nkv, d)
+        v = v.reshape(b, t, nkv, d)
         q = apply_rope(q, positions, config.rope_theta)
         k = apply_rope(k, positions, config.rope_theta)
         k_layer = write_to_pages(k_layer, k, page_table, positions, valid)
@@ -202,20 +213,20 @@ def encode(params: Params, config: ModelConfig,
     x = params["embed"][tokens]
 
     layer_params = {
-        k: params[k] for k in (
-            "attn_norm", "wq", "wk", "wv", "wo",
-            "mlp_norm", "w_gate", "w_up", "w_down",
-        )
+        k: params[k] for k in _layer_param_names(config)
     }
     causal = jnp.tril(jnp.ones((t, t), bool))
 
     def layer_step(x, lp):
         a_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
-        q = apply_rope((a_in @ lp["wq"]).reshape(b, t, nh, d),
+        q, k, v = a_in @ lp["wq"], a_in @ lp["wk"], a_in @ lp["wv"]
+        if config.attention_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = apply_rope(q.reshape(b, t, nh, d),
                        positions, config.rope_theta)
-        k = apply_rope((a_in @ lp["wk"]).reshape(b, t, nkv, d),
+        k = apply_rope(k.reshape(b, t, nkv, d),
                        positions, config.rope_theta)
-        v = (a_in @ lp["wv"]).reshape(b, t, nkv, d)
+        v = v.reshape(b, t, nkv, d)
         group = nh // nkv
         qg = q.reshape(b, t, nkv, group, d)
         scores = jnp.einsum(

@@ -14,8 +14,11 @@ def get_model(config: ModelConfig) -> Tuple[Callable, Callable]:
     if arch == "opt":
         from production_stack_tpu.models import opt
         return opt.init_params, opt.forward
+    if arch == "gpt2":
+        from production_stack_tpu.models import gpt2
+        return gpt2.init_params, gpt2.forward
     raise ValueError(f"Unknown architecture: {arch}")
 
 
 def list_architectures():
-    return ["llama", "mistral", "qwen2", "opt"]
+    return ["llama", "mistral", "qwen2", "opt", "gpt2"]

@@ -50,6 +50,10 @@ _LLAMA_SPECS: Dict[str, P] = {
     "w_up": P(None, None, "tp"),
     "w_down": P(None, "tp", None),
     "lm_head": P(None, "tp"),
+    # Qwen2-style attention biases follow their projections' columns.
+    "bq": P(None, "tp"),
+    "bk": P(None, "tp"),
+    "bv": P(None, "tp"),
 }
 
 _OPT_SPECS: Dict[str, P] = {
@@ -68,7 +72,7 @@ _OPT_SPECS: Dict[str, P] = {
 
 
 def param_specs(config: ModelConfig) -> Dict[str, P]:
-    if config.architecture == "opt":
+    if config.architecture in ("opt", "gpt2"):
         return dict(_OPT_SPECS)
     return dict(_LLAMA_SPECS)
 

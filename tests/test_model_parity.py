@@ -67,6 +67,47 @@ def _save_tiny_opt(tmp_path):
     return path, model
 
 
+def _save_tiny_gpt2(tmp_path):
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+    torch.manual_seed(0)
+    config = GPT2Config(
+        vocab_size=128,
+        n_embd=64,
+        n_layer=2,
+        n_head=4,
+        n_positions=256,
+        n_inner=128,
+    )
+    model = GPT2LMHeadModel(config)
+    model.eval()
+    path = str(tmp_path / "tiny_gpt2")
+    model.save_pretrained(path)
+    return path, model
+
+
+def _save_tiny_qwen2(tmp_path):
+    import torch
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+    torch.manual_seed(0)
+    config = Qwen2Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = Qwen2ForCausalLM(config)
+    model.eval()
+    path = str(tmp_path / "tiny_qwen2")
+    model.save_pretrained(path)
+    return path, model
+
+
 def _engine_from(path, dtype="float32", page_size=8, chunk=16):
     config = load_model_config(path)
     config.dtype = dtype
@@ -92,8 +133,11 @@ def _hf_greedy(model, prompt, n):
     return out[0, len(prompt):].tolist()
 
 
-@pytest.mark.parametrize("saver", [_save_tiny_llama, _save_tiny_opt],
-                         ids=["llama", "opt"])
+@pytest.mark.parametrize(
+    "saver",
+    [_save_tiny_llama, _save_tiny_opt, _save_tiny_gpt2,
+     _save_tiny_qwen2],
+    ids=["llama", "opt", "gpt2", "qwen2"])
 def test_greedy_generation_matches_hf(tmp_path, saver):
     path, hf_model = saver(tmp_path)
     engine = _engine_from(path)
