@@ -173,6 +173,8 @@ class EngineServer:
         self.pooling = pooling
         self._embedder = None
         self._embed_lock = asyncio.Lock()
+        self.profile_dir: Optional[str] = None
+        self._profiling = False
 
     # -- decoding helpers ---------------------------------------------------
 
@@ -543,6 +545,36 @@ class EngineServer:
     async def health(self, request: web.Request):
         return web.json_response({"status": "ok"})
 
+    async def profiler_start(self, request: web.Request):
+        """Start a JAX profiler trace (view in TensorBoard/XProf).
+
+        SURVEY.md §5: the reference has no tracing subsystem; the TPU
+        engine adds profiler hooks as the aux-parity extension.
+        """
+        import jax
+        trace_dir = request.query.get(
+            "dir", self.profile_dir or "/tmp/jax-trace")
+        if self._profiling:
+            return web.json_response(
+                {"error": {"message": "profiler already running"}},
+                status=409,
+            )
+        jax.profiler.start_trace(trace_dir)
+        self._profiling = True
+        return web.json_response({"status": "started",
+                                  "dir": trace_dir})
+
+    async def profiler_stop(self, request: web.Request):
+        import jax
+        if not self._profiling:
+            return web.json_response(
+                {"error": {"message": "profiler not running"}},
+                status=409,
+            )
+        jax.profiler.stop_trace()
+        self._profiling = False
+        return web.json_response({"status": "stopped"})
+
     async def version(self, request: web.Request):
         return web.json_response({"version": __version__})
 
@@ -578,6 +610,8 @@ class EngineServer:
         app.router.add_get("/health", self.health)
         app.router.add_get("/version", self.version)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_post("/debug/profiler/start", self.profiler_start)
+        app.router.add_post("/debug/profiler/stop", self.profiler_stop)
 
         async def on_startup(app):
             self.async_engine.start(asyncio.get_event_loop())

@@ -305,6 +305,10 @@ def initialize_all(app: web.Application, args) -> None:
                              session_key=args.session_key)
     initialize_request_rewriter(args.request_rewriter)
     initialize_feature_gates(args.feature_gates)
+    from production_stack_tpu.router.tracing import (
+        initialize_span_logger,
+    )
+    initialize_span_logger(getattr(args, "request_span_log", None))
 
     app["file_storage"] = initialize_storage(
         args.file_storage_class, args.file_storage_path

@@ -70,6 +70,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--request-rewriter", default="noop", choices=["noop"]
     )
     parser.add_argument(
+        "--request-span-log", default=None,
+        help="Emit one JSON span per request to this file "
+             "('-' = router log); disabled when unset",
+    )
+    parser.add_argument(
         "--log-level", default="info",
         choices=["debug", "info", "warning", "error", "critical"],
     )

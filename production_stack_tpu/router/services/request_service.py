@@ -109,6 +109,9 @@ async def route_general_request(request: web.Request,
     request_stats = monitor.get_request_stats(time.time())
     monitor.on_request_arrival(request_id, in_router_time)
 
+    from production_stack_tpu.router.tracing import start_span
+    span = start_span(request_id, model, endpoint_path)
+
     num_prefill_tokens = _estimate_prefill_tokens(request, body)
 
     policy = get_routing_logic()
@@ -121,9 +124,17 @@ async def route_general_request(request: web.Request,
             server_url = await choice
         except Exception as e:  # admission rejected (e.g. can never fit)
             monitor.on_request_kill("<unrouted>", request_id)
+            if span is not None:
+                from production_stack_tpu.router.tracing import (
+                    get_span_logger,
+                )
+                span.finish("rejected")
+                get_span_logger().emit(span)
             return _error(429, f"Request not admitted: {e}")
     else:
         server_url = choice
+    if span is not None:
+        span.on_routed(server_url)
     queue_delay = time.time() - in_router_time
     logger.debug("Routing %s to %s (queued %.1f ms)",
                  request_id, server_url, queue_delay * 1e3)
@@ -131,7 +142,7 @@ async def route_general_request(request: web.Request,
     store_callback = _semantic_cache_store_callback(endpoint_path, payload)
     return await _proxy_stream(
         request, server_url, endpoint_path, body, request_id, policy,
-        store_callback,
+        store_callback, span=span,
     )
 
 
@@ -165,7 +176,8 @@ def _semantic_cache_store_callback(endpoint_path: str, payload: dict):
 
 async def _proxy_stream(request: web.Request, server_url: str,
                         endpoint_path: str, body: bytes, request_id: str,
-                        policy, store_callback=None) -> web.StreamResponse:
+                        policy, store_callback=None,
+                        span=None) -> web.StreamResponse:
     monitor = get_request_stats_monitor()
     session = _client_session(request.app)
     fwd_headers = {
@@ -201,6 +213,8 @@ async def _proxy_stream(request: web.Request, server_url: str,
                     is_first_token=first_chunk,
                 )
                 first_chunk = False
+                if span is not None:
+                    span.on_chunk()
                 if (cache_buffer is not None
                         and len(cache_buffer) < _CACHE_STORE_MAX_BYTES):
                     cache_buffer.extend(chunk)
@@ -222,3 +236,11 @@ async def _proxy_stream(request: web.Request, server_url: str,
         if not completed:
             monitor.on_request_kill(server_url, request_id)
         policy.on_request_complete(server_url)
+        if span is not None:
+            from production_stack_tpu.router.tracing import (
+                get_span_logger,
+            )
+            span.finish("ok" if completed else "killed")
+            sink = get_span_logger()
+            if sink is not None:
+                sink.emit(span)

@@ -1,0 +1,120 @@
+"""Structured request-span logging.
+
+The reference has no tracing subsystem; its closest artifacts are
+per-request timing logs (request.py:215-217) and the Grafana
+router-queueing-delay panel. SURVEY.md §5 calls for structured spans at
+parity — this module emits one JSON line per request covering the full
+router-side lifecycle:
+
+    {"span": "request", "request_id": ..., "model": ..., "path": ...,
+     "backend": ..., "arrival_ts": ..., "queue_delay_ms": ...,
+     "ttft_ms": ..., "latency_ms": ..., "chunks": ..., "status": ...}
+
+Enable with ``--request-span-log PATH`` ("-" = the router log). Spans
+are written by a plain file append per completed request — no buffering
+state to lose on crash, and zero overhead when disabled (the hot path
+checks one ``is None``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class RequestSpan:
+    request_id: str
+    model: str
+    path: str
+    arrival_ts: float = field(default_factory=time.time)
+    backend: Optional[str] = None
+    routed_ts: Optional[float] = None
+    first_chunk_ts: Optional[float] = None
+    end_ts: Optional[float] = None
+    chunks: int = 0
+    status: str = "ok"  # ok | killed | rejected | error
+
+    def on_routed(self, backend: str) -> None:
+        self.backend = backend
+        self.routed_ts = time.time()
+
+    def on_chunk(self) -> None:
+        if self.first_chunk_ts is None:
+            self.first_chunk_ts = time.time()
+        self.chunks += 1
+
+    def finish(self, status: str = "ok") -> None:
+        self.status = status
+        self.end_ts = time.time()
+
+    def to_json(self) -> str:
+        def ms(a, b):
+            return (None if a is None or b is None
+                    else round((b - a) * 1e3, 2))
+        return json.dumps({
+            "span": "request",
+            "request_id": self.request_id,
+            "model": self.model,
+            "path": self.path,
+            "backend": self.backend,
+            "arrival_ts": round(self.arrival_ts, 6),
+            "queue_delay_ms": ms(self.arrival_ts, self.routed_ts),
+            "ttft_ms": ms(self.arrival_ts, self.first_chunk_ts),
+            "latency_ms": ms(self.arrival_ts, self.end_ts),
+            "chunks": self.chunks,
+            "status": self.status,
+        })
+
+
+class SpanLogger:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._file = (None if path == "-"
+                      else open(path, "a", buffering=1))
+
+    def emit(self, span: RequestSpan) -> None:
+        line = span.to_json()
+        if self._file is None:
+            logger.info("%s", line)
+        else:
+            with self._lock:
+                self._file.write(line + "\n")
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+
+
+_span_logger: Optional[SpanLogger] = None
+
+
+def initialize_span_logger(path: Optional[str]) -> Optional[SpanLogger]:
+    global _span_logger
+    if _span_logger is not None:
+        _span_logger.close()
+    _span_logger = SpanLogger(path) if path else None
+    if _span_logger:
+        logger.info("Request-span logging -> %s", path)
+    return _span_logger
+
+
+def get_span_logger() -> Optional[SpanLogger]:
+    return _span_logger
+
+
+def start_span(request_id: str, model: str,
+               path: str) -> Optional[RequestSpan]:
+    """None when span logging is disabled — the hot path stays free."""
+    if _span_logger is None:
+        return None
+    return RequestSpan(request_id=request_id, model=model, path=path)

@@ -1,0 +1,140 @@
+"""Request-span tracing (SURVEY.md §5 aux-parity: structured spans for
+the router lifecycle) and the engine's JAX profiler hook."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router import tracing
+
+
+def test_span_json_fields():
+    span = tracing.RequestSpan("rid-1", "m", "/v1/chat/completions")
+    span.on_routed("http://e:8000")
+    span.on_chunk()
+    span.on_chunk()
+    span.finish("ok")
+    data = json.loads(span.to_json())
+    assert data["span"] == "request"
+    assert data["request_id"] == "rid-1"
+    assert data["backend"] == "http://e:8000"
+    assert data["chunks"] == 2
+    assert data["status"] == "ok"
+    assert data["queue_delay_ms"] is not None
+    assert data["ttft_ms"] >= 0
+    assert data["latency_ms"] >= data["ttft_ms"]
+
+
+def test_span_logger_file_sink(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tracing.initialize_span_logger(path)
+    try:
+        span = tracing.start_span("rid-2", "m", "/v1/completions")
+        assert span is not None
+        span.finish()
+        tracing.get_span_logger().emit(span)
+        lines = open(path).read().splitlines()
+        assert len(lines) == 1
+        assert json.loads(lines[0])["request_id"] == "rid-2"
+    finally:
+        tracing.initialize_span_logger(None)
+
+
+def test_span_disabled_is_free():
+    tracing.initialize_span_logger(None)
+    assert tracing.start_span("x", "m", "/p") is None
+    assert tracing.get_span_logger() is None
+
+
+def test_router_emits_spans_through_proxy(tmp_path):
+    """End-to-end: fake engine + router with span logging enabled ->
+    one span line per request with a ttft and the chosen backend."""
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import parse_args
+    from production_stack_tpu.testing.fake_engine import (
+        build_fake_engine,
+    )
+
+    path = str(tmp_path / "spans.jsonl")
+
+    async def run():
+        fake = TestServer(
+            build_fake_engine(model="m1", speed=1000, ttft=0.0))
+        await fake.start_server()
+        try:
+            args = parse_args([
+                "--service-discovery", "static",
+                "--static-backends",
+                f"http://127.0.0.1:{fake.port}",
+                "--static-models", "m1",
+                "--routing-logic", "roundrobin",
+                "--request-span-log", path,
+            ])
+            client = TestClient(TestServer(build_app(args)))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/v1/chat/completions",
+                    json={"model": "m1",
+                          "messages": [{"role": "user", "content": "x"}],
+                          "max_tokens": 4},
+                )
+                assert resp.status == 200
+                await resp.read()
+            finally:
+                await client.close()
+        finally:
+            await fake.close()
+
+    try:
+        asyncio.run(run())
+        lines = open(path).read().splitlines()
+        assert len(lines) == 1
+        data = json.loads(lines[0])
+        assert data["model"] == "m1"
+        assert data["status"] == "ok"
+        assert data["backend"].startswith("http://127.0.0.1:")
+        assert data["chunks"] >= 1
+    finally:
+        from production_stack_tpu.router.tracing import (
+            initialize_span_logger,
+        )
+        initialize_span_logger(None)
+
+
+def test_engine_profiler_endpoints(tmp_path):
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.server import EngineServer
+
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=32),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=64,
+                                  prefill_chunk_size=32),
+    )
+    server = EngineServer(LLMEngine(config), "tiny-llama")
+
+    async def run():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            trace_dir = str(tmp_path / "trace")
+            resp = await client.post(
+                f"/debug/profiler/start?dir={trace_dir}")
+            assert resp.status == 200
+            # Double-start conflicts.
+            resp = await client.post(
+                f"/debug/profiler/start?dir={trace_dir}")
+            assert resp.status == 409
+            resp = await client.post("/debug/profiler/stop")
+            assert resp.status == 200
+            resp = await client.post("/debug/profiler/stop")
+            assert resp.status == 409
+        finally:
+            await client.close()
+
+    asyncio.run(run())
