@@ -140,6 +140,9 @@ class SchedulerConfig:
     max_num_seqs: int = 8  # decode batch width (padded)
     max_model_len: int = 2048
     prefill_chunk_size: int = 512  # chunked prefill unit
+    # Distinct sequences whose next chunks batch into one prefill
+    # program (fixed row count; rows pad with the trash page).
+    prefill_batch_size: int = 4
     max_queue_len: int = 1024
 
     def max_pages_per_seq(self, page_size: int) -> int:

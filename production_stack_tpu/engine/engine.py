@@ -199,10 +199,10 @@ class LLMEngine:
         if plan.prefill is not None:
             sampled = self.runner.run_prefill(plan.prefill)
             with self._lock:
-                self.scheduler.on_prefill_executed(plan.prefill, sampled)
-                seq = plan.prefill.seq
-                if plan.prefill.is_last_chunk:
-                    outputs.append(self._delta(seq, sampled))
+                for chunk, token in zip(plan.prefill.chunks, sampled):
+                    self.scheduler.on_prefill_executed(chunk, token)
+                    if chunk.is_last_chunk:
+                        outputs.append(self._delta(chunk.seq, token))
         else:
             tokens = self.runner.run_decode(plan.decode)
             with self._lock:

@@ -79,6 +79,7 @@ class ModelRunner:
             config.cache.page_size
         )
         self.decode_width = config.scheduler.max_num_seqs
+        self.prefill_width = config.scheduler.prefill_batch_size
         self._buckets = prefill_buckets(
             config.scheduler.prefill_chunk_size
         )
@@ -175,41 +176,68 @@ class ModelRunner:
 
     # ---- prefill ----------------------------------------------------------
 
-    def run_prefill(self, plan: PrefillPlan) -> Optional[int]:
-        """Execute one prefill chunk; returns sampled token on last chunk."""
-        seq = plan.seq
-        n = len(plan.chunk_tokens)
-        t = self._bucket_for(n)
+    def run_prefill(self, plan: PrefillPlan) -> List[Optional[int]]:
+        """Execute one batched prefill step (the next chunk of up to
+        ``prefill_batch_size`` distinct sequences, rows padded to the
+        fixed width). Returns one sampled token per chunk — None for
+        rows whose prompt is not yet fully prefilled."""
+        chunks = plan.chunks
+        b = self.prefill_width
+        t = self._bucket_for(max(len(c.chunk_tokens) for c in chunks))
 
-        tokens = np.zeros((1, t), np.int32)
-        tokens[0, :n] = plan.chunk_tokens
-        positions = np.zeros((1, t), np.int32)
-        positions[0, :n] = np.arange(
-            plan.chunk_start, plan.chunk_start + n
-        )
-        valid = np.zeros((1, t), bool)
-        valid[0, :n] = True
+        tokens = np.zeros((b, t), np.int32)
+        positions = np.zeros((b, t), np.int32)
+        valid = np.zeros((b, t), bool)
+        kv_lens = np.zeros((b,), np.int32)
+        last_index = np.zeros((b,), np.int32)
+        temperature = np.ones((b,), np.float32)
+        top_p = np.ones((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
 
-        sp = seq.sampling
+        for i, chunk in enumerate(chunks):
+            n = len(chunk.chunk_tokens)
+            tokens[i, :n] = chunk.chunk_tokens
+            positions[i, :n] = np.arange(
+                chunk.chunk_start, chunk.chunk_start + n
+            )
+            valid[i, :n] = True
+            kv_lens[i] = chunk.chunk_start + n
+            last_index[i] = n - 1
+            sp = chunk.seq.sampling
+            temperature[i] = sp.temperature
+            top_p[i] = sp.top_p
+            top_k[i] = sp.top_k
+
         payload = {
             "tokens": tokens,
             "positions": positions,
             "valid": valid,
-            "page_table": self._page_table_rows([seq]),
-            "kv_lens": np.asarray([plan.chunk_start + n], np.int32),
-            "last_index": np.asarray([n - 1], np.int32),
-            "temperature": np.asarray([sp.temperature], np.float32),
-            "top_p": np.asarray([sp.top_p], np.float32),
-            "top_k": np.asarray([sp.top_k], np.int32),
+            "page_table": self._page_table_rows(
+                [c.seq for c in chunks], pad_to=b),
+            "kv_lens": kv_lens,
+            "last_index": last_index,
+            "temperature": temperature,
+            "top_p": top_p,
+            "top_k": top_k,
             "rng": np.asarray(self._next_rng()),
         }
         if self.lora_registry is not None:
-            payload["lora_ids"] = np.asarray([seq.lora_id], np.int32)
+            ids = np.zeros((b,), np.int32)
+            for i, chunk in enumerate(chunks):
+                ids[i] = chunk.seq.lora_id
+            payload["lora_ids"] = ids
 
         sampled = self._dispatch(1, t, payload)
-        if plan.is_last_chunk:
-            return int(jax.device_get(sampled)[0])
-        return None
+        host = None
+        out: List[Optional[int]] = []
+        for i, chunk in enumerate(chunks):
+            if chunk.is_last_chunk:
+                if host is None:
+                    host = jax.device_get(sampled)
+                out.append(int(host[i]))
+            else:
+                out.append(None)
+        return out
 
     # ---- decode -----------------------------------------------------------
 

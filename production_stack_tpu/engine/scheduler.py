@@ -38,11 +38,20 @@ logger = init_logger(__name__)
 
 
 @dataclass
-class PrefillPlan:
+class PrefillChunk:
     seq: Sequence
     chunk_start: int  # absolute position of first token in chunk
     chunk_tokens: List[int]
     is_last_chunk: bool
+
+
+@dataclass
+class PrefillPlan:
+    """One batched prefill step: the next chunk of up to
+    ``prefill_batch_size`` DISTINCT waiting sequences, padded to a
+    fixed row count so the compiled program shape never varies."""
+
+    chunks: List[PrefillChunk]
 
 
 @dataclass
@@ -156,11 +165,18 @@ class Scheduler:
         return StepPlan()
 
     def _plan_prefill(self) -> Optional[PrefillPlan]:
-        while self.waiting:
-            seq = self.waiting[0]
+        chunks: List[PrefillChunk] = []
+        admitting = 0  # rows that will join `running` this step
+        idx = 0
+        while (idx < len(self.waiting)
+               and len(chunks) < self.config.prefill_batch_size):
+            seq = self.waiting[idx]
             if seq.state == SequenceState.ABORTED:
-                self.waiting.popleft()
+                del self.waiting[idx]
                 continue
+            if (len(self.running) + admitting
+                    >= self.config.max_num_seqs):
+                break
             if seq.num_computed_tokens == 0 and not seq.pages:
                 # First touch: reuse cached prefix pages, then allocate
                 # the remainder for the whole prompt up front.
@@ -179,13 +195,15 @@ class Scheduler:
                     self.cache.free_sequence(seq.pages)
                     seq.pages = []
                     seq.num_computed_tokens = 0
+                    if chunks:
+                        break  # run what we already gathered
                     if not self.running:
                         # Nothing will ever free pages: permanent.
                         logger.error(
                             "Request %s can never fit in the KV cache; "
                             "aborting", seq.seq_id
                         )
-                        self.waiting.popleft()
+                        del self.waiting[idx]
                         self._finish(seq, FinishReason.ABORT)
                         self.newly_aborted.append(seq)
                         continue
@@ -196,13 +214,19 @@ class Scheduler:
             start = seq.num_computed_tokens
             end = min(start + self.config.prefill_chunk_size,
                       seq.num_prompt_tokens)
-            return PrefillPlan(
+            is_last = end == seq.num_prompt_tokens
+            chunks.append(PrefillChunk(
                 seq=seq,
                 chunk_start=start,
                 chunk_tokens=seq.prompt_token_ids[start:end],
-                is_last_chunk=(end == seq.num_prompt_tokens),
-            )
-        return None
+                is_last_chunk=is_last,
+            ))
+            if is_last:
+                admitting += 1
+            idx += 1
+        if not chunks:
+            return None
+        return PrefillPlan(chunks=chunks)
 
     def _pages_needed(self, seq: Sequence, target_tokens: int) -> int:
         have = len(seq.pages) * self.page_size
@@ -245,12 +269,13 @@ class Scheduler:
 
     # ---- completion callbacks (driven by the engine) ----------------------
 
-    def on_prefill_executed(self, plan: PrefillPlan,
+    def on_prefill_executed(self, chunk: PrefillChunk,
                             sampled_token: Optional[int]) -> None:
-        seq = plan.seq
+        seq = chunk.seq
         if seq.state in (SequenceState.ABORTED, SequenceState.FINISHED):
             return  # aborted while the chunk was in flight on device
-        seq.num_computed_tokens = plan.chunk_start + len(plan.chunk_tokens)
+        seq.num_computed_tokens = (chunk.chunk_start
+                                   + len(chunk.chunk_tokens))
         self.cache.commit_full_pages(
             seq.prompt_token_ids[:seq.num_computed_tokens],
             seq.pages, seq.num_hashed_pages,
@@ -259,7 +284,7 @@ class Scheduler:
             len(seq.pages),
             seq.num_computed_tokens // self.page_size,
         )
-        if plan.is_last_chunk:
+        if chunk.is_last_chunk:
             assert sampled_token is not None
             try:
                 self.waiting.remove(seq)

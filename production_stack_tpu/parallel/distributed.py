@@ -78,7 +78,7 @@ class MultihostStepBridge:
     def _payload_template(self, kind: int, t: int) -> Dict[str, np.ndarray]:
         r = self.runner
         if kind == KIND_PREFILL:
-            b, tt = 1, t
+            b, tt = r.prefill_width, t
         else:
             b, tt = r.decode_width, 1
         template = {
