@@ -1,0 +1,83 @@
+"""WildChat dataset preparation (parity: benchmarks/cleanup_wildchat.py).
+
+Converts WildChat parquet shards (downloaded separately — this
+environment and many clusters are egress-free, so no auto-download)
+into the ShareGPT-style JSON the load generator replays, filtering by
+token budget and round count.
+
+  python benchmarks/prepare_wildchat.py --input wildchat/*.parquet \\
+      --output wildchat_clean.json --max-tokens 4096 --min-rounds 2
+"""
+
+import argparse
+import glob
+import json
+
+try:
+    from benchmarks.prepare_sharegpt import count_tokens
+except ImportError:  # run as a plain script from benchmarks/
+    from prepare_sharegpt import count_tokens
+
+
+def conversations_from_parquet(paths):
+    import pandas as pd
+    for path in paths:
+        df = pd.read_parquet(path)
+        for conv in df["conversation"]:
+            turns = []
+            for turn in conv:
+                role = turn.get("role")
+                content = turn.get("content") or ""
+                if role not in ("user", "assistant") or not content:
+                    continue
+                turns.append({
+                    "from": "human" if role == "user" else "gpt",
+                    "value": content,
+                })
+            if turns:
+                yield {"conversations": turns}
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--input", nargs="+", required=True,
+                        help="WildChat parquet shard(s) or globs")
+    parser.add_argument("--output", required=True)
+    parser.add_argument("--max-tokens", type=int, default=4096)
+    parser.add_argument("--min-rounds", type=int, default=2)
+    parser.add_argument("--max-conversations", type=int, default=None)
+    parser.add_argument("--tokenizer", default=None,
+                        help="Local HF tokenizer path (optional)")
+    args = parser.parse_args(argv)
+
+    tokenizer = None
+    if args.tokenizer:
+        from production_stack_tpu.engine.tokenizer import HFTokenizer
+        tokenizer = HFTokenizer(args.tokenizer)
+
+    paths = []
+    for pattern in args.input:
+        paths.extend(sorted(glob.glob(pattern)) or [pattern])
+
+    kept, seen = [], 0
+    for entry in conversations_from_parquet(paths):
+        seen += 1
+        turns = entry["conversations"]
+        human_turns = [t for t in turns if t["from"] == "human"]
+        if len(human_turns) < args.min_rounds:
+            continue
+        total = sum(count_tokens(t["value"], tokenizer) for t in turns)
+        if total > args.max_tokens:
+            continue
+        kept.append(entry)
+        if (args.max_conversations
+                and len(kept) >= args.max_conversations):
+            break
+
+    with open(args.output, "w") as f:
+        json.dump(kept, f)
+    print(f"Kept {len(kept)}/{seen} conversations")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+# GKE + TPU v5e infrastructure for the TPU serving stack
+# (counterpart of reference tutorials/terraform/gke, which provisions a
+# GPU cluster; here the engine pool is a TPU pod-slice node pool and no
+# device operator is needed).
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+    helm = {
+      source  = "hashicorp/helm"
+      version = ">= 2.12"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project_id
+  region  = var.region
+}
+
+resource "google_container_cluster" "stack" {
+  name     = var.cluster_name
+  location = var.zone
+
+  # Router/observability/control-plane tier.
+  initial_node_count = 2
+  node_config {
+    machine_type = "e2-standard-8"
+  }
+
+  addons_config {
+    gcp_filestore_csi_driver_config {
+      enabled = true
+    }
+  }
+  deletion_protection = false
+}
+
+resource "google_container_node_pool" "tpu" {
+  name     = "tpu-pool"
+  cluster  = google_container_cluster.stack.name
+  location = var.zone
+
+  initial_node_count = var.tpu_node_count
+
+  autoscaling {
+    min_node_count = var.tpu_node_count
+    max_node_count = var.tpu_max_nodes
+  }
+
+  node_config {
+    machine_type = var.tpu_machine_type # e.g. ct5lp-hightpu-8t
+
+    taint {
+      key    = "google.com/tpu"
+      value  = "present"
+      effect = "NO_SCHEDULE"
+    }
+  }
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+}
+
+provider "helm" {
+  kubernetes {
+    host  = "https://${google_container_cluster.stack.endpoint}"
+    token = data.google_client_config.default.access_token
+    cluster_ca_certificate = base64decode(
+      google_container_cluster.stack.master_auth[0].cluster_ca_certificate
+    )
+  }
+}
+
+data "google_client_config" "default" {}
+
+resource "helm_release" "tpu_stack" {
+  count      = var.install_chart ? 1 : 0
+  name       = "tpu-stack"
+  chart      = "${path.module}/../../../helm"
+  depends_on = [google_container_node_pool.tpu]
+
+  values = [file(var.values_file)]
+}

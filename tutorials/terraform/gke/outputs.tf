@@ -1,0 +1,7 @@
+output "cluster_endpoint" {
+  value = google_container_cluster.stack.endpoint
+}
+
+output "kubeconfig_command" {
+  value = "gcloud container clusters get-credentials ${var.cluster_name} --zone ${var.zone} --project ${var.project_id}"
+}

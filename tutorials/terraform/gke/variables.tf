@@ -1,0 +1,51 @@
+variable "project_id" {
+  type        = string
+  description = "GCP project"
+}
+
+variable "region" {
+  type    = string
+  default = "us-central2"
+}
+
+variable "zone" {
+  type    = string
+  default = "us-central2-b"
+}
+
+variable "cluster_name" {
+  type    = string
+  default = "tpu-stack"
+}
+
+variable "tpu_machine_type" {
+  type        = string
+  default     = "ct5lp-hightpu-8t" # v5e, 8 chips/node
+  description = "TPU VM machine type for the engine pool"
+}
+
+variable "tpu_topology" {
+  type    = string
+  default = "2x4"
+}
+
+variable "tpu_node_count" {
+  type    = number
+  default = 1
+}
+
+variable "tpu_max_nodes" {
+  type        = number
+  default     = 4
+  description = "Autoscaler ceiling (match the HPA's maxReplicas)"
+}
+
+variable "install_chart" {
+  type    = bool
+  default = true
+}
+
+variable "values_file" {
+  type    = string
+  default = "../../../deployment_on_cloud/gcp/production_stack_specification.yaml"
+}
