@@ -59,15 +59,22 @@ def _bench_config(tpu: bool):
             dtype="bfloat16",
         )
         cache = CacheConfig(page_size=16, num_pages=2048)
+        # prefill_batch_size packs waiting prompts into fat prefill
+        # programs; decode_steps=8 fuses 8 decode iterations per host
+        # round-trip (out_len 64 = 8 full windows).
         sched = SchedulerConfig(max_num_seqs=8, max_model_len=1024,
-                                prefill_chunk_size=512)
+                                prefill_chunk_size=512,
+                                prefill_batch_size=4,
+                                decode_steps=8)
         n_requests, prompt_len, out_len = 24, 512, 64
     else:  # CPU fallback: tiny model, same code path
         from production_stack_tpu.engine.config import tiny_model_config
         model = tiny_model_config("llama")
         cache = CacheConfig(page_size=16, num_pages=256)
         sched = SchedulerConfig(max_num_seqs=4, max_model_len=512,
-                                prefill_chunk_size=128)
+                                prefill_chunk_size=128,
+                                prefill_batch_size=4,
+                                decode_steps=4)
         n_requests, prompt_len, out_len = 8, 128, 16
     return (EngineConfig(model=model, cache=cache, scheduler=sched),
             n_requests, prompt_len, out_len)

@@ -143,6 +143,9 @@ class SchedulerConfig:
     # Distinct sequences whose next chunks batch into one prefill
     # program (fixed row count; rows pad with the trash page).
     prefill_batch_size: int = 4
+    # Decode iterations fused into one compiled program (tokens feed
+    # back on device; 1 host round-trip per K tokens). 1 = off.
+    decode_steps: int = 1
     max_queue_len: int = 1024
 
     def max_pages_per_seq(self, page_size: int) -> int:

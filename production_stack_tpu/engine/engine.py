@@ -204,11 +204,14 @@ class LLMEngine:
                     if chunk.is_last_chunk:
                         outputs.append(self._delta(chunk.seq, token))
         else:
-            tokens = self.runner.run_decode(plan.decode)
+            token_lists = self.runner.run_decode(plan.decode)
             with self._lock:
-                self.scheduler.on_decode_executed(plan.decode, tokens)
-                for seq, tok in zip(plan.decode.seqs, tokens):
-                    outputs.append(self._delta(seq, tok))
+                for seq, toks in zip(plan.decode.seqs, token_lists):
+                    for tok in toks:
+                        if seq.state != SequenceState.RUNNING:
+                            break  # stop hit mid-window: drop the tail
+                        self.scheduler.append_decode_token(seq, tok)
+                        outputs.append(self._delta(seq, tok))
         for out in outputs:
             if out.finished:
                 self.sequences.pop(out.seq_id, None)

@@ -104,6 +104,18 @@ class ModelRunner:
             static_argnames=("sample_index_mode",),
             donate_argnums=(1, 2),  # k_cache, v_cache
         )
+        # Multi-step decode: K decode iterations fused into one
+        # compiled program via lax.scan — sampled tokens feed back on
+        # device, so the host pays one dispatch + one device_get per K
+        # tokens instead of per token (vLLM's --num-scheduler-steps
+        # analogue, but as a single XLA program instead of queued
+        # kernel launches).
+        self.decode_steps = max(1, config.scheduler.decode_steps)
+        self._decode_multi_jit = jax.jit(
+            self._decode_multi_impl,
+            static_argnames=("num_steps",),
+            donate_argnums=(1, 2),  # k_cache, v_cache
+        )
 
     @property
     def _lora_stack(self):
@@ -130,6 +142,36 @@ class ModelRunner:
         sampled = sample_tokens(row_logits, temperature, top_p, top_k, rng)
         return sampled, k_cache, v_cache
 
+    def _decode_multi_impl(self, params, k_cache, v_cache, tokens,
+                           positions, page_table, kv_lens, valid,
+                           temperature, top_p, top_k, rng, lora,
+                           lora_ids, num_steps: int):
+        """K chained decode iterations in one program.
+
+        Carry = (last tokens [B,1], positions [B,1], kv_lens [B],
+        caches); each iteration writes KV, attends, samples, and feeds
+        the sampled token into the next — no host round-trip between
+        tokens. Returns sampled tokens [K, B].
+        """
+        def body(carry, step_rng):
+            tok, pos, kv, kc, vc = carry
+            logits, kc, vc = self._forward(
+                params, self.config.model, tok, pos, page_table,
+                kv, valid, kc, vc, lora=lora, lora_ids=lora_ids,
+            )
+            sampled = sample_tokens(
+                logits[:, 0, :], temperature, top_p, top_k, step_rng
+            )
+            return ((sampled[:, None], pos + 1, kv + 1, kc, vc),
+                    sampled)
+
+        rngs = jax.random.split(rng, num_steps)
+        carry = (tokens, positions, kv_lens, k_cache, v_cache)
+        (_, _, _, k_cache, v_cache), out = jax.lax.scan(
+            body, carry, rngs
+        )
+        return out, k_cache, v_cache
+
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
@@ -142,15 +184,37 @@ class ModelRunner:
 
     # ---- payload execution (shared by host 0 and multihost workers) -------
 
-    def execute_payload(self, kind: int, payload: dict) -> jax.Array:
+    def execute_payload(self, kind: int, payload: dict,
+                        t: int = 1) -> jax.Array:
         """Run one compiled step from a numpy payload.
 
         The payload is the complete device-program input (including the
         rng key), so host 0 and multihost workers — which receive it
         over the MultihostStepBridge broadcast — dispatch bit-identical
-        programs (parallel/distributed.py).
+        programs (parallel/distributed.py). For decode (kind 2), ``t``
+        is the multi-step window; prefill uses it as the token bucket
+        (already baked into the array shapes).
         """
         lora_ids = payload.get("lora_ids")
+        lora_ids = (None if lora_ids is None
+                    else jnp.asarray(lora_ids))
+        if kind == 2 and t > 1:
+            sampled, self.k_cache, self.v_cache = \
+                self._decode_multi_jit(
+                    self.params, self.k_cache, self.v_cache,
+                    jnp.asarray(payload["tokens"]),
+                    jnp.asarray(payload["positions"]),
+                    jnp.asarray(payload["page_table"]),
+                    jnp.asarray(payload["kv_lens"]),
+                    jnp.asarray(payload["valid"]),
+                    jnp.asarray(payload["temperature"]),
+                    jnp.asarray(payload["top_p"]),
+                    jnp.asarray(payload["top_k"]),
+                    jnp.asarray(payload["rng"]),
+                    self._lora_stack, lora_ids,
+                    num_steps=t,
+                )
+            return sampled  # [K, B]
         sampled, self.k_cache, self.v_cache = self._step_jit(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(payload["tokens"]),
@@ -163,8 +227,7 @@ class ModelRunner:
             jnp.asarray(payload["top_p"]),
             jnp.asarray(payload["top_k"]),
             jnp.asarray(payload["rng"]),
-            self._lora_stack,
-            None if lora_ids is None else jnp.asarray(lora_ids),
+            self._lora_stack, lora_ids,
             sample_index_mode=("last" if kind == 1 else "first"),
         )
         return sampled
@@ -172,7 +235,7 @@ class ModelRunner:
     def _dispatch(self, kind: int, t: int, payload: dict) -> jax.Array:
         if self.bridge is not None:
             self.bridge.publish(kind, t, payload)
-        return self.execute_payload(kind, payload)
+        return self.execute_payload(kind, payload, t)
 
     # ---- prefill ----------------------------------------------------------
 
@@ -241,10 +304,37 @@ class ModelRunner:
 
     # ---- decode -----------------------------------------------------------
 
-    def run_decode(self, plan: DecodePlan) -> List[int]:
-        """One decode step over all running sequences (padded batch)."""
+    def _decode_window(self, seqs) -> int:
+        """Largest safe multi-step window: every row must be able to
+        accept K more tokens without crossing its max_tokens budget or
+        max_model_len (extra speculation would change results). Only
+        the configured K or 1 are used, keeping the compiled-program
+        set at two decode shapes."""
+        k = self.decode_steps
+        if k <= 1:
+            return 1
+        max_len = self.config.scheduler.max_model_len
+        for seq in seqs:
+            remaining = min(
+                seq.sampling.max_tokens - len(seq.output_token_ids),
+                max_len - seq.total_len,
+            )
+            if remaining < k:
+                return 1
+            if (not seq.sampling.ignore_eos
+                    and seq.sampling.stop_token_ids):
+                # Stop tokens can fire mid-window; the tail is
+                # discarded on host, which is safe but wasteful —
+                # still usually a win, so keep the window.
+                pass
+        return k
+
+    def run_decode(self, plan: DecodePlan) -> List[List[int]]:
+        """One decode dispatch over all running sequences (padded
+        batch); returns per-sequence token lists (window K >= 1)."""
         seqs = plan.seqs[: self.decode_width]
         b = self.decode_width
+        window = self._decode_window(seqs)
 
         tokens = np.zeros((b, 1), np.int32)
         positions = np.zeros((b, 1), np.int32)
@@ -284,9 +374,12 @@ class ModelRunner:
                 ids[i] = seq.lora_id
             payload["lora_ids"] = ids
 
-        sampled = self._dispatch(2, 1, payload)
+        sampled = self._dispatch(2, window, payload)
         host = jax.device_get(sampled)
-        return [int(host[i]) for i in range(len(seqs))]
+        if window == 1:
+            return [[int(host[i])] for i in range(len(seqs))]
+        return [[int(host[k, i]) for k in range(window)]
+                for i in range(len(seqs))]
 
     # ---- page-granular IO (offload tiers) ---------------------------------
 

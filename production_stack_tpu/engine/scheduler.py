@@ -235,9 +235,11 @@ class Scheduler:
         return -(-(target_tokens - have) // self.page_size)
 
     def _ensure_decode_capacity(self) -> None:
-        """Every running sequence needs a page slot for its next token."""
+        """Every running sequence needs page slots for its next decode
+        window (decode_steps tokens when multi-step decode is on)."""
+        lookahead = max(1, self.config.decode_steps)
         for seq in list(self.running):
-            needed = self._pages_needed(seq, seq.total_len + 1)
+            needed = self._pages_needed(seq, seq.total_len + lookahead)
             if needed == 0:
                 continue
             try:
@@ -295,12 +297,13 @@ class Scheduler:
             self.running.append(seq)
             self._append_token(seq, sampled_token)
 
-    def on_decode_executed(self, plan: DecodePlan,
-                           sampled_tokens: List[int]) -> None:
-        for seq, token in zip(plan.seqs, sampled_tokens):
-            if seq.state != SequenceState.RUNNING:
-                continue  # aborted mid-step
-            self._append_token(seq, token)
+    def append_decode_token(self, seq: Sequence, token: int) -> bool:
+        """Append one decoded token; returns False if the sequence is
+        no longer running (remaining window tokens are discarded)."""
+        if seq.state != SequenceState.RUNNING:
+            return False
+        self._append_token(seq, token)
+        return seq.state == SequenceState.RUNNING
 
     def _append_token(self, seq: Sequence, token: int) -> None:
         seq.output_token_ids.append(token)

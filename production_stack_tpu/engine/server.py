@@ -658,6 +658,8 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             max_num_seqs=args.max_num_seqs,
             max_model_len=args.max_model_len,
             prefill_chunk_size=args.prefill_chunk_size,
+            prefill_batch_size=args.prefill_batch_size,
+            decode_steps=args.decode_steps,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -701,6 +703,10 @@ def parse_args(argv=None):
     parser.add_argument("--max-num-seqs", type=int, default=8)
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--prefill-chunk-size", type=int, default=512)
+    parser.add_argument("--prefill-batch-size", type=int, default=4)
+    parser.add_argument("--decode-steps", type=int, default=1,
+                        help="Decode iterations fused per compiled "
+                             "program (K tokens per host round-trip)")
     parser.add_argument("--tensor-parallel-size", type=int, default=1)
     parser.add_argument("--disable-prefix-caching", action="store_true")
     parser.add_argument("--enable-lora", action="store_true",

@@ -130,4 +130,4 @@ class MultihostStepBridge:
                 self._payload_template(kind, t)
             )
             payload = {k: np.asarray(v) for k, v in payload.items()}
-            self.runner.execute_payload(kind, payload)
+            self.runner.execute_payload(kind, payload, t)

@@ -1,0 +1,72 @@
+"""Multi-step decode: K fused decode iterations must generate exactly
+what single-step decoding generates (greedy), handle stop tokens
+mid-window (tail discarded), and respect max_tokens budgets."""
+
+import numpy as np
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+
+
+def _engine(decode_steps, max_num_seqs=4):
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=128),
+        scheduler=SchedulerConfig(max_num_seqs=max_num_seqs,
+                                  max_model_len=256,
+                                  prefill_chunk_size=32,
+                                  decode_steps=decode_steps),
+    )
+    return LLMEngine(config)
+
+
+def _gen(engine, prompts, **kw):
+    sampling = dict(max_tokens=12, temperature=0.0, ignore_eos=True)
+    sampling.update(kw)
+    seqs = []
+    for p in prompts:
+        sid = engine.add_request(p, SamplingParams(**sampling))
+        seqs.append(engine.sequences[sid])
+    while engine.has_work():
+        engine.step()
+    return [s.output_token_ids for s in seqs]
+
+
+def test_multistep_matches_single_step_greedy():
+    rs = np.random.RandomState(1)
+    prompts = [[int(x) for x in rs.randint(1, 500, size=n)]
+               for n in (7, 20, 41)]
+    expected = _gen(_engine(decode_steps=1), prompts)
+    got = _gen(_engine(decode_steps=4), prompts)
+    assert got == expected
+    assert all(len(t) == 12 for t in got)
+
+
+def test_window_respects_max_tokens():
+    """max_tokens not divisible by K: the tail runs single-step and the
+    budget is met exactly."""
+    prompts = [[5, 6, 7, 8]]
+    got = _gen(_engine(decode_steps=4), prompts, max_tokens=10)
+    assert len(got[0]) == 10
+    expected = _gen(_engine(decode_steps=1), prompts, max_tokens=10)
+    assert got == expected
+
+
+def test_stop_token_mid_window_discards_tail():
+    """Pick the greedy continuation's 2nd token as a stop token: with
+    K=4 it fires mid-window and the tail must be dropped."""
+    prompts = [[9, 10, 11, 12, 13]]
+    ref = _gen(_engine(decode_steps=1), prompts, max_tokens=8)[0]
+    stop = ref[1]
+    kw = dict(max_tokens=8, ignore_eos=False, stop_token_ids=[stop])
+    got1 = _gen(_engine(decode_steps=1), prompts, **kw)[0]
+    got4 = _gen(_engine(decode_steps=4), prompts, **kw)[0]
+    assert got1 == got4
+    assert got4[-1] == stop
+    assert len(got4) == 2
