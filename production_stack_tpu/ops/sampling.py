@@ -26,34 +26,51 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
     Returns [B] int32 token ids.
     """
     b, vocab = logits.shape
-    greedy_tokens = jnp.argmax(logits, axis=-1)
+    greedy_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     safe_temp = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_temp[:, None]
 
-    # Rank of each logit within its row (0 = largest).
-    sort_idx = jnp.argsort(-scaled, axis=-1)
-    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    def masked_sample():
+        # Rank of each logit within its row (0 = largest).
+        sort_idx = jnp.argsort(-scaled, axis=-1)
+        sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
 
-    # top-k: keep ranks < k (k==0 disables).
-    ranks = jnp.arange(vocab)[None, :]
-    k = jnp.where(top_k > 0, top_k, vocab)
-    topk_mask = ranks < k[:, None]
+        # top-k: keep ranks < k (k==0 disables).
+        ranks = jnp.arange(vocab)[None, :]
+        k = jnp.where(top_k > 0, top_k, vocab)
+        topk_mask = ranks < k[:, None]
 
-    # top-p: keep the smallest prefix with cumulative prob >= top_p,
-    # always including the most likely token.
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
-    topp_mask = (cumprobs - sorted_probs) < top_p[:, None]
+        # top-p: keep the smallest prefix with cumulative prob >=
+        # top_p, always including the most likely token.
+        sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+        topp_mask = (cumprobs - sorted_probs) < top_p[:, None]
 
-    keep_sorted = topk_mask & topp_mask
-    masked_sorted = jnp.where(keep_sorted, sorted_logits, NEG_INF)
-    # Scatter the mask back to vocab order.
-    masked = jnp.zeros_like(scaled).at[
-        jnp.arange(b)[:, None], sort_idx
-    ].set(masked_sorted)
+        keep_sorted = topk_mask & topp_mask
+        masked_sorted = jnp.where(keep_sorted, sorted_logits, NEG_INF)
+        # Scatter the mask back to vocab order.
+        masked = jnp.zeros_like(scaled).at[
+            jnp.arange(b)[:, None], sort_idx
+        ].set(masked_sorted)
+        return jax.random.categorical(key, masked, axis=-1)
 
-    sampled = jax.random.categorical(key, masked, axis=-1)
+    def plain_sample():
+        # No top-k/top-p anywhere in the batch: skip the vocab sort.
+        return jax.random.categorical(key, scaled, axis=-1)
+
+    def sample_path():
+        needs_mask = jnp.any((top_k > 0) | (top_p < 1.0))
+        return jax.lax.cond(
+            needs_mask, masked_sample, plain_sample
+        ).astype(jnp.int32)
+
+    # Runtime fast path: an all-greedy batch (the common serving case
+    # at temperature 0) never executes the sort/softmax at all.
+    any_stochastic = jnp.any(temperature > 0)
+    sampled = jax.lax.cond(
+        any_stochastic, sample_path, lambda: greedy_tokens
+    )
     return jnp.where(temperature > 0, sampled, greedy_tokens).astype(
         jnp.int32
     )
