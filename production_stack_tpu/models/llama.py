@@ -31,19 +31,30 @@ def dispatch_attention(config: ModelConfig, q, k_layer, v_layer,
                        page_table, positions, kv_lens):
     """Pick the attention implementation for this step shape.
 
-    Decode (T==1) can use the Pallas paged-attention kernel; prefill
-    chunks and the CPU path use the XLA reference implementation.
+    Under the pallas impl both shapes use page-walking kernels: decode
+    (T==1) the online-softmax decode kernel, prefill chunks the
+    chunked-prefill kernel (no materialized page gather). The XLA
+    gather-based implementation is the CPU path and the ground truth.
     """
     impl = config.attention_impl
-    if q.shape[1] == 1 and impl.startswith("pallas"):
-        from production_stack_tpu.ops.paged_attention_pallas import (
-            paged_decode_attention,
+    if impl.startswith("pallas"):
+        interpret = impl == "pallas-interpret"
+        if q.shape[1] == 1:
+            from production_stack_tpu.ops.paged_attention_pallas import (
+                paged_decode_attention,
+            )
+            out = paged_decode_attention(
+                q[:, 0], k_layer, v_layer, page_table, kv_lens,
+                interpret=interpret,
+            )
+            return out[:, None]
+        from production_stack_tpu.ops.prefill_attention_pallas import (
+            paged_prefill_attention,
         )
-        out = paged_decode_attention(
-            q[:, 0], k_layer, v_layer, page_table, kv_lens,
-            interpret=(impl == "pallas-interpret"),
+        return paged_prefill_attention(
+            q, k_layer, v_layer, page_table, positions, kv_lens,
+            interpret=interpret,
         )
-        return out[:, None]
     return paged_attention(
         q, k_layer, v_layer, page_table, positions, kv_lens
     )

@@ -1,0 +1,173 @@
+"""Pallas TPU kernel: paged prefill (chunked) attention.
+
+The XLA reference path (ops/attention.py) materializes every page of a
+sequence's context as a gathered [B, S, KV, D] array per prefill chunk
+— HBM traffic proportional to the page-table width regardless of the
+real context length. This kernel walks the page list instead, exactly
+like the decode kernel (ops/paged_attention_pallas.py), with a chunk of
+T query tokens per sequence:
+
+- grid (batch, kv_head, pages); one KV page DMA'd per step via the
+  scalar-prefetched page table,
+- queries arrive flattened [G*T, D] so both matmuls stay plain 2D MXU
+  contractions (Mosaic's supported form),
+- causal masking: a [T, P] position mask (query positions are a VMEM
+  input) broadcast over the G query groups,
+- flash-style online softmax in VMEM scratch across the page walk.
+
+Contract matches ops.attention.paged_attention for any T; parity is
+tested in tests/test_pallas_attention.py (interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _prefill_kernel(page_table_ref, kv_lens_ref, q_ref, pos_ref,
+                    k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                    page_size: int, group: int, chunk: int):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    num_page_steps = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [G*T, D]
+    k = k_ref[0, 0].astype(jnp.float32)  # [P, D]
+    v = v_ref[0, 0].astype(jnp.float32)  # [P, D]
+    head_dim = q.shape[-1]
+
+    scale = 1.0 / (head_dim ** 0.5)
+    scores = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [G*T, P]
+
+    # Causal + length mask, built at [T, P] and broadcast over groups.
+    q_pos = pos_ref[0]  # [T] int32 absolute positions
+    kv_len = kv_lens_ref[b]
+    token_pos = p * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (chunk, page_size), 1
+    )  # [T, P]
+    mask_tp = (token_pos <= q_pos[:, None]) & (token_pos < kv_len)
+    mask = jnp.broadcast_to(
+        mask_tp[None], (group, chunk, page_size)
+    ).reshape(group * chunk, page_size)
+    scores = jnp.where(mask, scores, NEG_INF)
+
+    # Online softmax update.
+    m_prev = m_ref[...]  # [G*T, 1]
+    m_new = jnp.maximum(
+        m_prev, jnp.max(scores, axis=-1, keepdims=True)
+    )
+    alpha = jnp.exp(m_prev - m_new)
+    probs = jnp.exp(scores - m_new)
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(
+        probs, axis=-1, keepdims=True
+    )
+    pv = jax.lax.dot_general(
+        probs, v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [G*T, D]
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = m_new
+
+    @pl.when(p == num_page_steps - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
+                            v_cache_layer: jnp.ndarray,
+                            page_table: jnp.ndarray,
+                            q_positions: jnp.ndarray,
+                            kv_lens: jnp.ndarray,
+                            interpret: bool = False) -> jnp.ndarray:
+    """Chunked-prefill attention against a sequence's cached pages.
+
+    Args:
+      q:           [B, T, num_q_heads, head_dim] (chunk, padded)
+      k/v_cache_layer: [num_kv_heads, num_pages, page_size, head_dim]
+      page_table:  [B, max_pages] int32 physical page ids
+      q_positions: [B, T] int32 absolute positions of the queries
+      kv_lens:     [B] int32 valid cached tokens (incl. this chunk)
+      interpret:   run in interpreter mode (CPU testing)
+
+    Returns [B, T, num_q_heads, head_dim].
+    """
+    b, t, num_q_heads, head_dim = q.shape
+    num_kv_heads, _, page_size, _ = k_cache_layer.shape
+    max_pages = page_table.shape[1]
+    group = num_q_heads // num_kv_heads
+
+    # [B, T, KV, G, D] -> [B, KV, G*T, D]: rows of one kv head's
+    # queries, flattened so kernel matmuls are 2D.
+    qg = (q.reshape(b, t, num_kv_heads, group, head_dim)
+          .transpose(0, 2, 3, 1, 4)
+          .reshape(b, num_kv_heads, group * t, head_dim))
+
+    kernel = functools.partial(
+        _prefill_kernel, page_size=page_size, group=group, chunk=t,
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, kv_lens
+        grid=(b, num_kv_heads, max_pages),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, group * t, head_dim),
+                lambda bi, hi, pi, pt, kl: (bi, hi, 0, 0),
+            ),
+            # Query positions for this sequence's chunk.
+            pl.BlockSpec(
+                (1, t),
+                lambda bi, hi, pi, pt, kl: (bi, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, page_size, head_dim),
+                lambda bi, hi, pi, pt, kl: (hi, pt[bi, pi], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, page_size, head_dim),
+                lambda bi, hi, pi, pt, kl: (hi, pt[bi, pi], 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group * t, head_dim),
+            lambda bi, hi, pi, pt, kl: (bi, hi, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group * t, 1), jnp.float32),  # m
+            pltpu.VMEM((group * t, 1), jnp.float32),  # l
+            pltpu.VMEM((group * t, head_dim), jnp.float32),  # acc
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (b, num_kv_heads, group * t, head_dim), q.dtype
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table, kv_lens, qg, q_positions, k_cache_layer,
+      v_cache_layer)
+    return (out.reshape(b, num_kv_heads, group, t, head_dim)
+            .transpose(0, 3, 1, 2, 4)
+            .reshape(b, t, num_q_heads, head_dim))
