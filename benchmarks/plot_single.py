@@ -1,0 +1,44 @@
+"""Plot one benchmark run's per-request CSV (parity:
+benchmarks/plot_single.py in the reference): TTFT and latency
+distributions + tokens/s over time.
+
+  python benchmarks/plot_single.py bench.csv --output bench.png
+"""
+
+import argparse
+import csv
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("csv_path")
+    parser.add_argument("--output", default="bench_single.png")
+    args = parser.parse_args(argv)
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = list(csv.DictReader(open(args.csv_path)))
+    if not rows:
+        raise SystemExit("empty CSV")
+    ttft = [float(r["ttft"]) for r in rows if r.get("ttft")]
+    latency = [float(r["latency"]) for r in rows if r.get("latency")]
+    start = [float(r["start_time"]) for r in rows]
+    t0 = min(start)
+
+    fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+    axes[0].hist(ttft, bins=30)
+    axes[0].set_title("TTFT (s)")
+    axes[1].hist(latency, bins=30)
+    axes[1].set_title("Request latency (s)")
+    axes[2].scatter([s - t0 for s in start], ttft, s=8)
+    axes[2].set_title("TTFT over run")
+    axes[2].set_xlabel("time since start (s)")
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=120)
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()
