@@ -110,7 +110,6 @@ class ModelRunner:
         # tokens instead of per token (vLLM's --num-scheduler-steps
         # analogue, but as a single XLA program instead of queued
         # kernel launches).
-        self.decode_steps = max(1, config.scheduler.decode_steps)
         self._decode_multi_jit = jax.jit(
             self._decode_multi_impl,
             static_argnames=("num_steps",),
@@ -253,7 +252,8 @@ class ModelRunner:
         valid = np.zeros((b, t), bool)
         kv_lens = np.zeros((b,), np.int32)
         last_index = np.zeros((b,), np.int32)
-        temperature = np.ones((b,), np.float32)
+        # Pad rows stay temperature 0 (see run_decode).
+        temperature = np.zeros((b,), np.float32)
         top_p = np.ones((b,), np.float32)
         top_k = np.zeros((b,), np.int32)
 
@@ -304,43 +304,22 @@ class ModelRunner:
 
     # ---- decode -----------------------------------------------------------
 
-    def _decode_window(self, seqs) -> int:
-        """Largest safe multi-step window: every row must be able to
-        accept K more tokens without crossing its max_tokens budget or
-        max_model_len (extra speculation would change results). Only
-        the configured K or 1 are used, keeping the compiled-program
-        set at two decode shapes."""
-        k = self.decode_steps
-        if k <= 1:
-            return 1
-        max_len = self.config.scheduler.max_model_len
-        for seq in seqs:
-            remaining = min(
-                seq.sampling.max_tokens - len(seq.output_token_ids),
-                max_len - seq.total_len,
-            )
-            if remaining < k:
-                return 1
-            if (not seq.sampling.ignore_eos
-                    and seq.sampling.stop_token_ids):
-                # Stop tokens can fire mid-window; the tail is
-                # discarded on host, which is safe but wasteful —
-                # still usually a win, so keep the window.
-                pass
-        return k
-
     def run_decode(self, plan: DecodePlan) -> List[List[int]]:
         """One decode dispatch over all running sequences (padded
-        batch); returns per-sequence token lists (window K >= 1)."""
+        batch); returns per-sequence token lists (window K >= 1). The
+        window is decided by the scheduler (DecodePlan.window) so page
+        reservation and the compiled program use the same lookahead."""
         seqs = plan.seqs[: self.decode_width]
         b = self.decode_width
-        window = self._decode_window(seqs)
+        window = max(1, plan.window)
 
         tokens = np.zeros((b, 1), np.int32)
         positions = np.zeros((b, 1), np.int32)
         valid = np.zeros((b, 1), bool)
         kv_lens = np.zeros((b,), np.int32)
-        temperature = np.ones((b,), np.float32)
+        # Pad rows stay temperature 0 so an all-greedy batch keeps the
+        # sampler's sort-free fast path (ops/sampling.py).
+        temperature = np.zeros((b,), np.float32)
         top_p = np.ones((b,), np.float32)
         top_k = np.zeros((b,), np.int32)
 

@@ -57,6 +57,10 @@ class PrefillPlan:
 @dataclass
 class DecodePlan:
     seqs: List[Sequence]
+    # Multi-step window for this dispatch (1 = single step). Decided
+    # here so page-capacity reservation and the runner's compiled
+    # program agree on the same lookahead.
+    window: int = 1
 
 
 @dataclass
@@ -159,10 +163,33 @@ class Scheduler:
             want_decode = bool(self.running)
         if want_decode:
             self._last_was_prefill = False
-            self._ensure_decode_capacity()
+            window = self._decode_window()
+            self._ensure_decode_capacity(window)
             if self.running:
-                return StepPlan(decode=DecodePlan(seqs=list(self.running)))
+                # Re-check: preemption may have changed who can take a
+                # full window.
+                window = min(window, self._decode_window())
+                return StepPlan(decode=DecodePlan(
+                    seqs=list(self.running), window=window))
         return StepPlan()
+
+    def _decode_window(self) -> int:
+        """Largest safe multi-step window: every running sequence must
+        accept K more tokens without crossing its max_tokens budget or
+        max_model_len (speculating past either would change results).
+        Only the configured K or 1 are used, so the runner compiles at
+        most two decode shapes."""
+        k = max(1, self.config.decode_steps)
+        if k == 1 or not self.running:
+            return 1
+        for seq in self.running:
+            remaining = min(
+                seq.sampling.max_tokens - len(seq.output_token_ids),
+                self.config.max_model_len - seq.total_len,
+            )
+            if remaining < k:
+                return 1
+        return k
 
     def _plan_prefill(self) -> Optional[PrefillPlan]:
         chunks: List[PrefillChunk] = []
@@ -234,10 +261,9 @@ class Scheduler:
             return 0
         return -(-(target_tokens - have) // self.page_size)
 
-    def _ensure_decode_capacity(self) -> None:
+    def _ensure_decode_capacity(self, lookahead: int = 1) -> None:
         """Every running sequence needs page slots for its next decode
-        window (decode_steps tokens when multi-step decode is on)."""
-        lookahead = max(1, self.config.decode_steps)
+        window (``lookahead`` tokens when multi-step decode is on)."""
         for seq in list(self.running):
             needed = self._pages_needed(seq, seq.total_len + lookahead)
             if needed == 0:

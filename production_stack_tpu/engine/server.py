@@ -165,7 +165,8 @@ def _usage(prompt_len: int, completion_len: int) -> dict:
 
 class EngineServer:
     def __init__(self, engine: LLMEngine, served_model_name: str,
-                 pooling: str = "last"):
+                 pooling: str = "last",
+                 profile_dir: Optional[str] = None):
         self.async_engine = AsyncEngine(engine)
         self.engine = engine
         self.model_name = served_model_name
@@ -173,7 +174,7 @@ class EngineServer:
         self.pooling = pooling
         self._embedder = None
         self._embed_lock = asyncio.Lock()
-        self.profile_dir: Optional[str] = None
+        self.profile_dir = profile_dir
         self._profiling = False
 
     # -- decoding helpers ---------------------------------------------------
@@ -719,6 +720,9 @@ def parse_args(argv=None):
     parser.add_argument("--pooling", default="last",
                         choices=["last", "mean"],
                         help="/v1/embeddings pooling mode")
+    parser.add_argument("--profile-dir", default=None,
+                        help="Default output dir for "
+                             "/debug/profiler/start traces")
     # Multi-host slice serving (jax.distributed; parallel/distributed.py).
     # On GKE TPU slices the three values auto-detect — pass none of them.
     parser.add_argument("--distributed", action="store_true",
@@ -769,7 +773,8 @@ def main(argv=None) -> None:
             bridge.worker_loop()
             return
         engine.runner.bridge = bridge
-        server = EngineServer(engine, served_name, pooling=args.pooling)
+        server = EngineServer(engine, served_name, pooling=args.pooling,
+                          profile_dir=args.profile_dir)
         logger.info("tpu-engine %s (multihost coordinator) serving %s "
                     "on %s:%d", __version__, served_name, args.host,
                     args.port)
@@ -780,7 +785,8 @@ def main(argv=None) -> None:
             bridge.shutdown()
         return
     engine, served_name = build_engine_from_args(args)
-    server = EngineServer(engine, served_name, pooling=args.pooling)
+    server = EngineServer(engine, served_name, pooling=args.pooling,
+                          profile_dir=args.profile_dir)
     logger.info("tpu-engine %s serving %s on %s:%d",
                 __version__, served_name, args.host, args.port)
     web.run_app(server.build_app(), host=args.host, port=args.port,

@@ -129,7 +129,9 @@ async def route_general_request(request: web.Request,
                     get_span_logger,
                 )
                 span.finish("rejected")
-                get_span_logger().emit(span)
+                sink = get_span_logger()
+                if sink is not None:
+                    sink.emit(span)
             return _error(429, f"Request not admitted: {e}")
     else:
         server_url = choice
