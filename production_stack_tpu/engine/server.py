@@ -723,6 +723,10 @@ def parse_args(argv=None):
     parser.add_argument("--profile-dir", default=None,
                         help="Default output dir for "
                              "/debug/profiler/start traces")
+    parser.add_argument("--compilation-cache-dir", default=None,
+                        help="Persistent XLA compilation cache (point "
+                             "at the PVC so pod restarts skip "
+                             "recompilation)")
     # Multi-host slice serving (jax.distributed; parallel/distributed.py).
     # On GKE TPU slices the three values auto-detect — pass none of them.
     parser.add_argument("--distributed", action="store_true",
@@ -753,6 +757,15 @@ def main(argv=None) -> None:
         except Exception:
             pass
     args = parse_args(argv)
+    if args.compilation_cache_dir:
+        # Persistent executable cache: a restarted pod (weight PVC +
+        # this cache) resumes serving without the cold-compile wait —
+        # the serving-side resume story (SURVEY.md §5).
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          args.compilation_cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
     if args.distributed:
         from production_stack_tpu.parallel.distributed import (
             MultihostStepBridge,
