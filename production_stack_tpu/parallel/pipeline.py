@@ -1,0 +1,184 @@
+"""Pipeline parallelism: layer stages over a ``pp`` mesh axis.
+
+The reference exposes no pipeline parallelism (SURVEY.md §2.6 — vLLM
+TP only); this is the TPU-native extension for models deeper than one
+slice's HBM: the layer-stacked parameters shard their leading L axis
+across pp stages, and a GPipe-style microbatch schedule streams
+activations stage-to-stage with ``ppermute`` hops over ICI/DCN.
+
+Idiomatic-JAX shape: one ``shard_map`` block; inside it each stage
+scans a static tick loop of length M + S - 1 (M microbatches, S
+stages). At tick t, stage s processes microbatch t - s: stage 0 embeds
+a fresh microbatch, inner stages run their local layer block on the
+activation received last tick, the last stage collects final hidden
+states. All stages execute every tick (bubble ticks compute on zeros —
+the XLA-friendly trade for a static schedule).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.models.llama import rms_norm
+from production_stack_tpu.ops.rope import apply_rope
+
+Params = Dict[str, jnp.ndarray]
+
+
+def _layer_block(x, lp, config: ModelConfig, positions):
+    """Apply one stage's stack of dense causal layers (same numerics
+    as models.llama.encode's layer_step)."""
+    nh, nkv, d = (config.num_attention_heads,
+                  config.num_key_value_heads, config.head_dim)
+    b, t, _ = x.shape
+    causal = jnp.tril(jnp.ones((t, t), bool))
+
+    def step(x, lp_i):
+        a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
+        q = a_in @ lp_i["wq"]
+        k = a_in @ lp_i["wk"]
+        v = a_in @ lp_i["wv"]
+        if config.attention_bias:
+            q, k, v = (q + lp_i["bq"], k + lp_i["bk"], v + lp_i["bv"])
+        q = apply_rope(q.reshape(b, t, nh, d), positions,
+                       config.rope_theta)
+        k = apply_rope(k.reshape(b, t, nkv, d), positions,
+                       config.rope_theta)
+        v = v.reshape(b, t, nkv, d)
+        group = nh // nkv
+        qg = q.reshape(b, t, nkv, group, d)
+        scores = jnp.einsum(
+            "btkgd,bskd->bkgts", qg.astype(jnp.float32),
+            k.astype(jnp.float32),
+        ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        scores = jnp.where(causal[None, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "bkgts,bskd->btkgd", probs, v.astype(jnp.float32)
+        ).reshape(b, t, nh * d).astype(x.dtype)
+        x = x + attn @ lp_i["wo"]
+        m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
+        x = x + (jax.nn.silu(m_in @ lp_i["w_gate"])
+                 * (m_in @ lp_i["w_up"])) @ lp_i["w_down"]
+        return x, None
+
+    x, _ = jax.lax.scan(step, x, lp)
+    return x
+
+
+def _layer_param_names(config: ModelConfig):
+    names = ["attn_norm", "wq", "wk", "wv", "wo",
+             "mlp_norm", "w_gate", "w_up", "w_down"]
+    if config.attention_bias:
+        names += ["bq", "bk", "bv"]
+    return names
+
+
+def pipeline_forward(params: Params, config: ModelConfig,
+                     tokens: jnp.ndarray, mesh: Mesh,
+                     pp_axis: str = "pp",
+                     num_microbatches: Optional[int] = None
+                     ) -> jnp.ndarray:
+    """Dense causal forward with layers pipelined over ``pp_axis``.
+
+    Args:
+      params: llama-family stacked params (models/llama.py layout);
+        layer count must divide by the pp-axis size.
+      tokens: [B, T]; B must divide by num_microbatches.
+      num_microbatches: defaults to the pp-axis size.
+
+    Returns logits [B, T, vocab] (replicated).
+    """
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    S = axes[pp_axis]
+    M = num_microbatches or S
+    b, t = tokens.shape
+    if b % M:
+        raise ValueError(f"batch {b} must divide by microbatches {M}")
+    L = config.num_hidden_layers
+    if L % S:
+        raise ValueError(f"layers {L} must divide by pp size {S}")
+    mb = b // M
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (mb, t))
+
+    layer_names = _layer_param_names(config)
+    layer_params = {k: params[k] for k in layer_names}
+    shared = {k: v for k, v in params.items() if k not in layer_names}
+
+    layer_specs = {k: P(pp_axis) for k in layer_params}
+    none_spec = P(*([None] * 0))
+
+    def stage_fn(layer_local, shared_p, tokens_all):
+        stage = jax.lax.axis_index(pp_axis)
+        ticks = M + S - 1
+        # Microbatch views: [M, mb, T]
+        mbs = tokens_all.reshape(M, mb, t)
+        h = config.hidden_size
+
+        def tick(carry, t_idx):
+            recv, collected = carry
+            # Stage 0 feeds microbatch t_idx (clamped; bubble ticks
+            # re-embed a stale microbatch and are ignored downstream).
+            m_idx = jnp.clip(t_idx, 0, M - 1)
+            embedded = shared_p["embed"][mbs[m_idx]]
+            x = jnp.where(stage == 0, embedded.astype(recv.dtype),
+                          recv)
+            x = _layer_block(x, layer_local, config, positions)
+            # Shift activations to the next stage; the last stage's
+            # output wraps to stage 0 where it is ignored.
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            sent = jax.lax.ppermute(x, pp_axis, perm)
+            # Last stage collects microbatch t_idx - (S - 1).
+            out_idx = jnp.clip(t_idx - (S - 1), 0, M - 1)
+            take = (stage == S - 1) & (t_idx >= S - 1)
+            collected = jnp.where(
+                take,
+                collected.at[out_idx].set(x),
+                collected,
+            )
+            return (sent, collected), None
+
+        init = (
+            jnp.zeros((mb, t, h), shared_p["embed"].dtype),
+            jnp.zeros((M, mb, t, h), shared_p["embed"].dtype),
+        )
+        (_, collected), _ = jax.lax.scan(
+            tick, init, jnp.arange(ticks)
+        )
+        # Only the last stage holds real data; sum-broadcast it.
+        collected = jnp.where(stage == S - 1, collected, 0.0)
+        collected = jax.lax.psum(collected, pp_axis)
+        x = rms_norm(collected.reshape(b, t, h), shared_p["final_norm"],
+                     config.rms_norm_eps)
+        head = shared_p.get("lm_head")
+        if head is None:
+            head = shared_p["embed"].T
+        return (x @ head).astype(jnp.float32)
+
+    fn = jax.shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(layer_specs, {k: none_spec for k in shared},
+                  none_spec),
+        out_specs=none_spec,
+        check_vma=False,
+    )
+    return fn(layer_params, shared, tokens)
+
+
+def shard_params_pipeline(params: Params, config: ModelConfig,
+                          mesh: Mesh, pp_axis: str = "pp") -> Params:
+    """Place layer-stacked params with their L axis sharded across the
+    pp stages (everything else replicated)."""
+    from jax.sharding import NamedSharding
+    layer_names = set(_layer_param_names(config))
+    out = {}
+    for k, v in params.items():
+        spec = (P(pp_axis) if k in layer_names else P())
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
