@@ -44,6 +44,9 @@ class ModelConfig:
     activation: str = "silu"  # silu (llama) | relu (opt) | gelu (gpt2)
     # Qwen2-style q/k/v projection biases on the llama-family body.
     attention_bias: bool = False
+    # Mixtral-style sparse MoE (architecture == "mixtral").
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 2
     # Decode attention implementation:
     #   auto            -> pallas on TPU, xla elsewhere (resolved by the
     #                      model runner at init)
@@ -77,6 +80,29 @@ class ModelConfig:
                 max_position_embeddings=hf["n_positions"],
                 tie_word_embeddings=True,
                 activation="gelu",
+                dtype="bfloat16",
+            )
+        if "mixtral" in arch:
+            return cls(
+                name=name or hf.get("_name_or_path", "mixtral"),
+                architecture="mixtral",
+                vocab_size=hf["vocab_size"],
+                hidden_size=hf["hidden_size"],
+                intermediate_size=hf["intermediate_size"],
+                num_hidden_layers=hf["num_hidden_layers"],
+                num_attention_heads=hf["num_attention_heads"],
+                num_key_value_heads=hf.get(
+                    "num_key_value_heads", hf["num_attention_heads"]),
+                head_dim=hf.get("head_dim"),
+                max_position_embeddings=hf.get(
+                    "max_position_embeddings", 4096),
+                rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+                rope_theta=hf.get("rope_theta", 1e6),
+                tie_word_embeddings=hf.get("tie_word_embeddings",
+                                           False),
+                num_local_experts=hf.get("num_local_experts", 8),
+                num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+                activation="silu",
                 dtype="bfloat16",
             )
         if "opt" in arch:

@@ -42,6 +42,10 @@ _TARGET_MAP = {
         "q_proj": "wq", "k_proj": "wk", "v_proj": "wv",
         "out_proj": "wo", "fc1": "fc1", "fc2": "fc2",
     },
+    "mixtral": {
+        "q_proj": "wq", "k_proj": "wk", "v_proj": "wv",
+        "o_proj": "wo",
+    },
 }
 
 
@@ -55,6 +59,12 @@ def target_shapes(config: ModelConfig) -> Dict[str, Tuple[int, int]]:
         return {
             "wq": (h, nh * d), "wk": (h, nh * d), "wv": (h, nh * d),
             "wo": (nh * d, h), "fc1": (h, ffn), "fc2": (ffn, h),
+        }
+    if config.architecture == "mixtral":
+        # Expert weights are not LoRA targets; attention only.
+        return {
+            "wq": (h, nh * d), "wk": (h, nkv * d), "wv": (h, nkv * d),
+            "wo": (nh * d, h),
         }
     return {
         "wq": (h, nh * d), "wk": (h, nkv * d), "wv": (h, nkv * d),

@@ -189,10 +189,62 @@ def load_opt_weights(model_dir: str, config: ModelConfig,
     }
 
 
+def load_mixtral_weights(model_dir: str, config: ModelConfig,
+                         dtype=None) -> Dict[str, jnp.ndarray]:
+    """HF Mixtral: llama-style attention + per-expert SwiGLU weights
+    (block_sparse_moe.experts.{e}.w1/w3/w2 = gate/up/down, all [out,
+    in]) stacked to [L, E, in, out]."""
+    raw = _load_raw_tensors(model_dir)
+    raw = {k.removeprefix("model."): v for k, v in raw.items()}
+    L = config.num_hidden_layers
+    E = config.num_local_experts
+    dtype = dtype or config.jax_dtype
+
+    def lt(template, transpose=True):
+        return jnp.asarray(
+            _stack(raw, template, L, transpose=transpose), dtype
+        )
+
+    def experts(which):  # w1 | w2 | w3
+        per_layer = []
+        for i in range(L):
+            per_expert = [
+                raw[f"layers.{i}.block_sparse_moe.experts.{e}"
+                    f".{which}.weight"].T
+                for e in range(E)
+            ]
+            per_layer.append(np.stack(per_expert))
+        return jnp.asarray(np.stack(per_layer), dtype)  # [L,E,in,out]
+
+    params = {
+        "embed": jnp.asarray(raw["embed_tokens.weight"], dtype),
+        "final_norm": jnp.asarray(raw["norm.weight"], dtype),
+        "attn_norm": lt("layers.{}.input_layernorm.weight", False),
+        "wq": lt("layers.{}.self_attn.q_proj.weight"),
+        "wk": lt("layers.{}.self_attn.k_proj.weight"),
+        "wv": lt("layers.{}.self_attn.v_proj.weight"),
+        "wo": lt("layers.{}.self_attn.o_proj.weight"),
+        "mlp_norm": lt("layers.{}.post_attention_layernorm.weight",
+                       False),
+        "moe_gate": lt("layers.{}.block_sparse_moe.gate.weight"),
+        "w_gate": experts("w1"),
+        "w_up": experts("w3"),
+        "w_down": experts("w2"),
+    }
+    head = raw.get("lm_head.weight")
+    if head is None:
+        config.tie_word_embeddings = True
+    else:
+        params["lm_head"] = jnp.asarray(head.T, dtype)
+    return params
+
+
 def load_weights(model_dir: str, config: ModelConfig,
                  dtype=None) -> Dict[str, jnp.ndarray]:
     if config.architecture == "opt":
         return load_opt_weights(model_dir, config, dtype)
     if config.architecture == "gpt2":
         return load_gpt2_weights(model_dir, config, dtype)
+    if config.architecture == "mixtral":
+        return load_mixtral_weights(model_dir, config, dtype)
     return load_llama_weights(model_dir, config, dtype)

@@ -17,8 +17,11 @@ def get_model(config: ModelConfig) -> Tuple[Callable, Callable]:
     if arch == "gpt2":
         from production_stack_tpu.models import gpt2
         return gpt2.init_params, gpt2.forward
+    if arch == "mixtral":
+        from production_stack_tpu.models import mixtral
+        return mixtral.init_params, mixtral.forward
     raise ValueError(f"Unknown architecture: {arch}")
 
 
 def list_architectures():
-    return ["llama", "mistral", "qwen2", "opt", "gpt2"]
+    return ["llama", "mistral", "qwen2", "opt", "gpt2", "mixtral"]

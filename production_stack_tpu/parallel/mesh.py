@@ -71,9 +71,32 @@ _OPT_SPECS: Dict[str, P] = {
 }
 
 
+# Mixtral: attention shards like llama; the EXPERT axis of the MoE
+# weights shards over 'tp' — expert parallelism (each device computes
+# its local experts; GSPMD inserts the combine psum). The router gate
+# is replicated.
+_MIXTRAL_SPECS: Dict[str, P] = {
+    "embed": P(None, None),
+    "final_norm": P(None),
+    "attn_norm": P(None, None),
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),
+    "mlp_norm": P(None, None),
+    "moe_gate": P(None, None, None),
+    "w_gate": P(None, "tp", None, None),
+    "w_up": P(None, "tp", None, None),
+    "w_down": P(None, "tp", None, None),
+    "lm_head": P(None, "tp"),
+}
+
+
 def param_specs(config: ModelConfig) -> Dict[str, P]:
     if config.architecture in ("opt", "gpt2"):
         return dict(_OPT_SPECS)
+    if config.architecture == "mixtral":
+        return dict(_MIXTRAL_SPECS)
     return dict(_LLAMA_SPECS)
 
 

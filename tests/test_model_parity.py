@@ -108,6 +108,30 @@ def _save_tiny_qwen2(tmp_path):
     return path, model
 
 
+def _save_tiny_mixtral(tmp_path):
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+    torch.manual_seed(0)
+    config = MixtralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = MixtralForCausalLM(config)
+    model.eval()
+    path = str(tmp_path / "tiny_mixtral")
+    model.save_pretrained(path)
+    return path, model
+
+
 def _engine_from(path, dtype="float32", page_size=8, chunk=16):
     config = load_model_config(path)
     config.dtype = dtype
@@ -136,8 +160,8 @@ def _hf_greedy(model, prompt, n):
 @pytest.mark.parametrize(
     "saver",
     [_save_tiny_llama, _save_tiny_opt, _save_tiny_gpt2,
-     _save_tiny_qwen2],
-    ids=["llama", "opt", "gpt2", "qwen2"])
+     _save_tiny_qwen2, _save_tiny_mixtral],
+    ids=["llama", "opt", "gpt2", "qwen2", "mixtral"])
 def test_greedy_generation_matches_hf(tmp_path, saver):
     path, hf_model = saver(tmp_path)
     engine = _engine_from(path)
@@ -145,6 +169,32 @@ def test_greedy_generation_matches_hf(tmp_path, saver):
     expected = _hf_greedy(hf_model, prompt, 12)
     seq = engine.generate(prompt, SamplingParams(
         max_tokens=12, temperature=0.0, ignore_eos=True
+    ))
+    assert seq.output_token_ids == expected
+
+
+def test_mixtral_expert_parallel_matches_single_device(tmp_path):
+    """Expert-parallel sharding (expert axis over 'tp') must not
+    change generation."""
+    import jax
+    from production_stack_tpu.parallel.mesh import build_mesh
+    path, hf_model = _save_tiny_mixtral(tmp_path)
+    prompt = [3, 11, 25, 99, 7, 42, 58, 13]
+    expected = _hf_greedy(hf_model, prompt, 8)
+
+    config = load_model_config(path)
+    config.dtype = "float32"
+    engine_config = EngineConfig(
+        model=config,
+        cache=CacheConfig(page_size=8, num_pages=128),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=256,
+                                  prefill_chunk_size=16),
+    )
+    mesh = build_mesh(tensor_parallel_size=2)  # shards E=4 experts 2-way
+    params = load_weights(path, config)
+    engine = LLMEngine(engine_config, mesh=mesh, params=params)
+    seq = engine.generate(prompt, SamplingParams(
+        max_tokens=8, temperature=0.0, ignore_eos=True
     ))
     assert seq.output_token_ids == expected
 
