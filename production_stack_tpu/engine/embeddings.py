@@ -38,7 +38,7 @@ class Embedder:
             raise ValueError(
                 f"pooling must be one of {_POOLING_MODES}, got {pooling!r}"
             )
-        if config.architecture != "llama":
+        if config.architecture not in ("llama", "mistral", "qwen2"):
             raise NotImplementedError(
                 "embeddings are implemented for the llama family "
                 f"(got architecture={config.architecture!r})"
