@@ -54,6 +54,8 @@ class LLMEngine:
         self.runner = ModelRunner(config, mesh=mesh, params=params)
         self.sequences: Dict[str, Sequence] = {}
         self._lock = threading.Lock()
+        from production_stack_tpu.engine.metrics import EngineMetrics
+        self.metrics = EngineMetrics()
         self.offload = None
         if config.offload.enable:
             self._init_offload()
@@ -178,6 +180,7 @@ class LLMEngine:
             seq = self.sequences.pop(seq_id, None)
             if seq is not None:
                 self.scheduler.abort_sequence(seq)
+                self.metrics.on_finished(seq)
 
     def has_work(self) -> bool:
         return self.scheduler.has_work()
@@ -214,7 +217,9 @@ class LLMEngine:
                         outputs.append(self._delta(seq, tok))
         for out in outputs:
             if out.finished:
-                self.sequences.pop(out.seq_id, None)
+                seq = self.sequences.pop(out.seq_id, None)
+                if seq is not None:
+                    self.metrics.on_finished(seq)
         return outputs
 
     def _delta(self, seq: Sequence, token: Optional[int]) -> StepOutput:

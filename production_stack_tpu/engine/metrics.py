@@ -1,0 +1,107 @@
+"""Engine-side Prometheus exposition: vLLM-compatible histograms.
+
+Real vLLM engines export request-latency histograms alongside the four
+gauges our router scrapes (reference engine_stats.py:46-55 reads the
+gauges; cluster Prometheus reads everything). This accumulator gives
+the TPU engine the same surface: TTFT, inter-token latency and e2e
+latency histograms plus token counters, rendered in Prometheus text
+format by engine/server.py:/metrics.
+
+Dependency-free (no prometheus_client in the engine hot path): fixed
+buckets, plain counters, one lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Sequence
+
+
+class Histogram:
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = list(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +inf tail
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, value: float) -> None:
+        self.total += value
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str) -> List[str]:
+        lines = [f"# TYPE {name} histogram"]
+        cumulative = 0
+        for b, c in zip(self.buckets, self.counts):
+            cumulative += c
+            lines.append(f'{name}_bucket{{le="{b}"}} {cumulative}')
+        cumulative += self.counts[-1]
+        lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
+        lines.append(f"{name}_sum {self.total}")
+        lines.append(f"{name}_count {self.n}")
+        return lines
+
+
+_TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1,
+                 0.25, 0.5, 0.75, 1.0, 2.5, 5.0, 7.5, 10.0)
+_ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.0075, 0.01, 0.025, 0.05,
+                0.075, 0.1, 0.2, 0.5, 1.0)
+_E2E_BUCKETS = (0.3, 0.5, 0.8, 1.0, 1.5, 2.0, 2.5, 5.0, 10.0, 15.0,
+                30.0, 60.0)
+
+
+class EngineMetrics:
+    """Request-lifecycle aggregates, updated on sequence completion."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ttft = Histogram(_TTFT_BUCKETS)
+        self.itl = Histogram(_ITL_BUCKETS)
+        self.e2e = Histogram(_E2E_BUCKETS)
+        self.prompt_tokens_total = 0
+        self.generation_tokens_total = 0
+        self.requests_total: Dict[str, int] = {}
+
+    def on_finished(self, seq) -> None:
+        with self._lock:
+            self.prompt_tokens_total += seq.num_prompt_tokens
+            n_out = len(seq.output_token_ids)
+            self.generation_tokens_total += n_out
+            reason = (seq.finish_reason.value if seq.finish_reason
+                      else "unknown")
+            self.requests_total[reason] = (
+                self.requests_total.get(reason, 0) + 1)
+            if seq.first_token_time is not None:
+                self.ttft.observe(
+                    seq.first_token_time - seq.arrival_time)
+                if seq.finish_time is not None and n_out > 1:
+                    self.itl.observe(
+                        (seq.finish_time - seq.first_token_time)
+                        / (n_out - 1))
+            if seq.finish_time is not None:
+                self.e2e.observe(seq.finish_time - seq.arrival_time)
+
+    def render(self) -> List[str]:
+        with self._lock:
+            lines = self.ttft.render("vllm:time_to_first_token_seconds")
+            lines += self.itl.render(
+                "vllm:time_per_output_token_seconds")
+            lines += self.e2e.render(
+                "vllm:e2e_request_latency_seconds")
+            lines += [
+                "# TYPE vllm:prompt_tokens_total counter",
+                f"vllm:prompt_tokens_total {self.prompt_tokens_total}",
+                "# TYPE vllm:generation_tokens_total counter",
+                ("vllm:generation_tokens_total "
+                 f"{self.generation_tokens_total}"),
+            ]
+            lines.append("# TYPE vllm:request_success_total counter")
+            for reason, count in sorted(self.requests_total.items()):
+                lines.append(
+                    'vllm:request_success_total'
+                    f'{{finished_reason="{reason}"}} {count}')
+            return lines

@@ -594,6 +594,8 @@ class EngineServer:
         ):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {float(value)}")
+        # vLLM-parity request-latency histograms + token counters.
+        lines.extend(self.engine.metrics.render())
         lines.append("")
         return web.Response(text="\n".join(lines),
                             content_type="text/plain")

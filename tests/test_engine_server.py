@@ -185,3 +185,24 @@ def test_null_sampling_params_use_openai_defaults():
     assert sp.top_p == 1.0
     sp = _sampling_from_body({"temperature": 0, "max_tokens": 4}, 256)
     assert sp.temperature == 0.0
+
+
+def test_engine_latency_histograms_after_traffic():
+    """/metrics exposes vLLM-parity TTFT/ITL/e2e histograms and token
+    counters once requests have completed."""
+    async def run(client):
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 6,
+        })
+        assert resp.status == 200
+        await resp.json()
+        text = await (await client.get("/metrics")).text()
+        assert 'vllm:time_to_first_token_seconds_count 1' in text
+        assert 'vllm:e2e_request_latency_seconds_count 1' in text
+        assert 'vllm:time_per_output_token_seconds_bucket' in text
+        assert 'vllm:generation_tokens_total 6' in text
+        assert 'vllm:request_success_total{finished_reason="length"} 1' \
+            in text
+    asyncio.run(_with_client(run))
