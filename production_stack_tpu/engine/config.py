@@ -47,6 +47,8 @@ class ModelConfig:
     # Mixtral-style sparse MoE (architecture == "mixtral").
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
+    # Weight-only quantization: none | int8 (engine/quantization.py).
+    quantization: str = "none"
     # Decode attention implementation:
     #   auto            -> pallas on TPU, xla elsewhere (resolved by the
     #                      model runner at init)

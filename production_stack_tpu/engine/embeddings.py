@@ -43,6 +43,14 @@ class Embedder:
                 "embeddings are implemented for the llama family "
                 f"(got architecture={config.architecture!r})"
             )
+        from production_stack_tpu.engine.quantization import (
+            has_quantized_leaves,
+        )
+        if has_quantized_leaves(params):
+            raise NotImplementedError(
+                "embeddings/score/rerank need unquantized weights "
+                "(weight-only int8 is serving-path only)"
+            )
         from production_stack_tpu.models import llama
         self.config = config
         self.params = params

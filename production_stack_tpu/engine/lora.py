@@ -135,17 +135,24 @@ def install_adapter(stack: Dict, slot: int,
     return {"a": a, "b": b, "scaling": scaling}
 
 
-def lora_matmul(x: jnp.ndarray, base_w: jnp.ndarray,
-                lora_layer: Optional[Dict], target: str,
-                lora_ids: Optional[jnp.ndarray],
+def lora_matmul(x: jnp.ndarray, base_w, lora_layer: Optional[Dict],
+                target: str, lora_ids: Optional[jnp.ndarray],
                 scale: Optional[jnp.ndarray]) -> jnp.ndarray:
     """``x @ W + scale_b * (x @ A[id_b]) @ B[id_b]`` per batch row.
 
-    Inside ``lax.scan`` the stacks arrive with the layer axis already
-    sliced off: ``lora_layer['a'][target]`` is [S, in, r]. The gather
-    over ``lora_ids`` keeps shapes static for any adapter mix.
+    ``base_w`` is either a dense matrix or an int8 (weight, scale)
+    pair (engine/quantization.py). Inside ``lax.scan`` the stacks
+    arrive with the layer axis already sliced off:
+    ``lora_layer['a'][target]`` is [S, in, r]. The gather over
+    ``lora_ids`` keeps shapes static for any adapter mix.
     """
-    out = x @ base_w
+    if isinstance(base_w, tuple):
+        from production_stack_tpu.engine.quantization import (
+            dequant_matmul,
+        )
+        out = dequant_matmul(x, base_w)
+    else:
+        out = x @ base_w
     if lora_layer is None:
         return out
     a_sel = lora_layer["a"][target][lora_ids]  # [B, in, r]

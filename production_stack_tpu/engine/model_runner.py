@@ -59,6 +59,13 @@ class ModelRunner:
             params = self._init_fn(
                 model_config, jax.random.PRNGKey(config.seed)
             )
+        if model_config.quantization == "int8":
+            from production_stack_tpu.engine.quantization import (
+                quantize_params,
+            )
+            logger.info("Quantizing projection weights to int8 "
+                        "(weight-only)")
+            params = quantize_params(params, model_config)
         self.params = shard_params(params, model_config, mesh)
 
         # Head-major paged cache: [L, kv_heads, pages, page_size, d].

@@ -1,0 +1,76 @@
+"""Weight-only int8 quantization for serving.
+
+Decode on TPU is HBM-bandwidth-bound on weight streaming; storing the
+projection matrices as int8 with per-output-channel scales halves that
+traffic (the weight-only-quantization recipe vLLM exposes via
+--quantization; here it is a load-time transform, no calibration data
+needed for symmetric weight-only).
+
+Representation: a quantized weight is the pytree pair
+``(w_int8 [L, in, out], scale [L, out] f32)``; the matmul helper
+(engine/lora.py lora_matmul) computes ``(x @ w_int8) * scale`` — XLA
+fuses the int8->bf16 convert and the scale into the dot's epilogue, so
+only int8 bytes ever cross HBM. Activations stay bf16; the MXU result
+is rescaled per channel.
+
+Serving-path only: the dense encode/training forwards use the
+unquantized layout (the Embedder refuses quantized params).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.engine.config import ModelConfig
+
+QuantizedWeight = Tuple[jnp.ndarray, jnp.ndarray]
+
+# Projection params quantized per architecture (layer-stacked rank-3
+# [L, in, out]). Norms, embeddings and biases stay in full precision.
+_TARGETS = {
+    "llama": ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"),
+    "mistral": ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"),
+    "qwen2": ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"),
+    "opt": ("wq", "wk", "wv", "wo", "fc1", "fc2"),
+    "gpt2": ("wq", "wk", "wv", "wo", "fc1", "fc2"),
+}
+
+
+def quantize_weight(w: jnp.ndarray) -> QuantizedWeight:
+    """Symmetric per-output-channel int8 over the contraction dim."""
+    w32 = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale.squeeze(-2)  # [L, in, out] -> scale [L, out]
+
+
+def dequant_matmul(x: jnp.ndarray, qw: QuantizedWeight) -> jnp.ndarray:
+    q, scale = qw
+    out = x @ q.astype(x.dtype)
+    return out * scale.astype(x.dtype)
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, tuple) and len(w) == 2
+
+
+def quantize_params(params: Dict, config: ModelConfig) -> Dict:
+    targets = _TARGETS.get(config.architecture)
+    if targets is None:
+        raise NotImplementedError(
+            f"--quantization int8 is not supported for "
+            f"architecture {config.architecture!r}"
+        )
+    out = dict(params)
+    for name in targets:
+        if name in out:
+            out[name] = quantize_weight(out[name])
+    return out
+
+
+def has_quantized_leaves(params: Dict) -> bool:
+    return any(is_quantized(v) for v in params.values())

@@ -645,6 +645,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
                   else load_weights(args.model, model_config))
         tokenizer = get_tokenizer(args.tokenizer or args.model)
         served_name = args.served_model_name or args.model
+    model_config.quantization = args.quantization
 
     if args.tensor_parallel_size > 1:
         from production_stack_tpu.parallel.mesh import build_mesh
@@ -699,6 +700,10 @@ def parse_args(argv=None):
     parser.add_argument("--random-weights", action="store_true")
     parser.add_argument("--dtype", default=None,
                         choices=[None, "bfloat16", "float32", "float16"])
+    parser.add_argument("--quantization", default="none",
+                        choices=["none", "int8"],
+                        help="Weight-only quantization (halves weight "
+                             "HBM traffic on the decode path)")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--page-size", type=int, default=16)

@@ -105,12 +105,23 @@ def shard_params(params: Dict[str, jax.Array], config: ModelConfig,
     if mesh is None:
         return params
     specs = param_specs(config)
-    return {
-        name: jax.device_put(
-            value, NamedSharding(mesh, specs.get(name, P()))
-        )
-        for name, value in params.items()
-    }
+
+    def place(name, value):
+        spec = specs.get(name, P())
+        if isinstance(value, tuple):
+            # int8 (weight [L, in, out], scale [L, out]) pair: the
+            # scale follows the weight's layer + output-channel axes.
+            w, scale = value
+            scale_spec = (P(spec[0], spec[2])
+                          if len(spec) == 3 else P())
+            return (
+                jax.device_put(w, NamedSharding(mesh, spec)),
+                jax.device_put(scale, NamedSharding(mesh, scale_spec)),
+            )
+        return jax.device_put(value, NamedSharding(mesh, spec))
+
+    return {name: place(name, value)
+            for name, value in params.items()}
 
 
 def cache_spec() -> P:
