@@ -222,6 +222,28 @@ class EngineConfig:
     lora: LoRAConfig = dataclasses.field(default_factory=LoRAConfig)
     seed: int = 0
 
+    def __post_init__(self):
+        # Learned-position-embedding models (gpt2/opt) index a fixed
+        # [max_positions, h] table; JAX clamps out-of-range gathers
+        # silently, so positions past the table would all reuse the
+        # last row and quietly degrade long generations. Cap the
+        # serving length at the model's limit instead.
+        if (self.model.architecture in ("gpt2", "opt")
+                and self.scheduler.max_model_len
+                > self.model.max_position_embeddings):
+            from production_stack_tpu.utils.log import init_logger
+            init_logger(__name__).warning(
+                "max_model_len %d exceeds %s's position table (%d); "
+                "clamping to %d",
+                self.scheduler.max_model_len, self.model.architecture,
+                self.model.max_position_embeddings,
+                self.model.max_position_embeddings,
+            )
+            self.scheduler = dataclasses.replace(
+                self.scheduler,
+                max_model_len=self.model.max_position_embeddings,
+            )
+
 
 def tiny_model_config(architecture: str = "llama") -> ModelConfig:
     """A tiny model for tests/benchmarks that runs anywhere."""

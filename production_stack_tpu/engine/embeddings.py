@@ -77,12 +77,28 @@ class Embedder:
             return pooled / jnp.maximum(norm, 1e-12)
 
         self._embed_jit = jax.jit(embed)
+        # Multihost serving: host 0 publishes each embed chunk over the
+        # step bridge so workers co-dispatch the same collective program
+        # (parallel/distributed.py KIND_EMBED). None = single host.
+        self.bridge = None
 
     def _bucket(self, n: int) -> int:
         b = 16
         while b < n:
             b *= 2
         return min(b, self.max_len)
+
+    def _launch_chunk(self, tokens: np.ndarray,
+                      lengths: np.ndarray) -> jax.Array:
+        """Dispatch one padded embed program (async, unforced)."""
+        return self._embed_jit(
+            self.params, jnp.asarray(tokens), jnp.asarray(lengths)
+        )
+
+    def run_chunk(self, tokens: np.ndarray,
+                  lengths: np.ndarray) -> np.ndarray:
+        """One padded embed program (shared by host 0 and workers)."""
+        return np.asarray(self._launch_chunk(tokens, lengths))
 
     def embed_batch(self, token_lists: List[List[int]]) -> np.ndarray:
         """Embed tokenized inputs; returns [N, hidden] float32."""
@@ -99,10 +115,26 @@ class Embedder:
                 ids = ids[:t]
                 tokens[j, :len(ids)] = ids
                 lengths[j] = len(ids)
-            pooled = self._embed_jit(
-                self.params, jnp.asarray(tokens), jnp.asarray(lengths)
-            )
-            out[i:i + len(chunk)] = np.asarray(pooled)[:len(chunk)]
+            if self.bridge is not None:
+                from production_stack_tpu.parallel.distributed import (
+                    KIND_EMBED,
+                )
+                # Atomic publish+launch under the bridge lock so this
+                # broadcast can't interleave with the engine thread's
+                # prefill/decode header/payload pairs (and the local
+                # program launches in published order). The blocking
+                # host transfer happens after release so decode
+                # dispatch isn't stalled for the embed forward.
+                with self.bridge.lock:
+                    self.bridge.publish(
+                        KIND_EMBED, t,
+                        {"tokens": tokens, "lengths": lengths},
+                    )
+                    pooled_dev = self._launch_chunk(tokens, lengths)
+                pooled = np.asarray(pooled_dev)
+            else:
+                pooled = self.run_chunk(tokens, lengths)
+            out[i:i + len(chunk)] = pooled[:len(chunk)]
             i += len(chunk)
         return out
 

@@ -46,6 +46,11 @@ _TARGET_MAP = {
         "q_proj": "wq", "k_proj": "wk", "v_proj": "wv",
         "o_proj": "wo",
     },
+    # GPT-2 fuses q/k/v into c_attn; handled specially in
+    # load_peft_adapter (A is shared, B is split three ways).
+    "gpt2": {
+        "attn.c_proj": "wo", "c_fc": "fc1", "mlp.c_proj": "fc2",
+    },
 }
 
 
@@ -197,8 +202,9 @@ def load_peft_adapter(path: str, config: ModelConfig,
 
     def find(template: str, i: int, proj: str, kind: str):
         for key in raw:
-            if (f"layers.{i}." in key and f"{proj}." in key
-                    and f"lora_{kind}" in key):
+            # Llama/OPT name layers "...layers.{i}."; GPT-2 "...h.{i}.".
+            if ((f"layers.{i}." in key or f"h.{i}." in key)
+                    and f"{proj}." in key and f"lora_{kind}" in key):
                 return raw[key]
         return None
 
@@ -218,6 +224,33 @@ def load_peft_adapter(path: str, config: ModelConfig,
             b_stack[i, :r, :] = np.asarray(B, np.float32).T
         if found:
             per_target[tgt] = (a_stack, b_stack)
+
+    if config.architecture == "gpt2":
+        # GPT-2's q/k/v live in one fused c_attn [h, 3h] projection.
+        # PEFT trains a single (A [r, h], B [3h, r]) pair for it; we
+        # split B into thirds so each of wq/wk/wv gets (A, B_chunk) —
+        # the low-rank update decomposes exactly because the three
+        # outputs are disjoint column blocks of c_attn.
+        h = config.hidden_size
+        a_stack = np.zeros((layers, h, max_lora_rank), np.float32)
+        b_stacks = {t: np.zeros((layers, max_lora_rank, h), np.float32)
+                    for t in ("wq", "wk", "wv")}
+        found = False
+        for i in range(layers):
+            A = find("", i, "c_attn", "A")  # [r, h]
+            B = find("", i, "c_attn", "B")  # [3h, r]
+            if A is None or B is None:
+                continue
+            found = True
+            r = A.shape[0]
+            a_stack[i, :, :r] = np.asarray(A, np.float32).T
+            Bf = np.asarray(B, np.float32)
+            for j, t in enumerate(("wq", "wk", "wv")):
+                b_stacks[t][i, :r, :] = Bf[j * h:(j + 1) * h, :].T
+        if found:
+            for t in ("wq", "wk", "wv"):
+                per_target[t] = (a_stack, b_stacks[t])
+
     if not per_target:
         raise ValueError(f"No LoRA weights found under {path}")
     return LoRAAdapter(
@@ -248,8 +281,12 @@ class LoRARegistry:
                     f"All {self.max_loras} LoRA slots in use"
                 )
             slot = len(self.slots) + 1  # slot 0 = base
-            self.slots[adapter.name] = slot
+        # Install before committing the name->slot mapping: if the
+        # adapter targets a projection this architecture doesn't
+        # expose, the name must not stay registered against an
+        # all-zero slot (which would silently serve the base model).
         self.stack = install_adapter(self.stack, slot, adapter)
+        self.slots[adapter.name] = slot
         logger.info("LoRA adapter %r installed in slot %d (rank %d)",
                     adapter.name, slot, adapter.rank)
         return slot

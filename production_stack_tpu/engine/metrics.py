@@ -99,9 +99,21 @@ class EngineMetrics:
                 ("vllm:generation_tokens_total "
                  f"{self.generation_tokens_total}"),
             ]
+            # vLLM's success counter tracks completed requests only;
+            # aborts go to a separate failure counter so reference
+            # dashboards don't overcount success.
             lines.append("# TYPE vllm:request_success_total counter")
             for reason, count in sorted(self.requests_total.items()):
+                if reason == "abort":
+                    continue
                 lines.append(
                     'vllm:request_success_total'
                     f'{{finished_reason="{reason}"}} {count}')
+            aborted = self.requests_total.get("abort", 0)
+            if aborted:
+                lines += [
+                    "# TYPE vllm:request_failure_total counter",
+                    'vllm:request_failure_total'
+                    f'{{finished_reason="abort"}} {aborted}',
+                ]
             return lines

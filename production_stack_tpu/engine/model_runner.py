@@ -94,6 +94,10 @@ class ModelRunner:
         # Multihost step broadcast (parallel/distributed.py); host 0's
         # engine sets this so every dispatch is mirrored to workers.
         self.bridge = None
+        # Embedder for /v1/embeddings|score|rerank; in multihost mode
+        # every host builds one at startup so KIND_EMBED payloads can
+        # be executed slice-wide (server.py main, --distributed).
+        self.embedder = None
 
         # Multi-LoRA: device-resident adapter stacks; a per-row slot-id
         # vector selects the adapter (engine/lora.py). None when off so
@@ -201,6 +205,10 @@ class ModelRunner:
         is the multi-step window; prefill uses it as the token bucket
         (already baked into the array shapes).
         """
+        from production_stack_tpu.parallel.distributed import KIND_EMBED
+        if kind == KIND_EMBED:
+            return self.embedder.run_chunk(payload["tokens"],
+                                           payload["lengths"])
         lora_ids = payload.get("lora_ids")
         lora_ids = (None if lora_ids is None
                     else jnp.asarray(lora_ids))
@@ -240,7 +248,10 @@ class ModelRunner:
 
     def _dispatch(self, kind: int, t: int, payload: dict) -> jax.Array:
         if self.bridge is not None:
-            self.bridge.publish(kind, t, payload)
+            # Atomic publish+execute: see MultihostStepBridge.lock.
+            with self.bridge.lock:
+                self.bridge.publish(kind, t, payload)
+                return self.execute_payload(kind, payload, t)
         return self.execute_payload(kind, payload, t)
 
     # ---- prefill ----------------------------------------------------------

@@ -426,12 +426,23 @@ class EngineServer:
     async def _ensure_embedder(self):
         from production_stack_tpu.engine.embeddings import Embedder
         if self._embedder is None:
+            if self.engine.runner.bridge is not None:
+                # Multihost: lazy construction would launch a
+                # collective program workers never mirror (they only
+                # enter embedders built at startup by main()), so the
+                # slice would deadlock on the first request.
+                raise NotImplementedError(
+                    "embeddings unavailable: this multihost slice was "
+                    "started without an embedder (unsupported "
+                    "architecture or quantized weights)"
+                )
             self._embedder = Embedder(
                 self.engine.config.model,
                 self.engine.runner.params,
                 max_len=self.engine.config.scheduler.max_model_len,
                 pooling=self.pooling,
             )
+            self.engine.runner.embedder = self._embedder
         return self._embedder
 
     async def _pair_scores(self, query: str, documents: List[str]):
@@ -788,6 +799,22 @@ def main(argv=None) -> None:
                          args.process_id)
         engine, served_name = build_engine_from_args(args)
         bridge = MultihostStepBridge(engine.runner)
+        # Build the embedder on EVERY host now: embed programs run
+        # collectives over the global mesh, so workers must be able to
+        # mirror KIND_EMBED payloads — a host-0-only lazy build would
+        # deadlock the slice on the first /v1/embeddings request.
+        try:
+            from production_stack_tpu.engine.embeddings import Embedder
+            embedder = Embedder(
+                engine.config.model, engine.runner.params,
+                max_len=engine.config.scheduler.max_model_len,
+                pooling=args.pooling,
+            )
+            engine.runner.embedder = embedder
+        except NotImplementedError as e:
+            logger.info("embeddings/score/rerank disabled on this "
+                        "slice: %s", e)
+            embedder = None
         if not is_coordinator():
             # Workers never serve HTTP; they mirror host 0's steps.
             bridge.worker_loop()
@@ -795,6 +822,9 @@ def main(argv=None) -> None:
         engine.runner.bridge = bridge
         server = EngineServer(engine, served_name, pooling=args.pooling,
                           profile_dir=args.profile_dir)
+        if embedder is not None:
+            embedder.bridge = bridge
+            server._embedder = embedder
         logger.info("tpu-engine %s (multihost coordinator) serving %s "
                     "on %s:%d", __version__, served_name, args.host,
                     args.port)

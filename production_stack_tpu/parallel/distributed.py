@@ -32,6 +32,7 @@ logger = init_logger(__name__)
 KIND_SHUTDOWN = 0
 KIND_PREFILL = 1
 KIND_DECODE = 2
+KIND_EMBED = 3  # /v1/embeddings|score|rerank batches (engine/embeddings.py)
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -72,11 +73,27 @@ class MultihostStepBridge:
 
     def __init__(self, runner):
         self.runner = runner
+        # Host 0 publishes from two threads (engine device loop:
+        # prefill/decode; embed worker threads: KIND_EMBED). Workers
+        # consume one strict header/payload/execute sequence, and XLA
+        # collective programs must launch in the same order on every
+        # process — so each publish+execute pair must be atomic.
+        import threading
+        self.lock = threading.Lock()
 
     # -- shapes --------------------------------------------------------------
 
     def _payload_template(self, kind: int, t: int) -> Dict[str, np.ndarray]:
         r = self.runner
+        if kind == KIND_EMBED:
+            # Embed batches have their own (batch_width, token-bucket)
+            # geometry; every host built the same Embedder at startup.
+            return {
+                "tokens": np.zeros((r.embedder.batch_width, t),
+                                   np.int32),
+                "lengths": np.zeros((r.embedder.batch_width,),
+                                    np.int32),
+            }
         if kind == KIND_PREFILL:
             b, tt = r.prefill_width, t
         else:
@@ -109,7 +126,8 @@ class MultihostStepBridge:
 
     def shutdown(self) -> None:
         """Release workers from their receive loop."""
-        self.publish(KIND_SHUTDOWN, 0, {})
+        with self.lock:
+            self.publish(KIND_SHUTDOWN, 0, {})
 
     # -- workers -------------------------------------------------------------
 

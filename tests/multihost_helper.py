@@ -57,15 +57,26 @@ def main() -> None:
     engine = LLMEngine(config, mesh=mesh)
     bridge = MultihostStepBridge(engine.runner)
 
+    # Every host builds the embedder (as server.py main does) so
+    # KIND_EMBED dispatches mirror slice-wide.
+    from production_stack_tpu.engine.embeddings import Embedder
+    embedder = Embedder(config.model, engine.runner.params,
+                        max_len=config.scheduler.max_model_len)
+    engine.runner.embedder = embedder
+
     if proc_id == 0:
         engine.runner.bridge = bridge
+        embedder.bridge = bridge
         seq = engine.generate(
             list(range(1, 20)),
             SamplingParams(max_tokens=6, temperature=0.0,
                            ignore_eos=True),
         )
+        vecs = embedder.embed_batch([[1, 2, 3], [4, 5, 6, 7]])
         bridge.shutdown()
         print("TOKENS=" + json.dumps(seq.output_token_ids))
+        print("EMBED=" + json.dumps(
+            [round(float(x), 6) for x in vecs[:, 0]]))
     else:
         bridge.worker_loop()
         print("WORKER_DONE")

@@ -60,7 +60,7 @@ def test_batch_executes_against_engine(tmp_path):
             )
             for _ in range(100):
                 info = await processor.retrieve_batch(
-                    "default", info.batch_id)
+                    "default", info.id)
                 if info.status.value in ("completed", "failed"):
                     break
                 await asyncio.sleep(0.2)
@@ -111,7 +111,7 @@ def test_batch_cancellation(tmp_path):
                 completion_window="24h", metadata=None,
             )
             info = await processor.cancel_batch("default",
-                                                info.batch_id)
+                                                info.id)
             assert info.status.value in ("cancelling", "cancelled")
         finally:
             await processor.close()

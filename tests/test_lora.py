@@ -243,3 +243,93 @@ def test_server_lists_and_serves_adapters(tmp_path):
             await client.close()
 
     asyncio.run(run())
+
+
+def test_registry_rollback_on_failed_install():
+    """A failed install must not leave the name mapped to a zero slot
+    (which would silently serve the base model for that adapter)."""
+    config = tiny_model_config("llama")
+    registry = LoRARegistry(config, max_loras=2, max_lora_rank=4)
+    bad = LoRAAdapter(name="bad", rank=2, scaling=1.0,
+                      weights={"not_a_target": (np.zeros((2, 4, 4)),
+                                                np.zeros((2, 4, 4)))})
+    with pytest.raises(ValueError, match="Unknown LoRA target"):
+        registry.register(bad)
+    assert "bad" not in registry.slots
+    # The slot stays free for the next adapter.
+    ok = _random_adapter(config, rank=2, max_rank=4, scale=1.0)
+    assert registry.register(ok) == 1
+
+
+def _write_gpt2_peft_dir(tmp_path, config, rank=2, alpha=4.0):
+    from safetensors.numpy import save_file
+    rs = np.random.RandomState(7)
+    h = config.hidden_size
+    raw = {}
+    for i in range(config.num_hidden_layers):
+        prefix = f"base_model.model.transformer.h.{i}.attn.c_attn"
+        raw[f"{prefix}.lora_A.weight"] = rs.randn(
+            rank, h).astype(np.float32)
+        raw[f"{prefix}.lora_B.weight"] = rs.randn(
+            3 * h, rank).astype(np.float32)
+        mlp = f"base_model.model.transformer.h.{i}.mlp.c_fc"
+        raw[f"{mlp}.lora_A.weight"] = rs.randn(
+            rank, h).astype(np.float32)
+        raw[f"{mlp}.lora_B.weight"] = rs.randn(
+            config.intermediate_size, rank).astype(np.float32)
+    adapter_dir = os.path.join(str(tmp_path), "gpt2-adapter")
+    os.makedirs(adapter_dir, exist_ok=True)
+    save_file(raw, os.path.join(adapter_dir,
+                                "adapter_model.safetensors"))
+    with open(os.path.join(adapter_dir, "adapter_config.json"),
+              "w") as f:
+        json.dump({"r": rank, "lora_alpha": alpha,
+                   "target_modules": ["c_attn", "c_fc"]}, f)
+    return adapter_dir, raw
+
+
+def test_peft_loader_gpt2_splits_fused_qkv(tmp_path):
+    """GPT-2's fused c_attn (A shared, B split into q/k/v thirds) must
+    decompose exactly: the q output block of x@(BA).T equals
+    x @ A.T @ B[:h].T."""
+    config = tiny_model_config("gpt2")
+    h = config.hidden_size
+    adapter_dir, raw = _write_gpt2_peft_dir(tmp_path, config, rank=2,
+                                            alpha=4.0)
+    adapter = load_peft_adapter(adapter_dir, config, max_lora_rank=4)
+    assert {"wq", "wk", "wv", "fc1"} <= set(adapter.weights)
+
+    A_raw = raw["base_model.model.transformer.h.0.attn.c_attn"
+                ".lora_A.weight"]  # [r, h]
+    B_raw = raw["base_model.model.transformer.h.0.attn.c_attn"
+                ".lora_B.weight"]  # [3h, r]
+    x = np.random.RandomState(0).randn(3, h).astype(np.float32)
+    fused = x @ A_raw.T @ B_raw.T  # [3, 3h]
+    for j, tgt in enumerate(("wq", "wk", "wv")):
+        a, b = adapter.weights[tgt]
+        ours = x @ a[0] @ b[0]  # rank-padded cols are zero
+        np.testing.assert_allclose(ours, fused[:, j * h:(j + 1) * h],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_gpt2_engine_generation_with_adapter(tmp_path):
+    config = tiny_model_config("gpt2")
+    adapter_dir, _ = _write_gpt2_peft_dir(tmp_path, config, rank=2)
+    engine_config = EngineConfig(
+        model=config,
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=128,
+                                  prefill_chunk_size=32),
+        lora=LoRAConfig(enable=True, max_loras=2, max_lora_rank=4),
+    )
+    engine = LLMEngine(engine_config)
+    engine.register_lora(adapter_dir, name="gpt2-lora")
+    seq_id = engine.add_request(
+        [1, 2, 3, 4],
+        SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
+        lora_name="gpt2-lora")
+    seq = engine.sequences[seq_id]
+    while engine.has_work():
+        engine.step()
+    assert len(seq.output_token_ids) == 4
+    assert seq.lora_id == 1

@@ -73,8 +73,22 @@ def test_two_process_bridge_generation():
         scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
                                   prefill_chunk_size=32),
     )
-    ref = LLMEngine(config).generate(
+    ref_engine = LLMEngine(config)
+    ref = ref_engine.generate(
         list(range(1, 20)),
         SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True),
     )
     assert ref.output_token_ids == tokens
+
+    # The embed bridge leg (KIND_EMBED) must also have run and matched
+    # a single-process embed of the same inputs.
+    embed_line = [ln for ln in outs[0][1].splitlines()
+                  if ln.startswith("EMBED=")]
+    assert embed_line, outs[0][1]
+    embed_first_dims = json.loads(embed_line[0][len("EMBED="):])
+    from production_stack_tpu.engine.embeddings import Embedder
+    embedder = Embedder(config.model, ref_engine.runner.params,
+                        max_len=config.scheduler.max_model_len)
+    ref_vecs = embedder.embed_batch([[1, 2, 3], [4, 5, 6, 7]])
+    np.testing.assert_allclose(embed_first_dims, ref_vecs[:, 0],
+                               atol=1e-4)
