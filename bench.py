@@ -24,17 +24,90 @@ import sys
 import time
 
 
+_PROBE_LOG: dict = {}
+
+
 def _tpu_available() -> bool:
-    """Probe TPU init in a subprocess so a wedged tunnel can't hang us."""
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; assert jax.devices()[0].platform != 'cpu'"],
-            timeout=120, capture_output=True,
-        )
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    """Probe TPU init in a subprocess so a wedged tunnel can't hang us.
+
+    The tunnel can take minutes to come up; ``jax.devices()`` on it has
+    been observed to block >10 min. So: generous per-attempt budget
+    (default 600 s, env-overridable), two attempts, and a loud report
+    either way — a CPU fallback must never masquerade as the TPU
+    number (round-1 failure mode).
+    """
+    budget = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT_S", "600"))
+    attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "2"))
+    t0 = time.time()
+    for i in range(attempts):
+        sys.stderr.write(
+            f"[bench] TPU probe attempt {i + 1}/{attempts} "
+            f"(budget {budget}s)...\n")
+        sys.stderr.flush()
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "assert d[0].platform != 'cpu'; "
+                 "print(d[0].device_kind)"],
+                timeout=budget, capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"[bench] TPU probe attempt {i + 1} timed out after "
+                f"{budget}s\n")
+            continue
+        if probe.returncode == 0:
+            kind = probe.stdout.strip().splitlines()[-1]
+            _PROBE_LOG.update(
+                device_kind=kind,
+                probe_seconds=round(time.time() - t0, 1))
+            sys.stderr.write(
+                f"[bench] TPU up: {kind} "
+                f"({_PROBE_LOG['probe_seconds']}s)\n")
+            return True
+        sys.stderr.write(
+            f"[bench] TPU probe attempt {i + 1} failed "
+            f"(rc={probe.returncode}): {probe.stderr.strip()[-400:]}\n")
+    _PROBE_LOG.update(
+        probe_seconds=round(time.time() - t0, 1),
+        probe_error=f"no TPU after {attempts} attempts x {budget}s")
+    sys.stderr.write(
+        "[bench] " + "=" * 60 + "\n"
+        "[bench] WARNING: NO TPU REACHABLE — falling back to CPU.\n"
+        "[bench] This number is NOT the TPU benchmark. "
+        f"({_PROBE_LOG['probe_error']})\n"
+        "[bench] " + "=" * 60 + "\n")
+    return False
+
+
+# Peak bf16 matmul FLOP/s per chip, for the MFU estimate.
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops(device_kind: str) -> float:
+    for k, v in _PEAK_FLOPS.items():
+        if device_kind.lower().startswith(k.lower()):
+            return v
+    return 197e12  # assume v5e-class if unknown
+
+
+def _param_count(model) -> int:
+    h, ffn, L, v = (model.hidden_size, model.intermediate_size,
+                    model.num_hidden_layers, model.vocab_size)
+    nh, nkv, d = (model.num_attention_heads,
+                  model.num_key_value_heads, model.head_dim)
+    attn = h * nh * d + 2 * h * nkv * d + nh * d * h
+    mlp = 3 * h * ffn
+    return L * (attn + mlp) + 2 * v * h
 
 
 def _bench_config(tpu: bool):
@@ -130,13 +203,19 @@ def main() -> None:
     # Warmup: compile all shapes (prefill buckets + decode). If a
     # Pallas kernel fails Mosaic compilation on this chip/toolchain,
     # fall back to the XLA attention path rather than failing the
-    # whole benchmark.
+    # whole benchmark — but record the failure loudly: the one run
+    # that matters must say which impl actually executed.
+    pallas_error = None
     try:
         warm = engine.generate(make_prompt(-1), sampling())
     except Exception as e:
+        pallas_error = repr(e)[:500]
         sys.stderr.write(
-            f"pallas path failed to compile ({e!r}); "
-            "falling back to attention_impl=xla\n"
+            "[bench] " + "=" * 60 + "\n"
+            f"[bench] WARNING: pallas path failed to compile:\n"
+            f"[bench]   {pallas_error}\n"
+            "[bench] falling back to attention_impl=xla\n"
+            "[bench] " + "=" * 60 + "\n"
         )
         config.model.attention_impl = "xla"
         engine = LLMEngine(config)
@@ -166,6 +245,31 @@ def main() -> None:
     total_tokens = sum(len(s.output_token_ids) for s in seqs)
     req_per_s = n_requests / wall
 
+    # MFU estimate: each processed token costs ~2*params matmul FLOPs;
+    # prefill tokens and generated tokens both pass through the full
+    # stack of projections (VERDICT r1: tokens/s x 2 x params / peak).
+    params_n = _param_count(config.model)
+    processed_tokens = n_requests * prompt_len + total_tokens
+    model_flops = 2.0 * params_n * processed_tokens
+    peak = _peak_flops(_PROBE_LOG.get("device_kind", ""))
+    mfu = model_flops / wall / peak if tpu else None
+
+    extra = {
+        "p50_ttft_s": round(p50_ttft, 4),
+        "gen_tokens_per_s": round(total_tokens / wall, 1),
+        "total_tokens_per_s": round(processed_tokens / wall, 1),
+        "n_requests": n_requests,
+        "prompt_len": prompt_len,
+        "output_len": out_len,
+        "platform": "tpu" if tpu else "cpu",
+        "attention_impl": attention_impl_used,
+        "param_count": params_n,
+    }
+    extra.update(_PROBE_LOG)
+    if mfu is not None:
+        extra["mfu"] = round(mfu, 4)
+    if pallas_error is not None:
+        extra["pallas_error"] = pallas_error
     print(json.dumps({
         "metric": ("multi-round-qa-style req/s, 1B-class llama, "
                    "1 TPU chip" if tpu else
@@ -173,15 +277,7 @@ def main() -> None:
         "value": round(req_per_s, 3),
         "unit": "req/s",
         "vs_baseline": round(req_per_s / 1.0, 3),
-        "extra": {
-            "p50_ttft_s": round(p50_ttft, 4),
-            "gen_tokens_per_s": round(total_tokens / wall, 1),
-            "n_requests": n_requests,
-            "prompt_len": prompt_len,
-            "output_len": out_len,
-            "platform": "tpu" if tpu else "cpu",
-            "attention_impl": attention_impl_used,
-        },
+        "extra": extra,
     }))
 
 

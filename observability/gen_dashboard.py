@@ -1,24 +1,37 @@
 """Generates the Grafana dashboard JSON (tpu-stack-dashboard.json).
 
-Panel set mirrors the reference's vllm-dashboard.json capability
-(available instances, latency/TTFT, QPS, prefill/decode counts,
-running/waiting, KV usage + prefix hit rate, block accounting) with
-TPU naming (HBM KV instead of "GPU KV").
+Panel set matches the reference's vllm-dashboard.json (21 panels in 4
+rows: overview, QoS, serving-engine load, node resources — reference
+observability/vllm-dashboard.json) with TPU naming (HBM KV instead of
+"GPU KV", TPU duty cycle instead of GPU usage), plus the fork's KV
+block-accounting panels and per-engine router views the reference
+doesn't have.
+
+Latency/TTFT/ITL distributions use the engine's vLLM-name histograms
+(engine/metrics.py); queueing delay and prefill length use the router
+gauges (router/services/metrics_service.py). Node panels use standard
+node-exporter series (the reference ships placeholder exprs there).
 
 Run: python observability/gen_dashboard.py > observability/tpu-stack-dashboard.json
 """
 
 import json
 
+_next_id = [0]
+
+
+def _nid() -> int:
+    _next_id[0] += 1
+    return _next_id[0]
+
 
 def target(expr, legend="{{server}}"):
     return {"expr": expr, "legendFormat": legend}
 
 
-def panel(panel_id, title, targets, x, y, w=8, h=7, unit=None,
-          kind="timeseries"):
+def panel(title, targets, x, y, w=8, h=7, unit=None, kind="timeseries"):
     p = {
-        "id": panel_id,
+        "id": _nid(),
         "title": title,
         "type": kind,
         "datasource": {"type": "prometheus", "uid": "prometheus"},
@@ -31,47 +44,119 @@ def panel(panel_id, title, targets, x, y, w=8, h=7, unit=None,
     return p
 
 
+def row(title, y):
+    return {
+        "id": _nid(),
+        "title": title,
+        "type": "row",
+        "gridPos": {"x": 0, "y": y, "w": 24, "h": 1},
+        "collapsed": False,
+        "panels": [],
+    }
+
+
 def build():
     panels = [
-        panel(1, "Healthy Serving Engines",
+        # ---- Overview System Performance (reference row 1) ----------------
+        row("Overview System Performance", 0),
+        panel("Available TPU Engine Instances",
               [target('sum(vllm:healthy_pods_total)', "engines")],
-              0, 0, w=6, kind="stat"),
-        panel(2, "Router QPS per Engine",
-              [target('vllm:current_qps')], 6, 0, w=9, unit="reqps"),
-        panel(3, "Average Request Latency",
-              [target('vllm:avg_latency')], 15, 0, w=9, unit="s"),
-        panel(4, "Prefill Requests (router view)",
-              [target('vllm:num_prefill_requests')], 0, 7),
-        panel(5, "Decoding Requests (router view)",
-              [target('vllm:num_decoding_requests')], 8, 7),
-        panel(6, "Average Decoding Length",
-              [target('vllm:avg_decoding_length')], 16, 7, unit="s"),
-        panel(7, "Engine Running Requests",
-              [target('vllm:num_requests_running')], 0, 14),
-        panel(8, "Engine Waiting Requests",
-              [target('vllm:num_requests_waiting')], 8, 14),
-        panel(9, "HBM KV Cache Usage",
-              [target('vllm:gpu_cache_usage_perc')], 16, 14,
+              0, 1, w=6, kind="stat"),
+        panel("Average Latency",
+              [target('avg(vllm:e2e_request_latency_seconds_sum) / '
+                      'avg(vllm:e2e_request_latency_seconds_count)',
+                      "avg e2e latency")],
+              6, 1, w=6, unit="s", kind="stat"),
+        panel("Request latency distribution",
+              [target('sum by(le) (vllm:e2e_request_latency_seconds_bucket)',
+                      "{{le}}")],
+              12, 1, w=12, kind="bargauge"),
+        # ---- QoS Information (reference row 2) -----------------------------
+        row("QoS Information", 8),
+        panel("Current QPS",
+              [target('sum(vllm:current_qps)', "qps")],
+              0, 9, w=4, unit="reqps", kind="stat"),
+        panel("Router-side Queueing Delay",
+              [target('avg(vllm:router_queueing_delay_seconds)',
+                      "queueing delay")],
+              4, 9, w=4, unit="s", kind="stat"),
+        panel("Average Prefill Length",
+              [target('avg(vllm:avg_prefill_length)', "prompt tokens")],
+              8, 9, w=4, kind="stat"),
+        panel("Average ITL",
+              [target('avg(vllm:time_per_output_token_seconds_sum) / '
+                      'avg(vllm:time_per_output_token_seconds_count)',
+                      "avg itl")],
+              12, 9, w=4, unit="s", kind="stat"),
+        panel("Request TTFT distribution",
+              [target('sum by(le) '
+                      '(vllm:time_to_first_token_seconds_bucket)',
+                      "{{le}}")],
+              16, 9, w=8, kind="bargauge"),
+        # ---- Serving Engine Load (reference row 3) -------------------------
+        row("Serving Engine Load", 16),
+        panel("Number of Running Requests",
+              [target('vllm:num_requests_running')], 0, 17),
+        panel("Number of Pending Requests",
+              [target('vllm:num_requests_waiting')], 8, 17),
+        panel("HBM KV Usage Percentage",
+              [target('vllm:gpu_cache_usage_perc')], 16, 17,
               unit="percentunit"),
-        panel(10, "Prefix Cache Hit Rate",
-              [target('vllm:gpu_prefix_cache_hit_rate')], 0, 21,
+        panel("HBM KV Cache Hit Rate",
+              [target('vllm:gpu_prefix_cache_hit_rate')], 0, 24,
               unit="percentunit"),
-        panel(11, "KV Blocks (allocated / reserved / free)",
+        panel("Number of Swapped Requests",
+              [target('sum(vllm:num_requests_swapped)', "swapped")],
+              8, 24, w=8, kind="stat"),
+        panel("KV Blocks (allocated / reserved / free)",
               [target('vllm:allocated_blocks', "alloc {{server}}"),
                target('vllm:pending_reserved_blocks',
                       "reserved {{server}}"),
                target('vllm:num_free_blocks', "free {{server}}")],
-              8, 21),
-        panel(12, "Swapped Requests",
-              [target('vllm:num_requests_swapped')], 16, 21),
-        panel(13, "Inter-Token Latency",
-              [target('vllm:avg_itl')], 0, 28, unit="s"),
+              16, 24),
+        # ---- Router per-engine views (fork extras) -------------------------
+        row("Router Per-Engine View", 31),
+        panel("Router QPS per Engine",
+              [target('vllm:current_qps')], 0, 32, unit="reqps"),
+        panel("Average Request Latency",
+              [target('vllm:avg_latency')], 8, 32, unit="s"),
+        panel("Prefill Requests (router view)",
+              [target('vllm:num_prefill_requests')], 16, 32),
+        panel("Decoding Requests (router view)",
+              [target('vllm:num_decoding_requests')], 0, 39),
+        panel("Average Decoding Length",
+              [target('vllm:avg_decoding_length')], 8, 39, unit="s"),
+        panel("Inter-Token Latency",
+              [target('vllm:avg_itl')], 16, 39, unit="s"),
+        # ---- Current Resource Usage (reference row 4) ----------------------
+        row("Current Resource Usage", 46),
+        panel("TPU Usage",
+              [target('avg by (node) '
+                      '(kubernetes_io:node_accelerator_duty_cycle)',
+                      "{{node}}")],
+              0, 47, w=6, unit="percent"),
+        panel("CPU Usage",
+              [target('1 - avg by (instance) '
+                      '(rate(node_cpu_seconds_total{mode="idle"}[2m]))',
+                      "{{instance}}")],
+              6, 47, w=6, unit="percentunit"),
+        panel("Memory Usage",
+              [target('1 - node_memory_MemAvailable_bytes / '
+                      'node_memory_MemTotal_bytes',
+                      "{{instance}}")],
+              12, 47, w=6, unit="percentunit"),
+        panel("Disk Usage",
+              [target('1 - node_filesystem_avail_bytes'
+                      '{mountpoint="/"} / node_filesystem_size_bytes'
+                      '{mountpoint="/"}',
+                      "{{instance}}")],
+              18, 47, w=6, unit="percentunit"),
     ]
     return {
         "title": "TPU Stack — Serving Overview",
         "uid": "tpu-stack-overview",
         "schemaVersion": 39,
-        "version": 1,
+        "version": 2,
         "refresh": "15s",
         "time": {"from": "now-30m", "to": "now"},
         "tags": ["tpu-stack", "llm"],

@@ -188,6 +188,10 @@ class ParallelConfig:
 
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
+    # Layer stages over the 'pp' mesh axis — a SERVING feature here
+    # (parallel/pipeline_serving.py), unlike the reference which has no
+    # pipeline parallelism at all (SURVEY.md §2.6).
+    pipeline_parallel_size: int = 1
 
 
 @dataclasses.dataclass

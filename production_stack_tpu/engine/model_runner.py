@@ -53,6 +53,45 @@ class ModelRunner:
                         model_config.attention_impl)
         self._init_fn, self._forward = get_model(model_config)
 
+        pp = config.parallel.pipeline_parallel_size
+        if pp > 1:
+            # Pipeline-parallel serving: stages over the mesh's 'pp'
+            # axis replace the plain layer scan
+            # (parallel/pipeline_serving.py).
+            if mesh is None or "pp" not in mesh.axis_names \
+                    or mesh.shape["pp"] != pp:
+                raise ValueError(
+                    "pipeline_parallel_size needs a mesh with a 'pp' "
+                    f"axis of size {pp} (parallel.mesh.build_mesh)")
+            if model_config.architecture not in ("llama", "mistral",
+                                                 "qwen2"):
+                raise NotImplementedError(
+                    "pipeline parallelism currently serves the llama "
+                    f"family (got {model_config.architecture!r})")
+            if model_config.num_hidden_layers % pp:
+                raise ValueError(
+                    f"layers {model_config.num_hidden_layers} must "
+                    f"divide by pipeline_parallel_size {pp}")
+            if config.lora.enable:
+                raise NotImplementedError("LoRA with pipeline "
+                                          "parallelism")
+            if model_config.quantization != "none":
+                raise NotImplementedError(
+                    "quantization with pipeline parallelism")
+            if config.parallel.tensor_parallel_size > 1:
+                # pp_paged_forward's shard_map is P('pp')-only today;
+                # silently accepting tp>1 would allgather the stage
+                # weights per step and defeat TP's memory scaling.
+                raise NotImplementedError(
+                    "tensor parallelism combined with pipeline "
+                    "parallelism (run tp within a stage is planned; "
+                    "use one or the other for now)")
+            from production_stack_tpu.parallel.pipeline_serving import (
+                pp_paged_forward,
+            )
+            self._forward = functools.partial(pp_paged_forward,
+                                              mesh=mesh)
+
         if params is None:
             logger.info("Initializing random weights for %s",
                         model_config.name)

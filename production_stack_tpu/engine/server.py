@@ -166,7 +166,8 @@ def _usage(prompt_len: int, completion_len: int) -> dict:
 class EngineServer:
     def __init__(self, engine: LLMEngine, served_model_name: str,
                  pooling: str = "last",
-                 profile_dir: Optional[str] = None):
+                 profile_dir: Optional[str] = None,
+                 chat_template: Optional[str] = None):
         self.async_engine = AsyncEngine(engine)
         self.engine = engine
         self.model_name = served_model_name
@@ -176,6 +177,9 @@ class EngineServer:
         self._embed_lock = asyncio.Lock()
         self.profile_dir = profile_dir
         self._profiling = False
+        # Jinja source overriding the model's chat template (vLLM's
+        # --chat-template; a path is read by main()).
+        self.chat_template = chat_template
 
     # -- decoding helpers ---------------------------------------------------
 
@@ -231,7 +235,8 @@ class EngineServer:
                 {"error": {"message": "'messages' must be a list"}},
                 status=400,
             )
-        prompt = render_chat_prompt(self.tokenizer, messages)
+        prompt = render_chat_prompt(self.tokenizer, messages,
+                                    chat_template=self.chat_template)
         return await self._generate_response(
             request, body, prompt, chat=True
         )
@@ -658,9 +663,12 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
         served_name = args.served_model_name or args.model
     model_config.quantization = args.quantization
 
-    if args.tensor_parallel_size > 1:
+    if args.tensor_parallel_size > 1 or args.pipeline_parallel_size > 1:
         from production_stack_tpu.parallel.mesh import build_mesh
-        mesh = build_mesh(args.tensor_parallel_size)
+        mesh = build_mesh(
+            tensor_parallel_size=args.tensor_parallel_size,
+            pipeline_parallel_size=args.pipeline_parallel_size,
+        )
 
     config = EngineConfig(
         model=model_config,
@@ -678,6 +686,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
+            pipeline_parallel_size=args.pipeline_parallel_size,
         ),
         offload=OffloadConfig(
             enable=args.enable_kv_offload or bool(args.kv_remote_url),
@@ -727,6 +736,9 @@ def parse_args(argv=None):
                         help="Decode iterations fused per compiled "
                              "program (K tokens per host round-trip)")
     parser.add_argument("--tensor-parallel-size", type=int, default=1)
+    parser.add_argument("--pipeline-parallel-size", type=int, default=1,
+                        help="Layer stages over the pp mesh axis "
+                             "(serving-path pipeline parallelism)")
     parser.add_argument("--disable-prefix-caching", action="store_true")
     parser.add_argument("--enable-lora", action="store_true",
                         help="Enable multi-LoRA adapter serving")
@@ -738,6 +750,9 @@ def parse_args(argv=None):
     parser.add_argument("--pooling", default="last",
                         choices=["last", "mean"],
                         help="/v1/embeddings pooling mode")
+    parser.add_argument("--chat-template", default=None,
+                        help="Jinja chat template source or file path, "
+                             "overriding the model's own template")
     parser.add_argument("--profile-dir", default=None,
                         help="Default output dir for "
                              "/debug/profiler/start traces")
@@ -759,6 +774,27 @@ def parse_args(argv=None):
     parser.add_argument("--kv-remote-url", default=None,
                         help="Remote shared KV cache server URL")
     return parser.parse_args(argv)
+
+
+def _load_chat_template(args) -> Optional[str]:
+    """--chat-template accepts inline Jinja source or a file path."""
+    import os
+    if not args.chat_template:
+        return None
+    if os.path.exists(args.chat_template):
+        with open(args.chat_template) as f:
+            source = f.read()
+    else:
+        source = args.chat_template
+    # Fail fast on a broken template: a render failure at request time
+    # silently falls back to the model's template (tokenizer.py), which
+    # an operator who set the flag should learn at startup instead.
+    import jinja2
+    jinja2.Template(source).render(
+        messages=[{"role": "user", "content": "probe"}],
+        add_generation_prompt=True,
+    )
+    return source
 
 
 def main(argv=None) -> None:
@@ -821,7 +857,8 @@ def main(argv=None) -> None:
             return
         engine.runner.bridge = bridge
         server = EngineServer(engine, served_name, pooling=args.pooling,
-                          profile_dir=args.profile_dir)
+                          profile_dir=args.profile_dir,
+                          chat_template=_load_chat_template(args))
         if embedder is not None:
             embedder.bridge = bridge
             server._embedder = embedder
@@ -836,7 +873,8 @@ def main(argv=None) -> None:
         return
     engine, served_name = build_engine_from_args(args)
     server = EngineServer(engine, served_name, pooling=args.pooling,
-                          profile_dir=args.profile_dir)
+                          profile_dir=args.profile_dir,
+                          chat_template=_load_chat_template(args))
     logger.info("tpu-engine %s serving %s on %s:%d",
                 __version__, served_name, args.host, args.port)
     web.run_app(server.build_app(), host=args.host, port=args.port,

@@ -55,6 +55,11 @@ class HFTokenizer(BaseTokenizer):
     def encode(self, text: str) -> List[int]:
         return self._tok.encode(text)
 
+    def encode_rendered(self, text: str) -> List[int]:
+        """Encode text a chat template already rendered: no extra
+        special tokens (the template embeds BOS etc. itself)."""
+        return self._tok.encode(text, add_special_tokens=False)
+
     def decode(self, token_ids: List[int]) -> str:
         return self._tok.decode(token_ids, skip_special_tokens=True)
 
@@ -78,9 +83,33 @@ def get_tokenizer(spec: Optional[str]) -> BaseTokenizer:
     return HFTokenizer(spec)
 
 
-def render_chat_prompt(tokenizer: BaseTokenizer, messages) -> List[int]:
-    """Messages -> prompt token ids, via the model's chat template when
-    available, else a simple role-tagged rendering."""
+def render_chat_prompt(tokenizer: BaseTokenizer, messages,
+                       chat_template: Optional[str] = None) -> List[int]:
+    """Messages -> prompt token ids.
+
+    Priority: explicit ``chat_template`` (Jinja source, the --chat-template
+    override the reference chart renders into vllm serve,
+    deployment-vllm-multi.yaml:99-103) > the model's own template >
+    a simple role-tagged rendering.
+    """
+    if chat_template:
+        try:
+            import jinja2
+            text = jinja2.Template(chat_template).render(
+                messages=messages, add_generation_prompt=True
+            )
+            # The template renders its own special tokens; encoding
+            # must not prepend a second BOS.
+            if isinstance(tokenizer, HFTokenizer):
+                return tokenizer.encode_rendered(text)
+            return tokenizer.encode(text)
+        except Exception as e:
+            # Fall back to the model/default template — but loudly: a
+            # silently ignored operator override serves wrong prompts.
+            from production_stack_tpu.utils.log import init_logger
+            init_logger(__name__).warning(
+                "--chat-template failed to render (%r); falling back "
+                "to the model's own template", e)
     if isinstance(tokenizer, HFTokenizer):
         ids = tokenizer.apply_chat_template(messages)
         if ids is not None:

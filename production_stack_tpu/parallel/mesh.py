@@ -21,17 +21,22 @@ from production_stack_tpu.engine.config import ModelConfig
 
 def build_mesh(tensor_parallel_size: int = 1,
                data_parallel_size: int = 1,
+               pipeline_parallel_size: int = 1,
                devices=None) -> Mesh:
+    """(dp, pp, tp) mesh. tp is innermost so tensor-parallel collectives
+    ride adjacent ICI links; pp stage hops cross the slower dimension
+    (or DCN on multi-slice)."""
     devices = devices if devices is not None else jax.devices()
-    needed = tensor_parallel_size * data_parallel_size
+    needed = (tensor_parallel_size * data_parallel_size
+              * pipeline_parallel_size)
     if len(devices) < needed:
         raise ValueError(
             f"Mesh needs {needed} devices, have {len(devices)}"
         )
     grid = np.asarray(devices[:needed]).reshape(
-        data_parallel_size, tensor_parallel_size
+        data_parallel_size, pipeline_parallel_size, tensor_parallel_size
     )
-    return Mesh(grid, axis_names=("dp", "tp"))
+    return Mesh(grid, axis_names=("dp", "pp", "tp"))
 
 
 # PartitionSpecs per parameter name. Layer-stacked params have a leading
@@ -100,11 +105,25 @@ def param_specs(config: ModelConfig) -> Dict[str, P]:
     return dict(_LLAMA_SPECS)
 
 
+def _pp_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None or "pp" not in mesh.axis_names:
+        return 1
+    return mesh.shape["pp"]
+
+
 def shard_params(params: Dict[str, jax.Array], config: ModelConfig,
                  mesh: Optional[Mesh]) -> Dict[str, jax.Array]:
     if mesh is None:
         return params
     specs = param_specs(config)
+    if _pp_size(mesh) > 1:
+        # Pipeline stages own contiguous layer blocks: layer-stacked
+        # params shard their leading L axis over 'pp'
+        # (parallel/pipeline_serving.py consumes these shards).
+        from production_stack_tpu.models.llama import _layer_param_names
+        for name in _layer_param_names(config):
+            if name in specs:
+                specs[name] = P("pp", *specs[name][1:])
 
     def place(name, value):
         spec = specs.get(name, P())
@@ -124,15 +143,19 @@ def shard_params(params: Dict[str, jax.Array], config: ModelConfig,
             for name, value in params.items()}
 
 
-def cache_spec() -> P:
-    """KV cache [L, kv_heads, pages, page_size, head_dim]: shard heads."""
+def cache_spec(mesh: Optional[Mesh] = None) -> P:
+    """KV cache [L, kv_heads, pages, page_size, head_dim]: shard heads
+    over tp; with pipeline parallelism each stage also owns its own
+    layers' pages (L over pp)."""
+    if _pp_size(mesh) > 1:
+        return P("pp", "tp", None, None, None)
     return P(None, "tp", None, None, None)
 
 
 def shard_cache(cache: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
     if mesh is None:
         return cache
-    return jax.device_put(cache, NamedSharding(mesh, cache_spec()))
+    return jax.device_put(cache, NamedSharding(mesh, cache_spec(mesh)))
 
 
 def replicated(mesh: Optional[Mesh]):

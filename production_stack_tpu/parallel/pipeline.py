@@ -114,48 +114,52 @@ def pipeline_forward(params: Params, config: ModelConfig,
     layer_specs = {k: P(pp_axis) for k in layer_params}
     none_spec = P(*([None] * 0))
 
+    if M % S:
+        raise ValueError(
+            f"microbatches {M} must divide by pp size {S} (outputs "
+            "shard M over the stages)")
+    mps = M // S  # microbatches homed per stage
+
     def stage_fn(layer_local, shared_p, tokens_all):
         stage = jax.lax.axis_index(pp_axis)
         ticks = M + S - 1
         # Microbatch views: [M, mb, T]
         mbs = tokens_all.reshape(M, mb, t)
         h = config.hidden_size
+        dtype = shared_p["embed"].dtype
+        shift = [(i, (i + 1) % S) for i in range(S)]
 
-        def tick(carry, t_idx):
-            recv, collected = carry
+        # The tick loop is UNROLLED (M + S - 1 is small and static) so
+        # every collective uses a static permutation. Finished
+        # microbatch m is delivered straight from the last stage to its
+        # home stage m // mps — one [mb,T,H] hop each — and outputs
+        # stay SHARDED over pp (out_specs P(pp_axis)); no full-tensor
+        # psum broadcast (round-1 review finding).
+        recv = jnp.zeros((mb, t, h), dtype)
+        collected = jnp.zeros((mps, mb, t, h), dtype)
+        for t_idx in range(ticks):
             # Stage 0 feeds microbatch t_idx (clamped; bubble ticks
             # re-embed a stale microbatch and are ignored downstream).
-            m_idx = jnp.clip(t_idx, 0, M - 1)
+            m_idx = min(t_idx, M - 1)
             embedded = shared_p["embed"][mbs[m_idx]]
-            x = jnp.where(stage == 0, embedded.astype(recv.dtype),
-                          recv)
+            x = jnp.where(stage == 0, embedded.astype(dtype), recv)
             x = _layer_block(x, layer_local, config, positions)
-            # Shift activations to the next stage; the last stage's
-            # output wraps to stage 0 where it is ignored.
-            perm = [(i, (i + 1) % S) for i in range(S)]
-            sent = jax.lax.ppermute(x, pp_axis, perm)
-            # Last stage collects microbatch t_idx - (S - 1).
-            out_idx = jnp.clip(t_idx - (S - 1), 0, M - 1)
-            take = (stage == S - 1) & (t_idx >= S - 1)
-            collected = jnp.where(
-                take,
-                collected.at[out_idx].set(x),
-                collected,
-            )
-            return (sent, collected), None
-
-        init = (
-            jnp.zeros((mb, t, h), shared_p["embed"].dtype),
-            jnp.zeros((M, mb, t, h), shared_p["embed"].dtype),
-        )
-        (_, collected), _ = jax.lax.scan(
-            tick, init, jnp.arange(ticks)
-        )
-        # Only the last stage holds real data; sum-broadcast it.
-        collected = jnp.where(stage == S - 1, collected, 0.0)
-        collected = jax.lax.psum(collected, pp_axis)
-        x = rms_norm(collected.reshape(b, t, h), shared_p["final_norm"],
-                     config.rms_norm_eps)
+            recv = jax.lax.ppermute(x, pp_axis, shift)
+            m_done = t_idx - (S - 1)
+            if m_done >= 0:
+                home, slot = m_done // mps, m_done % mps
+                if home == S - 1:
+                    delivered = x  # already on the last stage
+                else:
+                    delivered = jax.lax.ppermute(
+                        x, pp_axis, [(S - 1, home)])
+                collected = jnp.where(
+                    stage == home,
+                    collected.at[slot].set(delivered),
+                    collected,
+                )
+        x = rms_norm(collected.reshape(mps * mb, t, h),
+                     shared_p["final_norm"], config.rms_norm_eps)
         head = shared_p.get("lm_head")
         if head is None:
             head = shared_p["embed"].T
@@ -165,10 +169,13 @@ def pipeline_forward(params: Params, config: ModelConfig,
         stage_fn, mesh=mesh,
         in_specs=(layer_specs, {k: none_spec for k in shared},
                   none_spec),
-        out_specs=none_spec,
+        out_specs=P(pp_axis),
         check_vma=False,
     )
-    return fn(layer_params, shared, tokens)
+    # Device s returns its mps home microbatches; the pp-sharded global
+    # result is already in microbatch order (homes are contiguous
+    # blocks), so a reshape recovers [B, T, vocab].
+    return fn(layer_params, shared, tokens).reshape(b, t, -1)
 
 
 def shard_params_pipeline(params: Params, config: ModelConfig,

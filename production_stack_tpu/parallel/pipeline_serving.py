@@ -1,0 +1,193 @@
+"""Pipeline-parallel SERVING forward: paged-KV layer stages over ``pp``.
+
+The reference stack exposes no pipeline parallelism (SURVEY.md §2.6 —
+vLLM TP only via --tensor-parallel-size pass-through); this is the
+TPU-native extension that serves models deeper than one chip/slice's
+HBM. Unlike ``parallel/pipeline.py`` (a dense training-style forward),
+this implements the ENGINE's forward contract — paged KV cache writes,
+chunked prefill, decode — so ``--pipeline-parallel-size N`` is a real
+serving flag (engine/server.py).
+
+Design (idiomatic JAX, static shapes):
+- Layer-stacked params and the KV caches shard their leading L axis
+  over the ``pp`` mesh axis; each stage owns L/S layers and those
+  layers' KV pages. Embedding/head replicate.
+- One ``shard_map`` body runs a static tick loop (M microbatches over
+  the batch rows, S stages, M+S-1 ticks). At tick i, stage s runs its
+  local layer scan on microbatch i-s; activations hop stage-to-stage
+  with ``ppermute`` over ICI/DCN.
+- Bubble ticks compute on don't-care data; their KV writes are masked
+  via the ``valid`` mask, which ``ops.attention.write_to_pages``
+  redirects to the trash page (page 0) — no cache corruption, no
+  dynamic shapes.
+- The final hidden states (NOT logits: H << vocab, 16x less traffic)
+  are returned to every stage with one masked psum; each stage then
+  computes the replicated logits locally. This replaces the training
+  pipeline's full-activation psum the round-1 review flagged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.models.llama import (
+    _layer_param_names,
+    dispatch_attention,
+    rms_norm,
+)
+from production_stack_tpu.ops.attention import write_to_pages
+from production_stack_tpu.ops.rope import apply_rope
+
+Params = Dict[str, jnp.ndarray]
+
+
+def _num_microbatches(batch: int, stages: int) -> int:
+    """Largest microbatch count <= stages that divides the batch (1 =
+    sequential fill/drain; == stages hides the bubble best)."""
+    for m in range(min(batch, stages), 0, -1):
+        if batch % m == 0:
+            return m
+    return 1
+
+
+def _local_layers(x, lp, k_local, v_local, page_table, positions,
+                  kv_lens, valid, config: ModelConfig):
+    """One stage's layer scan — the paged layer math of
+    models/llama.py:forward (layer_step), minus LoRA (pp+LoRA is
+    rejected at engine build)."""
+    nh, nkv, d = (config.num_attention_heads,
+                  config.num_key_value_heads, config.head_dim)
+    b, t = positions.shape
+
+    def layer_step(x, scanned):
+        lp_i, k_layer, v_layer = scanned
+        a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
+        q = a_in @ lp_i["wq"]
+        k = a_in @ lp_i["wk"]
+        v = a_in @ lp_i["wv"]
+        if config.attention_bias:
+            q, k, v = q + lp_i["bq"], k + lp_i["bk"], v + lp_i["bv"]
+        q = apply_rope(q.reshape(b, t, nh, d), positions,
+                       config.rope_theta)
+        k = apply_rope(k.reshape(b, t, nkv, d), positions,
+                       config.rope_theta)
+        v = v.reshape(b, t, nkv, d)
+        k_layer = write_to_pages(k_layer, k, page_table, positions,
+                                 valid)
+        v_layer = write_to_pages(v_layer, v, page_table, positions,
+                                 valid)
+        attn = dispatch_attention(
+            config, q, k_layer, v_layer, page_table, positions, kv_lens
+        )
+        x = x + attn.reshape(b, t, nh * d) @ lp_i["wo"]
+        m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
+        x = x + (jax.nn.silu(m_in @ lp_i["w_gate"])
+                 * (m_in @ lp_i["w_up"])) @ lp_i["w_down"]
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (lp, k_local, v_local)
+    )
+    return x, new_k, new_v
+
+
+def pp_paged_forward(params: Params, config: ModelConfig,
+                     tokens: jnp.ndarray, positions: jnp.ndarray,
+                     page_table: jnp.ndarray, kv_lens: jnp.ndarray,
+                     valid: jnp.ndarray, k_cache: jnp.ndarray,
+                     v_cache: jnp.ndarray, lora=None, lora_ids=None,
+                     *, mesh: Mesh,
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Engine forward contract (models/llama.py:forward signature) with
+    layers pipelined over the mesh's ``pp`` axis.
+
+    k_cache/v_cache carry their GLOBAL shape [L, kv, pages, ps, d] but
+    are sharded P('pp') on L; inside the shard_map body each stage sees
+    its local [L/S, ...] slice.
+    """
+    if lora is not None:
+        raise NotImplementedError("LoRA with pipeline parallelism")
+    S = mesh.shape["pp"]
+    b, t = tokens.shape
+    M = _num_microbatches(b, S)
+    mb = b // M
+
+    layer_names = _layer_param_names(config)
+    layer_params = {k: params[k] for k in layer_names}
+    shared = {k: v for k, v in params.items() if k not in layer_names}
+    max_pages = page_table.shape[1]
+
+    def body(lp, shared_p, kc, vc, tokens, positions, page_table,
+             kv_lens, valid):
+        stage = jax.lax.axis_index("pp")
+        mtok = tokens.reshape(M, mb, t)
+        mpos = positions.reshape(M, mb, t)
+        mpt = page_table.reshape(M, mb, max_pages)
+        mkv = kv_lens.reshape(M, mb)
+        mvalid = valid.reshape(M, mb, t)
+        h = config.hidden_size
+        dtype = shared_p["embed"].dtype
+        ticks = M + S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, i):
+            x_recv, kc, vc, collected = carry
+            # Stage s processes microbatch i - s at tick i.
+            m_s = jnp.clip(i - stage, 0, M - 1)
+            active = (i >= stage) & (i - stage < M)
+            emb = shared_p["embed"][mtok[m_s]].astype(dtype)
+            x_in = jnp.where(stage == 0, emb, x_recv)
+            # Bubble ticks must not touch the cache: a False valid
+            # redirects the write to the trash page (ops/attention.py
+            # write_to_pages).
+            v_mask = mvalid[m_s] & active
+            x_new, kc, vc = _local_layers(
+                x_in, lp, kc, vc, mpt[m_s], mpos[m_s], mkv[m_s],
+                v_mask, config,
+            )
+            # Last stage banks microbatch i - (S - 1) once it's real.
+            take = (stage == S - 1) & (i >= S - 1)
+            banked = collected.at[jnp.clip(i - (S - 1), 0, M - 1)].set(
+                x_new)
+            collected = jnp.where(take, banked, collected)
+            x_send = jax.lax.ppermute(x_new, "pp", perm)
+            return (x_send, kc, vc, collected), None
+
+        init = (
+            jnp.zeros((mb, t, h), dtype),
+            kc, vc,
+            jnp.zeros((M, mb, t, h), dtype),
+        )
+        (_, kc, vc, collected), _ = jax.lax.scan(
+            tick, init, jnp.arange(ticks)
+        )
+        # Return the final HIDDEN states to every stage (one masked
+        # psum of [B, T, H] — serving shapes keep this small) and
+        # compute the replicated logits locally.
+        collected = jnp.where(stage == S - 1, collected, 0.0)
+        hidden = jax.lax.psum(collected, "pp").reshape(b, t, h)
+        x = rms_norm(hidden, shared_p["final_norm"],
+                     config.rms_norm_eps)
+        head = shared_p.get("lm_head")
+        if head is None:
+            head = shared_p["embed"].T
+        logits = (x @ head).astype(jnp.float32)
+        return logits, kc, vc
+
+    pp_only = P("pp")
+    repl = P()
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=({k: pp_only for k in layer_params},
+                  {k: repl for k in shared},
+                  pp_only, pp_only, repl, repl, repl, repl, repl),
+        out_specs=(repl, pp_only, pp_only),
+        check_vma=False,
+    )
+    return fn(layer_params, shared, k_cache, v_cache, tokens,
+              positions, page_table, kv_lens, valid)

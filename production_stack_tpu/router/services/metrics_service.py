@@ -36,6 +36,12 @@ pending_reserved_blocks = Gauge(
     _LBL)
 num_free_blocks = Gauge(
     "vllm:num_free_blocks", "Number of free KV blocks", _LBL)
+router_queueing_delay = Gauge(
+    "vllm:router_queueing_delay_seconds",
+    "Router-side queueing delay (arrival to admission)", _LBL)
+avg_prefill_length = Gauge(
+    "vllm:avg_prefill_length",
+    "Average prompt length of routed requests (tokens)", _LBL)
 
 
 def refresh_gauges() -> None:
@@ -65,6 +71,10 @@ def refresh_gauges() -> None:
         pending_reserved_blocks.labels(server=server).set(
             stat.pending_reserved_blocks)
         num_free_blocks.labels(server=server).set(stat.num_free_blocks)
+        router_queueing_delay.labels(server=server).set(
+            stat.queueing_delay)
+        avg_prefill_length.labels(server=server).set(
+            stat.avg_prefill_length)
     try:
         for ep in get_service_discovery().get_endpoint_info():
             healthy_pods_total.labels(server=ep.url).set(1)

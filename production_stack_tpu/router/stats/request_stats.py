@@ -15,6 +15,7 @@ from __future__ import annotations
 import math
 import os
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Set, Tuple
@@ -52,6 +53,11 @@ class RequestStats:
     avg_latency: float = -1.0
     avg_itl: float = -1.0
     num_swapped_requests: int = 0
+    # Router-side queueing delay (arrival -> routed admission), the
+    # reference dashboard's "Router-side Queueing Delay" metric.
+    queueing_delay: float = -1.0
+    # Average prompt length of recently routed requests (tokens).
+    avg_prefill_length: float = -1.0
     # KV block accounting (fork feature).
     allocated_blocks: int = 0
     pending_reserved_blocks: int = 0
@@ -109,6 +115,9 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
         self._ttft: Dict[str, SlidingWindow] = {}
         self._latency: Dict[str, SlidingWindow] = {}
         self._decode_len: Dict[str, SlidingWindow] = {}
+        self._queue_delay: Dict[str, SlidingWindow] = {}
+        self._prefill_len: Dict[str, SlidingWindow] = {}
+        self._itl: Dict[str, SlidingWindow] = {}
 
         self._arrival_time: Dict[str, float] = {}
         self._first_token_time: Dict[Tuple[str, str], float] = {}
@@ -132,13 +141,27 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
                 self._first_query_time = timestamp
 
     def on_request_routed(self, engine_url: str, request_id: str,
-                          prefill_tokens: int) -> None:
-        """Admission decision made: account reserved prefill tokens."""
+                          prefill_tokens: int,
+                          timestamp: Optional[float] = None) -> None:
+        """Admission decision made: account reserved prefill tokens,
+        record the router-side queueing delay (arrival -> admission —
+        nonzero mainly under HRA's future-based admission queue) and
+        the prompt length."""
+        now = time.time() if timestamp is None else timestamp
         with self._lock:
             self._prefill_tokens.setdefault(engine_url, {})[request_id] = (
                 prefill_tokens
             )
             self._in_prefill.setdefault(engine_url, set()).add(request_id)
+            arrived = self._arrival_time.get(request_id)
+            if arrived is not None:
+                self._queue_delay.setdefault(
+                    engine_url, SlidingWindow(self.window_s)
+                ).observe(now, max(0.0, now - arrived))
+            if prefill_tokens > 0:
+                self._prefill_len.setdefault(
+                    engine_url, SlidingWindow(self.window_s)
+                ).observe(now, float(prefill_tokens))
 
     def on_request_start(self, engine_url: str, request_id: str,
                          timestamp: float) -> None:
@@ -182,6 +205,12 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
             self._decode_len.setdefault(
                 engine_url, SlidingWindow(self.window_s)
             ).observe(timestamp, dec)
+            n_tokens = self._decode_tokens.get(engine_url, {}).get(
+                request_id, 0)
+            if n_tokens > 1:
+                self._itl.setdefault(
+                    engine_url, SlidingWindow(self.window_s)
+                ).observe(timestamp, dec / (n_tokens - 1))
             self._cleanup_locked(engine_url, request_id)
 
     def on_request_kill(self, engine_url: str, request_id: str) -> None:
@@ -238,6 +267,15 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
 
     # ---- snapshot ---------------------------------------------------------
 
+    @staticmethod
+    def _window_avg(table: Dict[str, SlidingWindow], url: str,
+                    now: float) -> float:
+        win = table.get(url)
+        if win is None:
+            return -1.0
+        win.advance(now)
+        return win.average()
+
     def get_request_stats(self, current_time: float) -> Dict[str, RequestStats]:
         with self._lock:
             out: Dict[str, RequestStats] = {}
@@ -247,18 +285,16 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
                 if url in self._qps:
                     self._qps[url].advance(current_time)
                     qps = self._qps[url].total() / self.window_s
-                ttft = -1.0
-                if url in self._ttft:
-                    self._ttft[url].advance(current_time)
-                    ttft = self._ttft[url].average()
-                avg_dec = -1.0
-                if url in self._decode_len:
-                    self._decode_len[url].advance(current_time)
-                    avg_dec = self._decode_len[url].average()
-                avg_lat = -1.0
-                if url in self._latency:
-                    self._latency[url].advance(current_time)
-                    avg_lat = self._latency[url].average()
+                ttft = self._window_avg(self._ttft, url, current_time)
+                avg_dec = self._window_avg(self._decode_len, url,
+                                           current_time)
+                avg_lat = self._window_avg(self._latency, url,
+                                           current_time)
+                qdelay = self._window_avg(self._queue_delay, url,
+                                          current_time)
+                avg_plen = self._window_avg(self._prefill_len, url,
+                                            current_time)
+                avg_itl = self._window_avg(self._itl, url, current_time)
 
                 prefill_ids = self._in_prefill.get(url, set())
                 decode_ids = self._in_decode.get(url, set())
@@ -283,7 +319,9 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
                             if self._first_query_time else 0.0),
                     avg_decoding_length=avg_dec,
                     avg_latency=avg_lat,
-                    avg_itl=-1.0,
+                    avg_itl=avg_itl,
+                    queueing_delay=qdelay,
+                    avg_prefill_length=avg_plen,
                     num_swapped_requests=self._swapped.get(url, 0),
                     allocated_blocks=allocated,
                     pending_reserved_blocks=reserved,

@@ -27,6 +27,7 @@ def schema():
     "helm/values.yaml",
     "tutorials/assets/values-01-minimal-example.yaml",
     "tutorials/assets/values-02-two-pods-session.yaml",
+    "tutorials/assets/values-03-pvc-prefetch.yaml",
     "tutorials/assets/values-06-remote-shared-kv.yaml",
     "tutorials/assets/values-08-lora.yaml",
 ])
@@ -112,7 +113,7 @@ def test_dashboard_metrics_exist():
     queried = set()
     for p in dashboard["panels"]:
         for t in p.get("targets", []):
-            queried.update(re.findall(r"vllm:[a-z_]+", t["expr"]))
+            queried.update(re.findall(r"vllm:[a-z0-9_]+", t["expr"]))
     from production_stack_tpu.router.services import metrics_service
     from prometheus_client import Gauge
     exported = {
@@ -120,9 +121,19 @@ def test_dashboard_metrics_exist():
         for g in vars(metrics_service).values()
         if isinstance(g, Gauge)
     }
+    # Engine-side series: gauges the engine server exports directly,
+    # plus every name EngineMetrics.render() emits (histograms expand
+    # to _bucket/_sum/_count in Prometheus).
     engine_metrics = {
         "vllm:num_requests_running", "vllm:num_requests_waiting",
         "vllm:gpu_cache_usage_perc", "vllm:gpu_prefix_cache_hit_rate",
     }
+    from production_stack_tpu.engine.metrics import EngineMetrics
+    for line in EngineMetrics().render():
+        for name in re.findall(r"vllm:[a-z0-9_]+", line):
+            engine_metrics.add(name)
+            if line.startswith("# TYPE") and "histogram" in line:
+                engine_metrics.update(
+                    {f"{name}_bucket", f"{name}_sum", f"{name}_count"})
     missing = queried - exported - engine_metrics
     assert not missing, f"dashboard queries unexported metrics: {missing}"

@@ -206,3 +206,22 @@ def test_engine_latency_histograms_after_traffic():
         assert 'vllm:request_success_total{finished_reason="length"} 1' \
             in text
     asyncio.run(_with_client(run))
+
+
+def test_chat_template_override():
+    """--chat-template Jinja source takes priority over the default
+    role-tagged rendering (reference chart's chatTemplate knob)."""
+    from production_stack_tpu.engine.tokenizer import (
+        ByteTokenizer,
+        render_chat_prompt,
+    )
+    tok = ByteTokenizer()
+    messages = [{"role": "user", "content": "hi"}]
+    tpl = "{% for m in messages %}[{{ m.role }}]{{ m.content }}{% endfor %}>>"
+    ids = render_chat_prompt(tok, messages, chat_template=tpl)
+    assert tok.decode(ids) == "[user]hi>>"
+    # A broken template falls back to the default rendering (loudly).
+    bad = render_chat_prompt(tok, messages,
+                             chat_template="{{ undefined_fn() }}")
+    default = render_chat_prompt(tok, messages, chat_template=None)
+    assert bad == default and tok.decode(bad) != ""

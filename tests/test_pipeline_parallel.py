@@ -81,3 +81,85 @@ def test_pipeline_rejects_bad_shapes():
     tokens = jnp.zeros((4, 8), jnp.int32)
     with pytest.raises(ValueError, match="divide"):
         pipeline_forward(params, config, tokens, mesh)
+
+
+# ---- serving-path pipeline parallelism (parallel/pipeline_serving.py) ----
+
+
+def _pp_engine(pp):
+    """Full LLMEngine on a (dp=1, pp, tp=1) mesh."""
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    model = tiny_model_config("llama")
+    model.num_hidden_layers = 4  # divisible by every pp size tested
+    config = EngineConfig(
+        model=model,
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=128,
+                                  prefill_chunk_size=32,
+                                  prefill_batch_size=2),
+        parallel=ParallelConfig(pipeline_parallel_size=pp),
+    )
+    mesh = build_mesh(pipeline_parallel_size=pp) if pp > 1 else None
+    return LLMEngine(config, mesh=mesh)
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_pp_engine_serves_and_matches_single_device(pp):
+    """--pipeline-parallel-size N is a SERVING feature: the engine
+    (chunked prefill + paged KV + continuous batching) runs with layers
+    staged over pp and reproduces the single-device greedy output."""
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    sampling = lambda: SamplingParams(  # noqa: E731
+        max_tokens=8, temperature=0.0, ignore_eos=True)
+    prompts = [list(range(2, 2 + n)) for n in (18, 7, 33)]
+
+    ref_engine = _pp_engine(1)
+    ref = [ref_engine.generate(p, sampling()).output_token_ids
+           for p in prompts]
+
+    pp_engine = _pp_engine(pp)
+    seqs = [pp_engine.sequences[pp_engine.add_request(p, sampling())]
+            for p in prompts]
+    while pp_engine.has_work():
+        pp_engine.step()
+    got = [s.output_token_ids for s in seqs]
+    assert got == ref
+
+
+def test_pp_engine_rejects_bad_configs():
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, LoRAConfig, ParallelConfig,
+        SchedulerConfig, tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(pipeline_parallel_size=2)
+    base = dict(
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=128,
+                                  prefill_chunk_size=32),
+    )
+    with pytest.raises(NotImplementedError, match="llama family"):
+        LLMEngine(EngineConfig(
+            model=tiny_model_config("opt"),
+            parallel=ParallelConfig(pipeline_parallel_size=2),
+            **base), mesh=mesh)
+    with pytest.raises(NotImplementedError, match="LoRA"):
+        LLMEngine(EngineConfig(
+            model=tiny_model_config("llama"),
+            parallel=ParallelConfig(pipeline_parallel_size=2),
+            lora=LoRAConfig(enable=True),
+            **base), mesh=mesh)
+    with pytest.raises(ValueError, match="mesh with a 'pp' axis"):
+        LLMEngine(EngineConfig(
+            model=tiny_model_config("llama"),
+            parallel=ParallelConfig(pipeline_parallel_size=2),
+            **base), mesh=None)

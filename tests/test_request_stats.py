@@ -112,3 +112,25 @@ def test_qps_sliding_window():
     # At t=20, only arrivals in (10, 20] remain: 10 requests over 10 s.
     stats = m.get_request_stats(20.0)
     assert abs(stats[URL].qps - 1.0) < 0.11
+
+
+def test_queueing_delay_prefill_length_and_itl():
+    """The dashboard's QoS metrics: queueing delay (arrival->routed),
+    avg prefill length, and per-request ITL on completion."""
+    m = make_monitor()
+    t = 2000.0
+    m.on_request_arrival("q1", t)
+    m.on_request_routed(URL, "q1", prefill_tokens=100, timestamp=t + 0.2)
+    m.on_request_start(URL, "q1", t + 0.21)
+    stats = m.get_request_stats(t + 0.3)
+    assert abs(stats[URL].queueing_delay - 0.2) < 1e-6
+    assert abs(stats[URL].avg_prefill_length - 100.0) < 1e-6
+
+    # 1 first token + 4 more tokens over 0.8 s decode -> ITL = 0.2 s.
+    m.on_request_response(URL, "q1", t + 0.5, is_first_token=True)
+    for i in range(4):
+        m.on_request_response(URL, "q1", t + 0.5 + (i + 1) * 0.2,
+                              is_first_token=False)
+    m.on_request_complete(URL, "q1", t + 1.3)
+    stats = m.get_request_stats(t + 1.4)
+    assert abs(stats[URL].avg_itl - 0.2) < 1e-6
