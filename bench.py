@@ -131,15 +131,17 @@ def _bench_config(tpu: bool):
             max_position_embeddings=2048,
             dtype="bfloat16",
         )
-        cache = CacheConfig(page_size=16, num_pages=2048)
-        # prefill_batch_size packs waiting prompts into fat prefill
-        # programs; decode_steps=8 fuses 8 decode iterations per host
-        # round-trip (out_len 64 = 8 full windows).
-        sched = SchedulerConfig(max_num_seqs=8, max_model_len=1024,
+        # page_size 128 = one lane tile per page: the Pallas kernels
+        # DMA whole tile-aligned pages (ops/paged_attention_pallas.py).
+        cache = CacheConfig(page_size=128, num_pages=512)
+        # Fat device programs, few host syncs: 32-wide decode with
+        # 32-step on-device bursts (per-row budgets/stops evaluated in
+        # the compiled program), 8-prompt batched prefill chunks.
+        sched = SchedulerConfig(max_num_seqs=32, max_model_len=1024,
                                 prefill_chunk_size=512,
-                                prefill_batch_size=4,
-                                decode_steps=8)
-        n_requests, prompt_len, out_len = 24, 512, 64
+                                prefill_batch_size=8,
+                                decode_steps=32)
+        n_requests, prompt_len, out_len = 48, 512, 64
     else:  # CPU fallback: tiny model, same code path
         from production_stack_tpu.engine.config import tiny_model_config
         model = tiny_model_config("llama")
@@ -223,6 +225,12 @@ def main() -> None:
         warm = engine.generate(make_prompt(-1), sampling())
     assert len(warm.output_token_ids) == out_len
 
+    # Optional profiler capture of the timed region (BENCH_PROFILE=
+    # <dir>); inspect with tensorboard's profile plugin or xprof.
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+
     # Closed-loop timed run.
     t0 = time.time()
     seqs = []
@@ -236,6 +244,8 @@ def main() -> None:
                               SequenceState.ABORTED) for s in seqs):
         engine.step()
     wall = time.time() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     ttfts = sorted(
         s.first_token_time - submit_times[s.seq_id]

@@ -1,0 +1,195 @@
+"""Attention kernel microbenchmark: Pallas page-walk vs XLA gather.
+
+Times the decode and prefill attention implementations in isolation on
+the current backend (intended for the real TPU chip) across batch,
+context length, and page size — the per-kernel evidence VERDICT round 2
+asked for ("kernel-vs-XLA microbench table, B=8-32, 2-16k ctx").
+
+Writes a JSON table to ``--out`` (default
+benchmarks/results/kernel_microbench.json) and prints a markdown table.
+
+Usage:
+    python benchmarks/kernel_microbench.py            # full sweep
+    python benchmarks/kernel_microbench.py --quick    # CI smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_state(b, ctx, page_size, kv_heads, head_dim, max_ctx,
+                dtype):
+    """Random cache + page tables for ``b`` sequences of ``ctx`` tokens."""
+    import jax.numpy as jnp
+    max_pages_per_seq = -(-max_ctx // page_size)
+    num_pages = b * max_pages_per_seq + 2
+    rng = np.random.RandomState(0)
+    kc = jnp.asarray(
+        rng.randn(kv_heads, num_pages, page_size, head_dim),
+        dtype)
+    vc = jnp.asarray(
+        rng.randn(kv_heads, num_pages, page_size, head_dim),
+        dtype)
+    pt = np.zeros((b, max_pages_per_seq), np.int32)
+    nxt = 1
+    for i in range(b):
+        for j in range(-(-ctx // page_size)):
+            pt[i, j] = nxt
+            nxt += 1
+    kl = np.full((b,), ctx, np.int32)
+    return kc, vc, jnp.asarray(pt), jnp.asarray(kl)
+
+
+def _time(fn, *args, iters=20, warmup=3):
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_decode(b, ctx, page_size, *, kv_heads=8, q_heads=32,
+                 head_dim=64, max_ctx=None, iters=20):
+    import jax
+    import jax.numpy as jnp
+    from production_stack_tpu.ops.attention import paged_attention
+    from production_stack_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention,
+    )
+    max_ctx = max_ctx or ctx
+    dtype = jnp.bfloat16
+    kc, vc, pt, kl = _make_state(
+        b, ctx, page_size, kv_heads, head_dim, max_ctx, dtype)
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(b, q_heads, head_dim), dtype)
+
+    # Jit BOTH paths: in the engine each runs inside the jitted
+    # forward — timing the XLA path eagerly would charge it per-op
+    # dispatch overhead it never pays in serving.
+    xla = jax.jit(lambda q, kc, vc, pt, kl: paged_attention(
+        q[:, None], kc, vc, pt, (kl - 1)[:, None], kl))
+    t_pallas = _time(
+        lambda: paged_decode_attention(q, kc, vc, pt, kl),
+        iters=iters)
+    t_xla = _time(lambda: xla(q, kc, vc, pt, kl), iters=iters)
+    return t_pallas, t_xla
+
+
+def bench_prefill(b, t, prior_ctx, page_size, *, kv_heads=8,
+                  q_heads=32, head_dim=64, max_ctx=None, iters=20):
+    import jax.numpy as jnp
+    from production_stack_tpu.ops.attention import paged_attention
+    from production_stack_tpu.ops.prefill_attention_pallas import (
+        paged_prefill_attention,
+    )
+    ctx = prior_ctx + t
+    max_ctx = max_ctx or ctx
+    dtype = jnp.bfloat16
+    kc, vc, pt, kl = _make_state(
+        b, ctx, page_size, kv_heads, head_dim, max_ctx, dtype)
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(b, t, q_heads, head_dim), dtype)
+    pos = jnp.asarray(
+        np.broadcast_to(
+            np.arange(prior_ctx, prior_ctx + t, dtype=np.int32)[None],
+            (b, t)).copy())
+
+    import jax
+    xla = jax.jit(paged_attention)
+    t_pallas = _time(
+        lambda: paged_prefill_attention(q, kc, vc, pt, pos, kl),
+        iters=iters)
+    t_xla = _time(lambda: xla(q, kc, vc, pt, pos, kl), iters=iters)
+    return t_pallas, t_xla
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny sweep (CI smoke)")
+    ap.add_argument("--out",
+                    default="benchmarks/results/kernel_microbench.json")
+    args = ap.parse_args()
+
+    import jax
+    device = jax.devices()[0]
+    print(f"# backend: {jax.default_backend()} "
+          f"({device.device_kind})")
+
+    rows = []
+    if args.quick:
+        decode_cases = [(8, 512, 16)]
+        prefill_cases = [(4, 128, 0, 16)]
+        iters = 3
+    else:
+        decode_cases = [
+            (b, ctx, ps)
+            for ps in (16, 64, 128)
+            for b, ctx in ((8, 512), (8, 2048), (16, 2048),
+                           (32, 2048), (32, 8192), (8, 16384))
+        ]
+        prefill_cases = [
+            (b, t, prior, ps)
+            for ps in (16, 64, 128)
+            for b, t, prior in ((4, 512, 0), (4, 512, 1536),
+                                (8, 512, 1536), (4, 512, 7680),
+                                (1, 512, 15872))
+        ]
+        iters = 20
+
+    for b, ctx, ps in decode_cases:
+        t_pal, t_xla = bench_decode(b, ctx, ps, iters=iters)
+        rows.append({
+            "kind": "decode", "batch": b, "ctx": ctx,
+            "page_size": ps, "pallas_us": round(t_pal * 1e6, 1),
+            "xla_us": round(t_xla * 1e6, 1),
+            "speedup": round(t_xla / t_pal, 2),
+        })
+        print(rows[-1])
+    for b, t, prior, ps in prefill_cases:
+        t_pal, t_xla = bench_prefill(b, t, prior, ps, iters=iters)
+        rows.append({
+            "kind": "prefill", "batch": b, "chunk": t,
+            "prior_ctx": prior, "page_size": ps,
+            "pallas_us": round(t_pal * 1e6, 1),
+            "xla_us": round(t_xla * 1e6, 1),
+            "speedup": round(t_xla / t_pal, 2),
+        })
+        print(rows[-1])
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump({
+            "backend": jax.default_backend(),
+            "device_kind": device.device_kind,
+            "rows": rows,
+        }, f, indent=1)
+    print(f"# wrote {args.out}")
+
+    # Markdown table for the docs.
+    print("\n| kind | B | ctx/chunk | page | pallas µs | xla µs | "
+          "xla/pallas |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        ctx = r.get("ctx", f"{r.get('chunk')}+{r.get('prior_ctx')}")
+        print(f"| {r['kind']} | {r['batch']} | {ctx} | "
+              f"{r['page_size']} | {r['pallas_us']} | {r['xla_us']} | "
+              f"{r['speedup']} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,7 @@ import numpy as np
 
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.scheduler import DecodePlan, PrefillPlan
-from production_stack_tpu.engine.sequence import Sequence
+from production_stack_tpu.engine.sequence import Sequence, decode_budget
 from production_stack_tpu.models.registry import get_model
 from production_stack_tpu.ops.sampling import sample_tokens
 from production_stack_tpu.parallel.mesh import (
@@ -28,6 +28,13 @@ from production_stack_tpu.parallel.mesh import (
 from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
+
+# Fixed per-row stop-set width for the decode burst: one compiled
+# shape regardless of batch composition (a data-dependent width would
+# recompile the fused K-step program mid-serving). Requests with more
+# stop ids than this still finish correctly — the host enforces the
+# full set; the burst merely speculates a little further.
+STOP_SET_WIDTH = 16
 
 
 def prefill_buckets(chunk_size: int) -> List[int]:
@@ -49,8 +56,21 @@ class ModelRunner:
             model_config.attention_impl = (
                 "xla" if jax.default_backend() == "cpu" else "pallas"
             )
-            logger.info("Decode attention impl: %s",
-                        model_config.attention_impl)
+        if (model_config.attention_impl == "pallas"
+                and jax.default_backend() != "cpu"):
+            # Per-kernel Mosaic lowering probe at the engine's real
+            # shapes: decode and prefill degrade to XLA independently
+            # (round-2 failure mode was a *global* fallback that threw
+            # away the working decode kernel when prefill didn't
+            # compile). Lowering runs Pallas's Mosaic rules (tiling,
+            # layouts, scalar prefetch) without burning a full compile.
+            self._resolve_pallas_impls(model_config, config)
+        logger.info(
+            "Attention impls: decode=%s prefill=%s",
+            model_config.attention_impl_decode
+            or model_config.attention_impl,
+            model_config.attention_impl_prefill
+            or model_config.attention_impl)
         self._init_fn, self._forward = get_model(model_config)
 
         pp = config.parallel.pipeline_parallel_size
@@ -107,15 +127,16 @@ class ModelRunner:
             params = quantize_params(params, model_config)
         self.params = shard_params(params, model_config, mesh)
 
-        # Head-major paged cache: [L, kv_heads, pages, page_size, d].
-        # The kv axis is major so the Pallas decode kernel's per-page
-        # blocks slice only major dims, and TP shards a leading axis.
+        # Head-major paged cache: [L, kv_heads, pages, d, page_size].
+        # The kv axis is major so TP shards a leading axis; pages are
+        # token-minor so the Pallas kernels DMA (d, 128)-tile-aligned
+        # page slices straight out of HBM (ops/paged_attention_pallas).
         cache_shape = (
             model_config.num_hidden_layers,
             model_config.num_key_value_heads,
             config.cache.num_pages,
-            config.cache.page_size,
             model_config.head_dim,
+            config.cache.page_size,
         )
         dtype = model_config.jax_dtype
         self.k_cache = shard_cache(jnp.zeros(cache_shape, dtype), mesh)
@@ -154,17 +175,95 @@ class ModelRunner:
             static_argnames=("sample_index_mode",),
             donate_argnums=(1, 2),  # k_cache, v_cache
         )
-        # Multi-step decode: K decode iterations fused into one
-        # compiled program via lax.scan — sampled tokens feed back on
-        # device, so the host pays one dispatch + one device_get per K
-        # tokens instead of per token (vLLM's --num-scheduler-steps
-        # analogue, but as a single XLA program instead of queued
-        # kernel launches).
-        self._decode_multi_jit = jax.jit(
-            self._decode_multi_impl,
+        # Decode burst: K decode iterations fused into one compiled
+        # program via lax.scan — sampled tokens feed back on device
+        # and per-sequence budgets + stop sets are evaluated on device
+        # too, so rows go inactive mid-burst without a host round-trip
+        # (vLLM's --num-scheduler-steps analogue, but as a single XLA
+        # program, and the window never collapses to 1 for
+        # mixed-progress batches). One dispatch + one device_get per K
+        # tokens; on a tunneled TPU (60 ms+ RTT per sync) this is the
+        # difference between host-bound and device-bound serving.
+        self._decode_burst_jit = jax.jit(
+            self._decode_burst_impl,
             static_argnames=("num_steps",),
             donate_argnums=(1, 2),  # k_cache, v_cache
         )
+
+    @staticmethod
+    def _lowering_error(fn, *args) -> Optional[str]:
+        try:
+            jax.jit(fn).trace(*args).lower(
+                lowering_platforms=("tpu",))
+            return None
+        except Exception as e:  # noqa: BLE001 — any lowering failure
+            return repr(e)[:400]
+
+    def _resolve_pallas_impls(self, model_config, config) -> None:
+        """Probe each Pallas kernel's TPU lowering at serving shapes."""
+        nh, nkv, d = (model_config.num_attention_heads,
+                      model_config.num_key_value_heads,
+                      model_config.head_dim)
+        dtype = model_config.jax_dtype
+        max_pages = config.scheduler.max_pages_per_seq(
+            config.cache.page_size)
+        cache = jax.ShapeDtypeStruct(
+            (nkv, config.cache.num_pages, d, config.cache.page_size),
+            dtype)
+
+        if config.cache.page_size % 128:
+            # The kernels DMA [head_dim, page_size] page slices out of
+            # HBM; Mosaic requires the minor dim be lane-tile (128)
+            # aligned. This is a *backend* rule the lowering probe
+            # below cannot see (it fires at Mosaic machine-code
+            # compile), so gate it explicitly.
+            logger.error(
+                "Pallas attention needs page_size %% 128 == 0 (got "
+                "%d); serving via XLA attention",
+                config.cache.page_size)
+            model_config.attention_impl_decode = "xla"
+            model_config.attention_impl_prefill = "xla"
+            return
+
+        from production_stack_tpu.ops.paged_attention_pallas import (
+            paged_decode_attention,
+        )
+        from production_stack_tpu.ops.prefill_attention_pallas import (
+            paged_prefill_attention,
+        )
+        b = config.scheduler.max_num_seqs
+        pb = config.scheduler.prefill_batch_size
+        probes = {
+            "decode": [(
+                paged_decode_attention,
+                (jax.ShapeDtypeStruct((b, nh, d), dtype), cache, cache,
+                 jax.ShapeDtypeStruct((b, max_pages), np.int32),
+                 jax.ShapeDtypeStruct((b,), np.int32)),
+            )],
+            # Serving compiles one prefill program per bucket — probe
+            # them all, not just the widest (a Mosaic rule can fail at
+            # one bucket shape only).
+            "prefill": [(
+                paged_prefill_attention,
+                (jax.ShapeDtypeStruct((pb, t, nh, d), dtype), cache,
+                 cache,
+                 jax.ShapeDtypeStruct((pb, max_pages), np.int32),
+                 jax.ShapeDtypeStruct((pb, t), np.int32),
+                 jax.ShapeDtypeStruct((pb,), np.int32)),
+            ) for t in prefill_buckets(
+                config.scheduler.prefill_chunk_size)],
+        }
+        for name, cases in probes.items():
+            err = next(
+                (e for fn, shapes in cases
+                 for e in [self._lowering_error(fn, *shapes)]
+                 if e is not None), None)
+            impl = "pallas" if err is None else "xla"
+            setattr(model_config, f"attention_impl_{name}", impl)
+            if err:
+                logger.error(
+                    "Pallas %s kernel failed TPU lowering; this shape "
+                    "serves via XLA attention: %s", name.upper(), err)
 
     @property
     def _lora_stack(self):
@@ -191,32 +290,57 @@ class ModelRunner:
         sampled = sample_tokens(row_logits, temperature, top_p, top_k, rng)
         return sampled, k_cache, v_cache
 
-    def _decode_multi_impl(self, params, k_cache, v_cache, tokens,
-                           positions, page_table, kv_lens, valid,
-                           temperature, top_p, top_k, rng, lora,
-                           lora_ids, num_steps: int):
-        """K chained decode iterations in one program.
+    def _decode_burst_impl(self, params, k_cache, v_cache, tokens,
+                           positions, page_table, kv_lens, active,
+                           budgets, stop_tokens, temperature, top_p,
+                           top_k, rng, lora, lora_ids, num_steps: int):
+        """K chained decode iterations in one program, with per-row
+        lifecycle on device.
 
         Carry = (last tokens [B,1], positions [B,1], kv_lens [B],
-        caches); each iteration writes KV, attends, samples, and feeds
-        the sampled token into the next — no host round-trip between
-        tokens. Returns sampled tokens [K, B].
+        active [B], emitted [B], caches); each iteration writes KV for
+        the active rows (``valid`` mask redirects inactive rows to the
+        trash page), attends, samples, checks each row's stop set and
+        token budget, and feeds the sampled token into the next — no
+        host round-trip between tokens, and a row that finishes early
+        simply freezes (its slots emit -1) instead of forcing the
+        whole batch back to single-step.
+
+        Args (beyond the single-step set):
+          active:      [B] bool — rows that decode this burst
+          budgets:     [B] int32 — max tokens this burst may emit per
+                       row (min(K, max_tokens budget, model_len
+                       budget) computed by the scheduler)
+          stop_tokens: [B, S] int32 — per-row stop set, padded with -1
+
+        Returns sampled tokens [K, B] (-1 for frozen slots).
         """
         def body(carry, step_rng):
-            tok, pos, kv, kc, vc = carry
+            tok, pos, kv, act, emitted, kc, vc = carry
             logits, kc, vc = self._forward(
                 params, self.config.model, tok, pos, page_table,
-                kv, valid, kc, vc, lora=lora, lora_ids=lora_ids,
+                kv, act[:, None], kc, vc, lora=lora,
+                lora_ids=lora_ids,
             )
             sampled = sample_tokens(
                 logits[:, 0, :], temperature, top_p, top_k, step_rng
             )
-            return ((sampled[:, None], pos + 1, kv + 1, kc, vc),
-                    sampled)
+            out = jnp.where(act, sampled, -1)
+            emitted = emitted + act
+            hit_stop = jnp.any(
+                sampled[:, None] == stop_tokens, axis=-1
+            )
+            act_next = act & ~hit_stop & (emitted < budgets)
+            step = act_next.astype(pos.dtype)
+            return ((jnp.where(act, sampled, tok[:, 0])[:, None],
+                     pos + step[:, None], kv + step, act_next,
+                     emitted, kc, vc), out)
 
         rngs = jax.random.split(rng, num_steps)
-        carry = (tokens, positions, kv_lens, k_cache, v_cache)
-        (_, _, _, k_cache, v_cache), out = jax.lax.scan(
+        emitted0 = jnp.zeros(active.shape, jnp.int32)
+        carry = (tokens, positions, kv_lens, active, emitted0,
+                 k_cache, v_cache)
+        (_, _, _, _, _, k_cache, v_cache), out = jax.lax.scan(
             body, carry, rngs
         )
         return out, k_cache, v_cache
@@ -253,13 +377,15 @@ class ModelRunner:
                     else jnp.asarray(lora_ids))
         if kind == 2 and t > 1:
             sampled, self.k_cache, self.v_cache = \
-                self._decode_multi_jit(
+                self._decode_burst_jit(
                     self.params, self.k_cache, self.v_cache,
                     jnp.asarray(payload["tokens"]),
                     jnp.asarray(payload["positions"]),
                     jnp.asarray(payload["page_table"]),
                     jnp.asarray(payload["kv_lens"]),
-                    jnp.asarray(payload["valid"]),
+                    jnp.asarray(payload["active"]),
+                    jnp.asarray(payload["budgets"]),
+                    jnp.asarray(payload["stop_tokens"]),
                     jnp.asarray(payload["temperature"]),
                     jnp.asarray(payload["top_p"]),
                     jnp.asarray(payload["top_k"]),
@@ -363,17 +489,21 @@ class ModelRunner:
 
     def run_decode(self, plan: DecodePlan) -> List[List[int]]:
         """One decode dispatch over all running sequences (padded
-        batch); returns per-sequence token lists (window K >= 1). The
-        window is decided by the scheduler (DecodePlan.window) so page
-        reservation and the compiled program use the same lookahead."""
+        batch); returns per-sequence token lists. With a multi-step
+        window the burst program evaluates per-row budgets and stop
+        sets on device, so one dispatch + one device_get covers up to
+        ``window`` tokens per row even when rows finish mid-burst."""
         seqs = plan.seqs[: self.decode_width]
         b = self.decode_width
         window = max(1, plan.window)
+        stop_w = STOP_SET_WIDTH
 
         tokens = np.zeros((b, 1), np.int32)
         positions = np.zeros((b, 1), np.int32)
         valid = np.zeros((b, 1), bool)
         kv_lens = np.zeros((b,), np.int32)
+        budgets = np.zeros((b,), np.int32)
+        stop_tokens = np.full((b, stop_w), -1, np.int32)
         # Pad rows stay temperature 0 so an all-greedy batch keeps the
         # sampler's sort-free fast path (ops/sampling.py).
         temperature = np.zeros((b,), np.float32)
@@ -388,6 +518,11 @@ class ModelRunner:
             positions[i, 0] = seq.total_len - 1
             valid[i, 0] = True
             kv_lens[i] = seq.total_len
+            budgets[i] = decode_budget(
+                seq, self.config.scheduler.max_model_len)
+            if not seq.sampling.ignore_eos:
+                ids = seq.sampling.stop_token_ids[:stop_w]
+                stop_tokens[i, : len(ids)] = ids
             temperature[i] = seq.sampling.temperature
             top_p[i] = seq.sampling.top_p
             top_k[i] = seq.sampling.top_k
@@ -404,6 +539,10 @@ class ModelRunner:
             "top_k": top_k,
             "rng": np.asarray(self._next_rng()),
         }
+        if window > 1:
+            payload["active"] = valid[:, 0].copy()
+            payload["budgets"] = budgets
+            payload["stop_tokens"] = stop_tokens
         if self.lora_registry is not None:
             ids = np.zeros((b,), np.int32)
             for i, seq in enumerate(seqs):
@@ -414,13 +553,14 @@ class ModelRunner:
         host = jax.device_get(sampled)
         if window == 1:
             return [[int(host[i])] for i in range(len(seqs))]
-        return [[int(host[k, i]) for k in range(window)]
+        return [[int(host[k, i]) for k in range(window)
+                 if host[k, i] >= 0]
                 for i in range(len(seqs))]
 
     # ---- page-granular IO (offload tiers) ---------------------------------
 
     def read_page(self, page_id: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Copy one page's KV out of HBM: [L, kv, page_size, d] each."""
+        """Copy one page's KV out of HBM: [L, kv, d, page_size] each."""
         k = jax.device_get(self.k_cache[:, :, page_id])
         v = jax.device_get(self.v_cache[:, :, page_id])
         return k, v

@@ -32,7 +32,7 @@ from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
 
-# A page's KV payload: (k, v), each [L, kv_heads, page_size, head_dim]
+# A page's KV payload: (k, v), each [L, kv_heads, head_dim, page_size]
 # (the head-major cache layout, model_runner.read_page).
 PagePayload = Tuple[np.ndarray, np.ndarray]
 

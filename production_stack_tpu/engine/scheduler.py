@@ -31,6 +31,7 @@ from production_stack_tpu.engine.sequence import (
     FinishReason,
     Sequence,
     SequenceState,
+    decode_budget,
 )
 from production_stack_tpu.utils.log import init_logger
 
@@ -174,22 +175,14 @@ class Scheduler:
         return StepPlan()
 
     def _decode_window(self) -> int:
-        """Largest safe multi-step window: every running sequence must
-        accept K more tokens without crossing its max_tokens budget or
-        max_model_len (speculating past either would change results).
-        Only the configured K or 1 are used, so the runner compiles at
-        most two decode shapes."""
-        k = max(1, self.config.decode_steps)
-        if k == 1 or not self.running:
-            return 1
-        for seq in self.running:
-            remaining = min(
-                seq.sampling.max_tokens - len(seq.output_token_ids),
-                self.config.max_model_len - seq.total_len,
-            )
-            if remaining < k:
-                return 1
-        return k
+        """The decode burst evaluates per-row budgets and stop sets on
+        device (model_runner._decode_burst_impl), so the full window
+        is always safe — rows with less than K remaining simply go
+        inactive mid-burst. One decode shape compiles, ever."""
+        return max(1, self.config.decode_steps)
+
+    def _seq_budget(self, seq: Sequence) -> int:
+        return decode_budget(seq, self.config.max_model_len)
 
     def _plan_prefill(self) -> Optional[PrefillPlan]:
         chunks: List[PrefillChunk] = []
@@ -263,9 +256,11 @@ class Scheduler:
 
     def _ensure_decode_capacity(self, lookahead: int = 1) -> None:
         """Every running sequence needs page slots for its next decode
-        window (``lookahead`` tokens when multi-step decode is on)."""
+        window: min(lookahead, its own remaining budget) tokens — a
+        row near its budget reserves only what its burst can write."""
         for seq in list(self.running):
-            needed = self._pages_needed(seq, seq.total_len + lookahead)
+            ahead = max(1, min(lookahead, self._seq_budget(seq)))
+            needed = self._pages_needed(seq, seq.total_len + ahead)
             if needed == 0:
                 continue
             try:

@@ -75,3 +75,15 @@ class Sequence:
 
     def remaining_prompt(self) -> int:
         return self.num_prompt_tokens - self.num_computed_tokens
+
+
+def decode_budget(seq: "Sequence", max_model_len: int) -> int:
+    """Tokens ``seq`` may still emit (max_tokens and model-length
+    budgets). Single source of truth: the scheduler's page
+    reservation, the host finish logic (scheduler._append_token), and
+    the device decode burst (model_runner._decode_burst_impl) must all
+    agree on this number or the burst could write past its pages."""
+    return min(
+        seq.sampling.max_tokens - len(seq.output_token_ids),
+        max_model_len - seq.total_len,
+    )

@@ -36,25 +36,27 @@ def dispatch_attention(config: ModelConfig, q, k_layer, v_layer,
     chunked-prefill kernel (no materialized page gather). The XLA
     gather-based implementation is the CPU path and the ground truth.
     """
-    impl = config.attention_impl
-    if impl.startswith("pallas"):
-        interpret = impl == "pallas-interpret"
-        if q.shape[1] == 1:
+    if q.shape[1] == 1:
+        impl = config.attention_impl_decode or config.attention_impl
+        if impl.startswith("pallas"):
             from production_stack_tpu.ops.paged_attention_pallas import (
                 paged_decode_attention,
             )
             out = paged_decode_attention(
                 q[:, 0], k_layer, v_layer, page_table, kv_lens,
-                interpret=interpret,
+                interpret=impl == "pallas-interpret",
             )
             return out[:, None]
-        from production_stack_tpu.ops.prefill_attention_pallas import (
-            paged_prefill_attention,
-        )
-        return paged_prefill_attention(
-            q, k_layer, v_layer, page_table, positions, kv_lens,
-            interpret=interpret,
-        )
+    else:
+        impl = config.attention_impl_prefill or config.attention_impl
+        if impl.startswith("pallas"):
+            from production_stack_tpu.ops.prefill_attention_pallas import (
+                paged_prefill_attention,
+            )
+            return paged_prefill_attention(
+                q, k_layer, v_layer, page_table, positions, kv_lens,
+                interpret=impl == "pallas-interpret",
+            )
     return paged_attention(
         q, k_layer, v_layer, page_table, positions, kv_lens
     )
@@ -126,7 +128,7 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
       page_table: [B, max_pages] physical page ids (page 0 = trash)
       kv_lens:    [B] valid cached tokens AFTER this block is written
       valid:      [B, T] mask of real (non-padding) tokens
-      k_cache/v_cache: [L, kv_heads, num_pages, page_size, head_dim]
+      k_cache/v_cache: [L, kv_heads, num_pages, head_dim, page_size]
       lora:       optional adapter stacks (engine/lora.py), layer-leading
       lora_ids:   [B] adapter slot per batch row (0 = base model)
 

@@ -23,15 +23,18 @@ NEG_INF = -1e30
 
 def gather_pages(cache_layer: jnp.ndarray,
                  page_table: jnp.ndarray) -> jnp.ndarray:
-    """[kv, num_pages, page, d] gathered to [B, max_pages*page, kv, d].
+    """[kv, num_pages, d, page] gathered to [B, max_pages*page, kv, d].
 
-    The cache keeps the kv-head axis major (layout shared with the
-    Pallas decode kernel, whose per-page blocks must slice only major
-    dims — Mosaic requires the last two dims be full tiles).
+    Cache layout (shared with the Pallas kernels): kv-head axis major
+    so TP shards a leading axis, and each page stored *token-minor*
+    ([head_dim, page_size]) so a page slice's last two dims are
+    (d, 128)-tile-aligned for direct HBM->VMEM DMA and arrive
+    pre-transposed for the MXU's ``q @ k^T`` contraction.
     """
-    gathered = cache_layer[:, page_table]  # [kv, B, P, page, d]
-    kv, b, p, page, d = gathered.shape
-    return gathered.reshape(kv, b, p * page, d).transpose(1, 2, 0, 3)
+    gathered = cache_layer[:, page_table]  # [kv, B, P, d, page]
+    kv, b, p, d, page = gathered.shape
+    return (gathered.transpose(1, 2, 4, 0, 3)  # [B, P, page, kv, d]
+            .reshape(b, p * page, kv, d))
 
 
 def write_to_pages(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
@@ -43,13 +46,13 @@ def write_to_pages(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     so padded slots write there harmlessly instead of needing predication.
 
     Args:
-      cache_layer: [kv_heads, num_pages, page_size, head_dim]
+      cache_layer: [kv_heads, num_pages, head_dim, page_size]
       new_kv:      [B, T, kv_heads, head_dim]
       page_table:  [B, max_pages] int32 physical page ids
       positions:   [B, T] absolute token positions
       valid:       [B, T] bool; False entries are redirected to page 0
     """
-    page_size = cache_layer.shape[2]
+    page_size = cache_layer.shape[3]
     b, t = positions.shape
     logical_page = positions // page_size  # [B, T]
     offset = positions % page_size  # [B, T]
@@ -59,9 +62,10 @@ def write_to_pages(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     physical_page = jnp.where(valid, physical_page, 0)
     flat_pages = physical_page.reshape(-1)
     flat_offsets = offset.reshape(-1)
-    # [B*T, kv, d] -> [kv, B*T, d] to match the head-major cache.
-    flat_kv = new_kv.reshape(b * t, *new_kv.shape[2:]).swapaxes(0, 1)
-    return cache_layer.at[:, flat_pages, flat_offsets].set(flat_kv)
+    # Advanced indices on dims 1 (page) and 3 (token slot) broadcast
+    # to the front: the updates shape is [B*T, kv, d].
+    flat_kv = new_kv.reshape(b * t, *new_kv.shape[2:])
+    return cache_layer.at[:, flat_pages, :, flat_offsets].set(flat_kv)
 
 
 def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
@@ -72,7 +76,7 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
 
     Args:
       q:           [B, T, num_q_heads, head_dim]
-      k/v_cache_layer: [num_kv_heads, num_pages, page_size, head_dim]
+      k/v_cache_layer: [num_kv_heads, num_pages, head_dim, page_size]
       page_table:  [B, max_pages]
       q_positions: [B, T] absolute positions of the queries
       kv_lens:     [B] number of valid cached tokens (>= max position + 1)

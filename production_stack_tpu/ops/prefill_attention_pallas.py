@@ -4,19 +4,31 @@ The XLA reference path (ops/attention.py) materializes every page of a
 sequence's context as a gathered [B, S, KV, D] array per prefill chunk
 — HBM traffic proportional to the page-table width regardless of the
 real context length. This kernel walks the page list instead, exactly
-like the decode kernel (ops/paged_attention_pallas.py), with a chunk of
-T query tokens per sequence:
+like the decode kernel (ops/paged_attention_pallas.py), with a chunk
+of T query tokens per sequence:
 
-- grid (batch, kv_head, pages); one KV page DMA'd per step via the
-  scalar-prefetched page table,
+- grid (batch, kv_head); the whole page walk runs *inside* the kernel
+  as a dynamic ``fori_loop`` bounded by the sequence's real ``kv_len``
+  (the round-2 grid-per-page design paid a fixed cost per tiny
+  BlockSpec DMA and lost to the XLA gather on-chip),
+- KV pages live in HBM and are copied in double-buffered bursts of C
+  pages via manual async DMAs; pages are stored token-minor
+  ([head_dim, page_size]) so the slices are tile-aligned and K needs
+  no transpose before the ``q @ k^T`` MXU contraction,
 - queries arrive flattened [G*T, D] so both matmuls stay plain 2D MXU
-  contractions (Mosaic's supported form),
-- causal masking: a [T, P] position mask (query positions are a VMEM
-  input) broadcast over the G query groups,
+  contractions,
+- causal masking is rebuilt in-kernel from a scalar-prefetched per-row
+  chunk start: query positions within a prefill chunk are contiguous
+  (engine/model_runner.py run_prefill), so ``start + iota`` recovers
+  them without shipping a [B, T] positions array through VMEM (a
+  (1, T) int32 VMEM block violates Mosaic's (8, 128) tiling rule —
+  the round-2 on-chip compile failure, BENCH_r02 ``pallas_error``),
 - flash-style online softmax in VMEM scratch across the page walk.
 
-Contract matches ops.attention.paged_attention for any T; parity is
-tested in tests/test_pallas_attention.py (interpret mode on CPU).
+Contract matches ops.attention.paged_attention for contiguous per-row
+q_positions (the engine's chunked-prefill shape); parity is tested in
+tests/test_pallas_attention.py and compiled lowering is checked by
+tests/test_pallas_lowering.py (TPU cross-lowering, no chip needed).
 """
 
 from __future__ import annotations
@@ -30,66 +42,116 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Pages per DMA burst (2 x 128-token pages = a 256-token KV tile per
+# compute step — prefill scores are [G*T, tile], so a fatter tile
+# costs VMEM quadratically while the MXU is already saturated).
+_PAGES_PER_CHUNK = 2
 
-def _prefill_kernel(page_table_ref, kv_lens_ref, q_ref, pos_ref,
-                    k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                    page_size: int, group: int, chunk: int):
+
+def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref, q_ref,
+                    k_hbm, v_hbm, o_ref, m_ref, l_ref, acc_ref,
+                    k_scratch, v_scratch, sem, *,
+                    page_size: int, pages_per_chunk: int, group: int,
+                    chunk: int, head_dim: int, max_pages: int):
     b = pl.program_id(0)
-    p = pl.program_id(2)
-    num_page_steps = pl.num_programs(2)
+    h = pl.program_id(1)
+    c = pages_per_chunk
+    chunk_tokens = c * page_size
+    rows = group * chunk
 
-    @pl.when(p == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+    kv_len = kv_lens_ref[b]
+    q_start = q_start_ref[b]
+    num_chunks = (kv_len + chunk_tokens - 1) // chunk_tokens
+
+    def dma(slot, chunk_idx, j):
+        page_idx = jnp.minimum(chunk_idx * c + j, max_pages - 1)
+        pid = page_table_ref[b, page_idx]
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[h, pid],
+                k_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
+                sem.at[0, slot, j],
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[h, pid],
+                v_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
+                sem.at[1, slot, j],
+            ),
+        )
+
+    def issue(slot, chunk_idx):
+        for j in range(c):
+            dk, dv = dma(slot, chunk_idx, j)
+            dk.start()
+            dv.start()
+
+    # Padded rows (kv_len == 0 -> num_chunks == 0) must not issue the
+    # warmup DMAs: the loop never waits them, and an unwaited DMA
+    # leaks its semaphore signal into the next grid step's waits.
+    @pl.when(num_chunks > 0)
+    def _warmup():
+        issue(0, 0)
+
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
 
     q = q_ref[0, 0].astype(jnp.float32)  # [G*T, D]
-    k = k_ref[0, 0].astype(jnp.float32)  # [P, D]
-    v = v_ref[0, 0].astype(jnp.float32)  # [P, D]
-    head_dim = q.shape[-1]
-
     scale = 1.0 / (head_dim ** 0.5)
-    scores = jax.lax.dot_general(
-        q, k,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # [G*T, P]
 
-    # Causal + length mask, built at [T, P] and broadcast over groups.
-    q_pos = pos_ref[0]  # [T] int32 absolute positions
-    kv_len = kv_lens_ref[b]
-    token_pos = p * page_size + jax.lax.broadcasted_iota(
-        jnp.int32, (chunk, page_size), 1
-    )  # [T, P]
-    mask_tp = (token_pos <= q_pos[:, None]) & (token_pos < kv_len)
-    mask = jnp.broadcast_to(
-        mask_tp[None], (group, chunk, page_size)
-    ).reshape(group * chunk, page_size)
-    scores = jnp.where(mask, scores, NEG_INF)
+    # Row r of the flattened queries is (g, t) = (r // T, r % T) whose
+    # absolute position is q_start + t (chunk positions contiguous).
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, chunk_tokens), 0
+    ) % chunk  # [G*T, C*P]
 
-    # Online softmax update.
-    m_prev = m_ref[...]  # [G*T, 1]
-    m_new = jnp.maximum(
-        m_prev, jnp.max(scores, axis=-1, keepdims=True)
-    )
-    alpha = jnp.exp(m_prev - m_new)
-    probs = jnp.exp(scores - m_new)
-    l_ref[...] = l_ref[...] * alpha + jnp.sum(
-        probs, axis=-1, keepdims=True
-    )
-    pv = jax.lax.dot_general(
-        probs, v,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [G*T, D]
-    acc_ref[...] = acc_ref[...] * alpha + pv
-    m_ref[...] = m_new
+    def chunk_step(chunk_idx, _):
+        slot = jax.lax.rem(chunk_idx, 2)
 
-    @pl.when(p == num_page_steps - 1)
-    def _finalize():
-        denom = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        @pl.when(chunk_idx + 1 < num_chunks)
+        def _prefetch():
+            issue(1 - slot, chunk_idx + 1)
+
+        for j in range(c):
+            dk, dv = dma(slot, chunk_idx, j)
+            dk.wait()
+            dv.wait()
+
+        k = k_scratch[slot].astype(jnp.float32)  # [D, C*P]
+        v = v_scratch[slot].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G*T, C*P]
+
+        token_pos = chunk_idx * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        mask = (token_pos <= q_pos) & (token_pos < kv_len)
+        scores = jnp.where(mask, scores, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(
+            m_prev, jnp.max(scores, axis=-1, keepdims=True)
+        )
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(
+            probs, axis=-1, keepdims=True
+        )
+        pv = jax.lax.dot_general(
+            probs, v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G*T, D]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, num_chunks, chunk_step, 0)
+    denom = jnp.maximum(l_ref[...], 1e-30)
+    o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -103,18 +165,28 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
 
     Args:
       q:           [B, T, num_q_heads, head_dim] (chunk, padded)
-      k/v_cache_layer: [num_kv_heads, num_pages, page_size, head_dim]
+      k/v_cache_layer: [num_kv_heads, num_pages, head_dim, page_size]
       page_table:  [B, max_pages] int32 physical page ids
-      q_positions: [B, T] int32 absolute positions of the queries
+      q_positions: [B, T] int32 absolute positions of the queries;
+                   must be contiguous per row (positions[i] =
+                   start_i + arange(T)), the engine's chunked-prefill
+                   shape — only row starts reach the kernel (SMEM)
       kv_lens:     [B] int32 valid cached tokens (incl. this chunk)
       interpret:   run in interpreter mode (CPU testing)
 
     Returns [B, T, num_q_heads, head_dim].
     """
     b, t, num_q_heads, head_dim = q.shape
-    num_kv_heads, _, page_size, _ = k_cache_layer.shape
-    max_pages = page_table.shape[1]
+    num_kv_heads, _, _, page_size = k_cache_layer.shape
     group = num_q_heads // num_kv_heads
+    c = _PAGES_PER_CHUNK
+
+    max_pages = page_table.shape[1]
+    if max_pages % c:
+        page_table = jnp.pad(
+            page_table, ((0, 0), (0, c - max_pages % c))
+        )
+        max_pages = page_table.shape[1]
 
     # [B, T, KV, G, D] -> [B, KV, G*T, D]: rows of one kv head's
     # queries, flattened so kernel matmuls are 2D.
@@ -122,40 +194,39 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
           .transpose(0, 2, 3, 1, 4)
           .reshape(b, num_kv_heads, group * t, head_dim))
 
+    # Only the per-row chunk start crosses into the kernel (SMEM
+    # scalar prefetch); positions are rebuilt as start + iota.
+    q_start = q_positions[:, 0]
+
     kernel = functools.partial(
-        _prefill_kernel, page_size=page_size, group=group, chunk=t,
+        _prefill_kernel, page_size=page_size, pages_per_chunk=c,
+        group=group, chunk=t, head_dim=head_dim, max_pages=max_pages,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # page_table, kv_lens
-        grid=(b, num_kv_heads, max_pages),
+        num_scalar_prefetch=3,  # page_table, kv_lens, q_start
+        grid=(b, num_kv_heads),
         in_specs=[
             pl.BlockSpec(
                 (1, 1, group * t, head_dim),
-                lambda bi, hi, pi, pt, kl: (bi, hi, 0, 0),
+                lambda bi, hi, pt, kl, qs: (bi, hi, 0, 0),
             ),
-            # Query positions for this sequence's chunk.
-            pl.BlockSpec(
-                (1, t),
-                lambda bi, hi, pi, pt, kl: (bi, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, page_size, head_dim),
-                lambda bi, hi, pi, pt, kl: (hi, pt[bi, pi], 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, page_size, head_dim),
-                lambda bi, hi, pi, pt, kl: (hi, pt[bi, pi], 0, 0),
-            ),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, group * t, head_dim),
-            lambda bi, hi, pi, pt, kl: (bi, hi, 0, 0),
+            lambda bi, hi, pt, kl, qs: (bi, hi, 0, 0),
         ),
         scratch_shapes=[
             pltpu.VMEM((group * t, 1), jnp.float32),  # m
             pltpu.VMEM((group * t, 1), jnp.float32),  # l
             pltpu.VMEM((group * t, head_dim), jnp.float32),  # acc
+            pltpu.VMEM((2, head_dim, c * page_size),
+                       k_cache_layer.dtype),
+            pltpu.VMEM((2, head_dim, c * page_size),
+                       v_cache_layer.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, c)),
         ],
     )
 
@@ -166,7 +237,7 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
         ),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(page_table, kv_lens, qg, q_positions, k_cache_layer,
+    )(page_table, kv_lens, q_start, qg, k_cache_layer,
       v_cache_layer)
     return (out.reshape(b, num_kv_heads, group, t, head_dim)
             .transpose(0, 3, 1, 2, 4)

@@ -110,6 +110,17 @@ class MultihostStepBridge:
             "top_k": np.zeros((b,), np.int32),
             "rng": np.zeros((2,), np.uint32),
         }
+        if kind == KIND_DECODE and t > 1:
+            # Decode bursts carry per-row lifecycle state
+            # (model_runner.run_decode); STOP_SET_WIDTH is fixed so
+            # this shape is derivable from the (kind, t) header alone.
+            from production_stack_tpu.engine.model_runner import (
+                STOP_SET_WIDTH,
+            )
+            template["active"] = np.zeros((b,), bool)
+            template["budgets"] = np.zeros((b,), np.int32)
+            template["stop_tokens"] = np.zeros(
+                (b, STOP_SET_WIDTH), np.int32)
         if r.lora_registry is not None:
             template["lora_ids"] = np.zeros((b,), np.int32)
         return template

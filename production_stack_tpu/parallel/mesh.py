@@ -144,7 +144,7 @@ def shard_params(params: Dict[str, jax.Array], config: ModelConfig,
 
 
 def cache_spec(mesh: Optional[Mesh] = None) -> P:
-    """KV cache [L, kv_heads, pages, page_size, head_dim]: shard heads
+    """KV cache [L, kv_heads, pages, head_dim, page_size]: shard heads
     over tp; with pipeline parallelism each stage also owns its own
     layers' pages (L over pp)."""
     if _pp_size(mesh) > 1:

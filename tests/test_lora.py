@@ -41,7 +41,7 @@ def _tiny_forward_setup():
     params = llama.init_params(config, jax.random.PRNGKey(0))
     num_pages, page_size, max_pages = 8, 16, 4
     cache_shape = (config.num_hidden_layers, config.num_key_value_heads,
-                   num_pages, page_size, config.head_dim)
+                   num_pages, config.head_dim, page_size)
     k_cache = jnp.zeros(cache_shape, config.jax_dtype)
     v_cache = jnp.zeros(cache_shape, config.jax_dtype)
     b, t = 2, 8

