@@ -4,15 +4,22 @@ Workload models the reference's multi-round-QA harness
 (benchmarks/multi-round-qa.py: closed-loop users, prompt + growing
 history, fixed output length): N requests with ~512-token prompts and
 64-token outputs run through the full engine (chunked prefill,
-continuous batching, paged attention, sampling). Weights are random — a
-1B-class Llama architecture is used because no checkpoints can be
-downloaded in this environment and throughput does not depend on weight
-values.
+continuous batching, paged attention, decode bursts, sampling).
+Weights are random — a 1B-class Llama architecture is used because no
+checkpoints can be downloaded in this environment and throughput does
+not depend on weight values.
+
+Robustness: all engine work runs in WORKER SUBPROCESSES with hard
+timeouts. A Mosaic miscompile can hang (not just error) and wedge the
+device — observed in round 3 — and the one run that matters must
+always print its JSON line: pallas attention is attempted first; on
+error OR hang the xla-attention worker runs; if even that cannot
+complete, a diagnostic line is printed instead of hanging the driver.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-value = requests/second. The reference publishes no absolute numbers
-(BASELINE.md), so vs_baseline is vs. the recorded target of 1.0 until a
-measured baseline lands in BASELINE.json.
+value = requests/second. vs_baseline divides by BASELINE.json's
+``published.req_per_s`` once a measured baseline is recorded there
+(1.0 until then).
 """
 
 from __future__ import annotations
@@ -22,7 +29,6 @@ import os
 import subprocess
 import sys
 import time
-
 
 _PROBE_LOG: dict = {}
 
@@ -155,14 +161,10 @@ def _bench_config(tpu: bool):
             n_requests, prompt_len, out_len)
 
 
-def main() -> None:
-    tpu = _tpu_available()
-    if not tpu:
-        # Hermetic CPU path: drop the tunnel plugin entirely.
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        if os.environ.get("PYTHONPATH", "").find("axon") != -1:
-            os.environ["PYTHONPATH"] = ""
-            os.execv(sys.executable, [sys.executable] + sys.argv)
+def run_worker(impl: str, tpu: bool) -> None:
+    """Run the closed-loop engine benchmark with one attention impl
+    and print the result JSON line (invoked as a subprocess so the
+    parent can enforce a hard timeout)."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
     import numpy as np
@@ -184,9 +186,14 @@ def main() -> None:
         pass
 
     config, n_requests, prompt_len, out_len = _bench_config(tpu)
+    config.model.attention_impl = impl
     engine = LLMEngine(config)
+    # The engine's per-kernel probe may itself have degraded a path.
+    impls = (config.model.attention_impl_decode
+             or config.model.attention_impl,
+             config.model.attention_impl_prefill
+             or config.model.attention_impl)
     rng = np.random.RandomState(0)
-    attention_impl_used = engine.config.model.attention_impl
 
     def make_prompt(i):
         # Shared "system prompt" prefix (exercises the prefix cache, as
@@ -202,28 +209,10 @@ def main() -> None:
         max_tokens=out_len, temperature=0.0, ignore_eos=True
     )
 
-    # Warmup: compile all shapes (prefill buckets + decode). If a
-    # Pallas kernel fails Mosaic compilation on this chip/toolchain,
-    # fall back to the XLA attention path rather than failing the
-    # whole benchmark — but record the failure loudly: the one run
-    # that matters must say which impl actually executed.
-    pallas_error = None
-    try:
-        warm = engine.generate(make_prompt(-1), sampling())
-    except Exception as e:
-        pallas_error = repr(e)[:500]
-        sys.stderr.write(
-            "[bench] " + "=" * 60 + "\n"
-            f"[bench] WARNING: pallas path failed to compile:\n"
-            f"[bench]   {pallas_error}\n"
-            "[bench] falling back to attention_impl=xla\n"
-            "[bench] " + "=" * 60 + "\n"
-        )
-        config.model.attention_impl = "xla"
-        engine = LLMEngine(config)
-        attention_impl_used = "xla"
-        warm = engine.generate(make_prompt(-1), sampling())
+    # Warmup: compile all shapes (prefill buckets + decode burst).
+    warm = engine.generate(make_prompt(-1), sampling())
     assert len(warm.output_token_ids) == out_len
+    sys.stderr.write(f"[bench-worker {impl}] warmup done\n")
 
     # Optional profiler capture of the timed region (BENCH_PROFILE=
     # <dir>); inspect with tensorboard's profile plugin or xprof.
@@ -261,8 +250,9 @@ def main() -> None:
     params_n = _param_count(config.model)
     processed_tokens = n_requests * prompt_len + total_tokens
     model_flops = 2.0 * params_n * processed_tokens
-    peak = _peak_flops(_PROBE_LOG.get("device_kind", ""))
-    mfu = model_flops / wall / peak if tpu else None
+    device_kind = os.environ.get("BENCH_DEVICE_KIND", "")
+    mfu = (model_flops / wall / _peak_flops(device_kind)
+           if tpu else None)
 
     extra = {
         "p50_ttft_s": round(p50_ttft, 4),
@@ -272,23 +262,105 @@ def main() -> None:
         "prompt_len": prompt_len,
         "output_len": out_len,
         "platform": "tpu" if tpu else "cpu",
-        "attention_impl": attention_impl_used,
+        "attention_impl": impls[0] if impls[0] == impls[1] else
+        f"decode={impls[0]},prefill={impls[1]}",
         "param_count": params_n,
+        "decode_batch": config.scheduler.max_num_seqs,
+        "decode_burst": config.scheduler.decode_steps,
     }
-    extra.update(_PROBE_LOG)
     if mfu is not None:
         extra["mfu"] = round(mfu, 4)
-    if pallas_error is not None:
-        extra["pallas_error"] = pallas_error
     print(json.dumps({
         "metric": ("multi-round-qa-style req/s, 1B-class llama, "
                    "1 TPU chip" if tpu else
                    "multi-round-qa-style req/s, tiny llama, CPU fallback"),
         "value": round(req_per_s, 3),
         "unit": "req/s",
-        "vs_baseline": round(req_per_s / 1.0, 3),
+        "vs_baseline": round(req_per_s, 3),
         "extra": extra,
     }))
+
+
+def _spawn_worker(impl: str, tpu: bool, timeout: int):
+    """Run one benchmark worker; returns (result_dict | None, error)."""
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--worker", impl] + (["--tpu"] if tpu else [])
+    env = dict(os.environ)
+    env["BENCH_DEVICE_KIND"] = _PROBE_LOG.get("device_kind", "")
+    try:
+        out = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                             text=True, env=env)
+    except subprocess.TimeoutExpired:
+        return None, (f"{impl} worker exceeded {timeout}s "
+                      "(hang — possible Mosaic compile wedge)")
+    sys.stderr.write(out.stderr[-2000:] + "\n")
+    if out.returncode != 0:
+        return None, (f"{impl} worker rc={out.returncode}: "
+                      + out.stderr.strip()[-500:])
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue  # truncated line (worker killed mid-print)
+    return None, f"{impl} worker printed no JSON"
+
+
+def _load_baseline() -> float:
+    try:
+        with open(os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "BASELINE.json")) as f:
+            return float(json.load(f)["published"]["req_per_s"])
+    except Exception:
+        return 1.0
+
+
+def main() -> None:
+    if "--worker" in sys.argv:
+        impl = sys.argv[sys.argv.index("--worker") + 1]
+        run_worker(impl, tpu="--tpu" in sys.argv)
+        return
+
+    tpu = _tpu_available()
+    timeout = int(os.environ.get("BENCH_WORKER_TIMEOUT_S", "1500"))
+    if not tpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if os.environ.get("PYTHONPATH", "").find("axon") != -1:
+            os.environ["PYTHONPATH"] = ""
+
+    attempts = ["pallas", "xla"] if tpu else ["xla"]
+    errors = {}
+    result = None
+    for impl in attempts:
+        sys.stderr.write(f"[bench] running {impl} worker "
+                         f"(timeout {timeout}s)...\n")
+        result, err = _spawn_worker(impl, tpu, timeout)
+        if result is not None:
+            break
+        errors[f"{impl}_error"] = err
+        sys.stderr.write(
+            "[bench] " + "=" * 60 + "\n"
+            f"[bench] WARNING: {err}\n"
+            "[bench] " + "=" * 60 + "\n")
+
+    if result is None:
+        # Never hang the driver: report the failure as the metric line.
+        extra = dict(_PROBE_LOG)
+        extra.update(errors)
+        print(json.dumps({
+            "metric": "multi-round-qa-style req/s (FAILED)",
+            "value": 0.0,
+            "unit": "req/s",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }))
+        return
+
+    baseline = _load_baseline()
+    result["extra"].update(_PROBE_LOG)
+    result["extra"].update(errors)
+    result["vs_baseline"] = round(result["value"] / baseline, 3)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

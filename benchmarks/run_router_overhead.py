@@ -1,0 +1,201 @@
+"""Router-overhead baseline: measured QPS/TTFT curves per policy.
+
+Launches N fake OpenAI engines (testing/fake_engine.py — configurable
+token rate, zero accelerators) behind the router, then drives the
+multi-round-QA workload through it across a QPS sweep for each routing
+policy. The router's own cost is the difference between these curves
+and the fake engines' configured service time.
+
+This is the measured artifact the reference produces with
+src/tests/perftest (fake-openai-server + request-generator); results
+land in benchmarks/results/router_overhead.{json,md} and are committed
+so the baseline is inspectable without re-running.
+
+Usage:
+    python benchmarks/run_router_overhead.py            # full sweep
+    python benchmarks/run_router_overhead.py --quick    # 1 policy/QPS
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MODEL = "perf/model"
+BASE_PORT = 9300
+ROUTER_PORT = 8301
+
+
+def _wait_http(url: str, timeout: float = 60.0) -> None:
+    import urllib.request
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(url, timeout=1)
+            return
+        except Exception:
+            time.sleep(0.3)
+    raise RuntimeError(f"{url} did not come up")
+
+
+def _launch(cmd, log):
+    return subprocess.Popen(
+        cmd, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+        start_new_session=True)
+
+
+def _free_ports(n: int):
+    """OS-allocated free ports: a stale process from an earlier case
+    (or an aborted run) can hold any fixed port and wedge the bind."""
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        socks.append(sk)
+        ports.append(sk.getsockname()[1])
+    for sk in socks:
+        sk.close()
+    return ports
+
+
+def run_case(policy: str, qps: float, num_engines: int, speed: int,
+             num_users: int, rounds: int) -> dict:
+    procs = []
+    ports = _free_ports(num_engines + 1)
+    router_port = ports[-1]
+    logf = open("/tmp/router_overhead_case.log", "w")
+    try:
+        backends, models = [], []
+        for i in range(num_engines):
+            port = ports[i]
+            procs.append(_launch(
+                [sys.executable, "-m",
+                 "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", MODEL,
+                 "--speed", str(speed), "--ttft", "0.02"], logf))
+            backends.append(f"http://127.0.0.1:{port}")
+            models.append(MODEL)
+        router_cmd = [
+            sys.executable, "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            "--service-discovery", "static",
+            "--static-backends", ",".join(backends),
+            "--static-models", ",".join(models),
+            "--routing-logic", policy,
+            "--engine-stats-interval", "5",
+        ]
+        if policy == "session":
+            router_cmd += ["--session-key", "x-user-id"]
+        procs.append(_launch(router_cmd, logf))
+        for b in backends:
+            _wait_http(b + "/health")
+        _wait_http(f"http://127.0.0.1:{router_port}/health")
+
+        out = subprocess.run(
+            [sys.executable, "benchmarks/multi_round_qa.py",
+             "--base-url", f"http://127.0.0.1:{router_port}",
+             "--model", MODEL,
+             "--num-users", str(num_users),
+             "--num-rounds", str(rounds),
+             "--qps", str(qps),
+             "--system-prompt-len", "100",
+             "--chat-history-len", "100",
+             "--answer-len", "50",
+             "--seed", "0"],
+            cwd=REPO, capture_output=True, text=True, timeout=600)
+        # The summary is the last JSON object on stdout.
+        tail = out.stdout.strip().splitlines()
+        start = next(i for i, line in enumerate(tail)
+                     if line.strip() == "{")
+        summary = json.loads("\n".join(tail[start:]))
+        summary.update(policy=policy, qps_target=qps,
+                       num_engines=num_engines,
+                       engine_speed_tok_s=speed)
+        return summary
+    finally:
+        for p in procs:
+            try:
+                os.killpg(p.pid, signal.SIGTERM)
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+        logf.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out-dir", default="benchmarks/results")
+    args = ap.parse_args()
+
+    if args.quick:
+        policies, qps_values = ["roundrobin"], [4.0]
+        num_users, rounds = 8, 2
+    else:
+        policies = ["roundrobin", "session", "llq", "hra", "custom"]
+        qps_values = [2.0, 8.0, 16.0]
+        num_users, rounds = 24, 3
+
+    rows = []
+    for policy in policies:
+        for qps in qps_values:
+            print(f"# {policy} @ {qps} qps ...", file=sys.stderr)
+            rows.append(run_case(policy, qps, num_engines=4,
+                                 speed=500, num_users=num_users,
+                                 rounds=rounds))
+            print(json.dumps(rows[-1]), file=sys.stderr)
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    with open(os.path.join(args.out_dir, "router_overhead.json"),
+              "w") as f:
+        json.dump({"rows": rows,
+                   "workload": {
+                       "engines": 4, "engine_speed_tok_s": 500,
+                       "engine_ttft_s": 0.02, "num_users": num_users,
+                       "rounds": rounds, "answer_len": 50,
+                   }}, f, indent=1)
+
+    lines = [
+        "# Router overhead baseline (fake engines, no accelerator)",
+        "",
+        "4 fake engines at 500 tok/s, 20 ms synthetic TTFT; "
+        f"{num_users} users x {rounds} rounds, 100-token system "
+        "prompt + growing history, 50-token answers. Engine-side "
+        "floor: TTFT 0.02 s. Anything above that is queueing + "
+        "router overhead.",
+        "",
+        "| policy | target QPS | achieved req/s | p50 TTFT (s) | "
+        "p99 TTFT (s) | avg latency (s) | gen tok/s | errors |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['policy']} | {r['qps_target']} | "
+            f"{r.get('req_per_s', '-')} | "
+            f"{r.get('p50_ttft_s', '-')} | {r.get('p99_ttft_s', '-')} "
+            f"| {r.get('avg_latency_s', '-')} | "
+            f"{r.get('gen_tokens_per_s', '-')} | "
+            f"{r.get('errors', 0)} |")
+    with open(os.path.join(args.out_dir, "router_overhead.md"),
+              "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()

@@ -198,6 +198,15 @@ class ParallelConfig:
     # (parallel/pipeline_serving.py), unlike the reference which has no
     # pipeline parallelism at all (SURVEY.md §2.6).
     pipeline_parallel_size: int = 1
+    # Sequence/context parallelism over the 'sp' mesh axis: prompts at
+    # least ``long_prefill_threshold`` tokens prefill in ONE dispatch
+    # with the sequence sharded T/sp per device and ring attention
+    # doing the O(T^2) mixing (parallel/context_serving.py) — the
+    # long-context strategy the reference lacks entirely.
+    context_parallel_size: int = 1
+    # Prompts this long (tokens) take the sp prefill path; defaults to
+    # 2 x prefill_chunk_size when context_parallel_size > 1.
+    long_prefill_threshold: Optional[int] = None
 
 
 @dataclasses.dataclass

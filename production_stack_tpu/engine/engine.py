@@ -48,8 +48,13 @@ class LLMEngine:
         self.config = config
         self.tokenizer = tokenizer or get_tokenizer(None)
         self.cache_manager = PagedCacheManager(config.cache)
+        sp_threshold = None
+        if config.parallel.context_parallel_size > 1:
+            sp_threshold = (config.parallel.long_prefill_threshold
+                            or 2 * config.scheduler.prefill_chunk_size)
         self.scheduler = Scheduler(
-            config.scheduler, config.cache, self.cache_manager
+            config.scheduler, config.cache, self.cache_manager,
+            sp_threshold=sp_threshold,
         )
         self.runner = ModelRunner(config, mesh=mesh, params=params)
         self.sequences: Dict[str, Sequence] = {}

@@ -83,11 +83,15 @@ class ModelRunner:
                 raise ValueError(
                     "pipeline_parallel_size needs a mesh with a 'pp' "
                     f"axis of size {pp} (parallel.mesh.build_mesh)")
-            if model_config.architecture not in ("llama", "mistral",
-                                                 "qwen2"):
+            from production_stack_tpu.parallel.pipeline_serving import (
+                PP_FAMILIES,
+                pp_paged_forward,
+            )
+            if model_config.architecture not in PP_FAMILIES:
                 raise NotImplementedError(
-                    "pipeline parallelism currently serves the llama "
-                    f"family (got {model_config.architecture!r})")
+                    "pipeline parallelism serves "
+                    f"{'/'.join(PP_FAMILIES)} "
+                    f"(got {model_config.architecture!r})")
             if model_config.num_hidden_layers % pp:
                 raise ValueError(
                     f"layers {model_config.num_hidden_layers} must "
@@ -98,19 +102,45 @@ class ModelRunner:
             if model_config.quantization != "none":
                 raise NotImplementedError(
                     "quantization with pipeline parallelism")
-            if config.parallel.tensor_parallel_size > 1:
-                # pp_paged_forward's shard_map is P('pp')-only today;
-                # silently accepting tp>1 would allgather the stage
-                # weights per step and defeat TP's memory scaling.
-                raise NotImplementedError(
-                    "tensor parallelism combined with pipeline "
-                    "parallelism (run tp within a stage is planned; "
-                    "use one or the other for now)")
-            from production_stack_tpu.parallel.pipeline_serving import (
-                pp_paged_forward,
-            )
+            tp = config.parallel.tensor_parallel_size
+            if tp > 1 and (model_config.num_key_value_heads % tp
+                           or model_config.num_attention_heads % tp):
+                raise ValueError(
+                    "pp x tp needs attention/kv heads divisible by "
+                    f"tensor_parallel_size {tp}")
             self._forward = functools.partial(pp_paged_forward,
                                               mesh=mesh)
+
+        cp = config.parallel.context_parallel_size
+        self._sp_size = cp
+        if cp > 1:
+            # Context-parallel prefill: long prompts shard their
+            # sequence over the 'sp' mesh axis
+            # (parallel/context_serving.py).
+            from production_stack_tpu.parallel.context_serving import (
+                SP_FAMILIES,
+            )
+            if mesh is None or "sp" not in mesh.axis_names \
+                    or mesh.shape["sp"] != cp:
+                raise ValueError(
+                    "context_parallel_size needs a mesh with an 'sp' "
+                    f"axis of size {cp} (parallel.mesh.build_mesh)")
+            if model_config.architecture not in SP_FAMILIES:
+                raise NotImplementedError(
+                    "context parallelism serves "
+                    f"{'/'.join(SP_FAMILIES)} "
+                    f"(got {model_config.architecture!r})")
+            if (config.parallel.tensor_parallel_size > 1
+                    or config.parallel.pipeline_parallel_size > 1):
+                raise NotImplementedError(
+                    "context parallelism composes with tp/pp meshes "
+                    "in a later round; use sp alone for now")
+            if config.lora.enable:
+                raise NotImplementedError(
+                    "LoRA with context parallelism")
+            if model_config.quantization != "none":
+                raise NotImplementedError(
+                    "quantization with context parallelism")
 
         if params is None:
             logger.info("Initializing random weights for %s",
@@ -189,6 +219,24 @@ class ModelRunner:
             static_argnames=("num_steps",),
             donate_argnums=(1, 2),  # k_cache, v_cache
         )
+        if self._sp_size > 1:
+            from production_stack_tpu.parallel.context_serving import (
+                sp_prefill_forward,
+            )
+
+            def _sp_step(params, k_cache, v_cache, tokens, page_table,
+                         valid, last_index, temperature, top_p, top_k,
+                         rng):
+                row_logits, k_cache, v_cache = sp_prefill_forward(
+                    params, self.config.model, tokens, page_table,
+                    valid, last_index, k_cache, v_cache,
+                    mesh=self.mesh)
+                sampled = sample_tokens(row_logits, temperature,
+                                        top_p, top_k, rng)
+                return sampled, k_cache, v_cache
+
+            self._sp_prefill_jit = jax.jit(
+                _sp_step, donate_argnums=(1, 2))
 
     @staticmethod
     def _lowering_error(fn, *args) -> Optional[str]:
@@ -421,11 +469,52 @@ class ModelRunner:
 
     # ---- prefill ----------------------------------------------------------
 
+    def run_sp_prefill(self, plan: PrefillPlan) -> List[Optional[int]]:
+        """Context-parallel whole-prompt prefill: ONE dispatch covers
+        the entire prompt with the sequence sharded over 'sp'
+        (parallel/context_serving.py). Returns the sampled first
+        token."""
+        if self.bridge is not None:
+            raise NotImplementedError(
+                "context-parallel prefill over the multihost step "
+                "bridge")
+        chunk = plan.chunks[0]
+        seq = chunk.seq
+        n = len(chunk.chunk_tokens)
+        sp = self._sp_size
+        # Pow2 T bucket, padded to an sp multiple, so the compiled
+        # shape set stays small.
+        t = 16
+        while t < n:
+            t *= 2
+        t += (-t) % sp
+
+        tokens = np.zeros((1, t), np.int32)
+        valid = np.zeros((1, t), bool)
+        tokens[0, :n] = chunk.chunk_tokens
+        valid[0, :n] = True
+        sp_params = seq.sampling
+        sampled, self.k_cache, self.v_cache = self._sp_prefill_jit(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(self._page_table_rows([seq])),
+            jnp.asarray(valid),
+            jnp.asarray(np.asarray([n - 1], np.int32)),
+            jnp.asarray(np.asarray([sp_params.temperature],
+                                   np.float32)),
+            jnp.asarray(np.asarray([sp_params.top_p], np.float32)),
+            jnp.asarray(np.asarray([sp_params.top_k], np.int32)),
+            self._next_rng(),
+        )
+        return [int(jax.device_get(sampled)[0])]
+
     def run_prefill(self, plan: PrefillPlan) -> List[Optional[int]]:
         """Execute one batched prefill step (the next chunk of up to
         ``prefill_batch_size`` distinct sequences, rows padded to the
         fixed width). Returns one sampled token per chunk — None for
         rows whose prompt is not yet fully prefilled."""
+        if plan.sp:
+            return self.run_sp_prefill(plan)
         chunks = plan.chunks
         b = self.prefill_width
         t = self._bucket_for(max(len(c.chunk_tokens) for c in chunks))

@@ -50,9 +50,15 @@ class PrefillChunk:
 class PrefillPlan:
     """One batched prefill step: the next chunk of up to
     ``prefill_batch_size`` DISTINCT waiting sequences, padded to a
-    fixed row count so the compiled program shape never varies."""
+    fixed row count so the compiled program shape never varies.
+
+    ``sp=True`` marks a context-parallel whole-prompt plan (a single
+    sequence whose entire prompt prefills in one dispatch with the
+    sequence sharded over the mesh's 'sp' axis —
+    parallel/context_serving.py)."""
 
     chunks: List[PrefillChunk]
+    sp: bool = False
 
 
 @dataclass
@@ -76,10 +82,16 @@ class StepPlan:
 
 class Scheduler:
     def __init__(self, config: SchedulerConfig, cache_config: CacheConfig,
-                 cache_manager: PagedCacheManager):
+                 cache_manager: PagedCacheManager,
+                 sp_threshold: Optional[int] = None):
         self.config = config
         self.page_size = cache_config.page_size
         self.cache = cache_manager
+        # Prompts >= this many tokens (first touch, no prefix hit)
+        # take the context-parallel whole-prompt prefill path; None
+        # disables it (engine sets this when --context-parallel-size
+        # > 1).
+        self.sp_threshold = sp_threshold
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self._last_was_prefill = False
@@ -205,6 +217,40 @@ class Scheduler:
                     matched = matched + self.restore_hook(
                         seq.prompt_token_ids, matched
                     )
+                if (self.sp_threshold is not None
+                        and not matched
+                        and seq.num_prompt_tokens >= self.sp_threshold):
+                    # Long cold prompt: context-parallel whole-prompt
+                    # prefill, one sequence per dispatch. Runs alone —
+                    # if chunked work was already gathered this step,
+                    # emit that first and pick the long prompt up next
+                    # step.
+                    if chunks:
+                        break
+                    try:
+                        seq.pages = list(self.cache.allocate_pages(
+                            self._pages_needed(
+                                seq, seq.num_prompt_tokens)))
+                    except OutOfPagesError:
+                        seq.pages = []
+                        if not self.running:
+                            logger.error(
+                                "Request %s can never fit in the KV "
+                                "cache; aborting", seq.seq_id)
+                            del self.waiting[idx]
+                            self._finish(seq, FinishReason.ABORT)
+                            self.newly_aborted.append(seq)
+                            continue
+                        logger.warning(
+                            "KV cache full: request %s waits",
+                            seq.seq_id)
+                        return None
+                    return PrefillPlan(chunks=[PrefillChunk(
+                        seq=seq,
+                        chunk_start=0,
+                        chunk_tokens=list(seq.prompt_token_ids),
+                        is_last_chunk=True,
+                    )], sp=True)
                 seq.pages = matched
                 seq.num_hashed_pages = len(matched)
                 seq.num_computed_tokens = len(matched) * self.page_size

@@ -663,11 +663,14 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
         served_name = args.served_model_name or args.model
     model_config.quantization = args.quantization
 
-    if args.tensor_parallel_size > 1 or args.pipeline_parallel_size > 1:
+    if (args.tensor_parallel_size > 1
+            or args.pipeline_parallel_size > 1
+            or args.context_parallel_size > 1):
         from production_stack_tpu.parallel.mesh import build_mesh
         mesh = build_mesh(
             tensor_parallel_size=args.tensor_parallel_size,
             pipeline_parallel_size=args.pipeline_parallel_size,
+            context_parallel_size=args.context_parallel_size,
         )
 
     config = EngineConfig(
@@ -687,6 +690,8 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
             pipeline_parallel_size=args.pipeline_parallel_size,
+            context_parallel_size=args.context_parallel_size,
+            long_prefill_threshold=args.long_prefill_threshold,
         ),
         offload=OffloadConfig(
             enable=args.enable_kv_offload or bool(args.kv_remote_url),
@@ -739,6 +744,16 @@ def parse_args(argv=None):
     parser.add_argument("--pipeline-parallel-size", type=int, default=1,
                         help="Layer stages over the pp mesh axis "
                              "(serving-path pipeline parallelism)")
+    parser.add_argument("--context-parallel-size", type=int, default=1,
+                        help="Sequence shards over the sp mesh axis: "
+                             "long prompts prefill in one ring-"
+                             "attention dispatch "
+                             "(parallel/context_serving.py)")
+    parser.add_argument("--long-prefill-threshold", type=int,
+                        default=None,
+                        help="Prompt length (tokens) that takes the "
+                             "context-parallel prefill path (default "
+                             "2 x prefill-chunk-size)")
     parser.add_argument("--disable-prefix-caching", action="store_true")
     parser.add_argument("--enable-lora", action="store_true",
                         help="Enable multi-LoRA adapter serving")
@@ -830,6 +845,15 @@ def main(argv=None) -> None:
             raise ValueError(
                 "KV offload tiers are host-0-local state and are not "
                 "yet supported in multi-host mode"
+            )
+        if args.context_parallel_size > 1:
+            # Fail at startup, not on the first long prompt: sp
+            # prefill payloads are not mirrored over the step bridge
+            # yet (model_runner.run_sp_prefill), and a mid-serving
+            # NotImplementedError would wedge the worker hosts.
+            raise ValueError(
+                "--context-parallel-size > 1 is not yet supported "
+                "with --distributed (single-host sp only)"
             )
         init_distributed(args.coordinator_address, args.num_processes,
                          args.process_id)

@@ -1,0 +1,145 @@
+"""Context-parallel SERVING prefill: one dispatch, sequence over ``sp``.
+
+The reference has no sequence/context parallelism (SURVEY.md §2.6);
+its long-context story is flag pass-through to vLLM. The standalone
+ring-attention forward (parallel/context.py) proved the math in rounds
+1-2 but was unreachable from the engine. This module implements the
+ENGINE's prefill contract over the ``sp`` mesh axis, so
+``--context-parallel-size N`` is a real serving flag
+(engine/server.py):
+
+- A long prompt prefills in ONE device program instead of a chunk
+  loop: tokens shard [B, T/n] per device, attention runs as ring
+  attention (ops/ring_attention.py — K/V hop the ring via ppermute
+  over ICI, flash-style online softmax), everything else is local.
+- The paged KV cache stays REPLICATED across sp: each layer
+  all-gathers the freshly computed K/V shards (T x kv x d — small
+  next to the O(T^2) attention the ring just distributed) and every
+  device performs the identical ``write_to_pages`` scatter, so after
+  prefill any shard can serve the decode steps on the standard
+  engine path ("decode on the owning shard").
+- Padding rows to T % sp == 0 carry valid=False; their KV writes land
+  on the trash page (ops/attention.write_to_pages) and their ring
+  outputs are discarded.
+- Only the final hidden state leaves the body sharded; the LM-head
+  matmul runs once on the [B, H] last-token rows outside shard_map —
+  logits for T tokens are never materialized.
+
+Scope (v1): llama-family architectures, first-touch prompts (no
+prefix-cache hit), sp composes with dp=tp=pp=1 (the engine gate in
+model_runner rejects the rest loudly).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.models.llama import (
+    _layer_param_names,
+    rms_norm,
+)
+from production_stack_tpu.ops.attention import write_to_pages
+from production_stack_tpu.ops.ring_attention import ring_attention
+from production_stack_tpu.ops.rope import apply_rope
+
+Params = Dict[str, jnp.ndarray]
+
+SP_FAMILIES = ("llama", "mistral", "qwen2")
+
+
+def sp_prefill_forward(params: Params, config: ModelConfig,
+                       tokens: jnp.ndarray, page_table: jnp.ndarray,
+                       valid: jnp.ndarray, last_index: jnp.ndarray,
+                       k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                       *, mesh: Mesh,
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Whole-prompt prefill with the sequence sharded over ``sp``.
+
+    Args:
+      tokens:     [B, T] prompt tokens, T % sp == 0 (runner pads)
+      page_table: [B, max_pages] physical pages for the whole prompt
+      valid:      [B, T] mask of real tokens (False = padding)
+      last_index: [B] index of each prompt's final token
+      k/v_cache:  [L, kv, pages, d, page_size], replicated over sp
+
+    Returns (row_logits [B, vocab] at last_index, new_k, new_v).
+    """
+    nh, nkv, d = (config.num_attention_heads,
+                  config.num_key_value_heads, config.head_dim)
+    b, t = tokens.shape
+    layer_names = _layer_param_names(config)
+    layer_params = {k: params[k] for k in layer_names}
+    shared = {k: v for k, v in params.items() if k not in layer_names}
+
+    def body(lp, shared_p, kc, vc, tokens_l, valid_l, page_table):
+        idx = jax.lax.axis_index("sp")
+        bl, tl = tokens_l.shape
+        positions_l = idx * tl + jnp.broadcast_to(
+            jnp.arange(tl)[None, :], (bl, tl))
+        # Global (replicated) views for the page writes.
+        positions_full = jnp.broadcast_to(
+            jnp.arange(t)[None, :], (b, t))
+        valid_full = jax.lax.all_gather(
+            valid_l, "sp", axis=1, tiled=True)
+
+        x = shared_p["embed"][tokens_l]
+
+        def layer_step(x, scanned):
+            lp_i, k_layer, v_layer = scanned
+            a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
+            q = a_in @ lp_i["wq"]
+            k = a_in @ lp_i["wk"]
+            v = a_in @ lp_i["wv"]
+            if config.attention_bias:
+                q, k, v = (q + lp_i["bq"], k + lp_i["bk"],
+                           v + lp_i["bv"])
+            q = apply_rope(q.reshape(bl, tl, nh, d), positions_l,
+                           config.rope_theta)
+            k = apply_rope(k.reshape(bl, tl, nkv, d), positions_l,
+                           config.rope_theta)
+            v = v.reshape(bl, tl, nkv, d)
+            # O(T^2) mixing distributed around the ring; K/V shards
+            # stay put, blocks rotate via ppermute.
+            attn = ring_attention(q, k, v, "sp")
+            # The cache is replicated: gather the full-sequence K/V
+            # (linear in T) and do the identical scatter everywhere.
+            k_full = jax.lax.all_gather(k, "sp", axis=1, tiled=True)
+            v_full = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
+            k_layer = write_to_pages(k_layer, k_full, page_table,
+                                     positions_full, valid_full)
+            v_layer = write_to_pages(v_layer, v_full, page_table,
+                                     positions_full, valid_full)
+            x = x + attn.reshape(bl, tl, nh * d) @ lp_i["wo"]
+            m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
+            x = x + (jax.nn.silu(m_in @ lp_i["w_gate"])
+                     * (m_in @ lp_i["w_up"])) @ lp_i["w_down"]
+            return x, (k_layer, v_layer)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_step, x, (lp, kc, vc)
+        )
+        return (rms_norm(x, shared_p["final_norm"],
+                         config.rms_norm_eps), new_k, new_v)
+
+    repl = P()
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=({k: repl for k in layer_params},
+                  {k: repl for k in shared},
+                  repl, repl, P(None, "sp"), P(None, "sp"), repl),
+        out_specs=(P(None, "sp", None), repl, repl),
+        check_vma=False,
+    )
+    hidden, new_k, new_v = fn(layer_params, shared, k_cache, v_cache,
+                              tokens, valid, page_table)
+    # LM head on the last-token rows only (B x H @ H x V).
+    last_h = hidden[jnp.arange(b), last_index]
+    head = shared.get("lm_head")
+    if head is None:
+        head = shared["embed"].T
+    return (last_h @ head).astype(jnp.float32), new_k, new_v

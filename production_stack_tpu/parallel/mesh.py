@@ -22,21 +22,24 @@ from production_stack_tpu.engine.config import ModelConfig
 def build_mesh(tensor_parallel_size: int = 1,
                data_parallel_size: int = 1,
                pipeline_parallel_size: int = 1,
+               context_parallel_size: int = 1,
                devices=None) -> Mesh:
-    """(dp, pp, tp) mesh. tp is innermost so tensor-parallel collectives
-    ride adjacent ICI links; pp stage hops cross the slower dimension
-    (or DCN on multi-slice)."""
+    """(dp, pp, sp, tp) mesh. tp is innermost so tensor-parallel
+    collectives ride adjacent ICI links; sp ring hops are next (ring
+    attention's ppermute neighbours stay adjacent); pp stage hops
+    cross the slowest dimension (or DCN on multi-slice)."""
     devices = devices if devices is not None else jax.devices()
     needed = (tensor_parallel_size * data_parallel_size
-              * pipeline_parallel_size)
+              * pipeline_parallel_size * context_parallel_size)
     if len(devices) < needed:
         raise ValueError(
             f"Mesh needs {needed} devices, have {len(devices)}"
         )
     grid = np.asarray(devices[:needed]).reshape(
-        data_parallel_size, pipeline_parallel_size, tensor_parallel_size
+        data_parallel_size, pipeline_parallel_size,
+        context_parallel_size, tensor_parallel_size
     )
-    return Mesh(grid, axis_names=("dp", "pp", "tp"))
+    return Mesh(grid, axis_names=("dp", "pp", "sp", "tp"))
 
 
 # PartitionSpecs per parameter name. Layer-stacked params have a leading

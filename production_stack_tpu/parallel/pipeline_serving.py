@@ -12,23 +12,37 @@ Design (idiomatic JAX, static shapes):
 - Layer-stacked params and the KV caches shard their leading L axis
   over the ``pp`` mesh axis; each stage owns L/S layers and those
   layers' KV pages. Embedding/head replicate.
+- pp composes with tp (round-2 gap): within a stage, projections are
+  column/row-sharded over the ``tp`` mesh axis exactly as the plain
+  TP path (parallel/mesh.py param_specs) places them; the body runs
+  head-local attention (the KV cache shards its kv-head axis) and
+  psums the row-parallel projections over ``tp``.
 - One ``shard_map`` body runs a static tick loop (M microbatches over
   the batch rows, S stages, M+S-1 ticks). At tick i, stage s runs its
   local layer scan on microbatch i-s; activations hop stage-to-stage
   with ``ppermute`` over ICI/DCN.
+- The batch is padded to a multiple of S so M == S always (round-2
+  weakness: batch % stages != 0 silently degraded to M=1, a pure
+  fill/drain bubble); padded rows carry valid=False so their KV
+  writes land on the trash page.
 - Bubble ticks compute on don't-care data; their KV writes are masked
   via the ``valid`` mask, which ``ops.attention.write_to_pages``
   redirects to the trash page (page 0) — no cache corruption, no
   dynamic shapes.
 - The final hidden states (NOT logits: H << vocab, 16x less traffic)
   are returned to every stage with one masked psum; each stage then
-  computes the replicated logits locally. This replaces the training
-  pipeline's full-activation psum the round-1 review flagged.
+  computes the logits locally (all-gathering over ``tp`` when the LM
+  head is column-sharded). This replaces the training pipeline's
+  full-activation psum the round-1 review flagged.
+
+Families: the llama body covers llama/mistral/qwen2; gpt2 has its own
+layer body (layer_norm + learned positions + gelu — round-2 gap:
+pp was llama-only).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,28 +54,29 @@ from production_stack_tpu.models.llama import (
     dispatch_attention,
     rms_norm,
 )
+from production_stack_tpu.models.gpt2 import layer_norm
 from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.rope import apply_rope
+from production_stack_tpu.parallel.mesh import (
+    cache_spec as mesh_cache_spec,
+    param_specs,
+)
 
 Params = Dict[str, jnp.ndarray]
 
 
-def _num_microbatches(batch: int, stages: int) -> int:
-    """Largest microbatch count <= stages that divides the batch (1 =
-    sequential fill/drain; == stages hides the bubble best)."""
-    for m in range(min(batch, stages), 0, -1):
-        if batch % m == 0:
-            return m
-    return 1
+def _psum_tp(x, tp: int):
+    return jax.lax.psum(x, "tp") if tp > 1 else x
 
 
-def _local_layers(x, lp, k_local, v_local, page_table, positions,
-                  kv_lens, valid, config: ModelConfig):
+def _local_layers_llama(x, lp, k_local, v_local, page_table, positions,
+                        kv_lens, valid, config: ModelConfig, tp: int):
     """One stage's layer scan — the paged layer math of
-    models/llama.py:forward (layer_step), minus LoRA (pp+LoRA is
-    rejected at engine build)."""
-    nh, nkv, d = (config.num_attention_heads,
-                  config.num_key_value_heads, config.head_dim)
+    models/llama.py:forward (layer_step) with tp-local head counts,
+    minus LoRA (pp+LoRA is rejected at engine build)."""
+    nh = config.num_attention_heads // tp
+    nkv = config.num_key_value_heads // tp
+    d = config.head_dim
     b, t = positions.shape
 
     def layer_step(x, scanned):
@@ -84,16 +99,90 @@ def _local_layers(x, lp, k_local, v_local, page_table, positions,
         attn = dispatch_attention(
             config, q, k_layer, v_layer, page_table, positions, kv_lens
         )
-        x = x + attn.reshape(b, t, nh * d) @ lp_i["wo"]
+        x = x + _psum_tp(attn.reshape(b, t, nh * d) @ lp_i["wo"], tp)
         m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
-        x = x + (jax.nn.silu(m_in @ lp_i["w_gate"])
-                 * (m_in @ lp_i["w_up"])) @ lp_i["w_down"]
+        x = x + _psum_tp(
+            (jax.nn.silu(m_in @ lp_i["w_gate"])
+             * (m_in @ lp_i["w_up"])) @ lp_i["w_down"], tp)
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (lp, k_local, v_local)
     )
     return x, new_k, new_v
+
+
+def _local_layers_gpt2(x, lp, k_local, v_local, page_table, positions,
+                       kv_lens, valid, config: ModelConfig, tp: int):
+    """GPT-2 stage body: pre-LN, learned positions are added before
+    the first stage (embed path), gelu MLP, per-projection biases.
+    Column biases (bq/bk/bv/fc1_b) arrive tp-sharded with their
+    projections; row outputs psum over tp before the replicated
+    bo/fc2_b is added once."""
+    nh = config.num_attention_heads // tp
+    d = config.head_dim
+    b, t = positions.shape
+
+    def layer_step(x, scanned):
+        lp_i, k_layer, v_layer = scanned
+        a_in = layer_norm(x, lp_i["attn_norm_w"], lp_i["attn_norm_b"])
+        q = (a_in @ lp_i["wq"] + lp_i["bq"]).reshape(b, t, nh, d)
+        k = (a_in @ lp_i["wk"] + lp_i["bk"]).reshape(b, t, nh, d)
+        v = (a_in @ lp_i["wv"] + lp_i["bv"]).reshape(b, t, nh, d)
+        k_layer = write_to_pages(k_layer, k, page_table, positions,
+                                 valid)
+        v_layer = write_to_pages(v_layer, v, page_table, positions,
+                                 valid)
+        attn = dispatch_attention(
+            config, q, k_layer, v_layer, page_table, positions, kv_lens
+        )
+        x = x + (_psum_tp(attn.reshape(b, t, nh * d) @ lp_i["wo"], tp)
+                 + lp_i["bo"])
+        m_in = layer_norm(x, lp_i["mlp_norm_w"], lp_i["mlp_norm_b"])
+        hidden = jax.nn.gelu(m_in @ lp_i["fc1"] + lp_i["fc1_b"],
+                             approximate=True)
+        x = x + (_psum_tp(hidden @ lp_i["fc2"], tp) + lp_i["fc2_b"])
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (lp, k_local, v_local)
+    )
+    return x, new_k, new_v
+
+
+def _embed(shared_p, config, tokens, positions, dtype):
+    x = shared_p["embed"][tokens].astype(dtype)
+    if config.architecture == "gpt2":
+        x = x + shared_p["pos_embed"][positions].astype(dtype)
+    return x
+
+
+def _head(shared_p, config, hidden, tp: int):
+    if config.architecture == "gpt2":
+        x = layer_norm(hidden, shared_p["final_norm_w"],
+                       shared_p["final_norm_b"])
+        return (x @ shared_p["embed"].T).astype(jnp.float32)
+    x = rms_norm(hidden, shared_p["final_norm"], config.rms_norm_eps)
+    head = shared_p.get("lm_head")
+    if head is None:
+        return (x @ shared_p["embed"].T).astype(jnp.float32)
+    # lm_head is column-sharded over tp (mesh.py _LLAMA_SPECS):
+    # assemble the full vocab axis from the local shards.
+    logits = (x @ head).astype(jnp.float32)
+    if tp > 1:
+        logits = jax.lax.all_gather(
+            logits, "tp", axis=logits.ndim - 1, tiled=True)
+    return logits
+
+
+_LOCAL_LAYER_BODIES = {
+    "llama": _local_layers_llama,
+    "mistral": _local_layers_llama,
+    "qwen2": _local_layers_llama,
+    "gpt2": _local_layers_gpt2,
+}
+
+PP_FAMILIES = tuple(_LOCAL_LAYER_BODIES)
 
 
 def pp_paged_forward(params: Params, config: ModelConfig,
@@ -104,20 +193,38 @@ def pp_paged_forward(params: Params, config: ModelConfig,
                      *, mesh: Mesh,
                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Engine forward contract (models/llama.py:forward signature) with
-    layers pipelined over the mesh's ``pp`` axis.
+    layers pipelined over the mesh's ``pp`` axis (and projections
+    sharded over ``tp`` within each stage).
 
-    k_cache/v_cache carry their GLOBAL shape [L, kv, pages, ps, d] but
-    are sharded P('pp') on L; inside the shard_map body each stage sees
-    its local [L/S, ...] slice.
+    k_cache/v_cache carry their GLOBAL shape [L, kv, pages, d, ps] but
+    are sharded P('pp', 'tp') on (L, kv); inside the shard_map body
+    each stage sees its local [L/S, kv/tp, ...] slice.
     """
     if lora is not None:
         raise NotImplementedError("LoRA with pipeline parallelism")
     S = mesh.shape["pp"]
+    tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
     b, t = tokens.shape
-    M = _num_microbatches(b, S)
-    mb = b // M
 
-    layer_names = _layer_param_names(config)
+    # Pad the batch to a multiple of S so M == S always (every stage
+    # busy outside fill/drain); padded rows are valid=False.
+    pad = (-b) % S
+    if pad:
+        tokens = jnp.pad(tokens, ((0, pad), (0, 0)))
+        positions = jnp.pad(positions, ((0, pad), (0, 0)))
+        page_table = jnp.pad(page_table, ((0, pad), (0, 0)))
+        kv_lens = jnp.pad(kv_lens, ((0, pad),))
+        valid = jnp.pad(valid, ((0, pad), (0, 0)))
+    bp = b + pad
+    M = min(S, bp)
+    mb = bp // M
+
+    local_layers = _LOCAL_LAYER_BODIES[config.architecture]
+    layer_names = _layer_param_names(config) \
+        if config.architecture != "gpt2" else [
+            "attn_norm_w", "attn_norm_b", "wq", "bq", "wk", "bk",
+            "wv", "bv", "wo", "bo", "mlp_norm_w", "mlp_norm_b",
+            "fc1", "fc1_b", "fc2", "fc2_b"]
     layer_params = {k: params[k] for k in layer_names}
     shared = {k: v for k, v in params.items() if k not in layer_names}
     max_pages = page_table.shape[1]
@@ -140,15 +247,15 @@ def pp_paged_forward(params: Params, config: ModelConfig,
             # Stage s processes microbatch i - s at tick i.
             m_s = jnp.clip(i - stage, 0, M - 1)
             active = (i >= stage) & (i - stage < M)
-            emb = shared_p["embed"][mtok[m_s]].astype(dtype)
+            emb = _embed(shared_p, config, mtok[m_s], mpos[m_s], dtype)
             x_in = jnp.where(stage == 0, emb, x_recv)
             # Bubble ticks must not touch the cache: a False valid
             # redirects the write to the trash page (ops/attention.py
             # write_to_pages).
             v_mask = mvalid[m_s] & active
-            x_new, kc, vc = _local_layers(
+            x_new, kc, vc = local_layers(
                 x_in, lp, kc, vc, mpt[m_s], mpos[m_s], mkv[m_s],
-                v_mask, config,
+                v_mask, config, tp,
             )
             # Last stage banks microbatch i - (S - 1) once it's real.
             take = (stage == S - 1) & (i >= S - 1)
@@ -168,26 +275,31 @@ def pp_paged_forward(params: Params, config: ModelConfig,
         )
         # Return the final HIDDEN states to every stage (one masked
         # psum of [B, T, H] — serving shapes keep this small) and
-        # compute the replicated logits locally.
+        # compute the logits locally.
         collected = jnp.where(stage == S - 1, collected, 0.0)
-        hidden = jax.lax.psum(collected, "pp").reshape(b, t, h)
-        x = rms_norm(hidden, shared_p["final_norm"],
-                     config.rms_norm_eps)
-        head = shared_p.get("lm_head")
-        if head is None:
-            head = shared_p["embed"].T
-        logits = (x @ head).astype(jnp.float32)
-        return logits, kc, vc
+        hidden = jax.lax.psum(collected, "pp").reshape(bp, t, h)
+        return _head(shared_p, config, hidden, tp), kc, vc
 
-    pp_only = P("pp")
+    # Layer params keep their TP column/row specs with the leading L
+    # axis mapped to 'pp' — exactly how shard_params placed them. A
+    # mesh without a 'tp' axis (pp-only callers) must still work:
+    # drop axis names the mesh doesn't have.
+    def on_mesh(spec: P) -> P:
+        return P(*(a if a in mesh.axis_names else None for a in spec))
+
+    specs = param_specs(config)
+    lp_specs = {k: on_mesh(P("pp", *specs[k][1:]))
+                for k in layer_params}
+    shared_specs = {k: on_mesh(specs.get(k, P())) for k in shared}
+    cache_spec = on_mesh(mesh_cache_spec(mesh))
     repl = P()
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=({k: pp_only for k in layer_params},
-                  {k: repl for k in shared},
-                  pp_only, pp_only, repl, repl, repl, repl, repl),
-        out_specs=(repl, pp_only, pp_only),
+        in_specs=(lp_specs, shared_specs, cache_spec, cache_spec,
+                  repl, repl, repl, repl, repl),
+        out_specs=(repl, cache_spec, cache_spec),
         check_vma=False,
     )
-    return fn(layer_params, shared, k_cache, v_cache, tokens,
-              positions, page_table, kv_lens, valid)
+    logits, kc, vc = fn(layer_params, shared, k_cache, v_cache, tokens,
+                        positions, page_table, kv_lens, valid)
+    return logits[:b], kc, vc
