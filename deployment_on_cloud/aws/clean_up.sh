@@ -1,0 +1,21 @@
+#!/bin/bash
+# Tear down the EKS router tier (EFS mounts must go first or the VPC
+# deletion hangs).
+set -euo pipefail
+CLUSTER_NAME="${1:?usage: clean_up.sh CLUSTER_NAME}"
+REGION="${REGION:-us-east-1}"
+
+helm uninstall tpu-stack || true
+FS_IDS=$(aws efs describe-file-systems --region "$REGION" \
+  --query "FileSystems[?Tags[?Key=='Name' && Value=='${CLUSTER_NAME}-router-files']].FileSystemId" \
+  --output text)
+for fs in $FS_IDS; do
+  for mt in $(aws efs describe-mount-targets --file-system-id "$fs" \
+      --region "$REGION" --query 'MountTargets[].MountTargetId' \
+      --output text); do
+    aws efs delete-mount-target --mount-target-id "$mt" --region "$REGION"
+  done
+  sleep 10
+  aws efs delete-file-system --file-system-id "$fs" --region "$REGION"
+done
+eksctl delete cluster --name "$CLUSTER_NAME" --region "$REGION"

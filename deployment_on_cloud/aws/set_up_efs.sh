@@ -1,0 +1,78 @@
+#!/bin/bash
+# Provision EFS + the CSI driver for the router tier's shared state
+# (files API storage, batch JSONL artifacts — counterpart of the
+# reference's aws/set_up_efs.sh flow, TPU-stack variant: the router
+# tier runs on EKS, engines live on GKE TPU pools).
+#
+# Usage: ./set_up_efs.sh CLUSTER_NAME
+set -euo pipefail
+
+CLUSTER_NAME="${1:?usage: set_up_efs.sh CLUSTER_NAME}"
+REGION="${REGION:-us-east-1}"
+
+echo "==> Looking up cluster VPC/subnets"
+VPC_ID=$(aws eks describe-cluster --name "$CLUSTER_NAME" \
+    --region "$REGION" \
+    --query 'cluster.resourcesVpcConfig.vpcId' --output text)
+SUBNETS=$(aws eks describe-cluster --name "$CLUSTER_NAME" \
+    --region "$REGION" \
+    --query 'cluster.resourcesVpcConfig.subnetIds[]' --output text)
+CIDR=$(aws ec2 describe-vpcs --vpc-ids "$VPC_ID" --region "$REGION" \
+    --query 'Vpcs[0].CidrBlock' --output text)
+
+echo "==> Creating EFS file system"
+FS_ID=$(aws efs create-file-system --region "$REGION" \
+    --performance-mode generalPurpose --encrypted \
+    --tags "Key=Name,Value=${CLUSTER_NAME}-router-files" \
+    --query 'FileSystemId' --output text)
+
+echo "==> Opening NFS (2049) from the VPC"
+SG_ID=$(aws ec2 create-security-group --region "$REGION" \
+    --group-name "${CLUSTER_NAME}-efs" \
+    --description "EFS for ${CLUSTER_NAME}" --vpc-id "$VPC_ID" \
+    --query 'GroupId' --output text)
+aws ec2 authorize-security-group-ingress --region "$REGION" \
+    --group-id "$SG_ID" --protocol tcp --port 2049 --cidr "$CIDR"
+
+echo "==> Waiting for the file system, then creating mount targets"
+aws efs wait file-system-available --file-system-id "$FS_ID" \
+    --region "$REGION" 2>/dev/null || sleep 15
+for subnet in $SUBNETS; do
+  aws efs create-mount-target --file-system-id "$FS_ID" \
+      --subnet-id "$subnet" --security-groups "$SG_ID" \
+      --region "$REGION" || true
+done
+
+echo "==> Installing the EFS CSI driver"
+eksctl create addon --name aws-efs-csi-driver \
+    --cluster "$CLUSTER_NAME" --region "$REGION" --force || \
+  helm repo add aws-efs-csi-driver \
+      https://kubernetes-sigs.github.io/aws-efs-csi-driver/ && \
+  helm upgrade --install aws-efs-csi-driver \
+      aws-efs-csi-driver/aws-efs-csi-driver -n kube-system
+
+echo "==> StorageClass + PVC (router-files-pvc)"
+kubectl apply -f - <<YAML
+kind: StorageClass
+apiVersion: storage.k8s.io/v1
+metadata:
+  name: efs-sc
+provisioner: efs.csi.aws.com
+parameters:
+  provisioningMode: efs-ap
+  fileSystemId: ${FS_ID}
+  directoryPerms: "700"
+---
+apiVersion: v1
+kind: PersistentVolumeClaim
+metadata:
+  name: router-files-pvc
+spec:
+  accessModes: [ReadWriteMany]
+  storageClassName: efs-sc
+  resources:
+    requests:
+      storage: 100Gi
+YAML
+
+echo "==> Done: EFS $FS_ID; use --set routerSpec.filesPvc=router-files-pvc"

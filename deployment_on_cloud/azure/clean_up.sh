@@ -1,0 +1,7 @@
+#!/bin/bash
+set -euo pipefail
+RESOURCE_GROUP="${1:?usage: clean_up.sh RESOURCE_GROUP CLUSTER_NAME}"
+CLUSTER_NAME="${2:?usage: clean_up.sh RESOURCE_GROUP CLUSTER_NAME}"
+helm uninstall tpu-stack || true
+az aks delete --resource-group "$RESOURCE_GROUP" \
+  --name "$CLUSTER_NAME" --yes

@@ -1,0 +1,64 @@
+#!/bin/bash
+# Provision a Filestore share and bind it into the cluster as the
+# model-weights PV (counterpart of the reference's EFS/Filestore CSI
+# flows: shared storage so every engine pod mounts the same checkpoint
+# instead of pulling per pod — tutorials/03-load-model-from-pv.md).
+#
+# Usage: ./set_up_filestore.sh PROJECT_ID INSTANCE_NAME [SIZE_GB]
+set -euo pipefail
+
+PROJECT_ID="${1:?usage: set_up_filestore.sh PROJECT_ID INSTANCE_NAME [SIZE_GB]}"
+INSTANCE_NAME="${2:?usage: set_up_filestore.sh PROJECT_ID INSTANCE_NAME [SIZE_GB]}"
+SIZE_GB="${3:-1024}"
+ZONE="${ZONE:-us-central2-b}"
+SHARE_NAME="${SHARE_NAME:-models}"
+NETWORK="${NETWORK:-default}"
+
+gcloud config set project "$PROJECT_ID"
+
+echo "==> Creating Filestore instance $INSTANCE_NAME (${SIZE_GB}GiB)"
+gcloud filestore instances create "$INSTANCE_NAME" \
+    --zone "$ZONE" \
+    --tier BASIC_SSD \
+    --file-share "name=${SHARE_NAME},capacity=${SIZE_GB}GB" \
+    --network "name=${NETWORK}"
+
+IP=$(gcloud filestore instances describe "$INSTANCE_NAME" \
+    --zone "$ZONE" --format='value(networks[0].ipAddresses[0])')
+echo "==> Filestore ready at ${IP}:/${SHARE_NAME}"
+
+echo "==> Creating PV + PVC (model-weights-pvc)"
+kubectl apply -f - <<YAML
+apiVersion: v1
+kind: PersistentVolume
+metadata:
+  name: model-weights-pv
+spec:
+  capacity:
+    storage: ${SIZE_GB}Gi
+  accessModes: [ReadWriteMany]
+  nfs:
+    server: ${IP}
+    path: /${SHARE_NAME}
+  persistentVolumeReclaimPolicy: Retain
+---
+apiVersion: v1
+kind: PersistentVolumeClaim
+metadata:
+  name: model-weights-pvc
+spec:
+  accessModes: [ReadWriteMany]
+  storageClassName: ""
+  volumeName: model-weights-pv
+  resources:
+    requests:
+      storage: ${SIZE_GB}Gi
+YAML
+
+cat <<MSG
+==> Done. Install the chart with the PVC mounted, e.g.:
+  helm install tpu-stack ../../helm \\
+    --set servingEngineSpec.modelSpec[0].pvcStorage=model-weights-pvc \\
+    --set servingEngineSpec.modelSpec[0].modelPath=/models/llama-3-8b
+(prefetch weights once with tutorials/assets/values-03-pvc-prefetch.yaml)
+MSG

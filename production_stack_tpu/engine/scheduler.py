@@ -245,6 +245,8 @@ class Scheduler:
                             "KV cache full: request %s waits",
                             seq.seq_id)
                         return None
+                    if seq.first_scheduled_time is None:
+                        seq.first_scheduled_time = time.time()
                     return PrefillPlan(chunks=[PrefillChunk(
                         seq=seq,
                         chunk_start=0,
@@ -281,6 +283,8 @@ class Scheduler:
             end = min(start + self.config.prefill_chunk_size,
                       seq.num_prompt_tokens)
             is_last = end == seq.num_prompt_tokens
+            if seq.first_scheduled_time is None:
+                seq.first_scheduled_time = time.time()
             chunks.append(PrefillChunk(
                 seq=seq,
                 chunk_start=start,
