@@ -244,34 +244,63 @@ def run_worker(impl: str, tpu: bool) -> None:
     total_tokens = sum(len(s.output_token_ids) for s in seqs)
     req_per_s = n_requests / wall
 
-    # Phase 2 — open-loop arrivals at ~70% of the closed-loop
-    # throughput (below the knee): the honest TTFT, decomposed into
-    # queueing (arrival -> first scheduled) vs prefill compute (first
-    # scheduled -> first token). The reference measures TTFT this way
-    # (lognormal arrivals, benchmarks/multi-round-qa.py); the
+    # Phase 2 — open-loop MULTI-ROUND arrivals at ~70% of the
+    # closed-loop throughput (below the knee): the honest TTFT,
+    # decomposed into queueing (arrival -> first scheduled) vs prefill
+    # compute (first scheduled -> first token). This mirrors the
+    # reference workload (lognormal user arrivals, each user's round 2
+    # replays its round-1 history — a prefix-cache hit); the
     # closed-loop burst above deliberately saturates the engine and
     # its TTFT is dominated by queueing.
-    arrival_qps = max(0.5, 0.7 * req_per_s)
+    n_users = max(2, n_requests // 2)
+    # Each user submits 2 requests (round 1 + follow-up), so the USER
+    # arrival rate is derated by 2 to keep the offered request load at
+    # ~70% of the measured closed-loop capacity.
+    user_rate = max(0.25, 0.7 * req_per_s / 2)
     rng_arr = np.random.RandomState(7)
     gaps = rng_arr.lognormal(
-        mean=float(np.log(1.0 / arrival_qps)), sigma=0.5,
-        size=n_requests)
+        mean=float(np.log(1.0 / user_rate)), sigma=0.5,
+        size=n_users)
     seqs2, submit2 = [], {}
-    t0 = time.time()
-    next_t = t0
-    for i in range(n_requests):
+    round1 = {}  # seq_id -> (user prompt, Sequence)
+    next_t = time.time()
+
+    def submit(prompt):
+        sid = engine.add_request(prompt, sampling())
+        seq = engine.sequences[sid]
+        seqs2.append(seq)
+        submit2[sid] = time.time()
+        return sid, seq
+
+    def pump_round2():
+        # A finished round-1 chat immediately asks its follow-up:
+        # history (prompt + answer) + fresh user text.
+        for sid, (prompt, seq) in list(round1.items()):
+            if seq.state in (SequenceState.FINISHED,
+                             SequenceState.ABORTED):
+                del round1[sid]
+                history = prompt + seq.output_token_ids
+                follow = [int(x) for x in rng.randint(
+                    1, config.model.vocab_size - 1, size=32)]
+                submit(history + follow)
+
+    for i in range(n_users):
         next_t += gaps[i]
         while engine.has_work() and time.time() < next_t:
             engine.step()
+            pump_round2()
         now = time.time()
         if now < next_t:
             time.sleep(next_t - now)
-        sid = engine.add_request(make_prompt(1000 + i), sampling())
-        seqs2.append(engine.sequences[sid])
-        submit2[sid] = time.time()
-    while any(s.state not in (SequenceState.FINISHED,
-                              SequenceState.ABORTED) for s in seqs2):
+        prompt = make_prompt(1000 + i)
+        sid, seq = submit(prompt)
+        round1[sid] = (prompt, seq)
+    while (round1
+           or any(s.state not in (SequenceState.FINISHED,
+                                  SequenceState.ABORTED)
+                  for s in seqs2)):
         engine.step()
+        pump_round2()
 
     def pctl(vals, q):
         vals = sorted(vals)
@@ -308,8 +337,10 @@ def run_worker(impl: str, tpu: bool) -> None:
         "param_count": params_n,
         "decode_batch": config.scheduler.max_num_seqs,
         "decode_burst": config.scheduler.decode_steps,
-        # Open-loop phase (arrivals at ~70% of closed-loop rate).
-        "arrivals_qps": round(arrival_qps, 2),
+        # Open-loop phase: user arrivals derated so the offered
+        # REQUEST load sits at ~70% of closed-loop capacity.
+        "arrivals_users_per_s": round(user_rate, 2),
+        "arrivals_offered_req_per_s": round(2 * user_rate, 2),
         "arrivals_p50_ttft_s": round(pctl(ttft2, 0.5), 4),
         "arrivals_p90_ttft_s": round(pctl(ttft2, 0.9), 4),
         "arrivals_p50_queueing_s": round(pctl(queueing2, 0.5), 4),
@@ -403,10 +434,18 @@ def main() -> None:
         }))
         return
 
-    baseline = _load_baseline()
     result["extra"].update(_PROBE_LOG)
     result["extra"].update(errors)
-    result["vs_baseline"] = round(result["value"] / baseline, 3)
+    if result["extra"].get("platform") == "tpu":
+        # BASELINE.json's published entry was measured on this TPU
+        # rig; comparing a CPU-fallback number against it would be
+        # meaningless.
+        result["vs_baseline"] = round(
+            result["value"] / _load_baseline(), 3)
+    else:
+        result["vs_baseline"] = 0.0
+        result["extra"]["vs_baseline_note"] = (
+            "no comparison: CPU fallback vs a TPU-measured baseline")
     print(json.dumps(result))
 
 
