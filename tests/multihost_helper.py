@@ -51,8 +51,13 @@ def main() -> None:
     config = EngineConfig(
         model=tiny_model_config("llama"),
         cache=CacheConfig(page_size=16, num_pages=64),
+        # decode_steps > 1 exercises the decode-BURST payload over the
+        # bridge (active/budgets/stop_tokens keys must be derivable
+        # from the (kind, t) header — a template drift here deadlocks
+        # the slice).
         scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
-                                  prefill_chunk_size=32),
+                                  prefill_chunk_size=32,
+                                  decode_steps=4),
     )
     engine = LLMEngine(config, mesh=mesh)
     bridge = MultihostStepBridge(engine.runner)

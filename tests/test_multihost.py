@@ -71,7 +71,8 @@ def test_two_process_bridge_generation():
         model=tiny_model_config("llama"),
         cache=CacheConfig(page_size=16, num_pages=64),
         scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
-                                  prefill_chunk_size=32),
+                                  prefill_chunk_size=32,
+                                  decode_steps=4),
     )
     ref_engine = LLMEngine(config)
     ref = ref_engine.generate(
