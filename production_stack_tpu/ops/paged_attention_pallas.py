@@ -21,10 +21,15 @@ keeps the whole page walk *inside* one kernel instance:
   would need its minor dim padded to 128, which Mosaic rejects for
   HBM slicing — and K arrives pre-transposed for the ``q @ k^T`` MXU
   contraction,
-- the page loop is a dynamic ``fori_loop`` bounded by the sequence's
-  real ``kv_len`` — work scales with the context actually cached, not
-  with the page-table width,
-- flash-style online softmax carried across chunks,
+- the page loop is a STATIC unroll over the page-table width with
+  ``pl.when`` guards on the row's real chunk count — skipped chunks
+  issue no DMAs and run no compute, so work still scales with the
+  context actually cached. (A dynamic ``fori_loop`` bound would be
+  tighter code, but dynamic trip counts + DMA semaphores push Mosaic
+  down a rarely-exercised compilation path — observed hanging the
+  AOT compiler on v5e — while the static unroll is the standard
+  public-Pallas shape.)
+- flash-style online softmax accumulated in VMEM scratch,
 - matmuls are 2D ``[G, D] x [D, C*P]`` / ``[G, C*P] x [D, C*P]^T``
   contractions (the MXU forms Mosaic supports), with the query-head
   group padded to >=8 sublanes.
@@ -64,13 +69,14 @@ _PAGES_PER_CHUNK = 4
 
 
 def _decode_kernel(page_table_ref, kv_lens_ref, q_ref, k_hbm, v_hbm,
-                   o_ref, k_scratch, v_scratch, sem, *,
-                   page_size: int, pages_per_chunk: int,
+                   o_ref, k_scratch, v_scratch, m_ref, l_ref, acc_ref,
+                   sem, *, page_size: int, pages_per_chunk: int,
                    group_pad: int, head_dim: int, max_pages: int):
     b = pl.program_id(0)
     h = pl.program_id(1)
     c = pages_per_chunk
     chunk_tokens = c * page_size
+    max_chunks = max_pages // c  # static unroll bound
 
     kv_len = kv_lens_ref[b]
     num_chunks = (kv_len + chunk_tokens - 1) // chunk_tokens
@@ -82,8 +88,7 @@ def _decode_kernel(page_table_ref, kv_lens_ref, q_ref, k_hbm, v_hbm,
         128-aligned lane window, so after ``c`` copies the buffer IS
         the [D, chunk_tokens] K/V tile — no in-VMEM reshuffle.
         """
-        page_idx = jnp.minimum(chunk_idx * c + j, max_pages - 1)
-        pid = page_table_ref[b, page_idx]
+        pid = page_table_ref[b, chunk_idx * c + j]
         return (
             pltpu.make_async_copy(
                 k_hbm.at[h, pid],
@@ -103,63 +108,67 @@ def _decode_kernel(page_table_ref, kv_lens_ref, q_ref, k_hbm, v_hbm,
             dk.start()
             dv.start()
 
-    # Padded batch rows have kv_len == 0 -> num_chunks == 0: the loop
-    # never runs, so nothing may be issued either — an unwaited DMA
-    # leaks its semaphore signal into the next grid step's waits.
+    # Padded batch rows have kv_len == 0 -> num_chunks == 0: nothing
+    # may be issued for them — an unwaited DMA leaks its semaphore
+    # signal into the next grid step's waits.
     @pl.when(num_chunks > 0)
     def _warmup():
         issue(0, 0)
 
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
     q = q_ref[0, 0].astype(jnp.float32)  # [G_pad, D]
     scale = 1.0 / (head_dim ** 0.5)
 
-    def chunk_step(chunk_idx, carry):
-        m_prev, l_prev, acc = carry
-        slot = jax.lax.rem(chunk_idx, 2)
+    for chunk_idx in range(max_chunks):
+        @pl.when(chunk_idx < num_chunks)
+        def _chunk(chunk_idx=chunk_idx):
+            slot = chunk_idx % 2
 
-        @pl.when(chunk_idx + 1 < num_chunks)
-        def _prefetch():
-            issue(1 - slot, chunk_idx + 1)
+            @pl.when(chunk_idx + 1 < num_chunks)
+            def _prefetch():
+                issue(1 - slot, chunk_idx + 1)
 
-        for j in range(c):
-            dk, dv = dma(slot, chunk_idx, j)
-            dk.wait()
-            dv.wait()
+            for j in range(c):
+                dk, dv = dma(slot, chunk_idx, j)
+                dk.wait()
+                dv.wait()
 
-        k = k_scratch[slot].astype(jnp.float32)  # [D, C*P]
-        v = v_scratch[slot].astype(jnp.float32)  # [D, C*P]
-        scores = jax.lax.dot_general(
-            q, k,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [G_pad, C*P]
+            k = k_scratch[slot].astype(jnp.float32)  # [D, C*P]
+            v = v_scratch[slot].astype(jnp.float32)  # [D, C*P]
+            scores = jax.lax.dot_general(
+                q, k,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G_pad, C*P]
 
-        token_pos = chunk_idx * chunk_tokens + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1
-        )
-        scores = jnp.where(token_pos < kv_len, scores, NEG_INF)
+            token_pos = (chunk_idx * chunk_tokens
+                         + jax.lax.broadcasted_iota(
+                             jnp.int32, scores.shape, 1))
+            scores = jnp.where(token_pos < kv_len, scores, NEG_INF)
 
-        m_new = jnp.maximum(
-            m_prev, jnp.max(scores, axis=-1, keepdims=True)
-        )
-        alpha = jnp.exp(m_prev - m_new)
-        probs = jnp.exp(scores - m_new)
-        l_new = l_prev * alpha + jnp.sum(probs, axis=-1, keepdims=True)
-        # pv: [G_pad, D] — contract the token axis of both operands.
-        pv = jax.lax.dot_general(
-            probs, v,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l_new, acc * alpha + pv
+            m_prev = m_ref[...]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(scores, axis=-1, keepdims=True)
+            )
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(scores - m_new)
+            l_ref[...] = l_ref[...] * alpha + jnp.sum(
+                probs, axis=-1, keepdims=True
+            )
+            # pv: [G_pad, D] — contract the token axis of both sides.
+            pv = jax.lax.dot_general(
+                probs, v,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[...] = acc_ref[...] * alpha + pv
+            m_ref[...] = m_new
 
-    m0 = jnp.full((group_pad, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((group_pad, 1), jnp.float32)
-    acc0 = jnp.zeros((group_pad, head_dim), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(
-        0, num_chunks, chunk_step, (m0, l0, acc0)
-    )
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    o_ref[0, 0] = (acc_ref[...]
+                   / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -186,7 +195,9 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     c = _PAGES_PER_CHUNK
 
     # Pad the page-table width to a chunk multiple so the DMA loop's
-    # page indices stay in range (padded entries are clamped + masked).
+    # page indices stay in range: the static unroll bound is
+    # max_pages // c, so every index lands inside the padded table
+    # (padded entries point at the trash page and are masked).
     max_pages = page_table.shape[1]
     if max_pages % c:
         page_table = jnp.pad(
@@ -229,6 +240,9 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
                        k_cache_layer.dtype),
             pltpu.VMEM((2, head_dim, c * page_size),
                        v_cache_layer.dtype),
+            pltpu.VMEM((group_pad, 1), jnp.float32),  # m
+            pltpu.VMEM((group_pad, 1), jnp.float32),  # l
+            pltpu.VMEM((group_pad, head_dim), jnp.float32),  # acc
             pltpu.SemaphoreType.DMA((2, 2, c)),
         ],
     )

@@ -8,9 +8,11 @@ like the decode kernel (ops/paged_attention_pallas.py), with a chunk
 of T query tokens per sequence:
 
 - grid (batch, kv_head); the whole page walk runs *inside* the kernel
-  as a dynamic ``fori_loop`` bounded by the sequence's real ``kv_len``
-  (the round-2 grid-per-page design paid a fixed cost per tiny
-  BlockSpec DMA and lost to the XLA gather on-chip),
+  as a STATIC unroll over the page-table width with ``pl.when``
+  guards on the row's real chunk count (the round-2 grid-per-page
+  design paid a fixed cost per tiny BlockSpec DMA and lost to the
+  XLA gather on-chip; a dynamic fori_loop bound hung Mosaic's AOT
+  compiler — see ops/paged_attention_pallas.py),
 - KV pages live in HBM and are copied in double-buffered bursts of C
   pages via manual async DMAs; pages are stored token-minor
   ([head_dim, page_size]) so the slices are tile-aligned and K needs
@@ -58,14 +60,14 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref, q_ref,
     c = pages_per_chunk
     chunk_tokens = c * page_size
     rows = group * chunk
+    max_chunks = max_pages // c  # static unroll bound
 
     kv_len = kv_lens_ref[b]
     q_start = q_start_ref[b]
     num_chunks = (kv_len + chunk_tokens - 1) // chunk_tokens
 
     def dma(slot, chunk_idx, j):
-        page_idx = jnp.minimum(chunk_idx * c + j, max_pages - 1)
-        pid = page_table_ref[b, page_idx]
+        pid = page_table_ref[b, chunk_idx * c + j]
         return (
             pltpu.make_async_copy(
                 k_hbm.at[h, pid],
@@ -105,51 +107,51 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref, q_ref,
         jnp.int32, (rows, chunk_tokens), 0
     ) % chunk  # [G*T, C*P]
 
-    def chunk_step(chunk_idx, _):
-        slot = jax.lax.rem(chunk_idx, 2)
+    for chunk_idx in range(max_chunks):
+        @pl.when(chunk_idx < num_chunks)
+        def _chunk(chunk_idx=chunk_idx):
+            slot = chunk_idx % 2
 
-        @pl.when(chunk_idx + 1 < num_chunks)
-        def _prefetch():
-            issue(1 - slot, chunk_idx + 1)
+            @pl.when(chunk_idx + 1 < num_chunks)
+            def _prefetch():
+                issue(1 - slot, chunk_idx + 1)
 
-        for j in range(c):
-            dk, dv = dma(slot, chunk_idx, j)
-            dk.wait()
-            dv.wait()
+            for j in range(c):
+                dk, dv = dma(slot, chunk_idx, j)
+                dk.wait()
+                dv.wait()
 
-        k = k_scratch[slot].astype(jnp.float32)  # [D, C*P]
-        v = v_scratch[slot].astype(jnp.float32)
-        scores = jax.lax.dot_general(
-            q, k,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [G*T, C*P]
+            k = k_scratch[slot].astype(jnp.float32)  # [D, C*P]
+            v = v_scratch[slot].astype(jnp.float32)
+            scores = jax.lax.dot_general(
+                q, k,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G*T, C*P]
 
-        token_pos = chunk_idx * chunk_tokens + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1
-        )
-        mask = (token_pos <= q_pos) & (token_pos < kv_len)
-        scores = jnp.where(mask, scores, NEG_INF)
+            token_pos = (chunk_idx * chunk_tokens
+                         + jax.lax.broadcasted_iota(
+                             jnp.int32, scores.shape, 1))
+            mask = (token_pos <= q_pos) & (token_pos < kv_len)
+            scores = jnp.where(mask, scores, NEG_INF)
 
-        m_prev = m_ref[...]
-        m_new = jnp.maximum(
-            m_prev, jnp.max(scores, axis=-1, keepdims=True)
-        )
-        alpha = jnp.exp(m_prev - m_new)
-        probs = jnp.exp(scores - m_new)
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(
-            probs, axis=-1, keepdims=True
-        )
-        pv = jax.lax.dot_general(
-            probs, v,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [G*T, D]
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = m_new
-        return 0
+            m_prev = m_ref[...]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(scores, axis=-1, keepdims=True)
+            )
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(scores - m_new)
+            l_ref[...] = l_ref[...] * alpha + jnp.sum(
+                probs, axis=-1, keepdims=True
+            )
+            pv = jax.lax.dot_general(
+                probs, v,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [G*T, D]
+            acc_ref[...] = acc_ref[...] * alpha + pv
+            m_ref[...] = m_new
 
-    jax.lax.fori_loop(0, num_chunks, chunk_step, 0)
     denom = jnp.maximum(l_ref[...], 1e-30)
     o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
