@@ -93,6 +93,14 @@ def build():
                       '(vllm:time_to_first_token_seconds_bucket)',
                       "{{le}}")],
               16, 9, w=8, kind="bargauge"),
+        panel("TTFT decomposition (queue vs prefill, p50)",
+              [target('histogram_quantile(0.5, sum by(le) (rate('
+                      'vllm:request_queue_time_seconds_bucket[5m])))',
+                      "queue p50"),
+               target('histogram_quantile(0.5, sum by(le) (rate('
+                      'vllm:request_prefill_time_seconds_bucket[5m]'
+                      ')))', "prefill p50")],
+              0, 9, w=8, unit="s"),
         # ---- Serving Engine Load (reference row 3) -------------------------
         row("Serving Engine Load", 16),
         panel("Number of Running Requests",

@@ -201,6 +201,9 @@ def test_engine_latency_histograms_after_traffic():
         text = await (await client.get("/metrics")).text()
         assert 'vllm:time_to_first_token_seconds_count 1' in text
         assert 'vllm:e2e_request_latency_seconds_count 1' in text
+        # TTFT decomposition: queue wait vs prefill compute.
+        assert 'vllm:request_queue_time_seconds_count 1' in text
+        assert 'vllm:request_prefill_time_seconds_count 1' in text
         assert 'vllm:time_per_output_token_seconds_bucket' in text
         assert 'vllm:generation_tokens_total 6' in text
         assert 'vllm:request_success_total{finished_reason="length"} 1' \
