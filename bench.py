@@ -209,9 +209,17 @@ def run_worker(impl: str, tpu: bool) -> None:
         max_tokens=out_len, temperature=0.0, ignore_eos=True
     )
 
-    # Warmup: compile all shapes (prefill buckets + decode burst).
+    # Warmup: compile every shape the two phases touch — the full
+    # prompt's chunk bucket AND the tail bucket the phase-2 follow-ups
+    # hit (prompt + answer + 32 fresh tokens => a partial last chunk).
+    # A 20-40 s XLA compile inside the timed open-loop phase would
+    # masquerade as queueing/prefill latency.
     warm = engine.generate(make_prompt(-1), sampling())
     assert len(warm.output_token_ids) == out_len
+    follow_len = prompt_len + out_len + 32
+    warm2 = engine.generate(
+        make_prompt(-2)[:1] * follow_len, sampling())
+    assert len(warm2.output_token_ids) == out_len
     sys.stderr.write(f"[bench-worker {impl}] warmup done\n")
 
     # Optional profiler capture of the timed region (BENCH_PROFILE=
