@@ -1,0 +1,100 @@
+"""Request abort paths: client disconnects hit abort_request while a
+sequence is waiting, mid-prefill, or mid-decode-burst; pages must be
+freed, the batch must keep serving, and terminal outputs must reach
+the engine's step() consumers (server streams read finish_reason from
+them)."""
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import (
+    SamplingParams,
+    SequenceState,
+)
+
+
+def _engine(decode_steps=4, num_pages=64):
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=num_pages),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
+                                  prefill_chunk_size=32,
+                                  decode_steps=decode_steps),
+    ))
+
+
+def _sampling(max_tokens=64):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                          ignore_eos=True)
+
+
+def test_abort_while_waiting_frees_slot():
+    eng = _engine()
+    sid = eng.add_request(list(range(1, 20)), _sampling())
+    assert eng.scheduler.num_waiting == 1
+    eng.abort_request(sid)
+    assert eng.scheduler.num_waiting == 0
+    assert sid not in eng.sequences
+    assert not eng.has_work()
+
+
+def test_abort_mid_decode_frees_pages_and_batch_continues():
+    eng = _engine(decode_steps=4)
+    free_before = eng.cache_manager.num_free_pages
+    victim = eng.add_request(list(range(1, 30)), _sampling())
+    survivor = eng.add_request(list(range(40, 60)), _sampling(8))
+    seqs = dict(eng.sequences)
+
+    # Run until both are decoding, then abort one mid-stream.
+    for _ in range(30):
+        eng.step()
+        if (seqs[victim].state == SequenceState.RUNNING
+                and seqs[survivor].state == SequenceState.RUNNING):
+            break
+    assert seqs[victim].state == SequenceState.RUNNING
+    eng.abort_request(victim)
+    assert seqs[victim].state == SequenceState.ABORTED
+    assert seqs[victim].pages == []  # KV pages returned
+
+    # The survivor must finish normally with the victim gone.
+    while eng.has_work():
+        eng.step()
+    assert seqs[survivor].state == SequenceState.FINISHED
+    assert len(seqs[survivor].output_token_ids) == 8
+    # Every page is reclaimable again (committed prefix pages are
+    # evictable, which num_free_pages counts).
+    assert eng.cache_manager.num_free_pages == free_before
+    assert eng.scheduler.num_running == 0
+
+
+def test_abort_is_idempotent_and_unknown_ids_are_noops():
+    eng = _engine()
+    sid = eng.add_request(list(range(1, 10)), _sampling(4))
+    eng.abort_request(sid)
+    eng.abort_request(sid)          # second abort: no-op
+    eng.abort_request("no-such-id")  # unknown: no-op
+    assert not eng.has_work()
+
+
+def test_oversized_prompt_rejected_at_admission():
+    """Prompts that can never fit are rejected synchronously at
+    add_request (the server maps this to an HTTP 4xx), marked ABORTED,
+    and leave no scheduler state behind."""
+    import pytest
+
+    eng = _engine(num_pages=8)  # 7 usable pages = 112 tokens
+    ok = eng.add_request(list(range(1, 20)), _sampling(4))
+    with pytest.raises(ValueError, match="cannot fit|max_model_len"):
+        eng.add_request(list(range(1, 300)), _sampling(4))
+    assert eng.scheduler.num_waiting == 1  # only the ok request
+
+    finished = {}
+    while eng.has_work():
+        for out in eng.step():
+            if out.finished:
+                finished[out.seq_id] = out.finish_reason
+    assert finished.get(ok) == "length"
