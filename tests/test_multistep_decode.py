@@ -70,3 +70,28 @@ def test_stop_token_mid_window_discards_tail():
     assert got1 == got4
     assert got4[-1] == stop
     assert len(got4) == 2
+
+
+def test_mixed_sampling_batch_keeps_greedy_rows_deterministic():
+    """A stochastic row in the burst batch must not perturb greedy
+    rows (per-row temperature; the sampler only randomizes rows with
+    temperature > 0)."""
+    rs = np.random.RandomState(3)
+    greedy_prompt = [int(x) for x in rs.randint(1, 500, size=23)]
+    stoch_prompt = [int(x) for x in rs.randint(1, 500, size=17)]
+
+    solo = _gen(_engine(decode_steps=4), [greedy_prompt])[0]
+
+    engine = _engine(decode_steps=4)
+    sids = [
+        engine.add_request(greedy_prompt, SamplingParams(
+            max_tokens=12, temperature=0.0, ignore_eos=True)),
+        engine.add_request(stoch_prompt, SamplingParams(
+            max_tokens=12, temperature=0.9, top_p=0.9,
+            ignore_eos=True)),
+    ]
+    seqs = [engine.sequences[s] for s in sids]
+    while engine.has_work():
+        engine.step()
+    assert seqs[0].output_token_ids == solo
+    assert len(seqs[1].output_token_ids) == 12
