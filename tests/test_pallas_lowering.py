@@ -127,3 +127,41 @@ def test_full_model_step_lowers_for_tpu():
 
     _lower_for_tpu(step, params, tokens, positions, page_table,
                    kv_lens, valid, k_cache, v_cache)
+
+
+def test_decode_burst_program_lowers_for_tpu():
+    """The fused K-step decode burst (lax.scan over the pallas-decode
+    forward, with donation-style carries, on-device budgets/stops)
+    must lower for TPU as one program — kernel-level lowering alone
+    misses scan/carry interactions."""
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from production_stack_tpu.engine.model_runner import ModelRunner
+
+    model = tiny_model_config("llama")
+    model.attention_impl = "pallas"
+    config = EngineConfig(
+        model=model,
+        cache=CacheConfig(page_size=128, num_pages=32),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=256,
+                                  prefill_chunk_size=64,
+                                  decode_steps=8),
+    )
+    runner = ModelRunner(config)
+    b = 4
+    args = (
+        runner.params, runner.k_cache, runner.v_cache,
+        jnp.zeros((b, 1), jnp.int32), jnp.zeros((b, 1), jnp.int32),
+        jnp.zeros((b, runner.max_pages_per_seq), jnp.int32),
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+        jnp.zeros((b,), jnp.int32),
+        jnp.full((b, 16), -1, jnp.int32),
+        jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32),
+        jnp.zeros((b,), jnp.int32), jax.random.PRNGKey(0),
+        None, None,
+    )
+    traced = jax.jit(
+        runner._decode_burst_impl, static_argnames=("num_steps",)
+    ).trace(*args, num_steps=8)
+    traced.lower(lowering_platforms=("tpu",))
