@@ -137,3 +137,22 @@ def test_dashboard_metrics_exist():
                     {f"{name}_bucket", f"{name}_sum", f"{name}_count"})
     missing = queried - exported - engine_metrics
     assert not missing, f"dashboard queries unexported metrics: {missing}"
+
+
+def test_dashboard_json_matches_generator():
+    """The committed Grafana dashboard must be exactly what
+    observability/gen_dashboard.py emits — edits belong in the
+    generator, not the JSON."""
+    import importlib.util
+    import json
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "gen_dashboard", root / "observability" / "gen_dashboard.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    committed = json.loads(
+        (root / "observability" / "tpu-stack-dashboard.json")
+        .read_text())
+    assert mod.build() == committed
