@@ -34,7 +34,8 @@ def parse_args(argv=None) -> argparse.Namespace:
 
     parser.add_argument(
         "--routing-logic",
-        choices=["roundrobin", "session", "llq", "hra", "custom"],
+        choices=["roundrobin", "session", "llq", "hra",
+                 "prefixaware", "custom"],
         default="roundrobin",
     )
     parser.add_argument(

@@ -44,6 +44,7 @@ class RoutingLogic(str, enum.Enum):
     SESSION_BASED = "session"
     LEAST_LOADED = "llq"
     HRA = "hra"
+    PREFIX_AWARE = "prefixaware"
     CUSTOM_LOGIC = "custom"
 
 
@@ -63,8 +64,13 @@ class RoutingPolicy(metaclass=SingletonABCMeta):
         headers: Mapping[str, str],
         request_id: str,
         num_prefill_tokens: int = 0,
+        prompt_text: Optional[str] = None,
     ) -> RouteResult:
         raise NotImplementedError
+
+    # Policies that score the request's prompt text set this; the
+    # proxy only pays the text extraction when someone will read it.
+    uses_prompt_text = False
 
     def on_request_complete(self, engine_url: str) -> None:
         """Hook fired when any request finishes; admission policies use it."""
@@ -85,7 +91,8 @@ class RoundRobinPolicy(RoutingPolicy):
         self._initialized = True
 
     def route_request(self, endpoints, engine_stats, request_stats, headers,
-                      request_id, num_prefill_tokens=0) -> str:
+                      request_id, num_prefill_tokens=0,
+                      prompt_text=None) -> str:
         ordered = sorted(endpoints, key=lambda e: e.url)
         url = ordered[next(self._counter) % len(ordered)].url
         return _mark_routed(url, request_id, num_prefill_tokens)
@@ -118,7 +125,8 @@ class SessionPolicy(RoutingPolicy):
         return best_url
 
     def route_request(self, endpoints, engine_stats, request_stats, headers,
-                      request_id, num_prefill_tokens=0) -> str:
+                      request_id, num_prefill_tokens=0,
+                      prompt_text=None) -> str:
         self._ring.sync([ep.url for ep in endpoints])
         session_id = headers.get(self.session_key)
         if session_id is None:
@@ -137,7 +145,8 @@ class LeastLoadedPolicy(RoutingPolicy):
         self._initialized = True
 
     def route_request(self, endpoints, engine_stats, request_stats, headers,
-                      request_id, num_prefill_tokens=0) -> str:
+                      request_id, num_prefill_tokens=0,
+                      prompt_text=None) -> str:
         def load(url: str) -> int:
             stat = request_stats.get(url)
             if stat is None:
@@ -193,7 +202,8 @@ class HeadRoomAdmissionPolicy(RoutingPolicy):
         self._initialized = True
 
     def route_request(self, endpoints, engine_stats, request_stats, headers,
-                      request_id, num_prefill_tokens=0):
+                      request_id, num_prefill_tokens=0,
+                      prompt_text=None):
         future: "asyncio.Future[str]" = (
             asyncio.get_event_loop().create_future()
         )
@@ -271,6 +281,114 @@ class HeadRoomAdmissionPolicy(RoutingPolicy):
             qlen[target] += 1
 
 
+class PrefixAwarePolicy(RoutingPolicy):
+    """KV-aware placement: route to the engine most likely to hold the
+    request's prompt prefix in its paged KV cache.
+
+    The engines' prefix caches are content-chained on token pages
+    (engine/kv_cache.py); the router cannot tokenize, so it
+    approximates the same structure on TEXT: the prompt is split into
+    fixed-size character blocks and chain-hashed, and each engine
+    carries a bounded LRU of the chains it has recently served. A new
+    request scores every candidate by longest matching chain prefix
+    and routes to the best (ties broken by fewest in-flight). Requests
+    with no text or no match fall back to least-loaded.
+
+    Affinity is LOAD-BOUNDED: the prefix match only wins while the
+    preferred engine's in-flight count stays within
+    ``SPILL_FACTOR x min + SPILL_SLACK`` of the least-loaded
+    candidate; beyond that the request spills to the least-loaded
+    engine and its chain is remembered THERE too (the spill target
+    will hold the prefix after serving it), so a hot shared prefix
+    replicates across engines instead of pinning the fleet's traffic
+    to one replica forever.
+
+    This is the BASELINE.md north-star "KV-aware routing" (the
+    reference's roadmap item via LMCache-aware routing) built on this
+    stack's own chain-hash prefix model — multi-round chats and
+    shared-system-prompt fleets keep hitting a replica whose HBM
+    already holds their context, without session headers.
+    """
+
+    BLOCK_CHARS = 256  # ~64 tokens per block at 4 chars/token
+    MAX_CHAINS_PER_ENGINE = 4096
+    SPILL_FACTOR = 2
+    SPILL_SLACK = 4
+    uses_prompt_text = True
+
+    def __init__(self):
+        if getattr(self, "_initialized", False):
+            return
+        # url -> ordered {chain_hash: None} acting as an LRU set.
+        self._index: Dict[str, "OrderedDict[int, None]"] = {}
+        self._initialized = True
+
+    def _chain(self, text: str) -> List[int]:
+        out, h = [], 0
+        for i in range(0, len(text), self.BLOCK_CHARS):
+            h = hash((h, text[i:i + self.BLOCK_CHARS]))
+            out.append(h)
+        return out
+
+    def _remember(self, url: str, chain: List[int]) -> None:
+        from collections import OrderedDict
+        lru = self._index.setdefault(url, OrderedDict())
+        for h in chain:
+            lru.pop(h, None)
+            lru[h] = None
+        while len(lru) > self.MAX_CHAINS_PER_ENGINE:
+            lru.popitem(last=False)
+
+    def _score(self, url: str, chain: List[int]) -> int:
+        lru = self._index.get(url)
+        if not lru:
+            return 0
+        n = 0
+        for h in chain:
+            if h not in lru:
+                break
+            n += 1
+        return n
+
+    def route_request(self, endpoints, engine_stats, request_stats, headers,
+                      request_id, num_prefill_tokens=0,
+                      prompt_text=None) -> str:
+        def load(url: str) -> int:
+            stat = request_stats.get(url)
+            if stat is None:
+                return 0
+            return stat.in_prefill_requests + stat.in_decoding_requests
+
+        # Engines that left the pool must not pin stale chains.
+        live = {ep.url for ep in endpoints}
+        for url in list(self._index):
+            if url not in live:
+                del self._index[url]
+
+        chain = self._chain(prompt_text) if prompt_text else []
+        loads = {ep.url: load(ep.url) for ep in endpoints}
+        min_load = min(loads.values())
+        if chain:
+            scores = {ep.url: self._score(ep.url, chain)
+                      for ep in endpoints}
+            best = max(endpoints,
+                       key=lambda ep: (scores[ep.url],
+                                       -loads[ep.url])).url
+            within_bound = loads[best] <= (
+                self.SPILL_FACTOR * min_load + self.SPILL_SLACK)
+            if scores[best] > 0 and within_bound:
+                self._remember(best, chain)
+                return _mark_routed(best, request_id,
+                                    num_prefill_tokens)
+        # Cold prefix, no text, or the preferred engine is overloaded:
+        # least-loaded placement — and remember the chain there, so a
+        # hot prefix replicates instead of pinning one engine.
+        url = min(endpoints, key=lambda ep: loads[ep.url]).url
+        if chain:
+            self._remember(url, chain)
+        return _mark_routed(url, request_id, num_prefill_tokens)
+
+
 class WorkEstimatePolicy(RoutingPolicy):
     """'custom' policy: routes by estimated outstanding work per engine.
 
@@ -285,7 +403,8 @@ class WorkEstimatePolicy(RoutingPolicy):
         self._initialized = True
 
     def route_request(self, endpoints, engine_stats, request_stats, headers,
-                      request_id, num_prefill_tokens=0) -> str:
+                      request_id, num_prefill_tokens=0,
+                      prompt_text=None) -> str:
         def work(url: str) -> float:
             stat = request_stats.get(url)
             if stat is None:
@@ -305,7 +424,7 @@ class WorkEstimatePolicy(RoutingPolicy):
 
 _POLICY_CLASSES = (
     RoundRobinPolicy, SessionPolicy, LeastLoadedPolicy,
-    HeadRoomAdmissionPolicy, WorkEstimatePolicy,
+    HeadRoomAdmissionPolicy, PrefixAwarePolicy, WorkEstimatePolicy,
 )
 
 
@@ -321,6 +440,8 @@ def initialize_routing_logic(routing_logic: Union[str, RoutingLogic],
         return LeastLoadedPolicy()
     if logic == RoutingLogic.HRA:
         return HeadRoomAdmissionPolicy()
+    if logic == RoutingLogic.PREFIX_AWARE:
+        return PrefixAwarePolicy()
     if logic == RoutingLogic.CUSTOM_LOGIC:
         return WorkEstimatePolicy()
     raise ValueError(f"Unknown routing logic: {routing_logic}")

@@ -67,6 +67,26 @@ def _estimate_prefill_tokens(request: web.Request, body: bytes) -> int:
     return len(body) // 4
 
 
+def _routable_prompt_text(payload: dict) -> "str | None":
+    """Stable text rendering of the request's prompt for prefix-aware
+    routing (chat history or completion prompt; None when the body
+    carries neither)."""
+    messages = payload.get("messages")
+    if isinstance(messages, list):
+        parts = []
+        for m in messages:
+            if isinstance(m, dict) and isinstance(m.get("content"), str):
+                parts.append(f"{m.get('role', '')}\x1f{m['content']}")
+        return "\x1e".join(parts) if parts else None
+    prompt = payload.get("prompt")
+    if isinstance(prompt, str):
+        return prompt
+    if isinstance(prompt, list) and prompt and \
+            all(isinstance(p, str) for p in prompt):
+        return "\x1e".join(prompt)
+    return None
+
+
 def _error(status: int, message: str) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": "invalid_request_error"}},
@@ -118,6 +138,8 @@ async def route_general_request(request: web.Request,
     choice = policy.route_request(
         endpoints, engine_stats, request_stats, request.headers,
         request_id, num_prefill_tokens,
+        prompt_text=(_routable_prompt_text(payload)
+                     if policy.uses_prompt_text else None),
     )
     if hasattr(choice, "__await__"):
         try:

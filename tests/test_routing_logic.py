@@ -306,3 +306,83 @@ def test_hra_churn_hundreds_queued_across_endpoint_events():
         # cancelled husks that were never popped.
         assert all(p.future.done() for p in policy._queue)
     asyncio.run(run())
+
+
+def test_prefix_aware_sticks_conversations_and_spreads_cold_prompts():
+    policy = initialize_routing_logic("prefixaware")
+    eps = EPS[:3]
+    sys_prompt = "You are a helpful assistant. " * 40  # > 1 block
+
+    # Round 1 of two different users: cold prefixes spread by load.
+    u1_r1 = sys_prompt + "user: tell me about TPUs"
+    u2_r1 = sys_prompt + "user: write me a haiku"
+    first = policy.route_request(eps, {}, {}, {}, "u1r1", 100,
+                                 prompt_text=u1_r1)
+    # u2 shares the system-prompt blocks -> follows u1's engine (the
+    # shared prefix is already cached there).
+    second = policy.route_request(eps, {}, {}, {}, "u2r1", 100,
+                                  prompt_text=u2_r1)
+    assert second == first
+
+    # Round 2 replays round-1 history + the answer: must stick.
+    u1_r2 = u1_r1 + " assistant: ... user: more please"
+    assert policy.route_request(eps, {}, {}, {}, "u1r2", 150,
+                                prompt_text=u1_r2) == first
+
+    # A completely different prompt has no cached prefix anywhere and
+    # falls back to least-loaded (any engine is acceptable).
+    cold = policy.route_request(
+        eps, {}, {}, {}, "cold", 50,
+        prompt_text="completely unrelated text " * 30)
+    assert cold in {e.url for e in eps}
+
+
+def test_prefix_aware_drops_index_for_departed_engines():
+    policy = reconfigure_routing_logic("prefixaware")
+    text = "shared prefix block " * 40
+    url = policy.route_request(EPS[:2], {}, {}, {}, "a", 10,
+                               prompt_text=text)
+    # The engine leaves the pool; the same prefix must not pin to it.
+    remaining = [ep for ep in EPS[:2] if ep.url != url]
+    got = policy.route_request(remaining, {}, {}, {}, "b", 10,
+                               prompt_text=text)
+    assert got == remaining[0].url
+    assert url not in policy._index
+
+
+def test_prefix_aware_handles_missing_text():
+    policy = reconfigure_routing_logic("prefixaware")
+    url = policy.route_request(EPS[:2], {}, {}, {}, "x", 10,
+                               prompt_text=None)
+    assert url in {EPS[0].url, EPS[1].url}
+
+
+def test_prefix_aware_spills_hot_prefix_under_load():
+    """A shared prefix must not pin the whole fleet to one replica:
+    once the preferred engine is overloaded relative to the least
+    loaded, the request spills there and the prefix replicates."""
+    policy = reconfigure_routing_logic("prefixaware")
+    eps = EPS[:2]
+    text = "the fleet-wide shared system prompt " * 30
+
+    first = policy.route_request(eps, {}, {}, {}, "warm", 10,
+                                 prompt_text=text)
+    other = next(ep.url for ep in eps if ep.url != first)
+
+    # Preferred engine now heavily loaded; the other is idle.
+    stats = {
+        first: RequestStats(
+            qps=1.0, ttft=0.1, in_prefill_requests=20,
+            in_decoding_requests=20, finished_requests=0,
+            uptime=10.0),
+        other: RequestStats(
+            qps=0.0, ttft=0.1, in_prefill_requests=0,
+            in_decoding_requests=0, finished_requests=0,
+            uptime=10.0),
+    }
+    got = policy.route_request(eps, {}, stats, {}, "spill", 10,
+                               prompt_text=text)
+    assert got == other  # spilled off the hot replica
+    # ... and the prefix is now indexed on BOTH engines, so with even
+    # load the spill target can win on its own.
+    assert policy._score(other, policy._chain(text)) > 0
