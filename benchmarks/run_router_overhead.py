@@ -147,7 +147,8 @@ def main():
         policies, qps_values = ["roundrobin"], [4.0]
         num_users, rounds = 8, 2
     else:
-        policies = ["roundrobin", "session", "llq", "hra", "custom"]
+        policies = ["roundrobin", "session", "llq", "hra",
+                    "prefixaware", "custom"]
         qps_values = [2.0, 8.0, 16.0]
         num_users, rounds = 24, 3
 
