@@ -1,0 +1,71 @@
+#!/bin/bash
+# Phased on-chip validation; each phase in its own process + timeout
+# so a Mosaic hang can't wedge the whole run.
+cd /root/repo
+echo "=== phase 0: sanity ==="
+timeout 120 python -c "import jax; print('sanity', jax.device_get(jax.numpy.ones(4)+1))" || exit 1
+
+echo "=== phase 1: decode kernel compile+parity ==="
+timeout 420 python - <<'PYEOF'
+import sys, time; sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax-comp-cache")
+from production_stack_tpu.ops.attention import paged_attention
+from production_stack_tpu.ops.paged_attention_pallas import paged_decode_attention
+rng = np.random.RandomState(0)
+nh, nkv, d, page, npages = 32, 8, 64, 128, 512
+kc = jnp.asarray(rng.randn(nkv, npages, d, page), jnp.float32).astype(jnp.bfloat16)
+vc = jnp.asarray(rng.randn(nkv, npages, d, page), jnp.float32).astype(jnp.bfloat16)
+b, maxp = 8, 8
+pt = np.zeros((b, maxp), np.int32); kl = np.zeros((b,), np.int32)
+nxt = 1
+for i in range(b):
+    n = rng.randint(400, maxp*page); kl[i] = n
+    for j in range(-(-n // page)): pt[i, j] = nxt; nxt += 1
+q = jnp.asarray(rng.randn(b, nh, d), jnp.float32).astype(jnp.bfloat16)
+pt_, kl_ = jnp.asarray(pt), jnp.asarray(kl)
+t0 = time.time()
+out = paged_decode_attention(q, kc, vc, pt_, kl_)
+host = jax.device_get(out)
+print("decode compiled+ran in %.1fs" % (time.time()-t0))
+ref = paged_attention(q[:, None], kc, vc, pt_, (kl_-1)[:, None], kl_)[:, 0]
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32)-ref.astype(jnp.float32))))
+print("DECODE OK err=%.4f" % err)
+PYEOF
+[ $? -ne 0 ] && echo "DECODE KERNEL FAILED/HUNG" 
+
+echo "=== phase 2: prefill kernel compile+parity ==="
+timeout 420 python - <<'PYEOF'
+import sys, time; sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax-comp-cache")
+from production_stack_tpu.ops.attention import paged_attention
+from production_stack_tpu.ops.prefill_attention_pallas import paged_prefill_attention
+rng = np.random.RandomState(0)
+nh, nkv, d, page, npages = 32, 8, 64, 128, 512
+kc = jnp.asarray(rng.randn(nkv, npages, d, page), jnp.float32).astype(jnp.bfloat16)
+vc = jnp.asarray(rng.randn(nkv, npages, d, page), jnp.float32).astype(jnp.bfloat16)
+b, t, maxp = 4, 512, 8
+pt = np.zeros((b, maxp), np.int32); kl = np.zeros((b,), np.int32)
+pos = np.zeros((b, t), np.int32); nxt = 1
+for i in range(b):
+    prior = int(rng.randint(0, 4)) * 128
+    kl[i] = prior + t
+    for j in range(-(-int(kl[i]) // page)): pt[i, j] = nxt; nxt += 1
+    pos[i] = np.arange(prior, prior + t)
+q = jnp.asarray(rng.randn(b, t, nh, d), jnp.float32).astype(jnp.bfloat16)
+pt_, kl_, pos_ = jnp.asarray(pt), jnp.asarray(kl), jnp.asarray(pos)
+t0 = time.time()
+out = paged_prefill_attention(q, kc, vc, pt_, pos_, kl_)
+host = jax.device_get(out)
+print("prefill compiled+ran in %.1fs" % (time.time()-t0))
+ref = paged_attention(q, kc, vc, pt_, pos_, kl_)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32)-ref.astype(jnp.float32))))
+print("PREFILL OK err=%.4f" % err)
+PYEOF
+[ $? -ne 0 ] && echo "PREFILL KERNEL FAILED/HUNG"
+
+echo "=== phase 3: kernel microbench ==="
+timeout 560 python benchmarks/kernel_microbench.py 2>/dev/null | tail -45
+
+echo "=== done ==="
