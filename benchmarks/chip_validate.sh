@@ -1,13 +1,17 @@
 #!/bin/bash
 # Phased on-chip validation; each phase in its own process + timeout
-# so a Mosaic hang can't wedge the whole run.
-cd /root/repo
+# so a Mosaic hang can't wedge the whole run. Exits non-zero if any
+# phase fails or hangs.
+cd "$(dirname "$0")/.." || exit 1
+REPO="$(pwd)"
+FAILED=0
+
 echo "=== phase 0: sanity ==="
 timeout 120 python -c "import jax; print('sanity', jax.device_get(jax.numpy.ones(4)+1))" || exit 1
 
 echo "=== phase 1: decode kernel compile+parity ==="
-timeout 420 python - <<'PYEOF'
-import sys, time; sys.path.insert(0, "/root/repo")
+PYTHONPATH="$REPO" timeout 420 python - <<'PYEOF'
+import time
 import numpy as np, jax, jax.numpy as jnp
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax-comp-cache")
 from production_stack_tpu.ops.attention import paged_attention
@@ -30,13 +34,14 @@ host = jax.device_get(out)
 print("decode compiled+ran in %.1fs" % (time.time()-t0))
 ref = paged_attention(q[:, None], kc, vc, pt_, (kl_-1)[:, None], kl_)[:, 0]
 err = float(jnp.max(jnp.abs(out.astype(jnp.float32)-ref.astype(jnp.float32))))
+assert err < 0.05, err
 print("DECODE OK err=%.4f" % err)
 PYEOF
-[ $? -ne 0 ] && echo "DECODE KERNEL FAILED/HUNG" 
+if [ $? -ne 0 ]; then echo "DECODE KERNEL FAILED/HUNG"; FAILED=1; fi
 
 echo "=== phase 2: prefill kernel compile+parity ==="
-timeout 420 python - <<'PYEOF'
-import sys, time; sys.path.insert(0, "/root/repo")
+PYTHONPATH="$REPO" timeout 420 python - <<'PYEOF'
+import time
 import numpy as np, jax, jax.numpy as jnp
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax-comp-cache")
 from production_stack_tpu.ops.attention import paged_attention
@@ -61,11 +66,14 @@ host = jax.device_get(out)
 print("prefill compiled+ran in %.1fs" % (time.time()-t0))
 ref = paged_attention(q, kc, vc, pt_, pos_, kl_)
 err = float(jnp.max(jnp.abs(out.astype(jnp.float32)-ref.astype(jnp.float32))))
+assert err < 0.05, err
 print("PREFILL OK err=%.4f" % err)
 PYEOF
-[ $? -ne 0 ] && echo "PREFILL KERNEL FAILED/HUNG"
+if [ $? -ne 0 ]; then echo "PREFILL KERNEL FAILED/HUNG"; FAILED=1; fi
 
 echo "=== phase 3: kernel microbench ==="
-timeout 560 python benchmarks/kernel_microbench.py 2>/dev/null | tail -45
+timeout 1500 python benchmarks/kernel_microbench.py
+if [ $? -ne 0 ]; then echo "MICROBENCH FAILED/HUNG"; FAILED=1; fi
 
-echo "=== done ==="
+echo "=== done (failed=$FAILED) ==="
+exit $FAILED
