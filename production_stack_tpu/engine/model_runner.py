@@ -10,6 +10,8 @@ every step so the arrays are updated in place in HBM.
 from __future__ import annotations
 
 import functools
+import os
+import time
 from typing import List, Optional, Tuple
 
 import jax
@@ -35,6 +37,21 @@ logger = init_logger(__name__)
 # stop ids than this still finish correctly — the host enforces the
 # full set; the burst merely speculates a little further.
 STOP_SET_WIDTH = 16
+
+# PSTPU_TIMING=1: log every dispatch's wall time (dispatch ->
+# device_get of the sampled tokens, i.e. including device execution)
+# to stderr as "timing <kind> t=<window|bucket> <seconds>". The only
+# reliable sync on a tunneled device is a host transfer, so these
+# walls include one ~RTT; per-phase aggregation is what they're for.
+# Timing mode forces a sync even on prefill dispatches that would
+# otherwise return async (no last chunk), so every logged wall really
+# contains its device execution.
+_TIMING = (os.environ.get("PSTPU_TIMING", "0").strip().lower()
+           in ("1", "true", "yes", "on"))
+
+
+def _timing_log(kind: str, t: int, wall: float) -> None:
+    logger.info("timing %s t=%d %.4f", kind, t, wall)
 
 
 def prefill_buckets(chunk_size: int) -> List[int]:
@@ -255,9 +272,13 @@ class ModelRunner:
         dtype = model_config.jax_dtype
         max_pages = config.scheduler.max_pages_per_seq(
             config.cache.page_size)
+        # Probe the exact serving form: the full stacked cache with a
+        # dynamic layer index (models pass layer through SMEM prefetch).
         cache = jax.ShapeDtypeStruct(
-            (nkv, config.cache.num_pages, d, config.cache.page_size),
+            (model_config.num_hidden_layers, nkv,
+             config.cache.num_pages, d, config.cache.page_size),
             dtype)
+        layer0 = jax.ShapeDtypeStruct((), np.int32)
 
         if config.cache.page_size % 128:
             # The kernels DMA [head_dim, page_size] page slices out of
@@ -286,7 +307,7 @@ class ModelRunner:
                 paged_decode_attention,
                 (jax.ShapeDtypeStruct((b, nh, d), dtype), cache, cache,
                  jax.ShapeDtypeStruct((b, max_pages), np.int32),
-                 jax.ShapeDtypeStruct((b,), np.int32)),
+                 jax.ShapeDtypeStruct((b,), np.int32), layer0),
             )],
             # Serving compiles one prefill program per bucket — probe
             # them all, not just the widest (a Mosaic rule can fail at
@@ -297,7 +318,7 @@ class ModelRunner:
                  cache,
                  jax.ShapeDtypeStruct((pb, max_pages), np.int32),
                  jax.ShapeDtypeStruct((pb, t), np.int32),
-                 jax.ShapeDtypeStruct((pb,), np.int32)),
+                 jax.ShapeDtypeStruct((pb,), np.int32), layer0),
             ) for t in prefill_buckets(
                 config.scheduler.prefill_chunk_size)],
         }
@@ -562,6 +583,7 @@ class ModelRunner:
                 ids[i] = chunk.seq.lora_id
             payload["lora_ids"] = ids
 
+        t0 = time.perf_counter() if _TIMING else 0.0
         sampled = self._dispatch(1, t, payload)
         host = None
         out: List[Optional[int]] = []
@@ -572,6 +594,10 @@ class ModelRunner:
                 out.append(int(host[i]))
             else:
                 out.append(None)
+        if _TIMING:
+            if host is None:  # async dispatch: sync so the wall is real
+                jax.device_get(sampled)
+            _timing_log("prefill", t, time.perf_counter() - t0)
         return out
 
     # ---- decode -----------------------------------------------------------
@@ -638,8 +664,11 @@ class ModelRunner:
                 ids[i] = seq.lora_id
             payload["lora_ids"] = ids
 
+        t0 = time.perf_counter() if _TIMING else 0.0
         sampled = self._dispatch(2, window, payload)
         host = jax.device_get(sampled)
+        if _TIMING:
+            _timing_log("decode", window, time.perf_counter() - t0)
         if window == 1:
             return [[int(host[i])] for i in range(len(seqs))]
         return [[int(host[k, i]) for k in range(window)

@@ -75,20 +75,23 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
 
     x = params["embed"][tokens] + params["pos_embed"][positions]
 
-    layer_params = {
-        k: params[k] for k in (
-            "attn_norm_w", "attn_norm_b", "wq", "bq", "wk", "bk",
-            "wv", "bv", "wo", "bo", "mlp_norm_w", "mlp_norm_b",
-            "fc1", "fc1_b", "fc2", "fc2_b",
-        )
-    }
+    names = ("attn_norm_w", "attn_norm_b", "wq", "bq", "wk", "bk",
+             "wv", "bv", "wo", "bo", "mlp_norm_w", "mlp_norm_b",
+             "fc1", "fc1_b", "fc2", "fc2_b")
     lora_scale = (None if lora is None
                   else lora["scaling"][lora_ids])
-    lora_scanned = (None if lora is None
+    lora_stacked = (None if lora is None
                     else {"a": lora["a"], "b": lora["b"]})
 
-    def layer_step(x, scanned):
-        lp, ll, k_layer, v_layer = scanned
+    # Static layer loop with in-place cache scatters at a static layer
+    # index (see models.llama.forward for why scan xs/ys is slow).
+    for layer in range(config.num_hidden_layers):
+        # tree.map: a projection may be a quantized (int8, scale)
+        # pytree pair, not a bare array (engine/quantization.py).
+        lp = {k: jax.tree.map(lambda s: s[layer], params[k])
+              for k in names}
+        ll = (None if lora_stacked is None
+              else jax.tree.map(lambda s: s[layer], lora_stacked))
         a_in = layer_norm(x, lp["attn_norm_w"], lp["attn_norm_b"])
         q = (lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids, lora_scale)
              + lp["bq"]).reshape(b, t, nh, d)
@@ -96,10 +99,13 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
              + lp["bk"]).reshape(b, t, nh, d)
         v = (lora_matmul(a_in, lp["wv"], ll, "wv", lora_ids, lora_scale)
              + lp["bv"]).reshape(b, t, nh, d)
-        k_layer = write_to_pages(k_layer, k, page_table, positions, valid)
-        v_layer = write_to_pages(v_layer, v, page_table, positions, valid)
-        attn = dispatch_attention(
-            config, q, k_layer, v_layer, page_table, positions, kv_lens
+        k_cache = write_to_pages(k_cache, k, page_table, positions,
+                                 valid, layer=layer)
+        v_cache = write_to_pages(v_cache, v, page_table, positions,
+                                 valid, layer=layer)
+        attn, k_cache, v_cache = dispatch_attention(
+            config, q, k_cache, v_cache, page_table, positions,
+            kv_lens, layer=layer,
         )
         x = x + (lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
                              "wo", lora_ids, lora_scale) + lp["bo"])
@@ -110,11 +116,7 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
             + lp["fc1_b"], approximate=True)
         x = x + (lora_matmul(hidden, lp["fc2"], ll, "fc2", lora_ids,
                              lora_scale) + lp["fc2_b"])
-        return x, (k_layer, v_layer)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (layer_params, lora_scanned, k_cache, v_cache)
-    )
+    new_k, new_v = k_cache, v_cache
 
     x = layer_norm(x, params["final_norm_w"], params["final_norm_b"])
     logits = (x @ params["embed"].T).astype(jnp.float32)

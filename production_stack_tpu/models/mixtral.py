@@ -120,19 +120,22 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
 
     x = params["embed"][tokens]
 
-    layer_params = {
-        k: params[k] for k in (
-            "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
-            "moe_gate", "w_gate", "w_up", "w_down",
-        )
-    }
+    names = ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+             "moe_gate", "w_gate", "w_up", "w_down")
     lora_scale = (None if lora is None
                   else lora["scaling"][lora_ids])
-    lora_scanned = (None if lora is None
+    lora_stacked = (None if lora is None
                     else {"a": lora["a"], "b": lora["b"]})
 
-    def layer_step(x, scanned):
-        lp, ll, k_layer, v_layer = scanned
+    # Static layer loop with in-place cache scatters at a static layer
+    # index (see models.llama.forward for why scan xs/ys is slow).
+    for layer in range(config.num_hidden_layers):
+        # tree.map: a projection may be a quantized (int8, scale)
+        # pytree pair, not a bare array (engine/quantization.py).
+        lp = {k: jax.tree.map(lambda s: s[layer], params[k])
+              for k in names}
+        ll = (None if lora_stacked is None
+              else jax.tree.map(lambda s: s[layer], lora_stacked))
         a_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
         q = lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids,
                         lora_scale).reshape(b, t, nh, d)
@@ -142,12 +145,13 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
                         lora_scale).reshape(b, t, nkv, d)
         q = apply_rope(q, positions, config.rope_theta)
         k = apply_rope(k, positions, config.rope_theta)
-        k_layer = write_to_pages(k_layer, k, page_table, positions,
-                                 valid)
-        v_layer = write_to_pages(v_layer, v, page_table, positions,
-                                 valid)
-        attn = dispatch_attention(
-            config, q, k_layer, v_layer, page_table, positions, kv_lens
+        k_cache = write_to_pages(k_cache, k, page_table, positions,
+                                 valid, layer=layer)
+        v_cache = write_to_pages(v_cache, v, page_table, positions,
+                                 valid, layer=layer)
+        attn, k_cache, v_cache = dispatch_attention(
+            config, q, k_cache, v_cache, page_table, positions,
+            kv_lens, layer=layer,
         )
         x = x + lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
                             "wo", lora_ids, lora_scale)
@@ -156,11 +160,7 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
             m_in, lp["moe_gate"], lp["w_gate"], lp["w_up"],
             lp["w_down"], config.num_experts_per_tok,
         )
-        return x, (k_layer, v_layer)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (layer_params, lora_scanned, k_cache, v_cache)
-    )
+    new_k, new_v = k_cache, v_cache
 
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     head = params.get("lm_head")

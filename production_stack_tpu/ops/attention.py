@@ -37,22 +37,32 @@ def gather_pages(cache_layer: jnp.ndarray,
             .reshape(b, p * page, kv, d))
 
 
-def write_to_pages(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
+def write_to_pages(cache: jnp.ndarray, new_kv: jnp.ndarray,
                    page_table: jnp.ndarray, positions: jnp.ndarray,
-                   valid: jnp.ndarray) -> jnp.ndarray:
+                   valid: jnp.ndarray,
+                   layer: "int | None" = None) -> jnp.ndarray:
     """Scatter new KV entries into their pages.
 
     Page 0 is the engine's trash page (the allocator never hands it out),
     so padded slots write there harmlessly instead of needing predication.
 
+    With ``layer`` (a static int), ``cache`` is the full stacked
+    [L, kv_heads, num_pages, head_dim, page_size] cache and the scatter
+    lands at that layer IN PLACE. Model forwards must use this form
+    inside their (statically unrolled) layer loop: threading per-layer
+    cache slices through ``lax.scan`` xs/ys makes XLA copy the whole
+    layer cache in and out every step (~20 ms/step measured on v5e for
+    a 1B config vs ~1.3 ms for the chained in-place form).
+
     Args:
-      cache_layer: [kv_heads, num_pages, head_dim, page_size]
+      cache:       [kv_heads, num_pages, head_dim, page_size], or the
+                   stacked [L, ...] form when ``layer`` is given
       new_kv:      [B, T, kv_heads, head_dim]
       page_table:  [B, max_pages] int32 physical page ids
       positions:   [B, T] absolute token positions
       valid:       [B, T] bool; False entries are redirected to page 0
     """
-    page_size = cache_layer.shape[3]
+    page_size = cache.shape[-1]
     b, t = positions.shape
     logical_page = positions // page_size  # [B, T]
     offset = positions % page_size  # [B, T]
@@ -62,27 +72,36 @@ def write_to_pages(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     physical_page = jnp.where(valid, physical_page, 0)
     flat_pages = physical_page.reshape(-1)
     flat_offsets = offset.reshape(-1)
-    # Advanced indices on dims 1 (page) and 3 (token slot) broadcast
-    # to the front: the updates shape is [B*T, kv, d].
+    # Advanced indices on the page and token-slot dims broadcast to
+    # the front: the updates shape is [B*T, kv, d].
     flat_kv = new_kv.reshape(b * t, *new_kv.shape[2:])
-    return cache_layer.at[:, flat_pages, :, flat_offsets].set(flat_kv)
+    if layer is None:
+        return cache.at[:, flat_pages, :, flat_offsets].set(flat_kv)
+    return cache.at[layer, :, flat_pages, :, flat_offsets].set(flat_kv)
 
 
 def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
                     v_cache_layer: jnp.ndarray, page_table: jnp.ndarray,
                     q_positions: jnp.ndarray,
-                    kv_lens: jnp.ndarray) -> jnp.ndarray:
+                    kv_lens: jnp.ndarray,
+                    layer: "int | None" = None) -> jnp.ndarray:
     """Causal attention of q against a sequence's cached pages.
 
     Args:
       q:           [B, T, num_q_heads, head_dim]
-      k/v_cache_layer: [num_kv_heads, num_pages, head_dim, page_size]
+      k/v_cache_layer: [num_kv_heads, num_pages, head_dim, page_size],
+                   or the stacked [L, ...] cache when ``layer`` (a
+                   static int) is given — the static slice fuses into
+                   the page gather instead of materializing
       page_table:  [B, max_pages]
       q_positions: [B, T] absolute positions of the queries
       kv_lens:     [B] number of valid cached tokens (>= max position + 1)
 
     Returns [B, T, num_q_heads, head_dim].
     """
+    if layer is not None:
+        k_cache_layer = k_cache_layer[layer]
+        v_cache_layer = v_cache_layer[layer]
     b, t, num_q_heads, head_dim = q.shape
     num_kv_heads = k_cache_layer.shape[0]
     group = num_q_heads // num_kv_heads

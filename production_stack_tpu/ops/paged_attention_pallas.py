@@ -68,10 +68,21 @@ _MIN_GROUP = 8
 _PAGES_PER_CHUNK = 4
 
 
-def _decode_kernel(page_table_ref, kv_lens_ref, q_ref, k_hbm, v_hbm,
-                   o_ref, k_scratch, v_scratch, m_ref, l_ref, acc_ref,
+def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
+                   k_hbm, v_hbm,
+                   o_ref, k_out, v_out,
+                   k_scratch, v_scratch, m_ref, l_ref, acc_ref,
                    sem, *, page_size: int, pages_per_chunk: int,
-                   group_pad: int, head_dim: int, max_pages: int):
+                   group_pad: int, head_dim: int, max_pages: int,
+                   has_layer: bool):
+    # k_out/v_out alias k_hbm/v_hbm (input_output_aliases below): the
+    # kernel never writes them — the aliasing exists so the caller can
+    # thread the cache THROUGH the custom call. Without it the cache
+    # buffer is both a custom-call operand and the target of the next
+    # layer's scatter, and XLA's copy-insertion breaks the apparent
+    # interference with a full-cache copy per layer (measured ~158
+    # ms/decode-step on v5e for the 1B bench config).
+    del k_out, v_out
     b = pl.program_id(0)
     h = pl.program_id(1)
     c = pages_per_chunk
@@ -89,14 +100,25 @@ def _decode_kernel(page_table_ref, kv_lens_ref, q_ref, k_hbm, v_hbm,
         the [D, chunk_tokens] K/V tile — no in-VMEM reshuffle.
         """
         pid = page_table_ref[b, chunk_idx * c + j]
+        if has_layer:
+            # Stacked [L, kv, pages, d, p] cache: the layer index
+            # arrives as a prefetched scalar, so ONE compiled kernel
+            # serves every layer and the caller never slices (an HLO
+            # slice feeding a pallas custom-call materializes the
+            # whole 10s-of-MB layer as a copy).
+            k_src = k_hbm.at[layer_ref[0], h, pid]
+            v_src = v_hbm.at[layer_ref[0], h, pid]
+        else:
+            k_src = k_hbm.at[h, pid]
+            v_src = v_hbm.at[h, pid]
         return (
             pltpu.make_async_copy(
-                k_hbm.at[h, pid],
+                k_src,
                 k_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
                 sem.at[0, slot, j],
             ),
             pltpu.make_async_copy(
-                v_hbm.at[h, pid],
+                v_src,
                 v_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
                 sem.at[1, slot, j],
             ),
@@ -176,20 +198,34 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
                            v_cache_layer: jnp.ndarray,
                            page_table: jnp.ndarray,
                            kv_lens: jnp.ndarray,
+                           layer: "jnp.ndarray | int | None" = None,
                            interpret: bool = False) -> jnp.ndarray:
     """Single-token paged attention.
 
     Args:
       q:           [B, num_q_heads, head_dim]
-      k/v_cache_layer: [num_kv_heads, num_pages, head_dim, page_size]
+      k/v_cache_layer: [num_kv_heads, num_pages, head_dim, page_size],
+                   or the full stacked [L, ...] cache with ``layer``
+                   given (scalar; reaches the kernel via SMEM prefetch
+                   so no per-layer slice is ever materialized)
       page_table:  [B, max_pages] int32 physical page ids
       kv_lens:     [B] int32 valid cached tokens per sequence
       interpret:   run in interpreter mode (CPU testing)
 
-    Returns [B, num_q_heads, head_dim].
+    Returns [B, num_q_heads, head_dim] for the 4D per-layer cache
+    form. For the stacked 5D form returns
+    ``(out, k_cache, v_cache)`` — the caches are passed THROUGH the
+    kernel via input/output aliasing and the caller must thread them
+    (models/llama.py layer loop); this keeps the cache buffer chain
+    linear so XLA's copy-insertion never duplicates it.
     """
+    has_layer = k_cache_layer.ndim == 5
+    if has_layer and layer is None:
+        raise ValueError("stacked [L, ...] cache needs a layer index")
+    layer_arr = jnp.asarray(
+        [0 if layer is None else layer], jnp.int32)
     b, num_q_heads, head_dim = q.shape
-    num_kv_heads, _, _, page_size = k_cache_layer.shape
+    num_kv_heads, _, _, page_size = k_cache_layer.shape[-4:]
     group = num_q_heads // num_kv_heads
     group_pad = max(group, _MIN_GROUP)
     c = _PAGES_PER_CHUNK
@@ -216,25 +252,39 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     kernel = functools.partial(
         _decode_kernel, page_size=page_size, pages_per_chunk=c,
         group_pad=group_pad, head_dim=head_dim, max_pages=max_pages,
+        has_layer=has_layer,
     )
+    if not has_layer:
+        # No pass-through cache outputs: splice placeholder refs into
+        # the kernel's (o_ref, k_out, v_out, *scratch) signature.
+        base_kernel = kernel
+
+        def kernel(pt, kl, la, q, k, v, o_ref, *scratch):
+            base_kernel(pt, kl, la, q, k, v, o_ref, None, None,
+                        *scratch)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # page_table, kv_lens
+        num_scalar_prefetch=3,  # page_table, kv_lens, layer
         grid=(b, num_kv_heads),
         in_specs=[
             # q block: one sequence's query group for one kv head.
             pl.BlockSpec(
                 (1, 1, group_pad, head_dim),
-                lambda bi, hi, pt, kl: (bi, hi, 0, 0),
+                lambda bi, hi, pt, kl, la: (bi, hi, 0, 0),
             ),
             # Full KV cache stays in HBM; the kernel DMAs pages itself.
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, group_pad, head_dim),
-            lambda bi, hi, pt, kl: (bi, hi, 0, 0),
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, group_pad, head_dim),
+                lambda bi, hi, pt, kl, la: (bi, hi, 0, 0),
+            ),
+        ] + ([
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ] if has_layer else []),
         scratch_shapes=[
             pltpu.VMEM((2, head_dim, c * page_size),
                        k_cache_layer.dtype),
@@ -247,12 +297,29 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
         ],
     )
 
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct(
+        (b, num_kv_heads, group_pad, head_dim), q.dtype)]
+    if has_layer:
+        out_shape += [
+            jax.ShapeDtypeStruct(
+                k_cache_layer.shape, k_cache_layer.dtype),
+            jax.ShapeDtypeStruct(
+                v_cache_layer.shape, v_cache_layer.dtype),
+        ]
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(
-            (b, num_kv_heads, group_pad, head_dim), q.dtype
-        ),
+        out_shape=out_shape,
         grid_spec=grid_spec,
+        # Inputs count scalar-prefetch operands: (page_table, kv_lens,
+        # layer, q, k, v) -> k=4, v=5 alias outputs 1, 2. Only the
+        # stacked (engine) form aliases: 4D callers keep using their
+        # caches afterwards, and aliasing a still-live value would
+        # force the copy it exists to avoid.
+        input_output_aliases={4: 1, 5: 2} if has_layer else {},
         interpret=interpret,
-    )(page_table, kv_lens, qg, k_cache_layer, v_cache_layer)
-    return out[:, :, :group].reshape(b, num_q_heads, head_dim)
+    )(page_table, kv_lens, layer_arr, qg, k_cache_layer,
+      v_cache_layer)
+    out = res[0][:, :, :group].reshape(b, num_q_heads, head_dim)
+    if has_layer:
+        return out, res[1], res[2]
+    return out

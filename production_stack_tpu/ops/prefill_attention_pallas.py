@@ -50,11 +50,18 @@ NEG_INF = -1e30
 _PAGES_PER_CHUNK = 2
 
 
-def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref, q_ref,
-                    k_hbm, v_hbm, o_ref, m_ref, l_ref, acc_ref,
+def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
+                    layer_ref, q_ref,
+                    k_hbm, v_hbm, o_ref, k_out, v_out,
+                    m_ref, l_ref, acc_ref,
                     k_scratch, v_scratch, sem, *,
                     page_size: int, pages_per_chunk: int, group: int,
-                    chunk: int, head_dim: int, max_pages: int):
+                    chunk: int, head_dim: int, max_pages: int,
+                    has_layer: bool):
+    # k_out/v_out alias the cache inputs so the caller can thread the
+    # cache through the custom call (see ops/paged_attention_pallas.py
+    # _decode_kernel for the copy-insertion rationale); never written.
+    del k_out, v_out
     b = pl.program_id(0)
     h = pl.program_id(1)
     c = pages_per_chunk
@@ -68,14 +75,23 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref, q_ref,
 
     def dma(slot, chunk_idx, j):
         pid = page_table_ref[b, chunk_idx * c + j]
+        if has_layer:
+            # Stacked cache + prefetched layer scalar: one compiled
+            # kernel for all layers, no materialized layer slice (see
+            # _decode_kernel).
+            k_src = k_hbm.at[layer_ref[0], h, pid]
+            v_src = v_hbm.at[layer_ref[0], h, pid]
+        else:
+            k_src = k_hbm.at[h, pid]
+            v_src = v_hbm.at[h, pid]
         return (
             pltpu.make_async_copy(
-                k_hbm.at[h, pid],
+                k_src,
                 k_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
                 sem.at[0, slot, j],
             ),
             pltpu.make_async_copy(
-                v_hbm.at[h, pid],
+                v_src,
                 v_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
                 sem.at[1, slot, j],
             ),
@@ -162,12 +178,16 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
                             page_table: jnp.ndarray,
                             q_positions: jnp.ndarray,
                             kv_lens: jnp.ndarray,
+                            layer: "jnp.ndarray | int | None" = None,
                             interpret: bool = False) -> jnp.ndarray:
     """Chunked-prefill attention against a sequence's cached pages.
 
     Args:
       q:           [B, T, num_q_heads, head_dim] (chunk, padded)
-      k/v_cache_layer: [num_kv_heads, num_pages, head_dim, page_size]
+      k/v_cache_layer: [num_kv_heads, num_pages, head_dim, page_size],
+                   or the full stacked [L, ...] cache with ``layer``
+                   given (scalar; reaches the kernel via SMEM prefetch
+                   so no per-layer slice is ever materialized)
       page_table:  [B, max_pages] int32 physical page ids
       q_positions: [B, T] int32 absolute positions of the queries;
                    must be contiguous per row (positions[i] =
@@ -176,10 +196,17 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
       kv_lens:     [B] int32 valid cached tokens (incl. this chunk)
       interpret:   run in interpreter mode (CPU testing)
 
-    Returns [B, T, num_q_heads, head_dim].
+    Returns [B, T, num_q_heads, head_dim] for the 4D per-layer cache
+    form; ``(out, k_cache, v_cache)`` for the stacked 5D form (caches
+    pass through the kernel aliased — see paged_decode_attention).
     """
+    has_layer = k_cache_layer.ndim == 5
+    if has_layer and layer is None:
+        raise ValueError("stacked [L, ...] cache needs a layer index")
+    layer_arr = jnp.asarray(
+        [0 if layer is None else layer], jnp.int32)
     b, t, num_q_heads, head_dim = q.shape
-    num_kv_heads, _, _, page_size = k_cache_layer.shape
+    num_kv_heads, _, _, page_size = k_cache_layer.shape[-4:]
     group = num_q_heads // num_kv_heads
     c = _PAGES_PER_CHUNK
 
@@ -203,23 +230,37 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     kernel = functools.partial(
         _prefill_kernel, page_size=page_size, pages_per_chunk=c,
         group=group, chunk=t, head_dim=head_dim, max_pages=max_pages,
+        has_layer=has_layer,
     )
+    if not has_layer:
+        # No pass-through cache outputs: splice placeholder refs into
+        # the kernel's (o_ref, k_out, v_out, *scratch) signature.
+        base_kernel = kernel
+
+        def kernel(pt, kl, qs, la, q, k, v, o_ref, *scratch):
+            base_kernel(pt, kl, qs, la, q, k, v, o_ref, None, None,
+                        *scratch)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,  # page_table, kv_lens, q_start
+        num_scalar_prefetch=4,  # page_table, kv_lens, q_start, layer
         grid=(b, num_kv_heads),
         in_specs=[
             pl.BlockSpec(
                 (1, 1, group * t, head_dim),
-                lambda bi, hi, pt, kl, qs: (bi, hi, 0, 0),
+                lambda bi, hi, pt, kl, qs, la: (bi, hi, 0, 0),
             ),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, group * t, head_dim),
-            lambda bi, hi, pt, kl, qs: (bi, hi, 0, 0),
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, group * t, head_dim),
+                lambda bi, hi, pt, kl, qs, la: (bi, hi, 0, 0),
+            ),
+        ] + ([
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ] if has_layer else []),
         scratch_shapes=[
             pltpu.VMEM((group * t, 1), jnp.float32),  # m
             pltpu.VMEM((group * t, 1), jnp.float32),  # l
@@ -232,15 +273,30 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
         ],
     )
 
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct(
+        (b, num_kv_heads, group * t, head_dim), q.dtype)]
+    if has_layer:
+        out_shape += [
+            jax.ShapeDtypeStruct(
+                k_cache_layer.shape, k_cache_layer.dtype),
+            jax.ShapeDtypeStruct(
+                v_cache_layer.shape, v_cache_layer.dtype),
+        ]
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(
-            (b, num_kv_heads, group * t, head_dim), q.dtype
-        ),
+        out_shape=out_shape,
         grid_spec=grid_spec,
+        # Inputs count scalar-prefetch operands: (page_table, kv_lens,
+        # q_start, layer, q, k, v) -> k=5, v=6 alias outputs 1, 2.
+        # Only the stacked (engine) form aliases — see
+        # paged_decode_attention.
+        input_output_aliases={5: 1, 6: 2} if has_layer else {},
         interpret=interpret,
-    )(page_table, kv_lens, q_start, qg, k_cache_layer,
+    )(page_table, kv_lens, q_start, layer_arr, qg, k_cache_layer,
       v_cache_layer)
-    return (out.reshape(b, num_kv_heads, group, t, head_dim)
-            .transpose(0, 3, 1, 2, 4)
-            .reshape(b, t, num_q_heads, head_dim))
+    out = (res[0].reshape(b, num_kv_heads, group, t, head_dim)
+           .transpose(0, 3, 1, 2, 4)
+           .reshape(b, t, num_q_heads, head_dim))
+    if has_layer:
+        return out, res[1], res[2]
+    return out

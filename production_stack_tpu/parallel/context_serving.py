@@ -89,8 +89,10 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
 
         x = shared_p["embed"][tokens_l]
 
-        def layer_step(x, scanned):
-            lp_i, k_layer, v_layer = scanned
+        # Static loop over layers, in-place cache scatters at a
+        # static index (see models.llama.forward).
+        for layer in range(config.num_hidden_layers):
+            lp_i = {name: s[layer] for name, s in lp.items()}
             a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
             q = a_in @ lp_i["wq"]
             k = a_in @ lp_i["wk"]
@@ -110,21 +112,18 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
             # (linear in T) and do the identical scatter everywhere.
             k_full = jax.lax.all_gather(k, "sp", axis=1, tiled=True)
             v_full = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
-            k_layer = write_to_pages(k_layer, k_full, page_table,
-                                     positions_full, valid_full)
-            v_layer = write_to_pages(v_layer, v_full, page_table,
-                                     positions_full, valid_full)
+            kc = write_to_pages(kc, k_full, page_table,
+                                positions_full, valid_full,
+                                layer=layer)
+            vc = write_to_pages(vc, v_full, page_table,
+                                positions_full, valid_full,
+                                layer=layer)
             x = x + attn.reshape(bl, tl, nh * d) @ lp_i["wo"]
             m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
             x = x + (jax.nn.silu(m_in @ lp_i["w_gate"])
                      * (m_in @ lp_i["w_up"])) @ lp_i["w_down"]
-            return x, (k_layer, v_layer)
-
-        x, (new_k, new_v) = jax.lax.scan(
-            layer_step, x, (lp, kc, vc)
-        )
         return (rms_norm(x, shared_p["final_norm"],
-                         config.rms_norm_eps), new_k, new_v)
+                         config.rms_norm_eps), kc, vc)
 
     repl = P()
     fn = jax.shard_map(

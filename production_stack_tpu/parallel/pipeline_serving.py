@@ -79,8 +79,10 @@ def _local_layers_llama(x, lp, k_local, v_local, page_table, positions,
     d = config.head_dim
     b, t = positions.shape
 
-    def layer_step(x, scanned):
-        lp_i, k_layer, v_layer = scanned
+    # Static loop over the stage's local layers, in-place cache
+    # scatters at a static index (see models.llama.forward).
+    for i in range(k_local.shape[0]):
+        lp_i = {name: s[i] for name, s in lp.items()}
         a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
         q = a_in @ lp_i["wq"]
         k = a_in @ lp_i["wk"]
@@ -92,24 +94,20 @@ def _local_layers_llama(x, lp, k_local, v_local, page_table, positions,
         k = apply_rope(k.reshape(b, t, nkv, d), positions,
                        config.rope_theta)
         v = v.reshape(b, t, nkv, d)
-        k_layer = write_to_pages(k_layer, k, page_table, positions,
-                                 valid)
-        v_layer = write_to_pages(v_layer, v, page_table, positions,
-                                 valid)
-        attn = dispatch_attention(
-            config, q, k_layer, v_layer, page_table, positions, kv_lens
+        k_local = write_to_pages(k_local, k, page_table, positions,
+                                 valid, layer=i)
+        v_local = write_to_pages(v_local, v, page_table, positions,
+                                 valid, layer=i)
+        attn, k_local, v_local = dispatch_attention(
+            config, q, k_local, v_local, page_table, positions,
+            kv_lens, layer=i,
         )
         x = x + _psum_tp(attn.reshape(b, t, nh * d) @ lp_i["wo"], tp)
         m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
         x = x + _psum_tp(
             (jax.nn.silu(m_in @ lp_i["w_gate"])
              * (m_in @ lp_i["w_up"])) @ lp_i["w_down"], tp)
-        return x, (k_layer, v_layer)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (lp, k_local, v_local)
-    )
-    return x, new_k, new_v
+    return x, k_local, v_local
 
 
 def _local_layers_gpt2(x, lp, k_local, v_local, page_table, positions,
@@ -123,18 +121,21 @@ def _local_layers_gpt2(x, lp, k_local, v_local, page_table, positions,
     d = config.head_dim
     b, t = positions.shape
 
-    def layer_step(x, scanned):
-        lp_i, k_layer, v_layer = scanned
+    # Static loop over the stage's local layers, in-place cache
+    # scatters at a static index (see models.llama.forward).
+    for i in range(k_local.shape[0]):
+        lp_i = {name: s[i] for name, s in lp.items()}
         a_in = layer_norm(x, lp_i["attn_norm_w"], lp_i["attn_norm_b"])
         q = (a_in @ lp_i["wq"] + lp_i["bq"]).reshape(b, t, nh, d)
         k = (a_in @ lp_i["wk"] + lp_i["bk"]).reshape(b, t, nh, d)
         v = (a_in @ lp_i["wv"] + lp_i["bv"]).reshape(b, t, nh, d)
-        k_layer = write_to_pages(k_layer, k, page_table, positions,
-                                 valid)
-        v_layer = write_to_pages(v_layer, v, page_table, positions,
-                                 valid)
-        attn = dispatch_attention(
-            config, q, k_layer, v_layer, page_table, positions, kv_lens
+        k_local = write_to_pages(k_local, k, page_table, positions,
+                                 valid, layer=i)
+        v_local = write_to_pages(v_local, v, page_table, positions,
+                                 valid, layer=i)
+        attn, k_local, v_local = dispatch_attention(
+            config, q, k_local, v_local, page_table, positions,
+            kv_lens, layer=i,
         )
         x = x + (_psum_tp(attn.reshape(b, t, nh * d) @ lp_i["wo"], tp)
                  + lp_i["bo"])
@@ -142,12 +143,7 @@ def _local_layers_gpt2(x, lp, k_local, v_local, page_table, positions,
         hidden = jax.nn.gelu(m_in @ lp_i["fc1"] + lp_i["fc1_b"],
                              approximate=True)
         x = x + (_psum_tp(hidden @ lp_i["fc2"], tp) + lp_i["fc2_b"])
-        return x, (k_layer, v_layer)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (lp, k_local, v_local)
-    )
-    return x, new_k, new_v
+    return x, k_local, v_local
 
 
 def _embed(shared_p, config, tokens, positions, dtype):
