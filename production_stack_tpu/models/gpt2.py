@@ -17,7 +17,11 @@ import jax
 import jax.numpy as jnp
 
 from production_stack_tpu.engine.config import ModelConfig
-from production_stack_tpu.models.llama import dispatch_attention
+from production_stack_tpu.models.llama import (
+    dispatch_attention,
+    slice_layer_lora,
+    slice_layer_params,
+)
 from production_stack_tpu.models.opt import layer_norm
 from production_stack_tpu.ops.attention import write_to_pages
 
@@ -86,12 +90,8 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
     # Static layer loop with in-place cache scatters at a static layer
     # index (see models.llama.forward for why scan xs/ys is slow).
     for layer in range(config.num_hidden_layers):
-        # tree.map: a projection may be a quantized (int8, scale)
-        # pytree pair, not a bare array (engine/quantization.py).
-        lp = {k: jax.tree.map(lambda s: s[layer], params[k])
-              for k in names}
-        ll = (None if lora_stacked is None
-              else jax.tree.map(lambda s: s[layer], lora_stacked))
+        lp = slice_layer_params(params, names, layer)
+        ll = slice_layer_lora(lora_stacked, layer)
         a_in = layer_norm(x, lp["attn_norm_w"], lp["attn_norm_b"])
         q = (lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids, lora_scale)
              + lp["bq"]).reshape(b, t, nh, d)

@@ -88,6 +88,25 @@ def dispatch_attention(config: ModelConfig, q, k_cache, v_cache,
     ), k_cache, v_cache
 
 
+def slice_layer_params(params: Params, names, layer: int) -> Params:
+    """One layer's weights out of the layer-stacked param dict.
+
+    tree.map, not plain indexing: a projection may be a quantized
+    (int8, scale) pytree pair rather than a bare array
+    (engine/quantization.py), and every model family's unrolled layer
+    loop must slice both forms identically.
+    """
+    return {k: jax.tree.map(lambda s: s[layer], params[k])
+            for k in names}
+
+
+def slice_layer_lora(lora_stacked, layer: int):
+    """One layer's adapter stacks (or None when LoRA is off)."""
+    if lora_stacked is None:
+        return None
+    return jax.tree.map(lambda s: s[layer], lora_stacked)
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray,
              eps: float) -> jnp.ndarray:
     x32 = x.astype(jnp.float32)
@@ -181,12 +200,9 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
     # config vs ~1.3 ms for this chained-scatter form. Weights are
     # read whole either way, so unrolling costs only HLO size.
     for layer in range(config.num_hidden_layers):
-        # tree.map: a projection may be a quantized (int8, scale)
-        # pytree pair, not a bare array (engine/quantization.py).
-        lp = {k: jax.tree.map(lambda s: s[layer], params[k])
-              for k in _layer_param_names(config)}
-        ll = (None if lora_stacked is None
-              else jax.tree.map(lambda s: s[layer], lora_stacked))
+        lp = slice_layer_params(params, _layer_param_names(config),
+                                layer)
+        ll = slice_layer_lora(lora_stacked, layer)
         # Attention block
         a_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
         q = lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids, lora_scale)

@@ -31,6 +31,8 @@ from production_stack_tpu.engine.config import ModelConfig
 from production_stack_tpu.models.llama import (
     dispatch_attention,
     rms_norm,
+    slice_layer_lora,
+    slice_layer_params,
 )
 from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.rope import apply_rope
@@ -130,12 +132,8 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
     # Static layer loop with in-place cache scatters at a static layer
     # index (see models.llama.forward for why scan xs/ys is slow).
     for layer in range(config.num_hidden_layers):
-        # tree.map: a projection may be a quantized (int8, scale)
-        # pytree pair, not a bare array (engine/quantization.py).
-        lp = {k: jax.tree.map(lambda s: s[layer], params[k])
-              for k in names}
-        ll = (None if lora_stacked is None
-              else jax.tree.map(lambda s: s[layer], lora_stacked))
+        lp = slice_layer_params(params, names, layer)
+        ll = slice_layer_lora(lora_stacked, layer)
         a_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
         q = lora_matmul(a_in, lp["wq"], ll, "wq", lora_ids,
                         lora_scale).reshape(b, t, nh, d)

@@ -23,18 +23,22 @@ NEG_INF = -1e30
 
 def gather_pages(cache_layer: jnp.ndarray,
                  page_table: jnp.ndarray) -> jnp.ndarray:
-    """[kv, num_pages, d, page] gathered to [B, max_pages*page, kv, d].
+    """[kv, num_pages, d, page] gathered to [kv, B, max_pages, d, page].
 
     Cache layout (shared with the Pallas kernels): kv-head axis major
     so TP shards a leading axis, and each page stored *token-minor*
     ([head_dim, page_size]) so a page slice's last two dims are
     (d, 128)-tile-aligned for direct HBM->VMEM DMA and arrive
     pre-transposed for the MXU's ``q @ k^T`` contraction.
+
+    The gather output keeps the cache's native axis order: an explicit
+    transpose here gets hoisted by XLA's algebraic simplifier onto the
+    gather *operand* — materializing a transposed copy of the ENTIRE
+    cache per layer (seen in compiled HLO as [L,kv,pages,d,p]
+    transposes). Consumers contract it via einsum in native order
+    instead.
     """
-    gathered = cache_layer[:, page_table]  # [kv, B, P, d, page]
-    kv, b, p, d, page = gathered.shape
-    return (gathered.transpose(1, 2, 4, 0, 3)  # [B, P, page, kv, d]
-            .reshape(b, p * page, kv, d))
+    return cache_layer[:, page_table]  # [kv, B, P, d, page]
 
 
 def write_to_pages(cache: jnp.ndarray, new_kv: jnp.ndarray,
@@ -62,6 +66,11 @@ def write_to_pages(cache: jnp.ndarray, new_kv: jnp.ndarray,
       positions:   [B, T] absolute token positions
       valid:       [B, T] bool; False entries are redirected to page 0
     """
+    if (cache.ndim == 5) != (layer is not None):
+        raise ValueError(
+            "layer index and cache rank must agree: pass a stacked "
+            "[L, ...] cache WITH layer, or a per-layer [kv, ...] "
+            f"cache WITHOUT (got ndim={cache.ndim}, layer={layer!r})")
     page_size = cache.shape[-1]
     b, t = positions.shape
     logical_page = positions // page_size  # [B, T]
@@ -99,6 +108,12 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
 
     Returns [B, T, num_q_heads, head_dim].
     """
+    if (k_cache_layer.ndim == 5) != (layer is not None):
+        raise ValueError(
+            "layer index and cache rank must agree: pass a stacked "
+            "[L, ...] cache WITH layer, or a per-layer [kv, ...] "
+            f"cache WITHOUT (got ndim={k_cache_layer.ndim}, "
+            f"layer={layer!r})")
     if layer is not None:
         k_cache_layer = k_cache_layer[layer]
         v_cache_layer = v_cache_layer[layer]
@@ -107,25 +122,41 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     group = num_q_heads // num_kv_heads
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, dtype=jnp.float32))
 
-    k = gather_pages(k_cache_layer, page_table)  # [B, S, kv, d]
+    k = gather_pages(k_cache_layer, page_table)  # [kv, B, P, d, page]
     v = gather_pages(v_cache_layer, page_table)
-    s = k.shape[1]
+    p_cnt, page = k.shape[2], k.shape[4]
 
     qg = q.reshape(b, t, num_kv_heads, group, head_dim)
-    # scores: [B, kv, group, T, S]
+    # scores: [B, kv, group, T, P, page], contracted in the cache's
+    # NATIVE axis order. Two deliberate choices, both HBM-traffic
+    # driven (this runs once per layer per step):
+    # - operands stay in the cache dtype with an f32 accumulator (the
+    #   MXU's native bf16xbf16->f32 form): upcasting k/v first makes
+    #   XLA hoist the convert above the page gather and materialize
+    #   the ENTIRE cache in f32,
+    # - no reshape/transpose of the gathered pages: an explicit
+    #   transpose gets hoisted onto the gather operand as a
+    #   whole-cache transposed copy (see gather_pages).
     scores = jnp.einsum(
-        "btkgd,bskd->bkgts", qg.astype(jnp.float32),
-        k.astype(jnp.float32),
+        "btkgd,kbpdc->bkgtpc", qg, k,
+        preferred_element_type=jnp.float32,
     ) * scale
 
-    kv_positions = jnp.arange(s)[None, :]  # [1, S]
-    causal = kv_positions[:, None, :] <= q_positions[:, :, None]  # [B,T,S]
-    in_len = kv_positions < kv_lens[:, None]  # [B, S]
-    mask = causal & in_len[:, None, :]  # [B, T, S]
-    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    token_pos = (jnp.arange(p_cnt)[:, None] * page
+                 + jnp.arange(page)[None, :])  # [P, page]
+    causal = (token_pos[None, None]
+              <= q_positions[:, :, None, None])  # [B, T, P, page]
+    in_len = token_pos[None] < kv_lens[:, None, None]  # [B, P, page]
+    mask = causal & in_len[:, None]  # [B, T, P, page]
+    scores = jnp.where(mask[:, None, None], scores, NEG_INF)
 
-    probs = jax.nn.softmax(scores, axis=-1)
+    # Softmax over the joint (P, page) token axis.
+    shape = scores.shape
+    probs = jax.nn.softmax(
+        scores.reshape(*shape[:-2], p_cnt * page), axis=-1
+    ).reshape(shape)  # f32
     out = jnp.einsum(
-        "bkgts,bskd->btkgd", probs, v.astype(jnp.float32)
+        "bkgtpc,kbpdc->btkgd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
     )
     return out.reshape(b, t, num_q_heads, head_dim).astype(q.dtype)

@@ -220,8 +220,12 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     linear so XLA's copy-insertion never duplicates it.
     """
     has_layer = k_cache_layer.ndim == 5
-    if has_layer and layer is None:
-        raise ValueError("stacked [L, ...] cache needs a layer index")
+    if has_layer != (layer is not None):
+        raise ValueError(
+            "layer index and cache rank must agree: pass a stacked "
+            "[L, ...] cache WITH layer, or a per-layer [kv, ...] "
+            f"cache WITHOUT (got ndim={k_cache_layer.ndim}, "
+            f"layer={layer!r})")
     layer_arr = jnp.asarray(
         [0 if layer is None else layer], jnp.int32)
     b, num_q_heads, head_dim = q.shape

@@ -161,6 +161,70 @@ def test_prefill_kernel_gqa():
     )
 
 
+def test_decode_stacked_cache_layer_form():
+    """The 5D + layer form (what the engine serves: SMEM layer index,
+    cache passed through via input/output aliasing) must match the 4D
+    per-layer slice at a NONZERO layer, and must hand the caches back
+    through unchanged."""
+    q, k_cache, v_cache, page_table, kv_lens = _setup(seed=11)
+    L, layer = 3, 2
+    rng = np.random.RandomState(21)
+    k5 = jnp.asarray(rng.randn(L, *k_cache.shape).astype(np.float32))
+    v5 = jnp.asarray(rng.randn(L, *v_cache.shape).astype(np.float32))
+    out, k_thru, v_thru = paged_decode_attention(
+        q, k5, v5, page_table, kv_lens, layer=layer, interpret=True
+    )
+    ref = paged_decode_attention(
+        q, k5[layer], v5[layer], page_table, kv_lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_array_equal(np.asarray(k_thru), np.asarray(k5))
+    np.testing.assert_array_equal(np.asarray(v_thru), np.asarray(v5))
+
+
+def test_prefill_stacked_cache_layer_form():
+    from production_stack_tpu.ops.prefill_attention_pallas import (
+        paged_prefill_attention,
+    )
+    (q, k_cache, v_cache, page_table, positions,
+     kv_lens) = _prefill_setup(seed=13)
+    L, layer = 3, 1
+    rng = np.random.RandomState(23)
+    k5 = jnp.asarray(rng.randn(L, *k_cache.shape).astype(np.float32))
+    v5 = jnp.asarray(rng.randn(L, *v_cache.shape).astype(np.float32))
+    out, k_thru, v_thru = paged_prefill_attention(
+        q, k5, v5, page_table, positions, kv_lens, layer=layer,
+        interpret=True
+    )
+    ref = paged_prefill_attention(
+        q, k5[layer], v5[layer], page_table, positions, kv_lens,
+        interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_array_equal(np.asarray(k_thru), np.asarray(k5))
+    np.testing.assert_array_equal(np.asarray(v_thru), np.asarray(v5))
+
+
+def test_layer_cache_rank_mismatch_raises():
+    q, k_cache, v_cache, page_table, kv_lens = _setup()
+    with pytest.raises(ValueError, match="layer index and cache rank"):
+        paged_decode_attention(
+            q, k_cache, v_cache, page_table, kv_lens, layer=0,
+            interpret=True)
+    k5 = jnp.asarray(np.zeros((2, *k_cache.shape), np.float32))
+    with pytest.raises(ValueError, match="layer index and cache rank"):
+        paged_decode_attention(
+            q, k5, k5, page_table, kv_lens, interpret=True)
+    with pytest.raises(ValueError, match="layer index and cache rank"):
+        paged_attention(
+            q[:, None], k_cache, v_cache, page_table,
+            (kv_lens - 1)[:, None], kv_lens, layer=0)
+
+
 def test_engine_generates_identically_with_pallas_decode(tmp_path):
     """Greedy generation with the pallas decode path (interpret mode)
     must match the XLA decode path token for token."""
