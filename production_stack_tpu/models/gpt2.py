@@ -4,7 +4,7 @@ Completes the decoder-family coverage the reference gets from vLLM's
 model zoo (engines are external images there —
 helm/templates/deployment-vllm-multi.yaml:55-64). Differences from OPT
 handled here: positional embeddings with no offset, gelu(tanh) MLP,
-always-tied LM head. Same scanned-layer + paged-cache structure as
+always-tied LM head. Same unrolled-layer + paged-cache structure as
 models/llama.py; the HF checkpoint's fused ``c_attn`` is split into
 q/k/v at load time (engine/weights.py) so the attention path is shared.
 """

@@ -3,7 +3,7 @@ model is opt-125m, tutorials/assets/values-01-minimal-example.yaml).
 
 Differences from Llama handled here: learned positional embeddings with
 HF's +2 offset, biased projections, LayerNorm (not RMSNorm), ReLU MLP,
-tied LM head. Same scanned-layer + paged-cache structure as
+tied LM head. Same unrolled-layer + paged-cache structure as
 models/llama.py.
 """
 
