@@ -22,7 +22,10 @@ from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.scheduler import DecodePlan, PrefillPlan
 from production_stack_tpu.engine.sequence import Sequence, decode_budget
 from production_stack_tpu.models.registry import get_model
-from production_stack_tpu.ops.sampling import sample_tokens
+from production_stack_tpu.ops.sampling import (
+    apply_penalties,
+    sample_tokens,
+)
 from production_stack_tpu.parallel.mesh import (
     shard_cache,
     shard_params,
@@ -243,13 +246,18 @@ class ModelRunner:
 
             def _sp_step(params, k_cache, v_cache, tokens, page_table,
                          valid, last_index, temperature, top_p, top_k,
-                         rng):
+                         rng, penalties, seeding):
                 row_logits, k_cache, v_cache = sp_prefill_forward(
                     params, self.config.model, tokens, page_table,
                     valid, last_index, k_cache, v_cache,
                     mesh=self.mesh)
+                if penalties is not None:
+                    row_logits = apply_penalties(row_logits, *penalties)
+                seeds, emitted = (seeding if seeding is not None
+                                  else (None, None))
                 sampled = sample_tokens(row_logits, temperature,
-                                        top_p, top_k, rng)
+                                        top_p, top_k, rng,
+                                        seeds=seeds, emitted=emitted)
                 return sampled, k_cache, v_cache
 
             self._sp_prefill_jit = jax.jit(
@@ -343,8 +351,8 @@ class ModelRunner:
 
     def _step_impl(self, params, k_cache, v_cache, tokens, positions,
                    page_table, kv_lens, valid, last_index, temperature,
-                   top_p, top_k, rng, lora, lora_ids,
-                   sample_index_mode: str):
+                   top_p, top_k, rng, lora, lora_ids, penalties,
+                   seeding, sample_index_mode: str):
         logits, k_cache, v_cache = self._forward(
             params, self.config.model, tokens, positions, page_table,
             kv_lens, valid, k_cache, v_cache,
@@ -356,13 +364,21 @@ class ModelRunner:
         else:
             # Decode: T == 1.
             row_logits = logits[:, 0, :]
-        sampled = sample_tokens(row_logits, temperature, top_p, top_k, rng)
+        if penalties is not None:
+            # (counts, prompt_mask, presence, frequency, repetition);
+            # None in the common no-penalty case so that path compiles
+            # with zero penalty overhead.
+            row_logits = apply_penalties(row_logits, *penalties)
+        seeds, emitted = seeding if seeding is not None else (None, None)
+        sampled = sample_tokens(row_logits, temperature, top_p, top_k,
+                                rng, seeds=seeds, emitted=emitted)
         return sampled, k_cache, v_cache
 
     def _decode_burst_impl(self, params, k_cache, v_cache, tokens,
                            positions, page_table, kv_lens, active,
                            budgets, stop_tokens, temperature, top_p,
-                           top_k, rng, lora, lora_ids, num_steps: int):
+                           top_k, rng, lora, lora_ids, penalties,
+                           seeding, num_steps: int):
         """K chained decode iterations in one program, with per-row
         lifecycle on device.
 
@@ -384,18 +400,48 @@ class ModelRunner:
 
         Returns sampled tokens [K, B] (-1 for frozen slots).
         """
+        b = active.shape[0]
+        if penalties is not None:
+            # (counts, prompt_mask, presence, frequency, repetition):
+            # counts joins the scan carry (updated per step), the rest
+            # stay loop-invariant closures.
+            counts0, penalties = penalties[0], penalties[1:]
+        else:
+            # Zero-size placeholder keeps the carry structure uniform.
+            counts0 = jnp.zeros((b, 0), jnp.int32)
+
         def body(carry, step_rng):
-            tok, pos, kv, act, emitted, kc, vc = carry
+            tok, pos, kv, act, emitted, counts, kc, vc = carry
             logits, kc, vc = self._forward(
                 params, self.config.model, tok, pos, page_table,
                 kv, act[:, None], kc, vc, lora=lora,
                 lora_ids=lora_ids,
             )
-            sampled = sample_tokens(
-                logits[:, 0, :], temperature, top_p, top_k, step_rng
-            )
+            row_logits = logits[:, 0, :]
+            if penalties is not None:
+                prompt_mask, presence, frequency, repetition = penalties
+                row_logits = apply_penalties(
+                    row_logits, counts, prompt_mask, presence,
+                    frequency, repetition)
+            if seeding is not None:
+                # Seeded rows' randomness depends only on (seed,
+                # absolute emitted index), so reproducibility survives
+                # burst boundaries and batch composition.
+                seeds, emitted_start = seeding
+                sampled = sample_tokens(
+                    row_logits, temperature, top_p, top_k, step_rng,
+                    seeds=seeds, emitted=emitted_start + emitted)
+            else:
+                sampled = sample_tokens(
+                    row_logits, temperature, top_p, top_k, step_rng
+                )
             out = jnp.where(act, sampled, -1)
             emitted = emitted + act
+            if penalties is not None:
+                # Occurrence counts track the burst on device so later
+                # steps penalize tokens sampled earlier in the burst.
+                counts = counts.at[jnp.arange(b), sampled].add(
+                    act.astype(counts.dtype))
             hit_stop = jnp.any(
                 sampled[:, None] == stop_tokens, axis=-1
             )
@@ -403,13 +449,13 @@ class ModelRunner:
             step = act_next.astype(pos.dtype)
             return ((jnp.where(act, sampled, tok[:, 0])[:, None],
                      pos + step[:, None], kv + step, act_next,
-                     emitted, kc, vc), out)
+                     emitted, counts, kc, vc), out)
 
         rngs = jax.random.split(rng, num_steps)
         emitted0 = jnp.zeros(active.shape, jnp.int32)
         carry = (tokens, positions, kv_lens, active, emitted0,
-                 k_cache, v_cache)
-        (_, _, _, _, _, k_cache, v_cache), out = jax.lax.scan(
+                 counts0, k_cache, v_cache)
+        (_, _, _, _, _, _, k_cache, v_cache), out = jax.lax.scan(
             body, carry, rngs
         )
         return out, k_cache, v_cache
@@ -444,6 +490,19 @@ class ModelRunner:
         lora_ids = payload.get("lora_ids")
         lora_ids = (None if lora_ids is None
                     else jnp.asarray(lora_ids))
+        penalties = None
+        if "pen_prompt_mask" in payload:
+            penalties = (
+                jnp.asarray(payload["pen_counts"]),
+                jnp.asarray(payload["pen_prompt_mask"]),
+                jnp.asarray(payload["pen_presence"]),
+                jnp.asarray(payload["pen_frequency"]),
+                jnp.asarray(payload["pen_repetition"]),
+            )
+        seeding = None
+        if "seed_rows" in payload:
+            seeding = (jnp.asarray(payload["seed_rows"]),
+                       jnp.asarray(payload["seed_emitted"]))
         if kind == 2 and t > 1:
             sampled, self.k_cache, self.v_cache = \
                 self._decode_burst_jit(
@@ -459,7 +518,7 @@ class ModelRunner:
                     jnp.asarray(payload["top_p"]),
                     jnp.asarray(payload["top_k"]),
                     jnp.asarray(payload["rng"]),
-                    self._lora_stack, lora_ids,
+                    self._lora_stack, lora_ids, penalties, seeding,
                     num_steps=t,
                 )
             return sampled  # [K, B]
@@ -475,10 +534,62 @@ class ModelRunner:
             jnp.asarray(payload["top_p"]),
             jnp.asarray(payload["top_k"]),
             jnp.asarray(payload["rng"]),
-            self._lora_stack, lora_ids,
+            self._lora_stack, lora_ids, penalties, seeding,
             sample_index_mode=("last" if kind == 1 else "first"),
         )
         return sampled
+
+    def _penalty_payload(self, seqs: "List[Optional[Sequence]]",
+                         pad_to: int) -> dict:
+        """Per-row penalty inputs, or {} when no row needs them (the
+        no-penalty batch keeps its penalty-free compiled program and
+        pays no [B, vocab] host->device transfer). ``None`` rows
+        (e.g. mid-prompt prefill chunks that discard their sample)
+        keep the no-op defaults."""
+        if not any(s is not None and s.sampling.needs_penalties
+                   for s in seqs):
+            return {}
+        v = self.config.model.vocab_size
+        counts = np.zeros((pad_to, v), np.int32)
+        pmask = np.zeros((pad_to, v), bool)
+        presence = np.zeros((pad_to,), np.float32)
+        frequency = np.zeros((pad_to,), np.float32)
+        repetition = np.ones((pad_to,), np.float32)
+        for i, seq in enumerate(seqs):
+            if seq is None:
+                continue
+            sp = seq.sampling
+            presence[i] = sp.presence_penalty
+            frequency[i] = sp.frequency_penalty
+            repetition[i] = sp.repetition_penalty
+            if sp.needs_penalties:
+                if seq.output_token_ids:
+                    np.add.at(
+                        counts[i],
+                        np.asarray(seq.output_token_ids, np.int64), 1)
+                pmask[i, np.asarray(
+                    seq.prompt_token_ids, np.int64)] = True
+        return {"pen_counts": counts, "pen_prompt_mask": pmask,
+                "pen_presence": presence, "pen_frequency": frequency,
+                "pen_repetition": repetition}
+
+    def _seed_payload(self, seqs: "List[Optional[Sequence]]",
+                      pad_to: int) -> dict:
+        """Per-row seed inputs, or {} when no row set a seed (the
+        unseeded batch keeps its seed-free compiled program)."""
+        if not any(s is not None and s.sampling.seed is not None
+                   for s in seqs):
+            return {}
+        seeds = np.full((pad_to,), -1, np.int64)
+        emitted = np.zeros((pad_to,), np.int32)
+        for i, seq in enumerate(seqs):
+            if seq is None:
+                continue
+            if seq.sampling.seed is not None:
+                seeds[i] = int(seq.sampling.seed) & 0xFFFFFFFF
+            emitted[i] = len(seq.output_token_ids)
+        return {"seed_rows": seeds.astype(np.int32),
+                "seed_emitted": emitted}
 
     def _dispatch(self, kind: int, t: int, payload: dict) -> jax.Array:
         if self.bridge is not None:
@@ -515,6 +626,19 @@ class ModelRunner:
         tokens[0, :n] = chunk.chunk_tokens
         valid[0, :n] = True
         sp_params = seq.sampling
+        pen = self._penalty_payload([seq], 1)
+        penalties = None
+        if pen:
+            penalties = (jnp.asarray(pen["pen_counts"]),
+                         jnp.asarray(pen["pen_prompt_mask"]),
+                         jnp.asarray(pen["pen_presence"]),
+                         jnp.asarray(pen["pen_frequency"]),
+                         jnp.asarray(pen["pen_repetition"]))
+        sd = self._seed_payload([seq], 1)
+        seeding = None
+        if sd:
+            seeding = (jnp.asarray(sd["seed_rows"]),
+                       jnp.asarray(sd["seed_emitted"]))
         sampled, self.k_cache, self.v_cache = self._sp_prefill_jit(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(tokens),
@@ -525,7 +649,7 @@ class ModelRunner:
                                    np.float32)),
             jnp.asarray(np.asarray([sp_params.top_p], np.float32)),
             jnp.asarray(np.asarray([sp_params.top_k], np.int32)),
-            self._next_rng(),
+            self._next_rng(), penalties, seeding,
         )
         return [int(jax.device_get(sampled)[0])]
 
@@ -582,6 +706,13 @@ class ModelRunner:
             for i, chunk in enumerate(chunks):
                 ids[i] = chunk.seq.lora_id
             payload["lora_ids"] = ids
+        # Only rows whose LAST chunk is in this dispatch keep their
+        # sampled token; mid-prompt chunks skip the [B, vocab] penalty
+        # transfer and the penalized program entirely.
+        sampling_rows = [c.seq if c.is_last_chunk else None
+                         for c in chunks]
+        payload.update(self._penalty_payload(sampling_rows, b))
+        payload.update(self._seed_payload(sampling_rows, b))
 
         t0 = time.perf_counter() if _TIMING else 0.0
         sampled = self._dispatch(1, t, payload)
@@ -663,6 +794,8 @@ class ModelRunner:
             for i, seq in enumerate(seqs):
                 ids[i] = seq.lora_id
             payload["lora_ids"] = ids
+        payload.update(self._penalty_payload(seqs, b))
+        payload.update(self._seed_payload(seqs, b))
 
         t0 = time.perf_counter() if _TIMING else 0.0
         sampled = self._dispatch(2, window, payload)

@@ -15,12 +15,30 @@ class SamplingParams:
     top_p: float = 1.0
     top_k: int = 0  # 0 = disabled
     stop_token_ids: List[int] = field(default_factory=list)
+    # Text-level stop sequences (OpenAI ``stop``): enforced by the
+    # server on the detokenized stream (engine/server.py
+    # _StopStringScanner) — token-level state can't see them because
+    # a stop string may span token boundaries.
+    stop_strings: List[str] = field(default_factory=list)
+    # OpenAI penalties over the tokens GENERATED so far (presence:
+    # flat once seen; frequency: per occurrence) and vLLM/HF-style
+    # repetition penalty over prompt+output. Applied on device inside
+    # the compiled step (ops/sampling.py apply_penalties).
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
     ignore_eos: bool = False
     seed: Optional[int] = None
 
     @property
     def greedy(self) -> bool:
         return self.temperature == 0.0
+
+    @property
+    def needs_penalties(self) -> bool:
+        return (self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0
+                or self.repetition_penalty != 1.0)
 
 
 class SequenceState(enum.Enum):

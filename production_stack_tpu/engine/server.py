@@ -146,13 +146,77 @@ def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
     temperature = body.get("temperature")
     top_p = body.get("top_p")
     top_k = body.get("top_k")
+    stop = body.get("stop")
+    if stop is None:
+        stop_strings = []
+    elif isinstance(stop, str):
+        stop_strings = [stop]
+    else:
+        stop_strings = [str(s) for s in stop][:4]  # OpenAI caps at 4
+    presence = body.get("presence_penalty")
+    frequency = body.get("frequency_penalty")
+    repetition = body.get("repetition_penalty")  # vLLM extension
     return SamplingParams(
         max_tokens=min(int(max_tokens), max_model_len),
         temperature=1.0 if temperature is None else float(temperature),
         top_p=1.0 if top_p is None else float(top_p),
         top_k=0 if top_k is None else int(top_k),
+        stop_strings=stop_strings,
+        presence_penalty=0.0 if presence is None else float(presence),
+        frequency_penalty=(0.0 if frequency is None
+                           else float(frequency)),
+        repetition_penalty=(1.0 if repetition is None
+                            else float(repetition)),
         ignore_eos=bool(body.get("ignore_eos", False)),
+        seed=None if body.get("seed") is None else int(body["seed"]),
     )
+
+
+class _StopStringScanner:
+    """Incremental OpenAI ``stop``-sequence detection on decoded text.
+
+    Stop sequences are a TEXT contract: a stop string can span token
+    boundaries, so it cannot be evaluated on token ids in the engine.
+    The scanner holds back the last ``max(len(stop)) - 1`` characters
+    of the stream; on a hit it emits only the text before the stop
+    (OpenAI semantics: the stop sequence itself is not returned) and
+    flags ``stopped`` so the caller aborts the engine sequence.
+    """
+
+    def __init__(self, stops):
+        self.stops = [s for s in stops if s]
+        self.hold = (max(len(s) for s in self.stops) - 1
+                     if self.stops else 0)
+        self.buf = ""
+        self.stopped = False
+
+    def feed(self, delta: str) -> str:
+        if self.stopped or not delta:
+            return ""
+        if not self.stops:
+            return delta
+        self.buf += delta
+        hit = -1
+        for s in self.stops:
+            j = self.buf.find(s)
+            if j != -1 and (hit == -1 or j < hit):
+                hit = j
+        if hit != -1:
+            self.stopped = True
+            out, self.buf = self.buf[:hit], ""
+            return out
+        if len(self.buf) > self.hold:
+            cut = len(self.buf) - self.hold
+            out, self.buf = self.buf[:cut], self.buf[cut:]
+            return out
+        return ""
+
+    def flush(self) -> str:
+        """Emit any held-back tail once the stream ends unstopped."""
+        if self.stopped:
+            return ""
+        out, self.buf = self.buf, ""
+        return out
 
 
 def _usage(prompt_len: int, completion_len: int) -> dict:
@@ -285,51 +349,114 @@ class EngineServer:
         # the same so per-model client accounting stays correct).
         response_model = lora_name or self.model_name
 
-        seq_id, stream = await self.async_engine.submit(
-            prompt, sampling, lora_name=lora_name
-        )
-        decoder = self._delta_decoder()
+        n = body.get("n")
+        try:
+            # 0 is invalid, not "default": only JSON null/absent means 1.
+            n = 1 if n is None else int(n)
+        except (TypeError, ValueError):
+            n = -1
+        if not 1 <= n <= 16:
+            return web.json_response(
+                {"error": {"message": "'n' must be an integer in "
+                                      "[1, 16]",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
 
-        if not stream_mode:
+        # ``n`` choices = n engine sequences sharing one prompt; the
+        # prefix cache makes the shared prompt prefill nearly free
+        # after the first, and continuous batching decodes them as
+        # ordinary batch rows.
+        subs = [await self.async_engine.submit(
+            prompt, sampling, lora_name=lora_name) for _ in range(n)]
+
+        async def consume_choice(seq_id, stream, on_delta=None):
+            """Drain one sequence's stream with stop-string scanning.
+
+            Returns (text, n_tokens, finish_reason); ``on_delta``
+            (streaming mode) is awaited per emitted text delta.
+            """
+            decoder = self._delta_decoder()
+            scanner = _StopStringScanner(sampling.stop_strings)
             pieces: List[str] = []
             n_tokens = 0
             finish_reason = "stop"
+
+            async def emit(text):
+                if not text:
+                    return
+                if on_delta is not None:
+                    # Streaming: deltas go straight to the wire; never
+                    # buffer the whole completion in memory.
+                    await on_delta(text)
+                else:
+                    pieces.append(text)
+
             try:
                 while True:
                     out = await stream.get()
                     if out.new_token is not None:
                         n_tokens += 1
-                        pieces.append(decoder(out.new_token))
+                        await emit(scanner.feed(decoder(out.new_token)))
+                        if scanner.stopped:
+                            # Text-level stop hit: the engine doesn't
+                            # know about it, so cut generation here.
+                            self.async_engine.abort(seq_id)
+                            finish_reason = "stop"
+                            break
                     if out.finished:
                         finish_reason = out.finish_reason or "stop"
-                        pieces.append(decoder(None, flush=True))
+                        await emit(scanner.feed(
+                            decoder(None, flush=True)))
+                        await emit(scanner.flush())
+                        if scanner.stopped:
+                            # The stop landed in the final flush: the
+                            # engine's reason (e.g. length) is
+                            # superseded by the text-level stop.
+                            finish_reason = "stop"
                         break
-            except asyncio.CancelledError:
-                self.async_engine.abort(seq_id)
-                raise
             finally:
                 self.async_engine.finish_stream(seq_id)
-            text = "".join(pieces)
+            return "".join(pieces), n_tokens, finish_reason
+
+        if not stream_mode:
+            tasks = [asyncio.ensure_future(consume_choice(sid, stream))
+                     for sid, stream in subs]
+            try:
+                results = await asyncio.gather(*tasks)
+            except BaseException:
+                # One choice failed or the request was cancelled:
+                # cancel the sibling consumers (gather leaves them
+                # running) and stop every engine sequence.
+                for t in tasks:
+                    t.cancel()
+                for sid, _ in subs:
+                    self.async_engine.abort(sid)
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
+            total_tokens = sum(r[1] for r in results)
             if chat:
+                choices = [{
+                    "index": i,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish,
+                } for i, (text, _, finish) in enumerate(results)]
                 payload = {
                     "id": rid, "object": "chat.completion",
                     "created": created, "model": response_model,
-                    "choices": [{
-                        "index": 0,
-                        "message": {"role": "assistant", "content": text},
-                        "finish_reason": finish_reason,
-                    }],
-                    "usage": _usage(len(prompt), n_tokens),
+                    "choices": choices,
+                    "usage": _usage(len(prompt), total_tokens),
                 }
             else:
+                choices = [{
+                    "index": i, "text": text,
+                    "finish_reason": finish,
+                } for i, (text, _, finish) in enumerate(results)]
                 payload = {
                     "id": rid, "object": "text_completion",
                     "created": created, "model": response_model,
-                    "choices": [{
-                        "index": 0, "text": text,
-                        "finish_reason": finish_reason,
-                    }],
-                    "usage": _usage(len(prompt), n_tokens),
+                    "choices": choices,
+                    "usage": _usage(len(prompt), total_tokens),
                 }
             return web.json_response(payload)
 
@@ -342,48 +469,57 @@ class EngineServer:
         def sse(payload: dict) -> bytes:
             return f"data: {json.dumps(payload)}\n\n".encode()
 
-        def chunk(delta: Optional[str], finish: Optional[str],
-                  first: bool = False) -> dict:
+        def chunk(index: int, delta: Optional[str],
+                  finish: Optional[str], first: bool = False) -> dict:
             if chat:
                 d: Dict[str, Any] = {}
                 if first:
                     d["role"] = "assistant"
                 if delta:
                     d["content"] = delta
-                choice = {"index": 0, "delta": d,
+                choice = {"index": index, "delta": d,
                           "finish_reason": finish}
                 obj = "chat.completion.chunk"
             else:
-                choice = {"index": 0, "text": delta or "",
+                choice = {"index": index, "text": delta or "",
                           "finish_reason": finish}
                 obj = "text_completion"
             return {"id": rid, "object": obj, "created": created,
                     "model": response_model, "choices": [choice]}
 
+        write_lock = asyncio.Lock()
+
+        async def stream_choice(index, seq_id, stream):
+            async def on_delta(text):
+                async with write_lock:
+                    await resp.write(sse(chunk(index, text, None)))
+
+            _, _, finish_reason = await consume_choice(
+                seq_id, stream, on_delta=on_delta)
+            async with write_lock:
+                await resp.write(sse(chunk(index, None, finish_reason)))
+
+        tasks = [asyncio.ensure_future(stream_choice(i, sid, stream))
+                 for i, (sid, stream) in enumerate(subs)]
         try:
             if chat:
-                await resp.write(sse(chunk(None, None, first=True)))
-            while True:
-                out = await stream.get()
-                if out.new_token is not None:
-                    delta = decoder(out.new_token)
-                    if delta:
-                        await resp.write(sse(chunk(delta, None)))
-                if out.finished:
-                    tail = decoder(None, flush=True)
-                    if tail:
-                        await resp.write(sse(chunk(tail, None)))
-                    await resp.write(
-                        sse(chunk(None, out.finish_reason or "stop"))
-                    )
-                    break
+                for i in range(n):
+                    await resp.write(sse(chunk(i, None, None,
+                                               first=True)))
+            await asyncio.gather(*tasks)
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
-        except (ConnectionResetError, asyncio.CancelledError):
-            self.async_engine.abort(seq_id)
+        except BaseException:
+            # Disconnect or failure on one choice: cancel the sibling
+            # stream tasks BEFORE aborting (abort pops their streams,
+            # and a consumer still waiting on a popped stream would
+            # block forever), then reap them.
+            for t in tasks:
+                t.cancel()
+            for sid, _ in subs:
+                self.async_engine.abort(sid)
+            await asyncio.gather(*tasks, return_exceptions=True)
             raise
-        finally:
-            self.async_engine.finish_stream(seq_id)
         return resp
 
     async def embeddings(self, request: web.Request):

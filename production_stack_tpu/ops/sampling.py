@@ -11,9 +11,43 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def apply_penalties(logits: jnp.ndarray, counts: jnp.ndarray,
+                    prompt_mask: jnp.ndarray,
+                    presence: jnp.ndarray, frequency: jnp.ndarray,
+                    repetition: jnp.ndarray) -> jnp.ndarray:
+    """Sampling penalties, vectorized per row.
+
+    OpenAI semantics for presence/frequency (over tokens *generated*
+    so far) and vLLM/HF semantics for repetition (over prompt +
+    generated: positive logits divided by r, negative multiplied).
+
+    Args:
+      logits:      [B, vocab] f32
+      counts:      [B, vocab] int32 occurrences in the OUTPUT so far
+      prompt_mask: [B, vocab] bool, True where the token appears in
+                   the prompt
+      presence/frequency: [B] f32 (0 disables)
+      repetition:  [B] f32 (1 disables)
+
+    Returns penalized [B, vocab] logits.
+    """
+    countsf = counts.astype(logits.dtype)
+    seen_out = countsf > 0
+    # Repetition applies FIRST, on the raw logits (vLLM/HF order);
+    # presence/frequency subtract from the result.
+    seen_any = seen_out | prompt_mask
+    rep = repetition[:, None]
+    repeated = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(seen_any, repeated, logits)
+    logits = logits - presence[:, None] * seen_out.astype(logits.dtype)
+    return logits - frequency[:, None] * countsf
+
+
 def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
                   top_p: jnp.ndarray, top_k: jnp.ndarray,
-                  key: jax.Array) -> jnp.ndarray:
+                  key: jax.Array,
+                  seeds: "jnp.ndarray | None" = None,
+                  emitted: "jnp.ndarray | None" = None) -> jnp.ndarray:
     """Sample one token per row.
 
     Args:
@@ -21,7 +55,14 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
       temperature: [B] (0 => greedy)
       top_p:       [B] (1.0 => disabled)
       top_k:       [B] int32 (0 => disabled)
-      key:         PRNG key
+      key:         PRNG key (the engine's stream; used for unseeded rows)
+      seeds:       optional [B] int32 per-row request seeds (-1 =
+                   unseeded). A seeded row's randomness derives ONLY
+                   from (seed, emitted-token index), so identical
+                   seeded requests reproduce identical samples
+                   regardless of batch composition or engine history.
+      emitted:     [B] int32 tokens generated so far per row (required
+                   with ``seeds``)
 
     Returns [B] int32 token ids.
     """
@@ -30,6 +71,22 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
 
     safe_temp = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_temp[:, None]
+
+    def categorical(masked):
+        if seeds is None:
+            return jax.random.categorical(key, masked, axis=-1)
+        # Per-row keys (legacy uint32[2] key form, what the engine's
+        # PRNGKey stream uses): unseeded rows fold the row index into
+        # the engine key; seeded rows rebuild their key from
+        # (seed, emitted index) only.
+        row_keys = jax.vmap(
+            lambda i: jax.random.fold_in(key, i))(jnp.arange(b))
+        seeded_keys = jax.vmap(
+            lambda s, e: jax.random.fold_in(
+                jax.random.PRNGKey(s.astype(jnp.uint32)), e)
+        )(seeds, emitted)
+        keys = jnp.where((seeds >= 0)[:, None], seeded_keys, row_keys)
+        return jax.vmap(jax.random.categorical)(keys, masked)
 
     def masked_sample():
         # Rank of each logit within its row (0 = largest).
@@ -53,11 +110,11 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
         masked = jnp.zeros_like(scaled).at[
             jnp.arange(b)[:, None], sort_idx
         ].set(masked_sorted)
-        return jax.random.categorical(key, masked, axis=-1)
+        return categorical(masked)
 
     def plain_sample():
         # No top-k/top-p anywhere in the batch: skip the vocab sort.
-        return jax.random.categorical(key, scaled, axis=-1)
+        return categorical(scaled)
 
     def sample_path():
         needs_mask = jnp.any((top_k > 0) | (top_p < 1.0))

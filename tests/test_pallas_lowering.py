@@ -159,7 +159,8 @@ def test_decode_burst_program_lowers_for_tpu():
         jnp.full((b, 16), -1, jnp.int32),
         jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32),
         jnp.zeros((b,), jnp.int32), jax.random.PRNGKey(0),
-        None, None,
+        None, None,   # lora, lora_ids
+        None, None,   # penalties, seeding
     )
     traced = jax.jit(
         runner._decode_burst_impl, static_argnames=("num_steps",)

@@ -61,3 +61,44 @@ def test_mixed_greedy_and_stochastic_rows():
     )
     # Row 0 greedy regardless of the stochastic row alongside.
     assert int(out[0]) == int(jnp.argmax(logits[0]))
+
+
+def test_apply_penalties_semantics():
+    from production_stack_tpu.ops.sampling import apply_penalties
+
+    logits = jnp.asarray([[2.0, -1.0, 0.5, 3.0]], jnp.float32)
+    counts = jnp.asarray([[2, 0, 1, 0]], jnp.int32)     # output so far
+    pmask = jnp.asarray([[False, True, False, False]])  # in prompt
+    out = apply_penalties(
+        logits, counts, pmask,
+        presence=jnp.asarray([0.5], jnp.float32),
+        frequency=jnp.asarray([0.25], jnp.float32),
+        repetition=jnp.asarray([2.0], jnp.float32),
+    )
+    out = np.asarray(out)[0]
+    # vLLM/HF order: repetition first on the raw logit, then the
+    # presence/frequency subtractions.
+    # token 0: seen twice -> 2.0/2 = 1.0, then -0.5 - 2*0.25
+    np.testing.assert_allclose(out[0], 2.0 / 2.0 - 0.5 - 0.5)
+    # token 1: prompt-only -> negative logit * r; no pres/freq
+    np.testing.assert_allclose(out[1], -1.0 * 2.0)
+    # token 2: seen once -> 0.5/2 = 0.25, then -0.5 - 0.25
+    np.testing.assert_allclose(out[2], 0.5 / 2.0 - 0.5 - 0.25)
+    # token 3: never seen -> unchanged
+    np.testing.assert_allclose(out[3], 3.0)
+
+
+def test_apply_penalties_disabled_is_identity():
+    from production_stack_tpu.ops.sampling import apply_penalties
+
+    logits = _logits(3, seed=9)
+    counts = jnp.ones(logits.shape, jnp.int32)
+    pmask = jnp.ones(logits.shape, bool)
+    out = apply_penalties(
+        logits, counts, pmask,
+        presence=jnp.zeros(3, jnp.float32),
+        frequency=jnp.zeros(3, jnp.float32),
+        repetition=jnp.ones(3, jnp.float32),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(logits),
+                               rtol=1e-6)
