@@ -40,6 +40,9 @@ class StepOutput:
     new_token: Optional[int]
     finished: bool
     finish_reason: Optional[str]
+    # (sampled_logprob, [(token_id, logprob), ...]) when the request
+    # asked for logprobs; None otherwise.
+    logprobs: Optional[tuple] = None
 
 
 class LLMEngine:
@@ -205,21 +208,27 @@ class LLMEngine:
                 self.sequences.pop(out.seq_id, None)
             return outputs
         if plan.prefill is not None:
-            sampled = self.runner.run_prefill(plan.prefill)
+            sampled, lp_rows = self.runner.run_prefill(plan.prefill)
             with self._lock:
-                for chunk, token in zip(plan.prefill.chunks, sampled):
+                for i, (chunk, token) in enumerate(
+                        zip(plan.prefill.chunks, sampled)):
                     self.scheduler.on_prefill_executed(chunk, token)
                     if chunk.is_last_chunk:
-                        outputs.append(self._delta(chunk.seq, token))
+                        outputs.append(self._delta(
+                            chunk.seq, token,
+                            lp_rows[i] if lp_rows else None))
         else:
-            token_lists = self.runner.run_decode(plan.decode)
+            token_lists, lp_lists = self.runner.run_decode(plan.decode)
             with self._lock:
-                for seq, toks in zip(plan.decode.seqs, token_lists):
-                    for tok in toks:
+                for i, (seq, toks) in enumerate(
+                        zip(plan.decode.seqs, token_lists)):
+                    for k, tok in enumerate(toks):
                         if seq.state != SequenceState.RUNNING:
                             break  # stop hit mid-window: drop the tail
                         self.scheduler.append_decode_token(seq, tok)
-                        outputs.append(self._delta(seq, tok))
+                        outputs.append(self._delta(
+                            seq, tok,
+                            lp_lists[i][k] if lp_lists else None))
         for out in outputs:
             if out.finished:
                 seq = self.sequences.pop(out.seq_id, None)
@@ -227,7 +236,8 @@ class LLMEngine:
                     self.metrics.on_finished(seq)
         return outputs
 
-    def _delta(self, seq: Sequence, token: Optional[int]) -> StepOutput:
+    def _delta(self, seq: Sequence, token: Optional[int],
+               logprobs: Optional[tuple] = None) -> StepOutput:
         finished = seq.state in (
             SequenceState.FINISHED, SequenceState.ABORTED
         )
@@ -237,6 +247,7 @@ class LLMEngine:
             finished=finished,
             finish_reason=(seq.finish_reason.value
                            if seq.finish_reason else None),
+            logprobs=logprobs,
         )
 
     # ---- metrics ----------------------------------------------------------

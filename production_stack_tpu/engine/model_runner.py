@@ -25,6 +25,7 @@ from production_stack_tpu.models.registry import get_model
 from production_stack_tpu.ops.sampling import (
     apply_penalties,
     sample_tokens,
+    token_logprobs,
 )
 from production_stack_tpu.parallel.mesh import (
     shard_cache,
@@ -40,6 +41,12 @@ logger = init_logger(__name__)
 # stop ids than this still finish correctly — the host enforces the
 # full set; the burst merely speculates a little further.
 STOP_SET_WIDTH = 16
+
+# Compiled top-logprobs width: OpenAI allows top_logprobs 0-20 but a
+# per-request width would compile a program per value; requests are
+# served min(requested, TOP_LOGPROBS_WIDTH) alternatives from one
+# compiled shape.
+TOP_LOGPROBS_WIDTH = 8
 
 # PSTPU_TIMING=1: log every dispatch's wall time (dispatch ->
 # device_get of the sampled tokens, i.e. including device execution)
@@ -222,7 +229,7 @@ class ModelRunner:
 
         self._step_jit = jax.jit(
             self._step_impl,
-            static_argnames=("sample_index_mode",),
+            static_argnames=("sample_index_mode", "want_logprobs"),
             donate_argnums=(1, 2),  # k_cache, v_cache
         )
         # Decode burst: K decode iterations fused into one compiled
@@ -236,7 +243,7 @@ class ModelRunner:
         # difference between host-bound and device-bound serving.
         self._decode_burst_jit = jax.jit(
             self._decode_burst_impl,
-            static_argnames=("num_steps",),
+            static_argnames=("num_steps", "want_logprobs"),
             donate_argnums=(1, 2),  # k_cache, v_cache
         )
         if self._sp_size > 1:
@@ -352,7 +359,8 @@ class ModelRunner:
     def _step_impl(self, params, k_cache, v_cache, tokens, positions,
                    page_table, kv_lens, valid, last_index, temperature,
                    top_p, top_k, rng, lora, lora_ids, penalties,
-                   seeding, sample_index_mode: str):
+                   seeding, sample_index_mode: str,
+                   want_logprobs: bool = False):
         logits, k_cache, v_cache = self._forward(
             params, self.config.model, tokens, positions, page_table,
             kv_lens, valid, k_cache, v_cache,
@@ -364,6 +372,7 @@ class ModelRunner:
         else:
             # Decode: T == 1.
             row_logits = logits[:, 0, :]
+        raw_logits = row_logits
         if penalties is not None:
             # (counts, prompt_mask, presence, frequency, repetition);
             # None in the common no-penalty case so that path compiles
@@ -372,13 +381,21 @@ class ModelRunner:
         seeds, emitted = seeding if seeding is not None else (None, None)
         sampled = sample_tokens(row_logits, temperature, top_p, top_k,
                                 rng, seeds=seeds, emitted=emitted)
+        if want_logprobs:
+            # From the raw distribution (pre-penalty/temperature), the
+            # OpenAI logprobs contract. raw_logits is bound before the
+            # penalty rewrite above.
+            lp = token_logprobs(raw_logits, sampled,
+                                TOP_LOGPROBS_WIDTH)
+            return (sampled,) + lp, k_cache, v_cache
         return sampled, k_cache, v_cache
 
     def _decode_burst_impl(self, params, k_cache, v_cache, tokens,
                            positions, page_table, kv_lens, active,
                            budgets, stop_tokens, temperature, top_p,
                            top_k, rng, lora, lora_ids, penalties,
-                           seeding, num_steps: int):
+                           seeding, num_steps: int,
+                           want_logprobs: bool = False):
         """K chained decode iterations in one program, with per-row
         lifecycle on device.
 
@@ -418,6 +435,7 @@ class ModelRunner:
                 lora_ids=lora_ids,
             )
             row_logits = logits[:, 0, :]
+            raw_logits = row_logits
             if penalties is not None:
                 prompt_mask, presence, frequency, repetition = penalties
                 row_logits = apply_penalties(
@@ -436,6 +454,9 @@ class ModelRunner:
                     row_logits, temperature, top_p, top_k, step_rng
                 )
             out = jnp.where(act, sampled, -1)
+            if want_logprobs:
+                out = (out,) + token_logprobs(raw_logits, sampled,
+                                              TOP_LOGPROBS_WIDTH)
             emitted = emitted + act
             if penalties is not None:
                 # Occurrence counts track the burst on device so later
@@ -490,19 +511,8 @@ class ModelRunner:
         lora_ids = payload.get("lora_ids")
         lora_ids = (None if lora_ids is None
                     else jnp.asarray(lora_ids))
-        penalties = None
-        if "pen_prompt_mask" in payload:
-            penalties = (
-                jnp.asarray(payload["pen_counts"]),
-                jnp.asarray(payload["pen_prompt_mask"]),
-                jnp.asarray(payload["pen_presence"]),
-                jnp.asarray(payload["pen_frequency"]),
-                jnp.asarray(payload["pen_repetition"]),
-            )
-        seeding = None
-        if "seed_rows" in payload:
-            seeding = (jnp.asarray(payload["seed_rows"]),
-                       jnp.asarray(payload["seed_emitted"]))
+        penalties, seeding = self._optional_device_inputs(payload)
+        want_lp = bool(payload.get("want_logprobs", False))
         if kind == 2 and t > 1:
             sampled, self.k_cache, self.v_cache = \
                 self._decode_burst_jit(
@@ -519,9 +529,9 @@ class ModelRunner:
                     jnp.asarray(payload["top_k"]),
                     jnp.asarray(payload["rng"]),
                     self._lora_stack, lora_ids, penalties, seeding,
-                    num_steps=t,
+                    num_steps=t, want_logprobs=want_lp,
                 )
-            return sampled  # [K, B]
+            return sampled  # [K, B] (+ logprob arrays when requested)
         sampled, self.k_cache, self.v_cache = self._step_jit(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(payload["tokens"]),
@@ -536,8 +546,16 @@ class ModelRunner:
             jnp.asarray(payload["rng"]),
             self._lora_stack, lora_ids, penalties, seeding,
             sample_index_mode=("last" if kind == 1 else "first"),
+            want_logprobs=want_lp,
         )
         return sampled
+
+    @staticmethod
+    def _lp_entry(seq, slp, tids, tlps):
+        """One position's logprob info, trimmed to the row's request."""
+        k = min(max(seq.sampling.top_logprobs, 0), TOP_LOGPROBS_WIDTH)
+        return (float(slp),
+                [(int(tids[j]), float(tlps[j])) for j in range(k)])
 
     def _penalty_payload(self, seqs: "List[Optional[Sequence]]",
                          pad_to: int) -> dict:
@@ -586,10 +604,34 @@ class ModelRunner:
             if seq is None:
                 continue
             if seq.sampling.seed is not None:
-                seeds[i] = int(seq.sampling.seed) & 0xFFFFFFFF
+                # Fold to 31 bits: the device gate is ``seeds >= 0``
+                # (int32), so bit 31 must never survive — otherwise
+                # half the seed space (and all negative seeds) would
+                # silently sample unseeded. XOR-folding keeps the map
+                # deterministic, which is all reproducibility needs.
+                s32 = int(seq.sampling.seed) & 0xFFFFFFFF
+                seeds[i] = (s32 & 0x7FFFFFFF) ^ (s32 >> 31)
             emitted[i] = len(seq.output_token_ids)
         return {"seed_rows": seeds.astype(np.int32),
                 "seed_emitted": emitted}
+
+    @staticmethod
+    def _optional_device_inputs(payload: dict):
+        """(penalties, seeding) device tuples from a step payload."""
+        penalties = None
+        if "pen_prompt_mask" in payload:
+            penalties = (
+                jnp.asarray(payload["pen_counts"]),
+                jnp.asarray(payload["pen_prompt_mask"]),
+                jnp.asarray(payload["pen_presence"]),
+                jnp.asarray(payload["pen_frequency"]),
+                jnp.asarray(payload["pen_repetition"]),
+            )
+        seeding = None
+        if "seed_rows" in payload:
+            seeding = (jnp.asarray(payload["seed_rows"]),
+                       jnp.asarray(payload["seed_emitted"]))
+        return penalties, seeding
 
     def _dispatch(self, kind: int, t: int, payload: dict) -> jax.Array:
         if self.bridge is not None:
@@ -626,19 +668,10 @@ class ModelRunner:
         tokens[0, :n] = chunk.chunk_tokens
         valid[0, :n] = True
         sp_params = seq.sampling
-        pen = self._penalty_payload([seq], 1)
-        penalties = None
-        if pen:
-            penalties = (jnp.asarray(pen["pen_counts"]),
-                         jnp.asarray(pen["pen_prompt_mask"]),
-                         jnp.asarray(pen["pen_presence"]),
-                         jnp.asarray(pen["pen_frequency"]),
-                         jnp.asarray(pen["pen_repetition"]))
-        sd = self._seed_payload([seq], 1)
-        seeding = None
-        if sd:
-            seeding = (jnp.asarray(sd["seed_rows"]),
-                       jnp.asarray(sd["seed_emitted"]))
+        opt = {}
+        opt.update(self._penalty_payload([seq], 1))
+        opt.update(self._seed_payload([seq], 1))
+        penalties, seeding = self._optional_device_inputs(opt)
         sampled, self.k_cache, self.v_cache = self._sp_prefill_jit(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(tokens),
@@ -659,7 +692,10 @@ class ModelRunner:
         fixed width). Returns one sampled token per chunk — None for
         rows whose prompt is not yet fully prefilled."""
         if plan.sp:
-            return self.run_sp_prefill(plan)
+            # Context-parallel whole-prompt prefill; logprobs are not
+            # computed on this path (sp serves long prompts, the
+            # request's logprobs flag is ignored for the first token).
+            return self.run_sp_prefill(plan), None
         chunks = plan.chunks
         b = self.prefill_width
         t = self._bucket_for(max(len(c.chunk_tokens) for c in chunks))
@@ -713,23 +749,37 @@ class ModelRunner:
                          for c in chunks]
         payload.update(self._penalty_payload(sampling_rows, b))
         payload.update(self._seed_payload(sampling_rows, b))
+        want_lp = any(s is not None and s.sampling.logprobs
+                      for s in sampling_rows)
+        if want_lp:
+            payload["want_logprobs"] = True
 
         t0 = time.perf_counter() if _TIMING else 0.0
         sampled = self._dispatch(1, t, payload)
         host = None
         out: List[Optional[int]] = []
+        lps: List[Optional[tuple]] = []
         for i, chunk in enumerate(chunks):
             if chunk.is_last_chunk:
                 if host is None:
                     host = jax.device_get(sampled)
-                out.append(int(host[i]))
+                if want_lp:
+                    out.append(int(host[0][i]))
+                    lps.append(
+                        self._lp_entry(chunk.seq, host[1][i],
+                                       host[2][i], host[3][i])
+                        if chunk.seq.sampling.logprobs else None)
+                else:
+                    out.append(int(host[i]))
+                    lps.append(None)
             else:
                 out.append(None)
+                lps.append(None)
         if _TIMING:
             if host is None:  # async dispatch: sync so the wall is real
                 jax.device_get(sampled)
             _timing_log("prefill", t, time.perf_counter() - t0)
-        return out
+        return out, (lps if want_lp else None)
 
     # ---- decode -----------------------------------------------------------
 
@@ -796,17 +846,42 @@ class ModelRunner:
             payload["lora_ids"] = ids
         payload.update(self._penalty_payload(seqs, b))
         payload.update(self._seed_payload(seqs, b))
+        want_lp = any(s.sampling.logprobs for s in seqs)
+        if want_lp:
+            payload["want_logprobs"] = True
 
         t0 = time.perf_counter() if _TIMING else 0.0
         sampled = self._dispatch(2, window, payload)
         host = jax.device_get(sampled)
         if _TIMING:
             _timing_log("decode", window, time.perf_counter() - t0)
+        if not want_lp:
+            if window == 1:
+                return [[int(host[i])] for i in range(len(seqs))], None
+            return [[int(host[k, i]) for k in range(window)
+                     if host[k, i] >= 0]
+                    for i in range(len(seqs))], None
+        toks, slp, tids, tlps = host
         if window == 1:
-            return [[int(host[i])] for i in range(len(seqs))]
-        return [[int(host[k, i]) for k in range(window)
-                 if host[k, i] >= 0]
-                for i in range(len(seqs))]
+            return ([[int(toks[i])] for i in range(len(seqs))],
+                    [[self._lp_entry(seqs[i], slp[i], tids[i],
+                                     tlps[i])
+                      if seqs[i].sampling.logprobs else None]
+                     for i in range(len(seqs))])
+        token_lists, lp_lists = [], []
+        for i, seq in enumerate(seqs):
+            row_t, row_l = [], []
+            for k in range(window):
+                if toks[k, i] < 0:
+                    continue
+                row_t.append(int(toks[k, i]))
+                row_l.append(
+                    self._lp_entry(seq, slp[k, i], tids[k, i],
+                                   tlps[k, i])
+                    if seq.sampling.logprobs else None)
+            token_lists.append(row_t)
+            lp_lists.append(row_l)
+        return token_lists, lp_lists
 
     # ---- page-granular IO (offload tiers) ---------------------------------
 

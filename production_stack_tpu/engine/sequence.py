@@ -29,6 +29,12 @@ class SamplingParams:
     repetition_penalty: float = 1.0
     ignore_eos: bool = False
     seed: Optional[int] = None
+    # OpenAI ``logprobs``/``top_logprobs``: return the sampled token's
+    # logprob and up to top_logprobs alternatives per position
+    # (computed on device from the unmodified distribution; capped at
+    # the compiled width, engine/model_runner.py TOP_LOGPROBS_WIDTH).
+    logprobs: bool = False
+    top_logprobs: int = 0
 
     @property
     def greedy(self) -> bool:

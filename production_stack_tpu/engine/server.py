@@ -156,6 +156,16 @@ def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
     presence = body.get("presence_penalty")
     frequency = body.get("frequency_penalty")
     repetition = body.get("repetition_penalty")  # vLLM extension
+    # Chat API: logprobs is a bool + top_logprobs an int; legacy
+    # completions API: logprobs is the top-k int itself.
+    lp_req = body.get("logprobs")
+    lp_top = int(body.get("top_logprobs") or 0)
+    if isinstance(lp_req, bool):
+        lp_flag = lp_req or lp_top > 0
+    elif lp_req is None:
+        lp_flag = lp_top > 0
+    else:
+        lp_flag, lp_top = True, int(lp_req)
     return SamplingParams(
         max_tokens=min(int(max_tokens), max_model_len),
         temperature=1.0 if temperature is None else float(temperature),
@@ -169,6 +179,8 @@ def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
                             else float(repetition)),
         ignore_eos=bool(body.get("ignore_eos", False)),
         seed=None if body.get("seed") is None else int(body["seed"]),
+        logprobs=lp_flag,
+        top_logprobs=lp_top,
     )
 
 
@@ -366,19 +378,50 @@ class EngineServer:
         # ``n`` choices = n engine sequences sharing one prompt; the
         # prefix cache makes the shared prompt prefill nearly free
         # after the first, and continuous batching decodes them as
-        # ordinary batch rows.
+        # ordinary batch rows. A seeded request derives per-choice
+        # seeds (seed + i): seeded randomness is a pure function of
+        # (seed, position), so identical seeds would make all n
+        # choices byte-identical.
+        def choice_sampling(i):
+            if n == 1 or sampling.seed is None:
+                return sampling
+            import dataclasses
+            return dataclasses.replace(sampling,
+                                       seed=sampling.seed + i)
+
         subs = [await self.async_engine.submit(
-            prompt, sampling, lora_name=lora_name) for _ in range(n)]
+            prompt, choice_sampling(i), lora_name=lora_name)
+            for i in range(n)]
+
+        def lp_json(token_id, entry):
+            """One position in OpenAI chat logprobs.content form."""
+            slp, tops = entry
+            txt = self.tokenizer.decode([token_id])
+            return {
+                "token": txt, "logprob": slp,
+                "bytes": list(txt.encode("utf-8", "replace")),
+                "top_logprobs": [
+                    {"token": self.tokenizer.decode([tid]),
+                     "logprob": tlp}
+                    for tid, tlp in tops
+                ],
+            }
 
         async def consume_choice(seq_id, stream, on_delta=None):
             """Drain one sequence's stream with stop-string scanning.
 
-            Returns (text, n_tokens, finish_reason); ``on_delta``
-            (streaming mode) is awaited per emitted text delta.
+            Returns (text, n_tokens, finish_reason, lp_content);
+            ``on_delta(text, lp_entries)`` (streaming mode) is awaited
+            per emitted text delta — lp_entries carries the logprob
+            positions consumed since the previous emit (the
+            detokenizer may buffer partial UTF-8, so text deltas and
+            token positions align only at emit points).
             """
             decoder = self._delta_decoder()
             scanner = _StopStringScanner(sampling.stop_strings)
             pieces: List[str] = []
+            lp_content: List[dict] = []
+            lp_pending: List[dict] = []
             n_tokens = 0
             finish_reason = "stop"
 
@@ -388,7 +431,8 @@ class EngineServer:
                 if on_delta is not None:
                     # Streaming: deltas go straight to the wire; never
                     # buffer the whole completion in memory.
-                    await on_delta(text)
+                    lps, lp_pending[:] = list(lp_pending), []
+                    await on_delta(text, lps)
                 else:
                     pieces.append(text)
 
@@ -397,7 +441,18 @@ class EngineServer:
                     out = await stream.get()
                     if out.new_token is not None:
                         n_tokens += 1
-                        await emit(scanner.feed(decoder(out.new_token)))
+                        text = scanner.feed(decoder(out.new_token))
+                        if (out.logprobs is not None
+                                and not scanner.stopped):
+                            # The token that triggered a stop string is
+                            # (partially) truncated from the text, so
+                            # its logprob entry is dropped too —
+                            # logprobs.content stays alignable with
+                            # the returned message.
+                            entry = lp_json(out.new_token, out.logprobs)
+                            lp_pending.append(entry)
+                            lp_content.append(entry)
+                        await emit(text)
                         if scanner.stopped:
                             # Text-level stop hit: the engine doesn't
                             # know about it, so cut generation here.
@@ -417,7 +472,8 @@ class EngineServer:
                         break
             finally:
                 self.async_engine.finish_stream(seq_id)
-            return "".join(pieces), n_tokens, finish_reason
+            return ("".join(pieces), n_tokens, finish_reason,
+                    lp_content)
 
         if not stream_mode:
             tasks = [asyncio.ensure_future(consume_choice(sid, stream))
@@ -440,7 +496,10 @@ class EngineServer:
                     "index": i,
                     "message": {"role": "assistant", "content": text},
                     "finish_reason": finish,
-                } for i, (text, _, finish) in enumerate(results)]
+                    "logprobs": ({"content": lps}
+                                 if sampling.logprobs else None),
+                } for i, (text, _, finish, lps)
+                  in enumerate(results)]
                 payload = {
                     "id": rid, "object": "chat.completion",
                     "created": created, "model": response_model,
@@ -448,10 +507,24 @@ class EngineServer:
                     "usage": _usage(len(prompt), total_tokens),
                 }
             else:
+                # Legacy completions logprobs shape.
+                def legacy_lp(lps):
+                    if not sampling.logprobs:
+                        return None
+                    return {
+                        "tokens": [e["token"] for e in lps],
+                        "token_logprobs": [e["logprob"] for e in lps],
+                        "top_logprobs": [
+                            {t["token"]: t["logprob"]
+                             for t in e["top_logprobs"]}
+                            for e in lps],
+                    }
                 choices = [{
                     "index": i, "text": text,
                     "finish_reason": finish,
-                } for i, (text, _, finish) in enumerate(results)]
+                    "logprobs": legacy_lp(lps),
+                } for i, (text, _, finish, lps)
+                  in enumerate(results)]
                 payload = {
                     "id": rid, "object": "text_completion",
                     "created": created, "model": response_model,
@@ -470,7 +543,8 @@ class EngineServer:
             return f"data: {json.dumps(payload)}\n\n".encode()
 
         def chunk(index: int, delta: Optional[str],
-                  finish: Optional[str], first: bool = False) -> dict:
+                  finish: Optional[str], first: bool = False,
+                  lps=None) -> dict:
             if chat:
                 d: Dict[str, Any] = {}
                 if first:
@@ -479,10 +553,22 @@ class EngineServer:
                     d["content"] = delta
                 choice = {"index": index, "delta": d,
                           "finish_reason": finish}
+                if sampling.logprobs:
+                    choice["logprobs"] = (
+                        {"content": lps} if lps else None)
                 obj = "chat.completion.chunk"
             else:
                 choice = {"index": index, "text": delta or "",
                           "finish_reason": finish}
+                if sampling.logprobs:
+                    choice["logprobs"] = (None if not lps else {
+                        "tokens": [e["token"] for e in lps],
+                        "token_logprobs": [e["logprob"] for e in lps],
+                        "top_logprobs": [
+                            {t["token"]: t["logprob"]
+                             for t in e["top_logprobs"]}
+                            for e in lps],
+                    })
                 obj = "text_completion"
             return {"id": rid, "object": obj, "created": created,
                     "model": response_model, "choices": [choice]}
@@ -490,11 +576,12 @@ class EngineServer:
         write_lock = asyncio.Lock()
 
         async def stream_choice(index, seq_id, stream):
-            async def on_delta(text):
+            async def on_delta(text, lps):
                 async with write_lock:
-                    await resp.write(sse(chunk(index, text, None)))
+                    await resp.write(sse(chunk(index, text, None,
+                                               lps=lps)))
 
-            _, _, finish_reason = await consume_choice(
+            _, _, finish_reason, _ = await consume_choice(
                 seq_id, stream, on_delta=on_delta)
             async with write_lock:
                 await resp.write(sse(chunk(index, None, finish_reason)))

@@ -43,6 +43,27 @@ def apply_penalties(logits: jnp.ndarray, counts: jnp.ndarray,
     return logits - frequency[:, None] * countsf
 
 
+def token_logprobs(logits: jnp.ndarray, sampled: jnp.ndarray,
+                   k: int):
+    """Logprob of each sampled token + the top-k alternatives.
+
+    Computed from the UNMODIFIED model distribution (before
+    temperature/penalties), the OpenAI ``logprobs`` contract.
+
+    Args:
+      logits:  [B, vocab] f32 raw logits
+      sampled: [B] int32 sampled token ids
+      k:       static top-k width (>= 1)
+
+    Returns (sampled_logprob [B], top_ids [B, k], top_logprobs [B, k]).
+    """
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    sampled_lp = jnp.take_along_axis(
+        lp, sampled[:, None].astype(jnp.int32), axis=1)[:, 0]
+    top_lp, top_ids = jax.lax.top_k(lp, k)
+    return sampled_lp, top_ids.astype(jnp.int32), top_lp
+
+
 def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
                   top_p: jnp.ndarray, top_k: jnp.ndarray,
                   key: jax.Array,

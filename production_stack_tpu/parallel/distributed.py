@@ -65,11 +65,18 @@ def is_coordinator() -> bool:
 class MultihostStepBridge:
     """Host-0 -> workers broadcast of per-step device-program inputs.
 
-    Protocol per step: a fixed [kind, t_bucket] int32 header, then the
-    payload pytree whose array shapes are a pure function of
-    (kind, t_bucket) and the engine config — so workers can always
-    offer a matching zero-filled structure to ``broadcast_one_to_all``.
+    Protocol per step: a fixed [kind, t_bucket, flags] int32 header,
+    then the payload pytree whose array shapes are a pure function of
+    (kind, t_bucket, flags) and the engine config — so workers can
+    always offer a matching zero-filled structure to
+    ``broadcast_one_to_all``. ``flags`` carries the presence of the
+    optional per-request inputs (penalties, seeding, logprobs) whose
+    keys are request-dependent rather than config-dependent.
     """
+
+    FLAG_PENALTIES = 1
+    FLAG_SEEDING = 2
+    FLAG_LOGPROBS = 4
 
     def __init__(self, runner):
         self.runner = runner
@@ -83,7 +90,8 @@ class MultihostStepBridge:
 
     # -- shapes --------------------------------------------------------------
 
-    def _payload_template(self, kind: int, t: int) -> Dict[str, np.ndarray]:
+    def _payload_template(self, kind: int, t: int,
+                          flags: int = 0) -> Dict[str, np.ndarray]:
         r = self.runner
         if kind == KIND_EMBED:
             # Embed batches have their own (batch_width, token-bucket)
@@ -123,6 +131,16 @@ class MultihostStepBridge:
                 (b, STOP_SET_WIDTH), np.int32)
         if r.lora_registry is not None:
             template["lora_ids"] = np.zeros((b,), np.int32)
+        if flags & self.FLAG_PENALTIES:
+            v = r.config.model.vocab_size
+            template["pen_counts"] = np.zeros((b, v), np.int32)
+            template["pen_prompt_mask"] = np.zeros((b, v), bool)
+            template["pen_presence"] = np.zeros((b,), np.float32)
+            template["pen_frequency"] = np.zeros((b,), np.float32)
+            template["pen_repetition"] = np.zeros((b,), np.float32)
+        if flags & self.FLAG_SEEDING:
+            template["seed_rows"] = np.zeros((b,), np.int32)
+            template["seed_emitted"] = np.zeros((b,), np.int32)
         return template
 
     # -- host 0 --------------------------------------------------------------
@@ -130,10 +148,21 @@ class MultihostStepBridge:
     def publish(self, kind: int, t: int,
                 payload: Dict[str, np.ndarray]) -> None:
         from jax.experimental import multihost_utils
-        header = np.asarray([kind, t], np.int32)
+        flags = 0
+        if "pen_prompt_mask" in payload:
+            flags |= self.FLAG_PENALTIES
+        if "seed_rows" in payload:
+            flags |= self.FLAG_SEEDING
+        if payload.get("want_logprobs"):
+            flags |= self.FLAG_LOGPROBS
+        header = np.asarray([kind, t, flags], np.int32)
         multihost_utils.broadcast_one_to_all(header)
         if kind != KIND_SHUTDOWN:
-            multihost_utils.broadcast_one_to_all(payload)
+            # want_logprobs is a static python flag, carried in the
+            # header (a non-array leaf can't ride the broadcast).
+            arrays = {k: v for k, v in payload.items()
+                      if k != "want_logprobs"}
+            multihost_utils.broadcast_one_to_all(arrays)
 
     def shutdown(self) -> None:
         """Release workers from their receive loop."""
@@ -148,15 +177,18 @@ class MultihostStepBridge:
         logger.info("worker %d entering step loop", jax.process_index())
         while True:
             header = multihost_utils.broadcast_one_to_all(
-                np.zeros((2,), np.int32)
+                np.zeros((3,), np.int32)
             )
-            kind, t = int(header[0]), int(header[1])
+            kind, t, flags = (int(header[0]), int(header[1]),
+                              int(header[2]))
             if kind == KIND_SHUTDOWN:
                 logger.info("worker %d shutting down",
                             jax.process_index())
                 return
             payload = multihost_utils.broadcast_one_to_all(
-                self._payload_template(kind, t)
+                self._payload_template(kind, t, flags)
             )
             payload = {k: np.asarray(v) for k, v in payload.items()}
+            if flags & self.FLAG_LOGPROBS:
+                payload["want_logprobs"] = True
             self.runner.execute_payload(kind, payload, t)

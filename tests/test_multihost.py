@@ -93,3 +93,63 @@ def test_two_process_bridge_generation():
     ref_vecs = embedder.embed_batch([[1, 2, 3], [4, 5, 6, 7]])
     np.testing.assert_allclose(embed_first_dims, ref_vecs[:, 0],
                                atol=1e-4)
+
+
+def test_bridge_template_matches_real_payloads():
+    """The worker-side payload template must structurally match what
+    host 0 actually publishes for every optional-input combination —
+    template/payload drift desyncs the broadcast and hangs the slice."""
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+    from production_stack_tpu.parallel.distributed import (
+        MultihostStepBridge,
+    )
+
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=128),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=256,
+                                  prefill_chunk_size=64,
+                                  decode_steps=4),
+    )
+    engine = LLMEngine(config)
+    bridge = MultihostStepBridge(engine.runner)
+
+    published = []
+
+    def fake_publish(kind, t, payload):
+        flags = 0
+        if "pen_prompt_mask" in payload:
+            flags |= bridge.FLAG_PENALTIES
+        if "seed_rows" in payload:
+            flags |= bridge.FLAG_SEEDING
+        if payload.get("want_logprobs"):
+            flags |= bridge.FLAG_LOGPROBS
+        arrays = {k: v for k, v in payload.items()
+                  if k != "want_logprobs"}
+        published.append((kind, t, flags, arrays))
+
+    engine.runner.bridge = bridge
+    bridge.publish = fake_publish
+
+    engine.generate(list(range(1, 40)), SamplingParams(
+        max_tokens=6, temperature=0.7, ignore_eos=True, seed=7,
+        presence_penalty=0.5, logprobs=True, top_logprobs=2,
+    ))
+
+    assert published, "bridge.publish never called"
+    for kind, t, flags, arrays in published:
+        template = bridge._payload_template(kind, t, flags)
+        assert set(template) == set(arrays), (
+            f"kind={kind} t={t} flags={flags}: template keys "
+            f"{sorted(template)} != payload keys {sorted(arrays)}")
+        for k in template:
+            assert template[k].shape == np.asarray(arrays[k]).shape, (
+                f"{k}: {template[k].shape} != "
+                f"{np.asarray(arrays[k]).shape}")
+            assert template[k].dtype == np.asarray(arrays[k]).dtype, (
+                f"{k}: {template[k].dtype} != "
+                f"{np.asarray(arrays[k]).dtype}")
