@@ -115,7 +115,11 @@ def build():
               unit="percentunit"),
         panel("Number of Swapped Requests",
               [target('sum(vllm:num_requests_swapped)', "swapped")],
-              8, 24, w=8, kind="stat"),
+              8, 24, w=4, kind="stat"),
+        panel("Preemptions / min",
+              [target('sum(rate(vllm:num_preemptions_total[1m])) '
+                      '* 60', "preempted")],
+              12, 24, w=4, kind="stat"),
         panel("KV Blocks (allocated / reserved / free)",
               [target('vllm:allocated_blocks', "alloc {{server}}"),
                target('vllm:pending_reserved_blocks',

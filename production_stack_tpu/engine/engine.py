@@ -259,6 +259,7 @@ class LLMEngine:
             "gpu_cache_usage_perc": self.cache_manager.usage_perc(),
             "gpu_prefix_cache_hit_rate":
                 self.cache_manager.prefix_hit_rate(),
+            "num_preemptions_total": self.scheduler.num_preemptions,
         }
         if self.offload is not None:
             out.update({

@@ -253,11 +253,13 @@ class ModelRunner:
 
             def _sp_step(params, k_cache, v_cache, tokens, page_table,
                          valid, last_index, temperature, top_p, top_k,
-                         rng, penalties, seeding):
+                         rng, penalties, seeding,
+                         want_logprobs=False):
                 row_logits, k_cache, v_cache = sp_prefill_forward(
                     params, self.config.model, tokens, page_table,
                     valid, last_index, k_cache, v_cache,
                     mesh=self.mesh)
+                raw_logits = row_logits
                 if penalties is not None:
                     row_logits = apply_penalties(row_logits, *penalties)
                 seeds, emitted = (seeding if seeding is not None
@@ -265,10 +267,15 @@ class ModelRunner:
                 sampled = sample_tokens(row_logits, temperature,
                                         top_p, top_k, rng,
                                         seeds=seeds, emitted=emitted)
+                if want_logprobs:
+                    lp = token_logprobs(raw_logits, sampled,
+                                        TOP_LOGPROBS_WIDTH)
+                    return (sampled,) + lp, k_cache, v_cache
                 return sampled, k_cache, v_cache
 
             self._sp_prefill_jit = jax.jit(
-                _sp_step, donate_argnums=(1, 2))
+                _sp_step, donate_argnums=(1, 2),
+                static_argnames=("want_logprobs",))
 
     @staticmethod
     def _lowering_error(fn, *args) -> Optional[str]:
@@ -415,7 +422,9 @@ class ModelRunner:
                        budget) computed by the scheduler)
           stop_tokens: [B, S] int32 — per-row stop set, padded with -1
 
-        Returns sampled tokens [K, B] (-1 for frozen slots).
+        Returns sampled tokens [K, B] (-1 for frozen slots); with
+        ``want_logprobs`` a tuple ([K, B] tokens, [K, B] sampled
+        logprobs, [K, B, W] top ids, [K, B, W] top logprobs).
         """
         b = active.shape[0]
         if penalties is not None:
@@ -643,7 +652,7 @@ class ModelRunner:
 
     # ---- prefill ----------------------------------------------------------
 
-    def run_sp_prefill(self, plan: PrefillPlan) -> List[Optional[int]]:
+    def run_sp_prefill(self, plan: PrefillPlan):
         """Context-parallel whole-prompt prefill: ONE dispatch covers
         the entire prompt with the sequence sharded over 'sp'
         (parallel/context_serving.py). Returns the sampled first
@@ -672,6 +681,7 @@ class ModelRunner:
         opt.update(self._penalty_payload([seq], 1))
         opt.update(self._seed_payload([seq], 1))
         penalties, seeding = self._optional_device_inputs(opt)
+        want_lp = sp_params.logprobs
         sampled, self.k_cache, self.v_cache = self._sp_prefill_jit(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(tokens),
@@ -683,19 +693,25 @@ class ModelRunner:
             jnp.asarray(np.asarray([sp_params.top_p], np.float32)),
             jnp.asarray(np.asarray([sp_params.top_k], np.int32)),
             self._next_rng(), penalties, seeding,
+            want_logprobs=want_lp,
         )
-        return [int(jax.device_get(sampled)[0])]
+        host = jax.device_get(sampled)
+        if want_lp:
+            toks, slp, tids, tlps = host
+            return ([int(toks[0])],
+                    [self._lp_entry(seq, slp[0], tids[0], tlps[0])])
+        return [int(host[0])], None
 
-    def run_prefill(self, plan: PrefillPlan) -> List[Optional[int]]:
+    def run_prefill(self, plan: PrefillPlan
+                    ) -> Tuple[List[Optional[int]], Optional[list]]:
         """Execute one batched prefill step (the next chunk of up to
         ``prefill_batch_size`` distinct sequences, rows padded to the
-        fixed width). Returns one sampled token per chunk — None for
-        rows whose prompt is not yet fully prefilled."""
+        fixed width). Returns (tokens, logprobs): one sampled token
+        per chunk — None for rows whose prompt is not yet fully
+        prefilled — and, when any sampling row requested logprobs, a
+        parallel list of per-row logprob entries (else None)."""
         if plan.sp:
-            # Context-parallel whole-prompt prefill; logprobs are not
-            # computed on this path (sp serves long prompts, the
-            # request's logprobs flag is ignored for the first token).
-            return self.run_sp_prefill(plan), None
+            return self.run_sp_prefill(plan)
         chunks = plan.chunks
         b = self.prefill_width
         t = self._bucket_for(max(len(c.chunk_tokens) for c in chunks))
@@ -783,9 +799,11 @@ class ModelRunner:
 
     # ---- decode -----------------------------------------------------------
 
-    def run_decode(self, plan: DecodePlan) -> List[List[int]]:
+    def run_decode(self, plan: DecodePlan
+                   ) -> Tuple[List[List[int]], Optional[list]]:
         """One decode dispatch over all running sequences (padded
-        batch); returns per-sequence token lists. With a multi-step
+        batch); returns (token_lists, logprob_lists) — logprob_lists
+        is None unless a row requested logprobs. With a multi-step
         window the burst program evaluates per-row budgets and stop
         sets on device, so one dispatch + one device_get covers up to
         ``window`` tokens per row even when rows finish mid-burst."""

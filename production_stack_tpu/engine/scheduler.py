@@ -102,6 +102,9 @@ class Scheduler:
         # permanent cache starvation); the engine drains this to emit
         # terminal outputs to their clients.
         self.newly_aborted: List[Sequence] = []
+        # Cumulative count of sequences preempted for KV-cache
+        # pressure (vllm:num_preemptions_total parity).
+        self.num_preemptions = 0
 
     # ---- queue management -------------------------------------------------
 
@@ -329,6 +332,7 @@ class Scheduler:
 
     def _preempt(self, seq: Sequence) -> None:
         logger.warning("Preempting %s (KV cache pressure)", seq.seq_id)
+        self.num_preemptions += 1
         self.running.remove(seq)
         self.cache.free_sequence(seq.pages)
         seq.pages = []

@@ -161,7 +161,11 @@ def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
     lp_req = body.get("logprobs")
     lp_top = int(body.get("top_logprobs") or 0)
     if isinstance(lp_req, bool):
-        lp_flag = lp_req or lp_top > 0
+        if not lp_req and lp_top > 0:
+            raise ValueError(
+                "'top_logprobs' is only allowed when 'logprobs' is "
+                "enabled")
+        lp_flag = lp_req
     elif lp_req is None:
         lp_flag = lp_top > 0
     else:
@@ -333,9 +337,16 @@ class EngineServer:
 
     async def _generate_response(self, request: web.Request, body: dict,
                                  prompt: List[int], chat: bool):
-        sampling = _sampling_from_body(
-            body, self.engine.config.scheduler.max_model_len
-        )
+        try:
+            sampling = _sampling_from_body(
+                body, self.engine.config.scheduler.max_model_len
+            )
+        except (ValueError, TypeError) as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
         stream_mode = bool(body.get("stream", False))
         created = int(time.time())
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:16]
@@ -393,6 +404,19 @@ class EngineServer:
             prompt, choice_sampling(i), lora_name=lora_name)
             for i in range(n)]
 
+        def legacy_lp(lps):
+            """lp_json entries -> the legacy /v1/completions shape."""
+            if not lps:
+                return None
+            return {
+                "tokens": [e["token"] for e in lps],
+                "token_logprobs": [e["logprob"] for e in lps],
+                "top_logprobs": [
+                    {t["token"]: t["logprob"]
+                     for t in e["top_logprobs"]}
+                    for e in lps],
+            }
+
         def lp_json(token_id, entry):
             """One position in OpenAI chat logprobs.content form."""
             slp, tops = entry
@@ -416,24 +440,56 @@ class EngineServer:
             positions consumed since the previous emit (the
             detokenizer may buffer partial UTF-8, so text deltas and
             token positions align only at emit points).
+
+            Logprob entries are released by CHARACTER accounting: a
+            token's entry joins logprobs.content only once its decoded
+            text has fully left the stop-string hold-back buffer, so a
+            stop hit drops the entries of every (partially) truncated
+            token — held-back runs included — and the content list
+            always aligns with the returned text.
             """
             decoder = self._delta_decoder()
             scanner = _StopStringScanner(sampling.stop_strings)
             pieces: List[str] = []
             lp_content: List[dict] = []
-            lp_pending: List[dict] = []
+            lp_queue: List[tuple] = []  # (entry, fed-chars watermark)
+            fed_chars = 0
+            emitted_chars = 0
             n_tokens = 0
             finish_reason = "stop"
 
+            def release_entries():
+                ready = []
+                while (lp_queue and lp_queue[0][1] is not None
+                       and lp_queue[0][1] <= emitted_chars):
+                    ready.append(lp_queue.pop(0)[0])
+                lp_content.extend(ready)
+                return ready
+
+            def queue_entry(entry, token_text):
+                # A token the detokenizer buffered (zero visible
+                # chars) can't be char-aligned on its own: its bytes
+                # surface inside a LATER feed's text, so it inherits
+                # that feed's watermark.
+                lp_queue.append(
+                    [entry, fed_chars if token_text else None])
+
+            def settle_watermarks():
+                for item in lp_queue:
+                    if item[1] is None:
+                        item[1] = fed_chars
+
             async def emit(text):
-                if not text:
+                nonlocal emitted_chars
+                emitted_chars += len(text)
+                ready = release_entries()
+                if not text and not ready:
                     return
                 if on_delta is not None:
                     # Streaming: deltas go straight to the wire; never
                     # buffer the whole completion in memory.
-                    lps, lp_pending[:] = list(lp_pending), []
-                    await on_delta(text, lps)
-                else:
+                    await on_delta(text, ready)
+                elif text:
                     pieces.append(text)
 
             try:
@@ -441,18 +497,15 @@ class EngineServer:
                     out = await stream.get()
                     if out.new_token is not None:
                         n_tokens += 1
-                        text = scanner.feed(decoder(out.new_token))
-                        if (out.logprobs is not None
-                                and not scanner.stopped):
-                            # The token that triggered a stop string is
-                            # (partially) truncated from the text, so
-                            # its logprob entry is dropped too —
-                            # logprobs.content stays alignable with
-                            # the returned message.
-                            entry = lp_json(out.new_token, out.logprobs)
-                            lp_pending.append(entry)
-                            lp_content.append(entry)
-                        await emit(text)
+                        token_text = decoder(out.new_token)
+                        fed_chars += len(token_text)
+                        if token_text:
+                            settle_watermarks()
+                        if out.logprobs is not None:
+                            queue_entry(
+                                lp_json(out.new_token, out.logprobs),
+                                token_text)
+                        await emit(scanner.feed(token_text))
                         if scanner.stopped:
                             # Text-level stop hit: the engine doesn't
                             # know about it, so cut generation here.
@@ -461,8 +514,10 @@ class EngineServer:
                             break
                     if out.finished:
                         finish_reason = out.finish_reason or "stop"
-                        await emit(scanner.feed(
-                            decoder(None, flush=True)))
+                        tail = decoder(None, flush=True)
+                        fed_chars += len(tail)
+                        settle_watermarks()
+                        await emit(scanner.feed(tail))
                         await emit(scanner.flush())
                         if scanner.stopped:
                             # The stop landed in the final flush: the
@@ -507,22 +562,11 @@ class EngineServer:
                     "usage": _usage(len(prompt), total_tokens),
                 }
             else:
-                # Legacy completions logprobs shape.
-                def legacy_lp(lps):
-                    if not sampling.logprobs:
-                        return None
-                    return {
-                        "tokens": [e["token"] for e in lps],
-                        "token_logprobs": [e["logprob"] for e in lps],
-                        "top_logprobs": [
-                            {t["token"]: t["logprob"]
-                             for t in e["top_logprobs"]}
-                            for e in lps],
-                    }
                 choices = [{
                     "index": i, "text": text,
                     "finish_reason": finish,
-                    "logprobs": legacy_lp(lps),
+                    "logprobs": (legacy_lp(lps)
+                                 if sampling.logprobs else None),
                 } for i, (text, _, finish, lps)
                   in enumerate(results)]
                 payload = {
@@ -561,14 +605,7 @@ class EngineServer:
                 choice = {"index": index, "text": delta or "",
                           "finish_reason": finish}
                 if sampling.logprobs:
-                    choice["logprobs"] = (None if not lps else {
-                        "tokens": [e["token"] for e in lps],
-                        "token_logprobs": [e["logprob"] for e in lps],
-                        "top_logprobs": [
-                            {t["token"]: t["logprob"]
-                             for t in e["top_logprobs"]}
-                            for e in lps],
-                    })
+                    choice["logprobs"] = legacy_lp(lps)
                 obj = "text_completion"
             return {"id": rid, "object": obj, "created": created,
                     "model": response_model, "choices": [choice]}
@@ -833,6 +870,9 @@ class EngineServer:
         ):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {float(value)}")
+        lines.append("# TYPE vllm:num_preemptions_total counter")
+        lines.append("vllm:num_preemptions_total "
+                     f"{float(stats['num_preemptions_total'])}")
         # vLLM-parity request-latency histograms + token counters.
         lines.extend(self.engine.metrics.render())
         lines.append("")

@@ -127,6 +127,7 @@ def test_dashboard_metrics_exist():
     engine_metrics = {
         "vllm:num_requests_running", "vllm:num_requests_waiting",
         "vllm:gpu_cache_usage_perc", "vllm:gpu_prefix_cache_hit_rate",
+        "vllm:num_preemptions_total",
     }
     from production_stack_tpu.engine.metrics import EngineMetrics
     for line in EngineMetrics().render():

@@ -424,3 +424,42 @@ def test_logprobs_streaming_chunks():
                     entries.extend(lp["content"])
         assert len(entries) == 4
     asyncio.run(_with_client(run))
+
+
+def test_stop_string_drops_truncated_logprob_entries():
+    """logprobs.content must align with the truncated text when a stop
+    string hits: entries for held-back/truncated tokens are dropped."""
+    async def run(client):
+        base = {
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 10, "temperature": 0.0,
+            "logprobs": True, "top_logprobs": 1,
+        }
+        full = await (await client.post(
+            "/v1/chat/completions", json=base)).json()
+        full_text = full["choices"][0]["message"]["content"]
+        full_entries = full["choices"][0]["logprobs"]["content"]
+        assert len(full_entries) == 10
+        stop = full_text[3:6]
+        resp = await (await client.post(
+            "/v1/chat/completions",
+            json=dict(base, stop=stop))).json()
+        text = resp["choices"][0]["message"]["content"]
+        entries = resp["choices"][0]["logprobs"]["content"]
+        assert stop not in text
+        # Released entries' token texts reassemble exactly the
+        # returned (truncated) text — no phantom trailing entries.
+        assert "".join(e["token"] for e in entries) == text
+    asyncio.run(_with_client(run))
+
+
+def test_top_logprobs_without_logprobs_rejected():
+    async def run(client):
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "x"}],
+            "logprobs": False, "top_logprobs": 2,
+        })
+        assert resp.status == 400
+    asyncio.run(_with_client(run))
