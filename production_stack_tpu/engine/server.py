@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import json
 import queue
 import threading
@@ -386,6 +387,40 @@ class EngineServer:
                 status=400,
             )
 
+        # Legacy /v1/completions best_of: generate best_of candidates
+        # server-side, return the n with the highest mean token
+        # logprob (the OpenAI contract; chat has no best_of).
+        best_of = n
+        if not chat and body.get("best_of") is not None:
+            try:
+                best_of = int(body["best_of"])
+            except (TypeError, ValueError):
+                best_of = -1
+            if not n <= best_of <= 16:
+                return web.json_response(
+                    {"error": {"message": "'best_of' must be an "
+                                          "integer in [n, 16]",
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+            if stream_mode and best_of > n:
+                return web.json_response(
+                    {"error": {"message": "'best_of' > n cannot be "
+                                          "streamed",
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+        candidates = best_of
+        # Capture BEFORE the internal force below: legacy forms like
+        # integer logprobs:0 or bare top_logprobs parse to
+        # sampling.logprobs=True while bool(body["logprobs"]) is
+        # falsy.
+        requested_lp = sampling.logprobs
+        if candidates > n and not sampling.logprobs:
+            # Candidate ranking needs per-token logprobs internally;
+            # the response omits them unless the client asked.
+            sampling = dataclasses.replace(sampling, logprobs=True)
+
         # ``n`` choices = n engine sequences sharing one prompt; the
         # prefix cache makes the shared prompt prefill nearly free
         # after the first, and continuous batching decodes them as
@@ -394,15 +429,14 @@ class EngineServer:
         # (seed, position), so identical seeds would make all n
         # choices byte-identical.
         def choice_sampling(i):
-            if n == 1 or sampling.seed is None:
+            if candidates == 1 or sampling.seed is None:
                 return sampling
-            import dataclasses
             return dataclasses.replace(sampling,
                                        seed=sampling.seed + i)
 
         subs = [await self.async_engine.submit(
             prompt, choice_sampling(i), lora_name=lora_name)
-            for i in range(n)]
+            for i in range(candidates)]
 
         def legacy_lp(lps):
             """lp_json entries -> the legacy /v1/completions shape."""
@@ -545,7 +579,25 @@ class EngineServer:
                     self.async_engine.abort(sid)
                 await asyncio.gather(*tasks, return_exceptions=True)
                 raise
-            total_tokens = sum(r[1] for r in results)
+            if candidates > n:
+                # Rank by mean token logprob; ties keep earlier
+                # candidates. The extra candidates' tokens still count
+                # toward usage (they were generated).
+                def mean_lp(r):
+                    lps = r[3]
+                    if not lps:
+                        return float("-inf")
+                    return (sum(e["logprob"] for e in lps)
+                            / len(lps))
+                ranked = sorted(range(candidates),
+                                key=lambda i: -mean_lp(results[i]))
+                total_tokens = sum(r[1] for r in results)
+                results = [results[i] for i in ranked[:n]]
+                if not requested_lp:
+                    sampling = dataclasses.replace(
+                        sampling, logprobs=False)
+            else:
+                total_tokens = sum(r[1] for r in results)
             if chat:
                 choices = [{
                     "index": i,

@@ -463,3 +463,45 @@ def test_top_logprobs_without_logprobs_rejected():
         })
         assert resp.status == 400
     asyncio.run(_with_client(run))
+
+
+def test_best_of_returns_top_n():
+    """best_of generates extra candidates and returns the n best by
+    mean token logprob, without leaking internal logprobs."""
+    async def run(client):
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "hello world",
+            "max_tokens": 6, "temperature": 0.9, "seed": 11,
+            "n": 2, "best_of": 4,
+        })
+        assert resp.status == 200
+        data = await resp.json()
+        assert [c["index"] for c in data["choices"]] == [0, 1]
+        assert all(c["logprobs"] is None for c in data["choices"])
+        # All 4 candidates' tokens count toward usage.
+        assert data["usage"]["completion_tokens"] == 24
+
+        # Legacy integer logprobs:0 ("sampled logprob, no
+        # alternatives") must survive best_of's internal forcing.
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "hello world",
+            "max_tokens": 4, "temperature": 0.9, "seed": 3,
+            "n": 1, "best_of": 2, "logprobs": 0,
+        })
+        data = await resp.json()
+        lp = data["choices"][0]["logprobs"]
+        assert lp is not None and len(lp["token_logprobs"]) == 4
+
+        # Streaming with best_of > n is rejected.
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "n": 1,
+            "best_of": 2, "stream": True,
+        })
+        assert resp.status == 400
+        # best_of < n is rejected.
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "n": 3,
+            "best_of": 2,
+        })
+        assert resp.status == 400
+    asyncio.run(_with_client(run))
