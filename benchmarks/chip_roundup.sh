@@ -1,0 +1,78 @@
+#!/bin/bash
+# One-command on-chip round-up for a (possibly short) live-tunnel
+# window: kernel validation + microbench, instrumented engine runs for
+# BOTH attention impls, and the full driver bench. Every phase runs in
+# its own process with a hard timeout (Mosaic hangs must not wedge the
+# harness — see results/round3_onchip_notes.md), and each phase's
+# artifacts land in benchmarks/results/ as soon as it finishes, so an
+# interrupted run still leaves evidence.
+#
+# Usage: bash benchmarks/chip_roundup.sh
+cd "$(dirname "$0")/.." || exit 1
+REPO="$(pwd)"
+OUT="benchmarks/results"
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+LOG="$OUT/chip_roundup_$STAMP"
+mkdir -p "$OUT"
+
+phase() { echo; echo "=== $1 ($(date -u +%H:%M:%S)) ==="; }
+
+phase "0: tunnel sanity"
+timeout 120 python -c "import jax; print('sanity', jax.device_get(jax.numpy.ones(4)+1))" || {
+  echo "NO TUNNEL — aborting"; exit 1; }
+
+phase "1: kernel validation + microbench"
+timeout 2400 bash benchmarks/chip_validate.sh 2>&1 | tee "${LOG}_validate.log" | tail -20
+
+phase "2: instrumented engine run (pallas)"
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout 1800 \
+  python bench.py --worker pallas --tpu \
+  > "${LOG}_pallas.json" 2> "${LOG}_pallas.err"
+echo "rc=$? headline:"; cat "${LOG}_pallas.json"
+
+phase "3: instrumented engine run (xla)"
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout 1800 \
+  python bench.py --worker xla --tpu \
+  > "${LOG}_xla.json" 2> "${LOG}_xla.err"
+echo "rc=$? headline:"; cat "${LOG}_xla.json"
+
+phase "4: per-phase timing decomposition"
+python - "$LOG" <<'PYEOF'
+import collections
+import json
+import re
+import sys
+
+log = sys.argv[1]
+print(f"| impl | req/s | tok/s | mfu | decode burst avg | prefill512 avg |")
+print(f"|---|---|---|---|---|---|")
+for impl in ("pallas", "xla"):
+    agg = collections.defaultdict(lambda: [0, 0.0])
+    try:
+        for line in open(f"{log}_{impl}.err"):
+            m = re.search(r"timing (\w+) t=(\d+) ([\d.]+)", line)
+            if m:
+                k = f"{m.group(1)}_t{m.group(2)}"
+                agg[k][0] += 1
+                agg[k][1] += float(m.group(3))
+        head = json.load(open(f"{log}_{impl}.json"))
+        e = head.get("extra", {})
+        d = agg.get("decode_t32", [1, 0.0])
+        p = agg.get("prefill_t512", [1, 0.0])
+        print(f"| {impl} | {head.get('value')} "
+              f"| {e.get('total_tokens_per_s')} | {e.get('mfu')} "
+              f"| {d[1]/max(d[0],1)*1000:.0f} ms "
+              f"| {p[1]/max(p[0],1)*1000:.0f} ms |")
+    except Exception as ex:  # noqa: BLE001 — report, don't die
+        print(f"| {impl} | (failed: {ex}) | | | | |")
+PYEOF
+
+phase "5: driver bench (full probe->fallback flow)"
+timeout 3600 python bench.py > "${LOG}_driver.json" 2> "${LOG}_driver.err"
+echo "rc=$? headline:"; cat "${LOG}_driver.json"
+
+echo
+echo "=== done; artifacts: ${LOG}_* ==="
+echo "Next: pick the faster impl as the engine default, refresh"
+echo "BASELINE.json round3_measured, and fold the table into"
+echo "tutorials/07 + results/round3_onchip_notes.md."
