@@ -9,7 +9,6 @@
 #
 # Usage: bash benchmarks/chip_roundup.sh
 cd "$(dirname "$0")/.." || exit 1
-REPO="$(pwd)"
 OUT="benchmarks/results"
 STAMP=$(date -u +%Y%m%dT%H%M%S)
 LOG="$OUT/chip_roundup_$STAMP"

@@ -324,20 +324,31 @@ class EngineServer:
 
     async def completions(self, request: web.Request):
         body = await self._json_body(request)
+        if body.get("suffix"):
+            return web.json_response(
+                {"error": {"message": "'suffix' (insertion) is not "
+                                      "supported",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
         prompt_in = body.get("prompt", "")
         if isinstance(prompt_in, list) and prompt_in and isinstance(
                 prompt_in[0], int):
             prompt = list(prompt_in)
+            prompt_text = None  # token-array prompt: decode for echo
         elif isinstance(prompt_in, list):
-            prompt = self.tokenizer.encode("".join(prompt_in))
+            prompt_text = "".join(prompt_in)
+            prompt = self.tokenizer.encode(prompt_text)
         else:
-            prompt = self.tokenizer.encode(str(prompt_in))
+            prompt_text = str(prompt_in)
+            prompt = self.tokenizer.encode(prompt_text)
         return await self._generate_response(
-            request, body, prompt, chat=False
+            request, body, prompt, chat=False, prompt_text=prompt_text
         )
 
     async def _generate_response(self, request: web.Request, body: dict,
-                                 prompt: List[int], chat: bool):
+                                 prompt: List[int], chat: bool,
+                                 prompt_text: Optional[str] = None):
         try:
             sampling = _sampling_from_body(
                 body, self.engine.config.scheduler.max_model_len
@@ -410,6 +421,23 @@ class EngineServer:
                                "type": "invalid_request_error"}},
                     status=400,
                 )
+        echo = bool(body.get("echo")) and not chat
+        if echo and sampling.logprobs:
+            return web.json_response(
+                {"error": {"message": "'echo' with 'logprobs' (prompt "
+                                      "logprobs) is not supported",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        # Echo returns the ORIGINAL prompt string when the client sent
+        # text (decode(encode(s)) need not round-trip: special-token
+        # text, sentencepiece normalization); token-array prompts are
+        # decoded.
+        echo_text = ""
+        if echo:
+            echo_text = (prompt_text if prompt_text is not None
+                         else self.tokenizer.decode(prompt))
+
         candidates = best_of
         # Capture BEFORE the internal force below: legacy forms like
         # integer logprobs:0 or bare top_logprobs parse to
@@ -615,7 +643,7 @@ class EngineServer:
                 }
             else:
                 choices = [{
-                    "index": i, "text": text,
+                    "index": i, "text": echo_text + text,
                     "finish_reason": finish,
                     "logprobs": (legacy_lp(lps)
                                  if sampling.logprobs else None),
@@ -679,9 +707,18 @@ class EngineServer:
                  for i, (sid, stream) in enumerate(subs)]
         try:
             if chat:
-                for i in range(n):
-                    await resp.write(sse(chunk(i, None, None,
-                                               first=True)))
+                # Under the lock: the stream_choice tasks are already
+                # scheduled, and a content delta must never overtake
+                # its choice's role chunk.
+                async with write_lock:
+                    for i in range(n):
+                        await resp.write(sse(chunk(i, None, None,
+                                                   first=True)))
+            elif echo_text:
+                async with write_lock:
+                    for i in range(n):
+                        await resp.write(sse(chunk(i, echo_text,
+                                                   None)))
             await asyncio.gather(*tasks)
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()

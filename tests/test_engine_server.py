@@ -505,3 +505,50 @@ def test_best_of_returns_top_n():
         })
         assert resp.status == 400
     asyncio.run(_with_client(run))
+
+
+def test_completions_echo_and_suffix():
+    async def run(client):
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "hello world",
+            "max_tokens": 3, "temperature": 0.0, "echo": True,
+        })
+        data = await resp.json()
+        text = data["choices"][0]["text"]
+        prompt_text = "hello world"
+        # Echo prepends the (detokenized) prompt; round-tripping the
+        # tiny tokenizer reproduces the input string exactly.
+        assert text.startswith(prompt_text)
+        assert len(text) > len(prompt_text)
+
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "suffix": "tail",
+        })
+        assert resp.status == 400
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "echo": True,
+            "logprobs": 1,
+        })
+        assert resp.status == 400
+    asyncio.run(_with_client(run))
+
+
+def test_completions_echo_streaming():
+    async def run(client):
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "hello world",
+            "max_tokens": 3, "temperature": 0.0, "echo": True,
+            "stream": True, "n": 2,
+        })
+        assert resp.status == 200
+        raw = (await resp.read()).decode()
+        texts = {0: "", 1: ""}
+        for line in raw.splitlines():
+            if line.startswith("data: {"):
+                payload = json.loads(line[len("data: "):])
+                c = payload["choices"][0]
+                texts[c["index"]] += c.get("text", "")
+        assert texts[0].startswith("hello world")
+        assert texts[1].startswith("hello world")
+        assert len(texts[0]) > len("hello world")
+    asyncio.run(_with_client(run))
