@@ -186,6 +186,12 @@ def run_worker(impl: str, tpu: bool) -> None:
         pass
 
     config, n_requests, prompt_len, out_len = _bench_config(tpu)
+    # "<impl>[+per_layer]": optional cache-layout variant (the round-3
+    # decode-roofline experiment, CacheConfig.cache_layout).
+    layout = "stacked"
+    if impl.endswith("+per_layer"):
+        impl, layout = impl.rsplit("+", 1)[0], "per_layer"
+    config.cache.cache_layout = layout
     config.model.attention_impl = impl
     engine = LLMEngine(config)
     # The engine's per-kernel probe may itself have degraded a path.
@@ -342,6 +348,7 @@ def run_worker(impl: str, tpu: bool) -> None:
         "platform": "tpu" if tpu else "cpu",
         "attention_impl": impls[0] if impls[0] == impls[1] else
         f"decode={impls[0]},prefill={impls[1]}",
+        "cache_layout": layout,
         "param_count": params_n,
         "decode_batch": config.scheduler.max_num_seqs,
         "decode_burst": config.scheduler.decode_steps,
@@ -414,7 +421,15 @@ def main() -> None:
         if os.environ.get("PYTHONPATH", "").find("axon") != -1:
             os.environ["PYTHONPATH"] = ""
 
-    attempts = ["pallas", "xla"] if tpu else ["xla"]
+    # 'auto' = the engine's empirical dispatch (measured-winner table:
+    # pallas prefill everywhere, xla decode below the 8k-ctx
+    # crossover); plain xla is the safety net. BENCH_IMPLS overrides
+    # for experiments (e.g. "xla+per_layer,auto" — see
+    # benchmarks/chip_roundup.sh phase 4).
+    if os.environ.get("BENCH_IMPLS"):
+        attempts = os.environ["BENCH_IMPLS"].split(",")
+    else:
+        attempts = ["auto", "xla"] if tpu else ["xla"]
     errors = {}
     result = None
     for impl in attempts:

@@ -35,6 +35,20 @@ PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout 1800 \
   > "${LOG}_xla.json" 2> "${LOG}_xla.err"
 echo "rc=$? headline:"; cat "${LOG}_xla.json"
 
+phase "3b: instrumented engine run (xla + per-layer cache pytree)"
+# The round-3 decode-roofline experiment (round3_onchip_notes.md par 0.6):
+# per-layer cache buffers vs the stacked array. Decide on numbers.
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout 1800 \
+  python bench.py --worker xla+per_layer --tpu \
+  > "${LOG}_xla_pl.json" 2> "${LOG}_xla_pl.err"
+echo "rc=$? headline:"; cat "${LOG}_xla_pl.json"
+
+phase "3c: instrumented engine run (pallas + per-layer cache pytree)"
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout 1800 \
+  python bench.py --worker pallas+per_layer --tpu \
+  > "${LOG}_pallas_pl.json" 2> "${LOG}_pallas_pl.err"
+echo "rc=$? headline:"; cat "${LOG}_pallas_pl.json"
+
 phase "4: per-phase timing decomposition"
 python - "$LOG" <<'PYEOF'
 import collections
@@ -45,7 +59,7 @@ import sys
 log = sys.argv[1]
 print(f"| impl | req/s | tok/s | mfu | decode burst avg | prefill512 avg |")
 print(f"|---|---|---|---|---|---|")
-for impl in ("pallas", "xla"):
+for impl in ("pallas", "xla", "xla_pl", "pallas_pl"):
     agg = collections.defaultdict(lambda: [0, 0.0])
     try:
         for line in open(f"{log}_{impl}.err"):

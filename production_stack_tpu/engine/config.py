@@ -162,6 +162,18 @@ class CacheConfig:
     page_size: int = 16  # tokens per page
     num_pages: int = 1024  # total pages in HBM (per shard)
     enable_prefix_caching: bool = True
+    # HBM buffer layout (models/llama.py cached_attention):
+    #   stacked   -> one [L, kv, pages, d, page_size] array per k/v;
+    #                layer writes are in-place scatters at a static
+    #                layer index.
+    #   per_layer -> a tuple of L [kv, pages, d, page_size] buffers;
+    #                every scatter/kernel touches exactly one layer's
+    #                buffer (67 MB vs 2.1 GB operands at the 1B bench
+    #                config) and donation aliases buffers 1:1. The
+    #                round-3 decode-roofline experiment
+    #                (benchmarks/results/round3_onchip_notes.md §0.6);
+    #                decide the default on measured numbers.
+    cache_layout: str = "stacked"
 
     def max_tokens(self) -> int:
         return self.page_size * self.num_pages

@@ -140,9 +140,11 @@ class AsyncEngine:
 
 
 def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
-    max_tokens = body.get("max_tokens") or body.get(
-        "max_completion_tokens"
-    ) or 256
+    max_tokens = body.get("max_tokens")
+    if max_tokens is None:
+        max_tokens = body.get("max_completion_tokens")
+    if max_tokens is None:
+        max_tokens = 256  # OpenAI default; 0 is invalid, not "unset"
     # JSON null must fall back to the OpenAI defaults, not to 0.
     temperature = body.get("temperature")
     top_p = body.get("top_p")
@@ -171,7 +173,7 @@ def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
         lp_flag = lp_top > 0
     else:
         lp_flag, lp_top = True, int(lp_req)
-    return SamplingParams(
+    params = SamplingParams(
         max_tokens=min(int(max_tokens), max_model_len),
         temperature=1.0 if temperature is None else float(temperature),
         top_p=1.0 if top_p is None else float(top_p),
@@ -187,6 +189,40 @@ def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
         logprobs=lp_flag,
         top_logprobs=lp_top,
     )
+    _validate_sampling(params)
+    return params
+
+
+def _validate_sampling(p: SamplingParams) -> None:
+    """Reject out-of-range sampling params with ValueError (the caller
+    maps it to HTTP 400, matching OpenAI/vLLM behavior) instead of
+    letting them reach the device, where e.g. repetition_penalty=0
+    divides logits and emits NaN garbage with a 200."""
+    if p.max_tokens < 1:
+        raise ValueError("max_tokens must be at least 1")
+    if not (0.0 <= p.temperature <= 2.0):
+        raise ValueError(
+            f"temperature must be in [0, 2], got {p.temperature}")
+    if not (0.0 < p.top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {p.top_p}")
+    if p.top_k < 0:
+        raise ValueError(
+            f"top_k must be a non-negative integer, got {p.top_k}")
+    if not (-2.0 <= p.presence_penalty <= 2.0):
+        raise ValueError(
+            f"presence_penalty must be in [-2, 2], got "
+            f"{p.presence_penalty}")
+    if not (-2.0 <= p.frequency_penalty <= 2.0):
+        raise ValueError(
+            f"frequency_penalty must be in [-2, 2], got "
+            f"{p.frequency_penalty}")
+    if p.repetition_penalty <= 0.0:
+        raise ValueError(
+            f"repetition_penalty must be a positive number, got "
+            f"{p.repetition_penalty}")
+    if not (0 <= p.top_logprobs <= 20):
+        raise ValueError(
+            f"top_logprobs must be in [0, 20], got {p.top_logprobs}")
 
 
 class _StopStringScanner:
@@ -1031,6 +1067,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             page_size=args.page_size,
             num_pages=args.num_pages,
             enable_prefix_caching=not args.disable_prefix_caching,
+            cache_layout=args.cache_layout,
         ),
         scheduler=SchedulerConfig(
             max_num_seqs=args.max_num_seqs,
@@ -1085,6 +1122,11 @@ def parse_args(argv=None):
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--page-size", type=int, default=16)
     parser.add_argument("--num-pages", type=int, default=512)
+    parser.add_argument("--cache-layout", default="stacked",
+                        choices=["stacked", "per_layer"],
+                        help="KV cache HBM layout: one stacked [L,...]"
+                             " array, or a tuple of per-layer buffers "
+                             "(engine/config.py CacheConfig)")
     parser.add_argument("--max-num-seqs", type=int, default=8)
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--prefill-chunk-size", type=int, default=512)

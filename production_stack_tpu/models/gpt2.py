@@ -18,14 +18,22 @@ import jax.numpy as jnp
 
 from production_stack_tpu.engine.config import ModelConfig
 from production_stack_tpu.models.llama import (
-    dispatch_attention,
+    cached_attention,
     slice_layer_lora,
     slice_layer_params,
 )
 from production_stack_tpu.models.opt import layer_norm
-from production_stack_tpu.ops.attention import write_to_pages
 
 Params = Dict[str, jnp.ndarray]
+
+# Canonical per-layer parameter names (leading L axis) — the single
+# source for the layer/shared split used by the unrolled forward here
+# and the pp/sp shard_map bodies (parallel/pipeline_serving.py,
+# parallel/context_serving.py).
+GPT2_LAYER_NAMES = (
+    "attn_norm_w", "attn_norm_b", "wq", "bq", "wk", "bk", "wv", "bv",
+    "wo", "bo", "mlp_norm_w", "mlp_norm_b", "fc1", "fc1_b", "fc2",
+    "fc2_b")
 
 
 def init_params(config: ModelConfig, key: jax.Array) -> Params:
@@ -79,9 +87,7 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
 
     x = params["embed"][tokens] + params["pos_embed"][positions]
 
-    names = ("attn_norm_w", "attn_norm_b", "wq", "bq", "wk", "bk",
-             "wv", "bv", "wo", "bo", "mlp_norm_w", "mlp_norm_b",
-             "fc1", "fc1_b", "fc2", "fc2_b")
+    names = GPT2_LAYER_NAMES
     lora_scale = (None if lora is None
                   else lora["scaling"][lora_ids])
     lora_stacked = (None if lora is None
@@ -99,13 +105,9 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
              + lp["bk"]).reshape(b, t, nh, d)
         v = (lora_matmul(a_in, lp["wv"], ll, "wv", lora_ids, lora_scale)
              + lp["bv"]).reshape(b, t, nh, d)
-        k_cache = write_to_pages(k_cache, k, page_table, positions,
-                                 valid, layer=layer)
-        v_cache = write_to_pages(v_cache, v, page_table, positions,
-                                 valid, layer=layer)
-        attn, k_cache, v_cache = dispatch_attention(
-            config, q, k_cache, v_cache, page_table, positions,
-            kv_lens, layer=layer,
+        attn, k_cache, v_cache = cached_attention(
+            config, q, k, v, k_cache, v_cache, page_table, positions,
+            kv_lens, valid, layer,
         )
         x = x + (lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
                              "wo", lora_ids, lora_scale) + lp["bo"])

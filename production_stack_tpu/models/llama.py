@@ -88,6 +88,48 @@ def dispatch_attention(config: ModelConfig, q, k_cache, v_cache,
     ), k_cache, v_cache
 
 
+def cached_attention(config: ModelConfig, q, k, v, k_cache, v_cache,
+                     page_table, positions, kv_lens, valid, layer: int):
+    """Write one layer's K/V into the paged cache and attend.
+
+    The single place both cache layouts are handled
+    (engine/config.py CacheConfig.cache_layout), shared by every model
+    family's unrolled layer loop:
+
+      stacked:   ``k_cache``/``v_cache`` are the full [L, kv, pages,
+                 d, page_size] arrays; writes are in-place scatters at
+                 the static ``layer`` index and the kernels take the
+                 stacked cache with the layer index through SMEM.
+      per_layer: tuples of L [kv, pages, d, page_size] buffers; this
+                 layer's buffer is updated and the tuple rebuilt, so
+                 each scatter/kernel operand is ONE layer's buffer and
+                 jit donation aliases the L buffers 1:1 (the round-3
+                 decode-roofline experiment, round3_onchip_notes §0.6).
+
+    Returns ``(attn, k_cache, v_cache)``; callers must thread the
+    returned caches so the buffer chain stays linear (see
+    dispatch_attention).
+    """
+    if isinstance(k_cache, (list, tuple)):
+        kc, vc = k_cache[layer], v_cache[layer]
+        kc = write_to_pages(kc, k, page_table, positions, valid)
+        vc = write_to_pages(vc, v, page_table, positions, valid)
+        attn, kc, vc = dispatch_attention(
+            config, q, kc, vc, page_table, positions, kv_lens,
+            layer=None)
+        k_cache = (tuple(k_cache[:layer]) + (kc,)
+                   + tuple(k_cache[layer + 1:]))
+        v_cache = (tuple(v_cache[:layer]) + (vc,)
+                   + tuple(v_cache[layer + 1:]))
+        return attn, k_cache, v_cache
+    k_cache = write_to_pages(k_cache, k, page_table, positions, valid,
+                             layer=layer)
+    v_cache = write_to_pages(v_cache, v, page_table, positions, valid,
+                             layer=layer)
+    return dispatch_attention(config, q, k_cache, v_cache, page_table,
+                              positions, kv_lens, layer=layer)
+
+
 def slice_layer_params(params: Params, names, layer: int) -> Params:
     """One layer's weights out of the layer-stacked param dict.
 
@@ -215,13 +257,9 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
         v = v.reshape(b, t, nkv, d)
         q = apply_rope(q, positions, config.rope_theta)
         k = apply_rope(k, positions, config.rope_theta)
-        k_cache = write_to_pages(k_cache, k, page_table, positions,
-                                 valid, layer=layer)
-        v_cache = write_to_pages(v_cache, v, page_table, positions,
-                                 valid, layer=layer)
-        attn, k_cache, v_cache = dispatch_attention(
-            config, q, k_cache, v_cache, page_table, positions,
-            kv_lens, layer=layer,
+        attn, k_cache, v_cache = cached_attention(
+            config, q, k, v, k_cache, v_cache, page_table, positions,
+            kv_lens, valid, layer,
         )
         x = x + lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
                             "wo", lora_ids, lora_scale)

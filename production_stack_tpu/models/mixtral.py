@@ -29,12 +29,11 @@ import jax.numpy as jnp
 
 from production_stack_tpu.engine.config import ModelConfig
 from production_stack_tpu.models.llama import (
-    dispatch_attention,
+    cached_attention,
     rms_norm,
     slice_layer_lora,
     slice_layer_params,
 )
-from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.rope import apply_rope
 
 Params = Dict[str, jnp.ndarray]
@@ -143,13 +142,9 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
                         lora_scale).reshape(b, t, nkv, d)
         q = apply_rope(q, positions, config.rope_theta)
         k = apply_rope(k, positions, config.rope_theta)
-        k_cache = write_to_pages(k_cache, k, page_table, positions,
-                                 valid, layer=layer)
-        v_cache = write_to_pages(v_cache, v, page_table, positions,
-                                 valid, layer=layer)
-        attn, k_cache, v_cache = dispatch_attention(
-            config, q, k_cache, v_cache, page_table, positions,
-            kv_lens, layer=layer,
+        attn, k_cache, v_cache = cached_attention(
+            config, q, k, v, k_cache, v_cache, page_table, positions,
+            kv_lens, valid, layer,
         )
         x = x + lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
                             "wo", lora_ids, lora_scale)

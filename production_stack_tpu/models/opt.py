@@ -15,12 +15,8 @@ import jax
 import jax.numpy as jnp
 
 from production_stack_tpu.engine.config import ModelConfig
-from production_stack_tpu.ops.attention import (
-    paged_attention,  # noqa: F401 (re-export for tests)
-    write_to_pages,
-)
 from production_stack_tpu.models.llama import (
-    dispatch_attention,
+    cached_attention,
     slice_layer_lora,
     slice_layer_params,
 )
@@ -113,13 +109,9 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
              + lp["bk"]).reshape(b, t, nh, d)
         v = (lora_matmul(a_in, lp["wv"], ll, "wv", lora_ids, lora_scale)
              + lp["bv"]).reshape(b, t, nh, d)
-        k_cache = write_to_pages(k_cache, k, page_table, positions,
-                                 valid, layer=layer)
-        v_cache = write_to_pages(v_cache, v, page_table, positions,
-                                 valid, layer=layer)
-        attn, k_cache, v_cache = dispatch_attention(
-            config, q, k_cache, v_cache, page_table, positions,
-            kv_lens, layer=layer,
+        attn, k_cache, v_cache = cached_attention(
+            config, q, k, v, k_cache, v_cache, page_table, positions,
+            kv_lens, valid, layer,
         )
         x = x + (lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
                              "wo", lora_ids, lora_scale) + lp["bo"])

@@ -68,7 +68,8 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
                   top_p: jnp.ndarray, top_k: jnp.ndarray,
                   key: jax.Array,
                   seeds: "jnp.ndarray | None" = None,
-                  emitted: "jnp.ndarray | None" = None) -> jnp.ndarray:
+                  emitted: "jnp.ndarray | None" = None,
+                  seed_mask: "jnp.ndarray | None" = None) -> jnp.ndarray:
     """Sample one token per row.
 
     Args:
@@ -77,13 +78,19 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
       top_p:       [B] (1.0 => disabled)
       top_k:       [B] int32 (0 => disabled)
       key:         PRNG key (the engine's stream; used for unseeded rows)
-      seeds:       optional [B] int32 per-row request seeds (-1 =
-                   unseeded). A seeded row's randomness derives ONLY
-                   from (seed, emitted-token index), so identical
-                   seeded requests reproduce identical samples
-                   regardless of batch composition or engine history.
+      seeds:       optional [B] int32 per-row request seeds, carrying
+                   the FULL 32-bit user seed (two's-complement
+                   reinterpretation — no folding, so distinct user
+                   seeds never collide). A seeded row's randomness
+                   derives ONLY from (seed, emitted-token index), so
+                   identical seeded requests reproduce identical
+                   samples regardless of batch composition or engine
+                   history.
       emitted:     [B] int32 tokens generated so far per row (required
                    with ``seeds``)
+      seed_mask:   [B] bool — True where the row is seeded. Required
+                   with ``seeds``: the seed value itself cannot gate
+                   seededness without surrendering a bit of seed space.
 
     Returns [B] int32 token ids.
     """
@@ -100,13 +107,20 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
         # PRNGKey stream uses): unseeded rows fold the row index into
         # the engine key; seeded rows rebuild their key from
         # (seed, emitted index) only.
+        if seed_mask is None:
+            # Seeds carry full 32-bit values: the sign bit is seed
+            # payload, NOT an unseeded marker, so there is no valid
+            # way to gate without the mask (a >= 0 fallback would
+            # silently drop seeding for half the seed space).
+            raise ValueError(
+                "sample_tokens: seeds requires seed_mask")
         row_keys = jax.vmap(
             lambda i: jax.random.fold_in(key, i))(jnp.arange(b))
         seeded_keys = jax.vmap(
             lambda s, e: jax.random.fold_in(
                 jax.random.PRNGKey(s.astype(jnp.uint32)), e)
         )(seeds, emitted)
-        keys = jnp.where((seeds >= 0)[:, None], seeded_keys, row_keys)
+        keys = jnp.where(seed_mask[:, None], seeded_keys, row_keys)
         return jax.vmap(jax.random.categorical)(keys, masked)
 
     def masked_sample():

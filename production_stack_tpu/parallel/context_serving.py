@@ -25,9 +25,9 @@ ENGINE's prefill contract over the ``sp`` mesh axis, so
   matmul runs once on the [B, H] last-token rows outside shard_map —
   logits for T tokens are never materialized.
 
-Scope (v1): llama-family architectures, first-touch prompts (no
-prefix-cache hit), sp composes with dp=tp=pp=1 (the engine gate in
-model_runner rejects the rest loudly).
+Scope: llama-family (llama/mistral/qwen2) + gpt2 architectures,
+first-touch prompts (no prefix-cache hit), sp composes with
+dp=tp=pp=1 (the engine gate in model_runner rejects the rest loudly).
 """
 
 from __future__ import annotations
@@ -43,13 +43,21 @@ from production_stack_tpu.models.llama import (
     _layer_param_names,
     rms_norm,
 )
+from production_stack_tpu.models.gpt2 import (
+    GPT2_LAYER_NAMES,
+    layer_norm,
+)
 from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.ring_attention import ring_attention
 from production_stack_tpu.ops.rope import apply_rope
 
 Params = Dict[str, jnp.ndarray]
 
-SP_FAMILIES = ("llama", "mistral", "qwen2")
+# llama body covers llama/mistral/qwen2; gpt2 has its own layer body
+# (learned positions, LayerNorm, biased projections, gelu MLP — the
+# round-3 "second family" widening).
+SP_FAMILIES = ("llama", "mistral", "qwen2", "gpt2")
+
 
 
 def sp_prefill_forward(params: Params, config: ModelConfig,
@@ -72,9 +80,54 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
     nh, nkv, d = (config.num_attention_heads,
                   config.num_key_value_heads, config.head_dim)
     b, t = tokens.shape
-    layer_names = _layer_param_names(config)
+    gpt2 = config.architecture == "gpt2"
+    layer_names = (GPT2_LAYER_NAMES if gpt2
+                   else _layer_param_names(config))
     layer_params = {k: params[k] for k in layer_names}
     shared = {k: v for k, v in params.items() if k not in layer_names}
+
+    def llama_layer(x, lp_i, positions_l):
+        bl, tl = positions_l.shape
+        a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
+        q = a_in @ lp_i["wq"]
+        k = a_in @ lp_i["wk"]
+        v = a_in @ lp_i["wv"]
+        if config.attention_bias:
+            q, k, v = (q + lp_i["bq"], k + lp_i["bk"],
+                       v + lp_i["bv"])
+        q = apply_rope(q.reshape(bl, tl, nh, d), positions_l,
+                       config.rope_theta)
+        k = apply_rope(k.reshape(bl, tl, nkv, d), positions_l,
+                       config.rope_theta)
+        v = v.reshape(bl, tl, nkv, d)
+        return x, q, k, v
+
+    def llama_post(x, attn, lp_i):
+        bl, tl = attn.shape[:2]
+        x = x + attn.reshape(bl, tl, nh * d) @ lp_i["wo"]
+        m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
+        return x + (jax.nn.silu(m_in @ lp_i["w_gate"])
+                    * (m_in @ lp_i["w_up"])) @ lp_i["w_down"]
+
+    def gpt2_layer(x, lp_i, positions_l):
+        bl, tl = positions_l.shape
+        a_in = layer_norm(x, lp_i["attn_norm_w"], lp_i["attn_norm_b"])
+        q = (a_in @ lp_i["wq"] + lp_i["bq"]).reshape(bl, tl, nh, d)
+        k = (a_in @ lp_i["wk"] + lp_i["bk"]).reshape(bl, tl, nkv, d)
+        v = (a_in @ lp_i["wv"] + lp_i["bv"]).reshape(bl, tl, nkv, d)
+        return x, q, k, v
+
+    def gpt2_post(x, attn, lp_i):
+        bl, tl = attn.shape[:2]
+        x = x + (attn.reshape(bl, tl, nh * d) @ lp_i["wo"]
+                 + lp_i["bo"])
+        m_in = layer_norm(x, lp_i["mlp_norm_w"], lp_i["mlp_norm_b"])
+        hidden = jax.nn.gelu(m_in @ lp_i["fc1"] + lp_i["fc1_b"],
+                             approximate=True)
+        return x + (hidden @ lp_i["fc2"] + lp_i["fc2_b"])
+
+    qkv_fn, post_fn = ((gpt2_layer, gpt2_post) if gpt2
+                       else (llama_layer, llama_post))
 
     def body(lp, shared_p, kc, vc, tokens_l, valid_l, page_table):
         idx = jax.lax.axis_index("sp")
@@ -88,23 +141,16 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
             valid_l, "sp", axis=1, tiled=True)
 
         x = shared_p["embed"][tokens_l]
+        if gpt2:
+            # Learned positions are indexed by GLOBAL position, so
+            # each shard embeds its own offset range.
+            x = x + shared_p["pos_embed"][positions_l]
 
         # Static loop over layers, in-place cache scatters at a
         # static index (see models.llama.forward).
         for layer in range(config.num_hidden_layers):
             lp_i = {name: s[layer] for name, s in lp.items()}
-            a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
-            q = a_in @ lp_i["wq"]
-            k = a_in @ lp_i["wk"]
-            v = a_in @ lp_i["wv"]
-            if config.attention_bias:
-                q, k, v = (q + lp_i["bq"], k + lp_i["bk"],
-                           v + lp_i["bv"])
-            q = apply_rope(q.reshape(bl, tl, nh, d), positions_l,
-                           config.rope_theta)
-            k = apply_rope(k.reshape(bl, tl, nkv, d), positions_l,
-                           config.rope_theta)
-            v = v.reshape(bl, tl, nkv, d)
+            x, q, k, v = qkv_fn(x, lp_i, positions_l)
             # O(T^2) mixing distributed around the ring; K/V shards
             # stay put, blocks rotate via ppermute.
             attn = ring_attention(q, k, v, "sp")
@@ -118,10 +164,10 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
             vc = write_to_pages(vc, v_full, page_table,
                                 positions_full, valid_full,
                                 layer=layer)
-            x = x + attn.reshape(bl, tl, nh * d) @ lp_i["wo"]
-            m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
-            x = x + (jax.nn.silu(m_in @ lp_i["w_gate"])
-                     * (m_in @ lp_i["w_up"])) @ lp_i["w_down"]
+            x = post_fn(x, attn, lp_i)
+        if gpt2:
+            return (layer_norm(x, shared_p["final_norm_w"],
+                               shared_p["final_norm_b"]), kc, vc)
         return (rms_norm(x, shared_p["final_norm"],
                          config.rms_norm_eps), kc, vc)
 

@@ -140,6 +140,7 @@ class MultihostStepBridge:
             template["pen_repetition"] = np.zeros((b,), np.float32)
         if flags & self.FLAG_SEEDING:
             template["seed_rows"] = np.zeros((b,), np.int32)
+            template["seed_on"] = np.zeros((b,), bool)
             template["seed_emitted"] = np.zeros((b,), np.int32)
         return template
 

@@ -158,6 +158,13 @@ def cache_spec(mesh: Optional[Mesh] = None) -> P:
 def shard_cache(cache: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
     if mesh is None:
         return cache
+    if cache.ndim == 4:
+        # Per-layer buffer [kv_heads, pages, head_dim, page_size]
+        # (CacheConfig.cache_layout='per_layer'): heads over tp; no L
+        # axis, so pp cannot shard it (the model runner rejects that
+        # combination).
+        return jax.device_put(
+            cache, NamedSharding(mesh, P("tp", None, None, None)))
     return jax.device_put(cache, NamedSharding(mesh, cache_spec(mesh)))
 
 

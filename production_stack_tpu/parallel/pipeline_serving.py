@@ -54,7 +54,10 @@ from production_stack_tpu.models.llama import (
     dispatch_attention,
     rms_norm,
 )
-from production_stack_tpu.models.gpt2 import layer_norm
+from production_stack_tpu.models.gpt2 import (
+    GPT2_LAYER_NAMES,
+    layer_norm,
+)
 from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.rope import apply_rope
 from production_stack_tpu.parallel.mesh import (
@@ -69,11 +72,21 @@ def _psum_tp(x, tp: int):
     return jax.lax.psum(x, "tp") if tp > 1 else x
 
 
+def _lora_mm(x, w, ll, target, lora_ids, lora_scale):
+    """Projection with optional LoRA delta (pp-only meshes: adapters
+    ride replicated except their L axis, so the full-width delta adds
+    to a full-width base output — tp>1 is rejected at engine build)."""
+    if ll is None:
+        return x @ w
+    from production_stack_tpu.engine.lora import lora_matmul
+    return lora_matmul(x, w, ll, target, lora_ids, lora_scale)
+
+
 def _local_layers_llama(x, lp, k_local, v_local, page_table, positions,
-                        kv_lens, valid, config: ModelConfig, tp: int):
+                        kv_lens, valid, config: ModelConfig, tp: int,
+                        lora=None, lora_ids=None, lora_scale=None):
     """One stage's layer scan — the paged layer math of
-    models/llama.py:forward (layer_step) with tp-local head counts,
-    minus LoRA (pp+LoRA is rejected at engine build)."""
+    models/llama.py:forward (layer_step) with tp-local head counts."""
     nh = config.num_attention_heads // tp
     nkv = config.num_key_value_heads // tp
     d = config.head_dim
@@ -83,10 +96,12 @@ def _local_layers_llama(x, lp, k_local, v_local, page_table, positions,
     # scatters at a static index (see models.llama.forward).
     for i in range(k_local.shape[0]):
         lp_i = {name: s[i] for name, s in lp.items()}
+        ll = (None if lora is None
+              else jax.tree.map(lambda s: s[i], lora))
         a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
-        q = a_in @ lp_i["wq"]
-        k = a_in @ lp_i["wk"]
-        v = a_in @ lp_i["wv"]
+        q = _lora_mm(a_in, lp_i["wq"], ll, "wq", lora_ids, lora_scale)
+        k = _lora_mm(a_in, lp_i["wk"], ll, "wk", lora_ids, lora_scale)
+        v = _lora_mm(a_in, lp_i["wv"], ll, "wv", lora_ids, lora_scale)
         if config.attention_bias:
             q, k, v = q + lp_i["bq"], k + lp_i["bk"], v + lp_i["bv"]
         q = apply_rope(q.reshape(b, t, nh, d), positions,
@@ -102,16 +117,24 @@ def _local_layers_llama(x, lp, k_local, v_local, page_table, positions,
             config, q, k_local, v_local, page_table, positions,
             kv_lens, layer=i,
         )
-        x = x + _psum_tp(attn.reshape(b, t, nh * d) @ lp_i["wo"], tp)
+        x = x + _psum_tp(
+            _lora_mm(attn.reshape(b, t, nh * d), lp_i["wo"], ll, "wo",
+                     lora_ids, lora_scale), tp)
         m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
         x = x + _psum_tp(
-            (jax.nn.silu(m_in @ lp_i["w_gate"])
-             * (m_in @ lp_i["w_up"])) @ lp_i["w_down"], tp)
+            _lora_mm(
+                jax.nn.silu(_lora_mm(m_in, lp_i["w_gate"], ll,
+                                     "w_gate", lora_ids, lora_scale))
+                * _lora_mm(m_in, lp_i["w_up"], ll, "w_up", lora_ids,
+                           lora_scale),
+                lp_i["w_down"], ll, "w_down", lora_ids, lora_scale),
+            tp)
     return x, k_local, v_local
 
 
 def _local_layers_gpt2(x, lp, k_local, v_local, page_table, positions,
-                       kv_lens, valid, config: ModelConfig, tp: int):
+                       kv_lens, valid, config: ModelConfig, tp: int,
+                       lora=None, lora_ids=None, lora_scale=None):
     """GPT-2 stage body: pre-LN, learned positions are added before
     the first stage (embed path), gelu MLP, per-projection biases.
     Column biases (bq/bk/bv/fc1_b) arrive tp-sharded with their
@@ -125,10 +148,15 @@ def _local_layers_gpt2(x, lp, k_local, v_local, page_table, positions,
     # scatters at a static index (see models.llama.forward).
     for i in range(k_local.shape[0]):
         lp_i = {name: s[i] for name, s in lp.items()}
+        ll = (None if lora is None
+              else jax.tree.map(lambda s: s[i], lora))
         a_in = layer_norm(x, lp_i["attn_norm_w"], lp_i["attn_norm_b"])
-        q = (a_in @ lp_i["wq"] + lp_i["bq"]).reshape(b, t, nh, d)
-        k = (a_in @ lp_i["wk"] + lp_i["bk"]).reshape(b, t, nh, d)
-        v = (a_in @ lp_i["wv"] + lp_i["bv"]).reshape(b, t, nh, d)
+        q = (_lora_mm(a_in, lp_i["wq"], ll, "wq", lora_ids, lora_scale)
+             + lp_i["bq"]).reshape(b, t, nh, d)
+        k = (_lora_mm(a_in, lp_i["wk"], ll, "wk", lora_ids, lora_scale)
+             + lp_i["bk"]).reshape(b, t, nh, d)
+        v = (_lora_mm(a_in, lp_i["wv"], ll, "wv", lora_ids, lora_scale)
+             + lp_i["bv"]).reshape(b, t, nh, d)
         k_local = write_to_pages(k_local, k, page_table, positions,
                                  valid, layer=i)
         v_local = write_to_pages(v_local, v, page_table, positions,
@@ -137,12 +165,17 @@ def _local_layers_gpt2(x, lp, k_local, v_local, page_table, positions,
             config, q, k_local, v_local, page_table, positions,
             kv_lens, layer=i,
         )
-        x = x + (_psum_tp(attn.reshape(b, t, nh * d) @ lp_i["wo"], tp)
-                 + lp_i["bo"])
+        x = x + (_psum_tp(
+            _lora_mm(attn.reshape(b, t, nh * d), lp_i["wo"], ll, "wo",
+                     lora_ids, lora_scale), tp) + lp_i["bo"])
         m_in = layer_norm(x, lp_i["mlp_norm_w"], lp_i["mlp_norm_b"])
-        hidden = jax.nn.gelu(m_in @ lp_i["fc1"] + lp_i["fc1_b"],
-                             approximate=True)
-        x = x + (_psum_tp(hidden @ lp_i["fc2"], tp) + lp_i["fc2_b"])
+        hidden = jax.nn.gelu(
+            _lora_mm(m_in, lp_i["fc1"], ll, "fc1", lora_ids,
+                     lora_scale) + lp_i["fc1_b"],
+            approximate=True)
+        x = x + (_psum_tp(_lora_mm(hidden, lp_i["fc2"], ll, "fc2",
+                                   lora_ids, lora_scale), tp)
+                 + lp_i["fc2_b"])
     return x, k_local, v_local
 
 
@@ -196,10 +229,11 @@ def pp_paged_forward(params: Params, config: ModelConfig,
     are sharded P('pp', 'tp') on (L, kv); inside the shard_map body
     each stage sees its local [L/S, kv/tp, ...] slice.
     """
-    if lora is not None:
-        raise NotImplementedError("LoRA with pipeline parallelism")
     S = mesh.shape["pp"]
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
+    if lora is not None and tp > 1:
+        raise NotImplementedError(
+            "LoRA with pipeline x tensor parallelism")
     b, t = tokens.shape
 
     # Pad the batch to a multiple of S so M == S always (every stage
@@ -216,23 +250,34 @@ def pp_paged_forward(params: Params, config: ModelConfig,
     mb = bp // M
 
     local_layers = _LOCAL_LAYER_BODIES[config.architecture]
-    layer_names = _layer_param_names(config) \
-        if config.architecture != "gpt2" else [
-            "attn_norm_w", "attn_norm_b", "wq", "bq", "wk", "bk",
-            "wv", "bv", "wo", "bo", "mlp_norm_w", "mlp_norm_b",
-            "fc1", "fc1_b", "fc2", "fc2_b"]
+    layer_names = (list(GPT2_LAYER_NAMES)
+                   if config.architecture == "gpt2"
+                   else _layer_param_names(config))
     layer_params = {k: params[k] for k in layer_names}
     shared = {k: v for k, v in params.items() if k not in layer_names}
     max_pages = page_table.shape[1]
+    # LoRA adapter stacks shard their leading L axis over pp with the
+    # other layer params; scaling/ids replicate. Padded batch rows run
+    # as base model (slot 0 is the all-zeros adapter).
+    lora_ab = (None if lora is None
+               else {"a": lora["a"], "b": lora["b"]})
+    if lora_ids is not None and pad:
+        lora_ids = jnp.pad(lora_ids, ((0, pad),))
+    lora_scale = (None if lora is None
+                  else lora["scaling"][lora_ids])
 
     def body(lp, shared_p, kc, vc, tokens, positions, page_table,
-             kv_lens, valid):
+             kv_lens, valid, lora_ab, lora_ids, lora_scale):
         stage = jax.lax.axis_index("pp")
         mtok = tokens.reshape(M, mb, t)
         mpos = positions.reshape(M, mb, t)
         mpt = page_table.reshape(M, mb, max_pages)
         mkv = kv_lens.reshape(M, mb)
         mvalid = valid.reshape(M, mb, t)
+        mlid = (None if lora_ids is None
+                else lora_ids.reshape(M, mb))
+        mlsc = (None if lora_scale is None
+                else lora_scale.reshape(M, mb))
         h = config.hidden_size
         dtype = shared_p["embed"].dtype
         ticks = M + S - 1
@@ -252,6 +297,9 @@ def pp_paged_forward(params: Params, config: ModelConfig,
             x_new, kc, vc = local_layers(
                 x_in, lp, kc, vc, mpt[m_s], mpos[m_s], mkv[m_s],
                 v_mask, config, tp,
+                lora=lora_ab,
+                lora_ids=None if mlid is None else mlid[m_s],
+                lora_scale=None if mlsc is None else mlsc[m_s],
             )
             # Last stage banks microbatch i - (S - 1) once it's real.
             take = (stage == S - 1) & (i >= S - 1)
@@ -289,13 +337,18 @@ def pp_paged_forward(params: Params, config: ModelConfig,
     shared_specs = {k: on_mesh(specs.get(k, P())) for k in shared}
     cache_spec = on_mesh(mesh_cache_spec(mesh))
     repl = P()
+    # Adapter stacks: leading L over pp (prefix spec covers every a/b
+    # leaf); ids/scaling replicate.
+    lora_ab_spec = P("pp")
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(lp_specs, shared_specs, cache_spec, cache_spec,
-                  repl, repl, repl, repl, repl),
+                  repl, repl, repl, repl, repl,
+                  lora_ab_spec, repl, repl),
         out_specs=(repl, cache_spec, cache_spec),
         check_vma=False,
     )
     logits, kc, vc = fn(layer_params, shared, k_cache, v_cache, tokens,
-                        positions, page_table, kv_lens, valid)
+                        positions, page_table, kv_lens, valid,
+                        lora_ab, lora_ids, lora_scale)
     return logits[:b], kc, vc

