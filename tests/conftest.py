@@ -36,6 +36,43 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+# Compile-heavy modules (engine builds, shard_map parity, multi-process
+# rigs) form the SLOW lane; everything else is the fast lane the common
+# dev loop runs (round-3 verdict: 206 tests / 24 min had no split).
+#   fast lane:  pytest -m "not slow"   (target <= 8 min)
+#   full suite: pytest                 (CI nightly / pre-merge)
+# Files can still mark themselves explicitly; this list saves each
+# slow module from repeating the boilerplate.
+_SLOW_MODULES = {
+    "test_abort",
+    "test_batch_e2e",
+    "test_batched_prefill",
+    "test_cache_layout",
+    "test_context_parallel_serving",
+    "test_e2e_router_engine",
+    "test_embeddings",
+    "test_engine_server",
+    "test_kv_offload",
+    "test_lora",
+    "test_model_parity",
+    "test_multihost",
+    "test_multistep_decode",
+    "test_pallas_attention",
+    "test_pallas_lowering",
+    "test_pipeline_parallel",
+    "test_quantization",
+    "test_real_checkpoint_sharded",
+    "test_ring_attention",
+    "test_score_rerank",
+    "test_tracing",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
 
 def pytest_pyfunc_call(pyfuncitem):
     """Execute coroutine test functions with asyncio.run."""
