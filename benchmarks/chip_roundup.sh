@@ -80,6 +80,12 @@ for impl in ("pallas", "xla", "xla_pl", "pallas_pl"):
         print(f"| {impl} | (failed: {ex}) | | | | |")
 PYEOF
 
+phase "4b: north-star 8B config (int8, BASELINE config 2)"
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" BENCH_MODEL=8b timeout 2400 \
+  python bench.py --worker xla --tpu \
+  > "${LOG}_8b.json" 2> "${LOG}_8b.err"
+echo "rc=$? headline:"; cat "${LOG}_8b.json"
+
 phase "5: driver bench (full probe->fallback flow)"
 timeout 3600 python bench.py > "${LOG}_driver.json" 2> "${LOG}_driver.err"
 echo "rc=$? headline:"; cat "${LOG}_driver.json"

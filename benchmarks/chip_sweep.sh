@@ -1,0 +1,43 @@
+#!/bin/bash
+# Engine-side QPS sweep on the live chip (VERDICT r3 task 5): starts
+# the real TPU engine server with the bench-grade config, runs
+# sweep.sh against it, and lands curves + plots in
+# benchmarks/results/engine_sweep/. Run AFTER chip_roundup.sh (which
+# decides the attention impl default); pass the winner as $1.
+#
+# Usage: bash benchmarks/chip_sweep.sh [xla|pallas|auto] [extra args]
+set -uo pipefail
+cd "$(dirname "$0")/.." || exit 1
+IMPL="${1:-auto}"
+OUT="benchmarks/results/engine_sweep"
+mkdir -p "$OUT"
+PORT=8093
+
+python -m production_stack_tpu.engine.server \
+  --model bench-1b --random-weights --port "$PORT" \
+  --page-size 128 --num-pages 512 --max-num-seqs 32 \
+  --max-model-len 1024 --prefill-chunk-size 512 \
+  --prefill-batch-size 8 --decode-steps 32 \
+  --attention-impl "$IMPL" \
+  > "$OUT/server.log" 2>&1 &
+SERVER_PID=$!
+trap 'kill $SERVER_PID 2>/dev/null' EXIT
+
+# Compile warmup can take minutes on the tunnel; poll generously.
+for i in $(seq 1 120); do
+  curl -s --max-time 2 "http://127.0.0.1:$PORT/health" >/dev/null 2>&1 \
+    && break
+  sleep 5
+done
+curl -s --max-time 5 "http://127.0.0.1:$PORT/health" >/dev/null || {
+  echo "engine server did not come up; tail of log:"
+  tail -20 "$OUT/server.log"; exit 1; }
+
+# Byte tokenizer: ~5-7 tokens/word, so the reference's 500-word
+# system prompt would blow the 1024-token model len. Use a
+# byte-budget-scaled workload (same shape, prompt ~600 + history
+# growth fits the window).
+SWEEP_SYSTEM_PROMPT=80 SWEEP_CHAT_HISTORY=30 SWEEP_ANSWER_LEN=64 \
+  bash benchmarks/sweep.sh "http://127.0.0.1:$PORT" bench-1b "$OUT"
+echo "=== engine sweep done; commit $OUT and fold the table into"
+echo "    tutorials/08 + BASELINE.json ==="

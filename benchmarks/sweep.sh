@@ -16,9 +16,9 @@ mkdir -p "$OUT"
 QPS_POINTS=(0.1 0.5 1.1 2.1 3.1 4.1)
 NUM_USERS=20
 NUM_ROUNDS=5
-SYSTEM_PROMPT=500   # words
-CHAT_HISTORY=200    # words
-ANSWER_LEN=100
+SYSTEM_PROMPT="${SWEEP_SYSTEM_PROMPT:-500}"   # words
+CHAT_HISTORY="${SWEEP_CHAT_HISTORY:-200}"     # words
+ANSWER_LEN="${SWEEP_ANSWER_LEN:-100}"
 
 # Warmup: long-history users to populate caches (run.sh warmup phase).
 python "$(dirname "$0")/multi_round_qa.py" \

@@ -276,6 +276,25 @@ class EngineConfig:
             )
 
 
+def bench_1b_model_config() -> ModelConfig:
+    """The 1B-class llama geometry the TPU bench serves (bench.py) and
+    benchmarks/chip_sweep.sh's ``--model bench-1b`` server runs — one
+    definition so the sweep drives exactly the benched config."""
+    return ModelConfig(
+        name="llama-1b-class",
+        architecture="llama",
+        vocab_size=32128,
+        hidden_size=2048,
+        intermediate_size=5632,
+        num_hidden_layers=16,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        head_dim=64,
+        max_position_embeddings=2048,
+        dtype="bfloat16",
+    )
+
+
 def tiny_model_config(architecture: str = "llama") -> ModelConfig:
     """A tiny model for tests/benchmarks that runs anywhere."""
     return ModelConfig(

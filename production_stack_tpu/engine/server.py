@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional
 from aiohttp import web
 
 from production_stack_tpu.engine.config import (
+    bench_1b_model_config,
     CacheConfig,
     EngineConfig,
     LoRAConfig,
@@ -1037,6 +1038,15 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
         params = None
         tokenizer = get_tokenizer("byte")
         served_name = args.served_model_name or args.model
+    elif args.model == "bench-1b":
+        # The 1B-class bench geometry (shared with bench.py via
+        # config.bench_1b_model_config), random weights + byte
+        # tokenizer: lets benchmarks/chip_sweep.sh drive the real HTTP
+        # server at bench scale without a checkpoint on disk.
+        model_config = bench_1b_model_config()
+        params = None
+        tokenizer = get_tokenizer("byte")
+        served_name = args.served_model_name or args.model
     else:
         from production_stack_tpu.engine.weights import (
             load_model_config,
@@ -1050,6 +1060,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
         tokenizer = get_tokenizer(args.tokenizer or args.model)
         served_name = args.served_model_name or args.model
     model_config.quantization = args.quantization
+    model_config.attention_impl = args.attention_impl
 
     if (args.tensor_parallel_size > 1
             or args.pipeline_parallel_size > 1
@@ -1114,6 +1125,11 @@ def parse_args(argv=None):
     parser.add_argument("--random-weights", action="store_true")
     parser.add_argument("--dtype", default=None,
                         choices=[None, "bfloat16", "float32", "float16"])
+    parser.add_argument("--attention-impl", default="auto",
+                        choices=["auto", "xla", "pallas",
+                                 "pallas-interpret"],
+                        help="auto = empirical dispatch by the "
+                             "measured-winner table (model_runner)")
     parser.add_argument("--quantization", default="none",
                         choices=["none", "int8"],
                         help="Weight-only quantization (halves weight "

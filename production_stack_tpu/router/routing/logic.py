@@ -15,6 +15,7 @@ import asyncio
 import enum
 import heapq
 import itertools
+import random
 import time
 from dataclasses import dataclass, field as dataclass_field
 from math import ceil
@@ -137,11 +138,23 @@ class SessionPolicy(RoutingPolicy):
 
 
 class LeastLoadedPolicy(RoutingPolicy):
-    """LLQ: route to the engine with the fewest in-flight requests."""
+    """LLQ: route to the engine with the fewest in-flight requests.
+
+    Ties break RANDOMLY among the least-loaded engines. A stable
+    ``min()`` tie-break routed every equal-load arrival to the
+    lowest-index engine, so consecutive arrivals burst onto one
+    backend between count updates — measured 10-15% lower throughput
+    and ~2x p99 TTFT vs roundrobin at 16 QPS on the fake-engine rig
+    (benchmarks/results/llq_tiebreak.md). Randomizing the tie spreads
+    those bursts without weakening the load signal.
+    """
 
     def __init__(self):
         if getattr(self, "_initialized", False):
             return
+        # Seeded so tests are reproducible; the tie population itself
+        # is load-driven, the seed only orders equal choices.
+        self._rng = random.Random(0x11A)
         self._initialized = True
 
     def route_request(self, endpoints, engine_stats, request_stats, headers,
@@ -153,7 +166,11 @@ class LeastLoadedPolicy(RoutingPolicy):
                 return 0
             return stat.in_prefill_requests + stat.in_decoding_requests
 
-        url = min(endpoints, key=lambda ep: load(ep.url)).url
+        loads = [(load(ep.url), ep.url) for ep in endpoints]
+        best = min(l for l, _ in loads)
+        candidates = [u for l, u in loads if l == best]
+        url = (candidates[0] if len(candidates) == 1
+               else self._rng.choice(candidates))
         return _mark_routed(url, request_id, num_prefill_tokens)
 
 
