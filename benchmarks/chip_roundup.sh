@@ -1,11 +1,12 @@
 #!/bin/bash
 # One-command on-chip round-up for a (possibly short) live-tunnel
-# window: kernel validation + microbench, instrumented engine runs for
-# BOTH attention impls, and the full driver bench. Every phase runs in
-# its own process with a hard timeout (Mosaic hangs must not wedge the
-# harness — see results/round3_onchip_notes.md), and each phase's
-# artifacts land in benchmarks/results/ as soon as it finishes, so an
-# interrupted run still leaves evidence.
+# window. Phases are ORDERED BY VALUE-PER-MINUTE: the known-good XLA
+# engine number and the layout-deciding decode probe land first, the
+# Pallas validation/microbench and variants after, so an interrupted
+# window still leaves the artifacts that matter most. Every phase runs
+# in its own process with a hard timeout (a Mosaic hang must not wedge
+# the harness — results/round3_onchip_notes.md), and artifacts land in
+# benchmarks/results/ as soon as each phase finishes.
 #
 # Usage: bash benchmarks/chip_roundup.sh
 cd "$(dirname "$0")/.." || exit 1
@@ -17,39 +18,48 @@ mkdir -p "$OUT"
 phase() { echo; echo "=== $1 ($(date -u +%H:%M:%S)) ==="; }
 
 phase "0: tunnel sanity"
-timeout 120 python -c "import jax; print('sanity', jax.device_get(jax.numpy.ones(4)+1))" || {
+timeout -k 10 120 python -c "import jax; print('sanity', jax.device_get(jax.numpy.ones(4)+1))" || {
   echo "NO TUNNEL — aborting"; exit 1; }
 
-phase "1: kernel validation + microbench"
-timeout 2400 bash benchmarks/chip_validate.sh 2>&1 | tee "${LOG}_validate.log" | tail -20
-
-phase "2: instrumented engine run (pallas)"
-PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout 1800 \
-  python bench.py --worker pallas --tpu \
-  > "${LOG}_pallas.json" 2> "${LOG}_pallas.err"
-echo "rc=$? headline:"; cat "${LOG}_pallas.json"
-
-phase "3: instrumented engine run (xla)"
-PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout 1800 \
+phase "1: instrumented engine run (xla, stacked) — the reference point"
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout -k 30 1800 \
   python bench.py --worker xla --tpu \
   > "${LOG}_xla.json" 2> "${LOG}_xla.err"
 echo "rc=$? headline:"; cat "${LOG}_xla.json"
 
-phase "3b: instrumented engine run (xla + per-layer cache pytree)"
-# The round-3 decode-roofline experiment (round3_onchip_notes.md par 0.6):
-# per-layer cache buffers vs the stacked array. Decide on numbers.
-PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout 1800 \
+phase "2: decode roofline probe (kv-writes + engine bursts, both layouts)"
+timeout -k 30 2400 python benchmarks/decode_probe.py 2>&1 \
+  | tee "${LOG}_decode_probe.log" | tail -10
+
+phase "3: engine run (xla + per-layer cache pytree)"
+# The round-3 decode-roofline experiment (round3_onchip_notes.md par 0.6).
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout -k 30 1800 \
   python bench.py --worker xla+per_layer --tpu \
   > "${LOG}_xla_pl.json" 2> "${LOG}_xla_pl.err"
 echo "rc=$? headline:"; cat "${LOG}_xla_pl.json"
 
-phase "3c: instrumented engine run (pallas + per-layer cache pytree)"
-PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout 1800 \
+phase "4: kernel validation + microbench (gates the pallas runs)"
+timeout -k 30 2400 bash benchmarks/chip_validate.sh 2>&1 | tee "${LOG}_validate.log" | tail -20
+
+phase "5: instrumented engine run (pallas, stacked — aliasing fix)"
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout -k 30 1800 \
+  python bench.py --worker pallas --tpu \
+  > "${LOG}_pallas.json" 2> "${LOG}_pallas.err"
+echo "rc=$? headline:"; cat "${LOG}_pallas.json"
+
+phase "5b: engine run (pallas + per-layer cache pytree)"
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout -k 30 1800 \
   python bench.py --worker pallas+per_layer --tpu \
   > "${LOG}_pallas_pl.json" 2> "${LOG}_pallas_pl.err"
 echo "rc=$? headline:"; cat "${LOG}_pallas_pl.json"
 
-phase "4: per-phase timing decomposition"
+phase "6: north-star 8B config (int8, BASELINE config 2)"
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" BENCH_MODEL=8b timeout -k 30 2400 \
+  python bench.py --worker xla --tpu \
+  > "${LOG}_8b.json" 2> "${LOG}_8b.err"
+echo "rc=$? headline:"; cat "${LOG}_8b.json"
+
+phase "7: per-phase timing decomposition"
 python - "$LOG" <<'PYEOF'
 import collections
 import json
@@ -59,7 +69,7 @@ import sys
 log = sys.argv[1]
 print(f"| impl | req/s | tok/s | mfu | decode burst avg | prefill512 avg |")
 print(f"|---|---|---|---|---|---|")
-for impl in ("pallas", "xla", "xla_pl", "pallas_pl"):
+for impl in ("xla", "xla_pl", "pallas", "pallas_pl", "8b"):
     agg = collections.defaultdict(lambda: [0, 0.0])
     try:
         for line in open(f"{log}_{impl}.err"):
@@ -80,18 +90,13 @@ for impl in ("pallas", "xla", "xla_pl", "pallas_pl"):
         print(f"| {impl} | (failed: {ex}) | | | | |")
 PYEOF
 
-phase "4b: north-star 8B config (int8, BASELINE config 2)"
-PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" BENCH_MODEL=8b timeout 2400 \
-  python bench.py --worker xla --tpu \
-  > "${LOG}_8b.json" 2> "${LOG}_8b.err"
-echo "rc=$? headline:"; cat "${LOG}_8b.json"
-
-phase "5: driver bench (full probe->fallback flow)"
-timeout 3600 python bench.py > "${LOG}_driver.json" 2> "${LOG}_driver.err"
+phase "8: driver bench (full probe->fallback flow)"
+timeout -k 30 3600 python bench.py > "${LOG}_driver.json" 2> "${LOG}_driver.err"
 echo "rc=$? headline:"; cat "${LOG}_driver.json"
 
 echo
 echo "=== done; artifacts: ${LOG}_* ==="
-echo "Next: pick the faster impl as the engine default, refresh"
-echo "BASELINE.json round3_measured, and fold the table into"
-echo "tutorials/07 + results/round3_onchip_notes.md."
+echo "Next: set the engine defaults (attention impl + cache layout) to"
+echo "the measured winners, refresh BASELINE.json round4_measured, run"
+echo "benchmarks/chip_sweep.sh <winner>, and fold tables into"
+echo "tutorials/07+08 and results/round4_onchip_notes.md."

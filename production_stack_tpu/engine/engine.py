@@ -91,7 +91,7 @@ class LLMEngine:
         self.offload.offload_page(page_hash, k_page, v_page)
 
     def _restore_offloaded_prefix(self, prompt_token_ids,
-                                  matched_pages):
+                                  matched_pages, cache_salt=0):
         """After an in-HBM prefix miss, pull further pages from the
         host/remote tiers into freshly allocated HBM pages."""
         from production_stack_tpu.engine.kv_cache import (
@@ -100,7 +100,8 @@ class LLMEngine:
         )
         usable = len(prompt_token_ids) - 1
         hashes = PagedCacheManager.chain_hashes(
-            prompt_token_ids[:usable], self.cache_manager.page_size
+            prompt_token_ids[:usable], self.cache_manager.page_size,
+            cache_salt,
         )
         remaining = hashes[len(matched_pages):]
         n = self.offload.lookup_chain(remaining)
@@ -155,6 +156,8 @@ class LLMEngine:
             sampling=sampling,
             output_sink=output_sink,
             lora_id=lora_id,
+            cache_salt=(self.runner.lora_registry.cache_root(lora_id)
+                        if lora_id else 0),
         )
         with self._lock:
             self.sequences[seq.seq_id] = seq
@@ -272,9 +275,11 @@ class LLMEngine:
 
     def generate(self, prompt_token_ids: List[int],
                  sampling: Optional[SamplingParams] = None,
+                 lora_name: Optional[str] = None,
                  ) -> Sequence:
         """Blocking single-prompt generation (tests/benchmarks)."""
-        seq_id = self.add_request(prompt_token_ids, sampling)
+        seq_id = self.add_request(prompt_token_ids, sampling,
+                                  lora_name=lora_name)
         seq = self.sequences[seq_id]
         while seq.state not in (SequenceState.FINISHED,
                                 SequenceState.ABORTED):

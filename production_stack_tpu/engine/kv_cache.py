@@ -125,10 +125,19 @@ class PagedCacheManager:
 
     @staticmethod
     def chain_hashes(token_ids: Sequence[int],
-                     page_size: int) -> List[PageHash]:
-        """Content hashes for each *full* page of a token prefix."""
+                     page_size: int,
+                     root: int = 0) -> List[PageHash]:
+        """Content hashes for each *full* page of a token prefix.
+
+        ``root`` seeds the chain's first parent. It namespaces cache
+        identity beyond token content — the engine passes the
+        sequence's cache salt (Sequence.cache_salt), which is nonzero
+        for LoRA-adapter requests: adapter deltas on wk/wv make the
+        KV bytes adapter-specific, so a base-model prompt must never
+        hit pages prefilled through an adapter (and vice versa).
+        """
         hashes: List[PageHash] = []
-        parent = 0
+        parent = root
         for start in range(0, len(token_ids) - page_size + 1, page_size):
             chunk = tuple(token_ids[start:start + page_size])
             h: PageHash = (parent, chunk)
@@ -136,7 +145,8 @@ class PagedCacheManager:
             parent = hash(h)
         return hashes
 
-    def match_prefix(self, token_ids: Sequence[int]) -> List[int]:
+    def match_prefix(self, token_ids: Sequence[int],
+                     root: int = 0) -> List[int]:
         """Longest chain of cached full pages matching the prompt prefix.
 
         Returns the page ids (ref-counted up; caller owns them).
@@ -149,7 +159,7 @@ class PagedCacheManager:
         # recomputed so prefill produces logits for sampling.
         usable = len(token_ids) - 1
         for page_hash in self.chain_hashes(token_ids[:usable],
-                                           self.page_size):
+                                           self.page_size, root):
             page_id = self._hash_to_page.get(page_hash)
             if page_id is None:
                 break
@@ -168,7 +178,8 @@ class PagedCacheManager:
 
     def commit_full_pages(self, token_ids: Sequence[int],
                           pages: List[int],
-                          already_hashed: int) -> None:
+                          already_hashed: int,
+                          root: int = 0) -> None:
         """Register content hashes for pages that have become full.
 
         Args:
@@ -178,7 +189,7 @@ class PagedCacheManager:
         """
         if not self.config.enable_prefix_caching:
             return
-        hashes = self.chain_hashes(token_ids, self.page_size)
+        hashes = self.chain_hashes(token_ids, self.page_size, root)
         for i in range(already_hashed, min(len(hashes), len(pages))):
             page_id = pages[i]
             info = self._pages.get(page_id)

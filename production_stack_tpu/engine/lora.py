@@ -271,6 +271,13 @@ class LoRARegistry:
         self.max_lora_rank = max_lora_rank
         self.stack = empty_lora_stack(config, max_loras, max_lora_rank)
         self.slots: Dict[str, int] = {}
+        # Per-slot prefix-cache namespace roots: adapter KV (wk/wv
+        # carry the deltas) must never cross-hit base or other-adapter
+        # pages, and RE-registering a name with new weights must not
+        # hit its own stale pages — in HBM or in a persistent remote
+        # offload tier across restarts (content-addressed, see
+        # register()).
+        self._cache_roots: Dict[int, int] = {}
 
     def register(self, adapter: LoRAAdapter) -> int:
         if adapter.name in self.slots:
@@ -287,6 +294,21 @@ class LoRARegistry:
         # all-zero slot (which would silently serve the base model).
         self.stack = install_adapter(self.stack, slot, adapter)
         self.slots[adapter.name] = slot
+        # Content-addressed: the namespace is a digest of the actual
+        # adapter weights, so (a) re-registering identical weights
+        # keeps prefix-cache/offload reuse, (b) NEW weights under the
+        # same name get a fresh namespace even across process restarts
+        # against a persistent remote KV tier (a process-local counter
+        # would collide there).
+        import hashlib
+        h = hashlib.sha256(f"lora:{adapter.name}".encode())
+        for tgt in sorted(adapter.weights):
+            a, b = adapter.weights[tgt]
+            h.update(tgt.encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+            h.update(np.ascontiguousarray(b).tobytes())
+        h.update(repr(adapter.scaling).encode())
+        self._cache_roots[slot] = int.from_bytes(h.digest()[:8], "big")
         logger.info("LoRA adapter %r installed in slot %d (rank %d)",
                     adapter.name, slot, adapter.rank)
         return slot
@@ -295,6 +317,12 @@ class LoRARegistry:
         if name is None:
             return 0
         return self.slots[name]
+
+    def cache_root(self, slot: int) -> int:
+        """Prefix-cache chain root for a slot (0 = base namespace)."""
+        if slot == 0:
+            return 0
+        return self._cache_roots[slot]
 
     def names(self) -> List[str]:
         return list(self.slots)

@@ -215,10 +215,12 @@ class Scheduler:
             if seq.num_computed_tokens == 0 and not seq.pages:
                 # First touch: reuse cached prefix pages, then allocate
                 # the remainder for the whole prompt up front.
-                matched = self.cache.match_prefix(seq.prompt_token_ids)
+                matched = self.cache.match_prefix(
+                    seq.prompt_token_ids, seq.cache_salt)
                 if self.restore_hook is not None:
                     matched = matched + self.restore_hook(
-                        seq.prompt_token_ids, matched
+                        seq.prompt_token_ids, matched,
+                        seq.cache_salt,
                     )
                 if (self.sp_threshold is not None
                         and not matched
@@ -355,7 +357,7 @@ class Scheduler:
                                    + len(chunk.chunk_tokens))
         self.cache.commit_full_pages(
             seq.prompt_token_ids[:seq.num_computed_tokens],
-            seq.pages, seq.num_hashed_pages,
+            seq.pages, seq.num_hashed_pages, seq.cache_salt,
         )
         seq.num_hashed_pages = min(
             len(seq.pages),

@@ -83,6 +83,9 @@ class Sequence:
     finish_time: Optional[float] = None
     # LoRA adapter slot (0 = base model; see engine/lora.py).
     lora_id: int = 0
+    # Prefix-cache namespace root (kv_cache.chain_hashes): nonzero for
+    # adapter requests so adapter-specific KV never cross-hits.
+    cache_salt: int = 0
     # Server-side stream hook (asyncio queue or callable), opaque here.
     output_sink: Any = None
 

@@ -728,6 +728,7 @@ class EngineServer:
                     "model": response_model, "choices": [choice]}
 
         write_lock = asyncio.Lock()
+        completion_tokens = [0] * n
 
         async def stream_choice(index, seq_id, stream):
             async def on_delta(text, lps):
@@ -735,8 +736,9 @@ class EngineServer:
                     await resp.write(sse(chunk(index, text, None,
                                                lps=lps)))
 
-            _, _, finish_reason, _ = await consume_choice(
+            _, n_toks, finish_reason, _ = await consume_choice(
                 seq_id, stream, on_delta=on_delta)
+            completion_tokens[index] = n_toks
             async with write_lock:
                 await resp.write(sse(chunk(index, None, finish_reason)))
 
@@ -757,6 +759,20 @@ class EngineServer:
                         await resp.write(sse(chunk(i, echo_text,
                                                    None)))
             await asyncio.gather(*tasks)
+            stream_opts = body.get("stream_options")
+            if (isinstance(stream_opts, dict)
+                    and stream_opts.get("include_usage")):
+                # OpenAI stream_options.include_usage: one final chunk
+                # with empty choices and the aggregate usage.
+                await resp.write(sse({
+                    "id": rid,
+                    "object": ("chat.completion.chunk" if chat
+                               else "text_completion"),
+                    "created": created, "model": response_model,
+                    "choices": [],
+                    "usage": _usage(len(prompt),
+                                    sum(completion_tokens)),
+                }))
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
         except BaseException:

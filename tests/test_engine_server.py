@@ -552,3 +552,30 @@ def test_completions_echo_streaming():
         assert texts[1].startswith("hello world")
         assert len(texts[0]) > len("hello world")
     asyncio.run(_with_client(run))
+
+
+def test_stream_options_include_usage():
+    """OpenAI stream_options.include_usage: a final pre-[DONE] chunk
+    with empty choices and aggregate usage."""
+    async def run(client):
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama", "stream": True,
+            "stream_options": {"include_usage": True},
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "ignore_eos": True,
+        })
+        assert resp.status == 200
+        chunks = []
+        async for line in resp.content:
+            line = line.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunks.append(json.loads(line[len("data: "):]))
+        usage_chunks = [c for c in chunks if c.get("usage")]
+        assert len(usage_chunks) == 1
+        assert usage_chunks[0]["choices"] == []
+        u = usage_chunks[0]["usage"]
+        assert u["completion_tokens"] == 4
+        assert u["total_tokens"] == u["prompt_tokens"] + 4
+        # Usage chunk is the LAST data chunk before [DONE].
+        assert chunks[-1].get("usage")
+    asyncio.run(_with_client(run))

@@ -333,3 +333,64 @@ def test_gpt2_engine_generation_with_adapter(tmp_path):
         engine.step()
     assert len(seq.output_token_ids) == 4
     assert seq.lora_id == 1
+
+
+def test_prefix_cache_never_crosses_adapters():
+    """Adapter KV (wk/wv carry the deltas) must not serve base-model
+    requests with the same prompt, or vice versa — the page-hash chain
+    is salted per (adapter, generation). Round-4 fix: before it, the
+    second request below hit the first's pages and decoded against
+    adapter-contaminated KV."""
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        SchedulerConfig,
+        tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    def make_engine(with_adapter):
+        config = EngineConfig(
+            model=tiny_model_config("llama"),
+            cache=CacheConfig(page_size=16, num_pages=64),
+            scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
+                                      prefill_chunk_size=32,
+                                      prefill_batch_size=2),
+            lora=LoRAConfig(enable=True, max_loras=2, max_lora_rank=4),
+        )
+        engine = LLMEngine(config)
+        if with_adapter:
+            engine.runner.lora_registry.register(
+                _random_adapter(engine.config.model, rank=4,
+                                max_rank=4, scale=2.0))
+        return engine
+
+    sampling = lambda: SamplingParams(  # noqa: E731
+        max_tokens=6, temperature=0.0, ignore_eos=True)
+    # > 2 full pages so the prefix cache has chainable pages.
+    prompt = list(range(3, 3 + 40))
+
+    # Ground truth: base-only engine, no adapter ever ran.
+    clean = make_engine(False)
+    base_expected = clean.generate(prompt, sampling()).output_token_ids
+
+    # Adapter request first (pages get cached), then the SAME prompt
+    # as base: the base answer must be identical to the clean engine's.
+    eng = make_engine(True)
+    adapter_out = eng.generate(prompt, sampling(),
+                               lora_name="test-adapter").output_token_ids
+    base_out = eng.generate(prompt, sampling()).output_token_ids
+    assert base_out == base_expected
+    # Sanity: the adapter path actually diverges (scale 2.0 adapter).
+    assert adapter_out != base_expected
+
+    # And adapter-after-adapter still hits its own namespace: same
+    # output, now with a prefix-cache hit.
+    hits_before = eng.cache_manager.prefix_hit_tokens
+    adapter_out2 = eng.generate(prompt, sampling(),
+                                lora_name="test-adapter"
+                                ).output_token_ids
+    assert adapter_out2 == adapter_out
+    assert eng.cache_manager.prefix_hit_tokens > hits_before
