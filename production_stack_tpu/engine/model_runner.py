@@ -150,9 +150,6 @@ class ModelRunner:
                 raise NotImplementedError(
                     "LoRA with pipeline x tensor parallelism (pp-only "
                     "LoRA is supported)")
-            if model_config.quantization != "none":
-                raise NotImplementedError(
-                    "quantization with pipeline parallelism")
             tp = config.parallel.tensor_parallel_size
             if tp > 1 and (model_config.num_key_value_heads % tp
                            or model_config.num_attention_heads % tp):
@@ -181,11 +178,18 @@ class ModelRunner:
                     "context parallelism serves "
                     f"{'/'.join(SP_FAMILIES)} "
                     f"(got {model_config.architecture!r})")
-            if (config.parallel.tensor_parallel_size > 1
-                    or config.parallel.pipeline_parallel_size > 1):
+            if config.parallel.pipeline_parallel_size > 1:
                 raise NotImplementedError(
-                    "context parallelism composes with tp/pp meshes "
-                    "in a later round; use sp alone for now")
+                    "context parallelism with pipeline parallelism "
+                    "(sp composes with tp; pp shards the layer axis "
+                    "the sp prefill walks in full)")
+            sp_tp = config.parallel.tensor_parallel_size
+            if sp_tp > 1 and (
+                    model_config.num_attention_heads % sp_tp
+                    or model_config.num_key_value_heads % sp_tp):
+                raise ValueError(
+                    "sp x tp needs attention/kv heads divisible by "
+                    f"tensor_parallel_size {sp_tp}")
             if config.lora.enable:
                 raise NotImplementedError(
                     "LoRA with context parallelism")

@@ -26,8 +26,16 @@ ENGINE's prefill contract over the ``sp`` mesh axis, so
   logits for T tokens are never materialized.
 
 Scope: llama-family (llama/mistral/qwen2) + gpt2 architectures,
-first-touch prompts (no prefix-cache hit), sp composes with
-dp=tp=pp=1 (the engine gate in model_runner rejects the rest loudly).
+first-touch prompts (no prefix-cache hit). sp composes with tp
+(round-5): weights enter the shard_map with their GSPMD layouts
+(parallel/mesh.py param_specs — column projections sliced over 'tp'),
+each device runs its local heads through the ring, and the
+row-parallel matmuls (wo / w_down / fc2) finish with an explicit
+``psum`` over 'tp' — the same collective GSPMD inserts on the
+decode path, so sp x tp prefill and plain-tp decode agree bit-for-bit
+on the replicated activations. sp also composes with dp (replicated
+batch rows); pp composition is still rejected loudly by the
+model_runner gate.
 """
 
 from __future__ import annotations
@@ -77,14 +85,35 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
 
     Returns (row_logits [B, vocab] at last_index, new_k, new_v).
     """
-    nh, nkv, d = (config.num_attention_heads,
-                  config.num_key_value_heads, config.head_dim)
+    from production_stack_tpu.parallel.mesh import (
+        _on_mesh,
+        param_specs,
+    )
+
+    # A caller-built mesh may carry only an 'sp' axis (build_mesh
+    # always has all four): without 'tp', weights stay replicated and
+    # the psums are skipped entirely.
+    has_tp = "tp" in mesh.axis_names
+    tp = mesh.shape["tp"] if has_tp else 1
+    nh, nkv, d = (config.num_attention_heads // tp,
+                  config.num_key_value_heads // tp, config.head_dim)
     b, t = tokens.shape
     gpt2 = config.architecture == "gpt2"
     layer_names = (GPT2_LAYER_NAMES if gpt2
                    else _layer_param_names(config))
     layer_params = {k: params[k] for k in layer_names}
     shared = {k: v for k, v in params.items() if k not in layer_names}
+    # Weights keep their serving GSPMD layouts inside the shard_map
+    # (no resharding at the boundary): column-parallel projections are
+    # 'tp' slices, so the body below works on nh/nkv LOCAL heads and
+    # closes each row-parallel matmul with a psum over 'tp'.
+    specs = param_specs(config)
+
+    def on_mesh(spec: P) -> P:
+        return _on_mesh(spec, mesh)
+
+    def psum_tp(x):
+        return jax.lax.psum(x, "tp") if has_tp else x
 
     def llama_layer(x, lp_i, positions_l):
         bl, tl = positions_l.shape
@@ -104,10 +133,14 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
 
     def llama_post(x, attn, lp_i):
         bl, tl = attn.shape[:2]
-        x = x + attn.reshape(bl, tl, nh * d) @ lp_i["wo"]
+        # wo / w_down are row-parallel ('tp' slices of the input dim):
+        # each device holds a partial sum until the psum.
+        x = x + psum_tp(
+            attn.reshape(bl, tl, nh * d) @ lp_i["wo"])
         m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
-        return x + (jax.nn.silu(m_in @ lp_i["w_gate"])
-                    * (m_in @ lp_i["w_up"])) @ lp_i["w_down"]
+        return x + psum_tp(
+            (jax.nn.silu(m_in @ lp_i["w_gate"])
+             * (m_in @ lp_i["w_up"])) @ lp_i["w_down"])
 
     def gpt2_layer(x, lp_i, positions_l):
         bl, tl = positions_l.shape
@@ -119,12 +152,16 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
 
     def gpt2_post(x, attn, lp_i):
         bl, tl = attn.shape[:2]
-        x = x + (attn.reshape(bl, tl, nh * d) @ lp_i["wo"]
-                 + lp_i["bo"])
+        # Row-parallel wo/fc2 close with a psum; their biases are
+        # replicated and must be added exactly once (after the psum).
+        x = x + (psum_tp(
+            attn.reshape(bl, tl, nh * d) @ lp_i["wo"])
+            + lp_i["bo"])
         m_in = layer_norm(x, lp_i["mlp_norm_w"], lp_i["mlp_norm_b"])
         hidden = jax.nn.gelu(m_in @ lp_i["fc1"] + lp_i["fc1_b"],
                              approximate=True)
-        return x + (hidden @ lp_i["fc2"] + lp_i["fc2_b"])
+        return x + (psum_tp(hidden @ lp_i["fc2"])
+                    + lp_i["fc2_b"])
 
     qkv_fn, post_fn = ((gpt2_layer, gpt2_post) if gpt2
                        else (llama_layer, llama_post))
@@ -172,12 +209,16 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
                          config.rms_norm_eps), kc, vc)
 
     repl = P()
+    # KV cache shards its head axis over 'tp' (parallel/mesh.py
+    # cache_spec): each device scatters the K/V heads it computed.
+    cache_sp = on_mesh(P(None, "tp", None, None, None))
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=({k: repl for k in layer_params},
-                  {k: repl for k in shared},
-                  repl, repl, P(None, "sp"), P(None, "sp"), repl),
-        out_specs=(P(None, "sp", None), repl, repl),
+        in_specs=({k: on_mesh(specs.get(k, repl)) for k in layer_params},
+                  {k: on_mesh(specs.get(k, repl)) for k in shared},
+                  cache_sp, cache_sp, P(None, "sp"), P(None, "sp"),
+                  repl),
+        out_specs=(P(None, "sp", None), cache_sp, cache_sp),
         check_vma=False,
     )
     hidden, new_k, new_v = fn(layer_params, shared, k_cache, v_cache,

@@ -114,6 +114,13 @@ def _pp_size(mesh: Optional[Mesh]) -> int:
     return mesh.shape["pp"]
 
 
+def _on_mesh(spec: P, mesh: Mesh) -> P:
+    """Drop axis names the mesh doesn't carry (a caller-built mesh may
+    have only a subset of build_mesh's four axes — e.g. an ('sp',)
+    mesh for context-parallel prefill): absent axes mean replicated."""
+    return P(*(a if a in mesh.axis_names else None for a in spec))
+
+
 def shard_params(params: Dict[str, jax.Array], config: ModelConfig,
                  mesh: Optional[Mesh]) -> Dict[str, jax.Array]:
     if mesh is None:
@@ -129,7 +136,7 @@ def shard_params(params: Dict[str, jax.Array], config: ModelConfig,
                 specs[name] = P("pp", *specs[name][1:])
 
     def place(name, value):
-        spec = specs.get(name, P())
+        spec = _on_mesh(specs.get(name, P()), mesh)
         if isinstance(value, tuple):
             # int8 (weight [L, in, out], scale [L, out]) pair: the
             # scale follows the weight's layer + output-channel axes.
@@ -150,9 +157,9 @@ def cache_spec(mesh: Optional[Mesh] = None) -> P:
     """KV cache [L, kv_heads, pages, head_dim, page_size]: shard heads
     over tp; with pipeline parallelism each stage also owns its own
     layers' pages (L over pp)."""
-    if _pp_size(mesh) > 1:
-        return P("pp", "tp", None, None, None)
-    return P(None, "tp", None, None, None)
+    spec = (P("pp", "tp", None, None, None) if _pp_size(mesh) > 1
+            else P(None, "tp", None, None, None))
+    return spec if mesh is None else _on_mesh(spec, mesh)
 
 
 def shard_cache(cache: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
@@ -164,7 +171,8 @@ def shard_cache(cache: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
         # axis, so pp cannot shard it (the model runner rejects that
         # combination).
         return jax.device_put(
-            cache, NamedSharding(mesh, P("tp", None, None, None)))
+            cache, NamedSharding(
+                mesh, _on_mesh(P("tp", None, None, None), mesh)))
     return jax.device_put(cache, NamedSharding(mesh, cache_spec(mesh)))
 
 

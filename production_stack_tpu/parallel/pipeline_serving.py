@@ -61,6 +61,7 @@ from production_stack_tpu.models.gpt2 import (
 from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.rope import apply_rope
 from production_stack_tpu.parallel.mesh import (
+    _on_mesh,
     cache_spec as mesh_cache_spec,
     param_specs,
 )
@@ -75,11 +76,22 @@ def _psum_tp(x, tp: int):
 def _lora_mm(x, w, ll, target, lora_ids, lora_scale):
     """Projection with optional LoRA delta (pp-only meshes: adapters
     ride replicated except their L axis, so the full-width delta adds
-    to a full-width base output — tp>1 is rejected at engine build)."""
-    if ll is None:
-        return x @ w
+    to a full-width base output — tp>1 is rejected at engine build).
+    ``w`` may be an int8 (weight, scale) pair: lora_matmul owns the
+    dense/dequant dispatch and returns the plain base matmul when
+    ``ll`` is None."""
+    if ll is None and not isinstance(w, tuple):
+        return x @ w  # skip the helper import on the hot plain path
     from production_stack_tpu.engine.lora import lora_matmul
     return lora_matmul(x, w, ll, target, lora_ids, lora_scale)
+
+
+def _stage_layer(lp, i):
+    """Slice layer ``i`` off each stage-local stack; int8 params are
+    (weight, scale) pairs whose members slice together."""
+    return {name: ((s[0][i], s[1][i]) if isinstance(s, tuple)
+                   else s[i])
+            for name, s in lp.items()}
 
 
 def _local_layers_llama(x, lp, k_local, v_local, page_table, positions,
@@ -95,7 +107,7 @@ def _local_layers_llama(x, lp, k_local, v_local, page_table, positions,
     # Static loop over the stage's local layers, in-place cache
     # scatters at a static index (see models.llama.forward).
     for i in range(k_local.shape[0]):
-        lp_i = {name: s[i] for name, s in lp.items()}
+        lp_i = _stage_layer(lp, i)
         ll = (None if lora is None
               else jax.tree.map(lambda s: s[i], lora))
         a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
@@ -147,7 +159,7 @@ def _local_layers_gpt2(x, lp, k_local, v_local, page_table, positions,
     # Static loop over the stage's local layers, in-place cache
     # scatters at a static index (see models.llama.forward).
     for i in range(k_local.shape[0]):
-        lp_i = {name: s[i] for name, s in lp.items()}
+        lp_i = _stage_layer(lp, i)
         ll = (None if lora is None
               else jax.tree.map(lambda s: s[i], lora))
         a_in = layer_norm(x, lp_i["attn_norm_w"], lp_i["attn_norm_b"])
@@ -329,11 +341,20 @@ def pp_paged_forward(params: Params, config: ModelConfig,
     # mesh without a 'tp' axis (pp-only callers) must still work:
     # drop axis names the mesh doesn't have.
     def on_mesh(spec: P) -> P:
-        return P(*(a if a in mesh.axis_names else None for a in spec))
+        return _on_mesh(spec, mesh)
 
     specs = param_specs(config)
-    lp_specs = {k: on_mesh(P("pp", *specs[k][1:]))
-                for k in layer_params}
+
+    def lp_spec(k):
+        spec = on_mesh(P("pp", *specs[k][1:]))
+        if isinstance(layer_params[k], tuple):
+            # int8 (weight [L, in, out], scale [L, out]): the scale
+            # follows the weight's layer + output-channel axes
+            # (mirrors parallel/mesh.py shard_params).
+            return (spec, P(spec[0], spec[2]))
+        return spec
+
+    lp_specs = {k: lp_spec(k) for k in layer_params}
     shared_specs = {k: on_mesh(specs.get(k, P())) for k in shared}
     cache_spec = on_mesh(mesh_cache_spec(mesh))
     repl = P()

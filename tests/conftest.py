@@ -29,6 +29,17 @@ if "xla_force_host_platform_device_count" not in _flags:
 try:
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # Persistent XLA compilation cache: the slow lane is dominated by
+    # recompiles of the same engine programs every run (26m at round
+    # 4). Lower the min-compile-time floor so the many ~1s engine
+    # programs are cached too. Override the location with
+    # JAX_TEST_CACHE_DIR; wiped by `rm -rf ~/.cache/psx_jax_tests`.
+    _cache_dir = os.environ.get(
+        "JAX_TEST_CACHE_DIR",
+        os.path.expanduser("~/.cache/psx_jax_tests"))
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except Exception:
     pass
 

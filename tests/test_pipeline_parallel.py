@@ -86,7 +86,7 @@ def test_pipeline_rejects_bad_shapes():
 # ---- serving-path pipeline parallelism (parallel/pipeline_serving.py) ----
 
 
-def _pp_engine(pp):
+def _pp_engine(pp, quant="none"):
     """Full LLMEngine on a (dp=1, pp, tp=1) mesh."""
     from production_stack_tpu.engine.config import (
         CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
@@ -97,6 +97,7 @@ def _pp_engine(pp):
 
     model = tiny_model_config("llama")
     model.num_hidden_layers = 4  # divisible by every pp size tested
+    model.quantization = quant
     config = EngineConfig(
         model=model,
         cache=CacheConfig(page_size=16, num_pages=64),
@@ -170,7 +171,7 @@ def test_pp_engine_rejects_bad_configs():
             **base), mesh=None)
 
 
-def _pp_tp_engine(pp, tp, architecture="llama"):
+def _pp_tp_engine(pp, tp, architecture="llama", quant="none"):
     """Full LLMEngine on a (dp=1, pp, tp) mesh."""
     from production_stack_tpu.engine.config import (
         CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
@@ -181,6 +182,7 @@ def _pp_tp_engine(pp, tp, architecture="llama"):
 
     model = tiny_model_config(architecture)
     model.num_hidden_layers = 4
+    model.quantization = quant
     config = EngineConfig(
         model=model,
         cache=CacheConfig(page_size=16, num_pages=64),
@@ -209,6 +211,55 @@ def test_pp_tp_engine_matches_single_device():
            for p in prompts]
     # One engine instance serves all prompts (continuous batching).
     eng = _pp_tp_engine(2, 2)
+    seqs = [eng.sequences[eng.add_request(p, sampling())]
+            for p in prompts]
+    while eng.has_work():
+        eng.step()
+    assert [s.output_token_ids for s in seqs] == ref
+
+
+def test_pp_quantized_engine_matches_single_device():
+    """int8 weights staged over pp=2 (round-5: the pp+quant guard
+    lifted): the single-device int8 engine and the pp engine derive
+    IDENTICAL (weight, scale) pairs from the same seed, so greedy
+    outputs must agree token for token — no quantization-noise
+    allowance needed."""
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    sampling = lambda: SamplingParams(  # noqa: E731
+        max_tokens=8, temperature=0.0, ignore_eos=True)
+    prompts = [list(range(2, 2 + n)) for n in (18, 7)]
+
+    ref_engine = _pp_engine(1, quant="int8")
+    ref = [ref_engine.generate(p, sampling()).output_token_ids
+           for p in prompts]
+    eng = _pp_engine(2, quant="int8")
+    import jax.numpy as jnp
+    w, scale = eng.runner.params["wq"]
+    assert w.dtype == jnp.int8  # staged weights really are int8
+    seqs = [eng.sequences[eng.add_request(p, sampling())]
+            for p in prompts]
+    while eng.has_work():
+        eng.step()
+    assert [s.output_token_ids for s in seqs] == ref
+
+
+def test_pp_tp_quantized_engine_matches_single_device():
+    """pp=2 x tp=2 with int8: exercises the tp-sharded scale spec
+    (pipeline_serving lp_spec — column weights carry a 'tp' scale
+    slice, row weights a replicated scale that commutes with the
+    psum). Same seed -> identical (weight, scale) pairs -> exact
+    greedy parity with the single-device int8 engine."""
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    sampling = lambda: SamplingParams(  # noqa: E731
+        max_tokens=8, temperature=0.0, ignore_eos=True)
+    prompts = [list(range(2, 2 + n)) for n in (18, 7)]
+
+    ref_engine = _pp_tp_engine(1, 1, quant="int8")
+    ref = [ref_engine.generate(p, sampling()).output_token_ids
+           for p in prompts]
+    eng = _pp_tp_engine(2, 2, quant="int8")
     seqs = [eng.sequences[eng.add_request(p, sampling())]
             for p in prompts]
     while eng.has_work():
