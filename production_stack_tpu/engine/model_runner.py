@@ -193,9 +193,6 @@ class ModelRunner:
             if config.lora.enable:
                 raise NotImplementedError(
                     "LoRA with context parallelism")
-            if model_config.quantization != "none":
-                raise NotImplementedError(
-                    "quantization with context parallelism")
 
         if params is None:
             logger.info("Initializing random weights for %s",

@@ -58,6 +58,7 @@ from production_stack_tpu.models.gpt2 import (
 from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.ring_attention import ring_attention
 from production_stack_tpu.ops.rope import apply_rope
+from production_stack_tpu.parallel.pipeline_serving import _stage_layer
 
 Params = Dict[str, jnp.ndarray]
 
@@ -115,12 +116,23 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
     def psum_tp(x):
         return jax.lax.psum(x, "tp") if has_tp else x
 
+    def mm(x, w):
+        # int8 (weight, scale) pairs dequantize in the dot's epilogue
+        # (engine/quantization.py); per-output-channel scales commute
+        # with the row-parallel psum above.
+        if isinstance(w, tuple):
+            from production_stack_tpu.engine.quantization import (
+                dequant_matmul,
+            )
+            return dequant_matmul(x, w)
+        return x @ w
+
     def llama_layer(x, lp_i, positions_l):
         bl, tl = positions_l.shape
         a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
-        q = a_in @ lp_i["wq"]
-        k = a_in @ lp_i["wk"]
-        v = a_in @ lp_i["wv"]
+        q = mm(a_in, lp_i["wq"])
+        k = mm(a_in, lp_i["wk"])
+        v = mm(a_in, lp_i["wv"])
         if config.attention_bias:
             q, k, v = (q + lp_i["bq"], k + lp_i["bk"],
                        v + lp_i["bv"])
@@ -136,18 +148,18 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
         # wo / w_down are row-parallel ('tp' slices of the input dim):
         # each device holds a partial sum until the psum.
         x = x + psum_tp(
-            attn.reshape(bl, tl, nh * d) @ lp_i["wo"])
+            mm(attn.reshape(bl, tl, nh * d), lp_i["wo"]))
         m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
         return x + psum_tp(
-            (jax.nn.silu(m_in @ lp_i["w_gate"])
-             * (m_in @ lp_i["w_up"])) @ lp_i["w_down"])
+            mm(jax.nn.silu(mm(m_in, lp_i["w_gate"]))
+               * mm(m_in, lp_i["w_up"]), lp_i["w_down"]))
 
     def gpt2_layer(x, lp_i, positions_l):
         bl, tl = positions_l.shape
         a_in = layer_norm(x, lp_i["attn_norm_w"], lp_i["attn_norm_b"])
-        q = (a_in @ lp_i["wq"] + lp_i["bq"]).reshape(bl, tl, nh, d)
-        k = (a_in @ lp_i["wk"] + lp_i["bk"]).reshape(bl, tl, nkv, d)
-        v = (a_in @ lp_i["wv"] + lp_i["bv"]).reshape(bl, tl, nkv, d)
+        q = (mm(a_in, lp_i["wq"]) + lp_i["bq"]).reshape(bl, tl, nh, d)
+        k = (mm(a_in, lp_i["wk"]) + lp_i["bk"]).reshape(bl, tl, nkv, d)
+        v = (mm(a_in, lp_i["wv"]) + lp_i["bv"]).reshape(bl, tl, nkv, d)
         return x, q, k, v
 
     def gpt2_post(x, attn, lp_i):
@@ -155,12 +167,12 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
         # Row-parallel wo/fc2 close with a psum; their biases are
         # replicated and must be added exactly once (after the psum).
         x = x + (psum_tp(
-            attn.reshape(bl, tl, nh * d) @ lp_i["wo"])
+            mm(attn.reshape(bl, tl, nh * d), lp_i["wo"]))
             + lp_i["bo"])
         m_in = layer_norm(x, lp_i["mlp_norm_w"], lp_i["mlp_norm_b"])
-        hidden = jax.nn.gelu(m_in @ lp_i["fc1"] + lp_i["fc1_b"],
+        hidden = jax.nn.gelu(mm(m_in, lp_i["fc1"]) + lp_i["fc1_b"],
                              approximate=True)
-        return x + (psum_tp(hidden @ lp_i["fc2"])
+        return x + (psum_tp(mm(hidden, lp_i["fc2"]))
                     + lp_i["fc2_b"])
 
     qkv_fn, post_fn = ((gpt2_layer, gpt2_post) if gpt2
@@ -186,7 +198,7 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
         # Static loop over layers, in-place cache scatters at a
         # static index (see models.llama.forward).
         for layer in range(config.num_hidden_layers):
-            lp_i = {name: s[layer] for name, s in lp.items()}
+            lp_i = _stage_layer(lp, layer)
             x, q, k, v = qkv_fn(x, lp_i, positions_l)
             # O(T^2) mixing distributed around the ring; K/V shards
             # stay put, blocks rotate via ppermute.
@@ -212,9 +224,18 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
     # KV cache shards its head axis over 'tp' (parallel/mesh.py
     # cache_spec): each device scatters the K/V heads it computed.
     cache_sp = on_mesh(P(None, "tp", None, None, None))
+    def lp_spec(k):
+        spec = on_mesh(specs.get(k, repl))
+        if isinstance(layer_params[k], tuple):
+            # int8 (weight [L, in, out], scale [L, out]): the scale
+            # follows the weight's layer + output-channel axes
+            # (mirrors parallel/mesh.py shard_params).
+            return (spec, P(spec[0], spec[2]))
+        return spec
+
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=({k: on_mesh(specs.get(k, repl)) for k in layer_params},
+        in_specs=({k: lp_spec(k) for k in layer_params},
                   {k: on_mesh(specs.get(k, repl)) for k in shared},
                   cache_sp, cache_sp, P(None, "sp"), P(None, "sp"),
                   repl),

@@ -19,10 +19,11 @@ from production_stack_tpu.engine.engine import LLMEngine
 from production_stack_tpu.engine.sequence import SamplingParams
 
 
-def _engine(sp, threshold=64, family="llama", tp=1):
+def _engine(sp, threshold=64, family="llama", tp=1, quant="none"):
     from production_stack_tpu.parallel.mesh import build_mesh
 
     model = tiny_model_config(family)
+    model.quantization = quant
     config = EngineConfig(
         model=model,
         cache=CacheConfig(page_size=16, num_pages=128),
@@ -110,6 +111,32 @@ def test_sp_tp_mixed_lengths_continuous_batching():
     while eng.has_work():
         eng.step()
     assert [s.output_token_ids for s in seqs] == ref
+
+
+def test_sp_quantized_matches_single_device():
+    """int8 under sp (round-5: the sp+quant guard lifted — the 8B
+    int8 long-context config needs exactly this): the single-device
+    int8 engine and the sp=4 engine derive IDENTICAL (weight, scale)
+    pairs from the same seed, so greedy outputs must agree exactly."""
+    prompt = list(range(2, 2 + 4 * 32 + 7))
+
+    ref = _engine(1, quant="int8").generate(
+        prompt, _sampling()).output_token_ids
+    got = _engine(4, quant="int8").generate(
+        prompt, _sampling()).output_token_ids
+    assert got == ref
+
+
+def test_sp_tp_quantized_matches_single_device():
+    """sp=2 x tp=2 with int8: column weights carry 'tp'-sliced scales,
+    row weights replicated scales that commute with the psum."""
+    prompt = list(range(2, 2 + 4 * 32 + 1))
+
+    ref = _engine(1, quant="int8").generate(
+        prompt, _sampling()).output_token_ids
+    got = _engine(2, tp=2, quant="int8").generate(
+        prompt, _sampling()).output_token_ids
+    assert got == ref
 
 
 def test_sp_only_mesh_without_tp_axis():
