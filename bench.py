@@ -205,11 +205,13 @@ def run_worker(impl: str, tpu: bool) -> None:
         pass
 
     config, n_requests, prompt_len, out_len = _bench_config(tpu)
-    # "<impl>[+per_layer]": optional cache-layout variant (the round-3
-    # decode-roofline experiment, CacheConfig.cache_layout).
-    layout = "stacked"
-    if impl.endswith("+per_layer"):
-        impl, layout = impl.rsplit("+", 1)[0], "per_layer"
+    # "<impl>[+per_layer|+stacked]": optional cache-layout override.
+    # The default follows CacheConfig's 'auto' (per_layer — the
+    # measured winner, benchmarks/results/decode_probe.json
+    # 2026-07-31: 11.07 vs 5.94 req/s at this bench config).
+    layout = "auto"
+    if impl.endswith(("+per_layer", "+stacked")):
+        impl, layout = impl.rsplit("+", 1)
     config.cache.cache_layout = layout
     config.model.attention_impl = impl
     engine = LLMEngine(config)
@@ -367,7 +369,7 @@ def run_worker(impl: str, tpu: bool) -> None:
         "platform": "tpu" if tpu else "cpu",
         "attention_impl": impls[0] if impls[0] == impls[1] else
         f"decode={impls[0]},prefill={impls[1]}",
-        "cache_layout": layout,
+        "cache_layout": config.cache.cache_layout,
         "param_count": params_n,
         "decode_batch": config.scheduler.max_num_seqs,
         "decode_burst": config.scheduler.decode_steps,
@@ -440,15 +442,20 @@ def main() -> None:
         if os.environ.get("PYTHONPATH", "").find("axon") != -1:
             os.environ["PYTHONPATH"] = ""
 
-    # 'auto' = the engine's empirical dispatch (measured-winner table:
-    # pallas prefill everywhere, xla decode below the 8k-ctx
-    # crossover); plain xla is the safety net. BENCH_IMPLS overrides
-    # for experiments (e.g. "xla+per_layer,auto" — see
-    # benchmarks/chip_roundup.sh phase 4).
+    # The default attempt list is the measured winner first (xla
+    # attention + per_layer cache via CacheConfig 'auto' — 11.07
+    # req/s on-chip 2026-07-31), stacked as the fallback. The 'auto'
+    # dispatch (pallas prefill) is deliberately NOT attempted by the
+    # driver: its fresh Mosaic AOT compile is the known tunnel-wedge
+    # trigger (2026-07-31 01:27 UTC the auto worker hung 1500 s and
+    # wedged the tunnel for the phases after it — results/
+    # round5_notes.md); a wedge here would take the fallback attempts
+    # down with it. BENCH_IMPLS overrides for experiments (e.g.
+    # BENCH_IMPLS="auto,xla+stacked").
     if os.environ.get("BENCH_IMPLS"):
         attempts = os.environ["BENCH_IMPLS"].split(",")
     else:
-        attempts = ["auto", "xla"] if tpu else ["xla"]
+        attempts = ["xla", "xla+stacked"] if tpu else ["xla"]
     errors = {}
     result = None
     for impl in attempts:

@@ -23,7 +23,7 @@ timeout -k 10 120 python -c "import jax; print('sanity', jax.device_get(jax.nump
 
 phase "1: instrumented engine run (xla, stacked) — the reference point"
 PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout -k 30 1800 \
-  python bench.py --worker xla --tpu \
+  python bench.py --worker xla+stacked --tpu \
   > "${LOG}_xla.json" 2> "${LOG}_xla.err"
 echo "rc=$? headline:"; cat "${LOG}_xla.json"
 
@@ -43,7 +43,7 @@ timeout -k 30 2400 bash benchmarks/chip_validate.sh 2>&1 | tee "${LOG}_validate.
 
 phase "5: instrumented engine run (pallas, stacked — aliasing fix)"
 PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout -k 30 1800 \
-  python bench.py --worker pallas --tpu \
+  python bench.py --worker pallas+stacked --tpu \
   > "${LOG}_pallas.json" 2> "${LOG}_pallas.err"
 echo "rc=$? headline:"; cat "${LOG}_pallas.json"
 
@@ -54,6 +54,7 @@ PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" timeout -k 30 1800 \
 echo "rc=$? headline:"; cat "${LOG}_pallas_pl.json"
 
 phase "6: north-star 8B config (int8, BASELINE config 2)"
+# Bare impl = the serving default layout (auto -> per_layer).
 PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" BENCH_MODEL=8b timeout -k 30 2400 \
   python bench.py --worker xla --tpu \
   > "${LOG}_8b.json" 2> "${LOG}_8b.err"

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Second chip-window plan for round 5 (run by the tunnel watcher on
+# the first successful probe after the 01:27 UTC re-wedge). Ordered
+# by value-per-minute given what window 1 already banked
+# (results/round5_notes.md): the 8B north star has never produced a
+# number, so it goes first; then the QPS sweep, the driver-flow
+# check, and kernel parity. Every phase is a subprocess under
+# `timeout -k` (a Mosaic hang must not take the harness down).
+#
+# Usage: bash benchmarks/chip_window2.sh
+cd "$(dirname "$0")/.." || exit 1
+OUT="benchmarks/results"
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+LOG="$OUT/chip_window2_$STAMP"
+mkdir -p "$OUT"
+
+phase() { echo; echo "=== $1 ($(date -u +%H:%M:%S)) ==="; }
+
+phase "0: tunnel sanity"
+timeout -k 10 120 python -c "import jax; print('sanity', jax.device_get(jax.numpy.ones(4)+1))" || {
+  echo "NO TUNNEL — aborting"; exit 1; }
+
+phase "1: north-star 8B (int8, direct-int8 init, per_layer cache)"
+# The host-side init is ~2 min; budget generously.
+PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" BENCH_MODEL=8b \
+  BENCH_IMPLS=xla timeout -k 30 3000 \
+  python bench.py > "${LOG}_8b.json" 2> "${LOG}_8b.err"
+echo "rc=$? headline:"; cat "${LOG}_8b.json"
+
+phase "2: engine QPS sweep (xla winner config)"
+timeout -k 60 5400 bash benchmarks/chip_sweep.sh xla 2>&1 \
+  | tee "${LOG}_sweep.log" | tail -15
+
+phase "3: driver-flow bench (new defaults: xla + per_layer)"
+timeout -k 30 3600 python bench.py > "${LOG}_driver.json" \
+  2> "${LOG}_driver.err"
+echo "rc=$? headline:"; cat "${LOG}_driver.json"
+
+phase "4: kernel parity validation (fixed PYTHONPATH)"
+VALIDATE_SKIP_MICROBENCH=1 timeout -k 30 1200 \
+  bash benchmarks/chip_validate.sh 2>&1 \
+  | tee "${LOG}_validate.log" | tail -8
+
+echo
+echo "=== done; artifacts: ${LOG}_* ==="

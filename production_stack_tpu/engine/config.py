@@ -163,17 +163,20 @@ class CacheConfig:
     num_pages: int = 1024  # total pages in HBM (per shard)
     enable_prefix_caching: bool = True
     # HBM buffer layout (models/llama.py cached_attention):
+    #   auto      -> per_layer, except pipeline/context-parallel
+    #                configs (which shard or walk the stacked L axis)
+    #                resolve to stacked. Decided on-chip 2026-07-31
+    #                (benchmarks/results/decode_probe.json: per_layer
+    #                13.5 vs stacked 27.4 ms/token-step; engine bench
+    #                11.07 vs 5.94 req/s).
     #   stacked   -> one [L, kv, pages, d, page_size] array per k/v;
     #                layer writes are in-place scatters at a static
     #                layer index.
     #   per_layer -> a tuple of L [kv, pages, d, page_size] buffers;
     #                every scatter/kernel touches exactly one layer's
     #                buffer (67 MB vs 2.1 GB operands at the 1B bench
-    #                config) and donation aliases buffers 1:1. The
-    #                round-3 decode-roofline experiment
-    #                (benchmarks/results/round3_onchip_notes.md §0.6);
-    #                decide the default on measured numbers.
-    cache_layout: str = "stacked"
+    #                config) and donation aliases buffers 1:1.
+    cache_layout: str = "auto"
 
     def max_tokens(self) -> int:
         return self.page_size * self.num_pages

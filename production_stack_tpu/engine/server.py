@@ -1154,11 +1154,13 @@ def parse_args(argv=None):
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--page-size", type=int, default=16)
     parser.add_argument("--num-pages", type=int, default=512)
-    parser.add_argument("--cache-layout", default="stacked",
-                        choices=["stacked", "per_layer"],
-                        help="KV cache HBM layout: one stacked [L,...]"
-                             " array, or a tuple of per-layer buffers "
-                             "(engine/config.py CacheConfig)")
+    parser.add_argument("--cache-layout", default="auto",
+                        choices=["auto", "stacked", "per_layer"],
+                        help="KV cache HBM layout: auto (measured "
+                             "winner: per_layer unless pp/sp), one "
+                             "stacked [L,...] array, or a tuple of "
+                             "per-layer buffers (engine/config.py "
+                             "CacheConfig)")
     parser.add_argument("--max-num-seqs", type=int, default=8)
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--prefill-chunk-size", type=int, default=512)

@@ -94,6 +94,47 @@ def test_per_layer_offload_page_roundtrip():
     ks, _ = engine_s.runner.read_page(1)
     assert ks.shape == k.shape
 
+def test_auto_layout_resolves_per_layer():
+    """The 'auto' default resolves to per_layer (the on-chip measured
+    winner, benchmarks/results/decode_probe.json 2026-07-31) for
+    plain configs."""
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
+                                  prefill_chunk_size=32,
+                                  prefill_batch_size=2),
+    )
+    assert config.cache.cache_layout == "auto"
+    engine = LLMEngine(config)
+    assert engine.runner.cache_layout == "per_layer"
+    assert isinstance(engine.runner.k_cache, tuple)
+
+
+def test_auto_layout_resolves_stacked_under_pp():
+    """pp shards the stacked L axis, so 'auto' resolves to stacked
+    there (explicit per_layer+pp stays a loud error)."""
+    import jax
+
+    from production_stack_tpu.engine.config import ParallelConfig
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices for a pp mesh")
+    parallel = ParallelConfig(pipeline_parallel_size=2)
+    mesh = build_mesh(pipeline_parallel_size=2)
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
+                                  prefill_chunk_size=32,
+                                  prefill_batch_size=2),
+        parallel=parallel,
+    )
+    engine = LLMEngine(config, mesh=mesh)
+    assert engine.runner.cache_layout == "stacked"
+
+
 def test_rejects_unknown_layout():
     config = EngineConfig(
         model=tiny_model_config("llama"),

@@ -48,7 +48,7 @@ def test_dequant_matmul_close_to_dense():
     assert rel < 0.02
 
 
-def _engine(quant, mesh=None):
+def _engine(quant, mesh=None, params=None):
     model = tiny_model_config("llama")
     model.quantization = quant
     config = EngineConfig(
@@ -57,15 +57,20 @@ def _engine(quant, mesh=None):
         scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
                                   prefill_chunk_size=32),
     )
-    return LLMEngine(config, mesh=mesh)
+    return LLMEngine(config, mesh=mesh, params=params)
 
 
 def test_quantized_generation_tracks_full_precision():
+    """Quantizing a given full-precision checkpoint (the real serving
+    path — random int8 init draws its own weights by design, see
+    quantization.init_random_quantized)."""
     prompt = list(range(3, 40))
     sp = dict(max_tokens=8, temperature=0.0, ignore_eos=True)
-    full = _engine("none").generate(
+    params = llama.init_params(tiny_model_config("llama"),
+                               jax.random.PRNGKey(0))
+    full = _engine("none", params=params).generate(
         prompt, SamplingParams(**sp)).output_token_ids
-    quant = _engine("int8").generate(
+    quant = _engine("int8", params=params).generate(
         prompt, SamplingParams(**sp)).output_token_ids
     assert len(quant) == 8
     # Random tiny weights amplify quantization noise; require the
@@ -99,3 +104,46 @@ def test_quantized_params_reject_embedder():
     with pytest.raises(NotImplementedError, match="unquantized"):
         Embedder(engine.config.model, engine.runner.params,
                  max_len=128)
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt2"])
+def test_direct_int8_random_init_shapes(family):
+    """Random int8 init (quantization.init_random_quantized) produces
+    the same pytree structure as quantize(init) without ever
+    materializing the full-precision model (the 8B-on-16GB OOM fix,
+    results/round5_notes.md). gpt2 exercises the bias/norm-bias
+    leaves (semantics derived from the family init, not names)."""
+    from production_stack_tpu.engine.quantization import (
+        init_random_quantized,
+        is_quantized,
+    )
+    from production_stack_tpu.models import gpt2 as gpt2_mod
+
+    init_fns = {"llama": llama.init_params,
+                "gpt2": gpt2_mod.init_params}
+    model = tiny_model_config(family)
+    init_fn = init_fns[family]
+    ref = quantize_params(init_fn(model, jax.random.PRNGKey(0)), model)
+    direct = init_random_quantized(init_fn, model, seed=0)
+    assert set(direct) == set(ref)
+    for name, leaf in ref.items():
+        if is_quantized(leaf):
+            assert is_quantized(direct[name])
+            assert direct[name][0].shape == leaf[0].shape
+            assert direct[name][0].dtype == jnp.int8
+            assert direct[name][1].shape == leaf[1].shape
+        else:
+            assert direct[name].shape == leaf.shape
+            assert direct[name].dtype == leaf.dtype
+    # Norm gains must be ones (zeros would zero every activation);
+    # biases must be zeros — exactly as the family init defines them.
+    for name, leaf in ref.items():
+        if is_quantized(leaf):
+            continue
+        a = np.asarray(leaf, np.float32)
+        if np.all(a == 1.0):
+            np.testing.assert_array_equal(
+                np.asarray(direct[name], np.float32), 1.0)
+        elif np.all(a == 0.0):
+            np.testing.assert_array_equal(
+                np.asarray(direct[name], np.float32), 0.0)
