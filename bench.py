@@ -176,6 +176,15 @@ def _bench_config(tpu: bool):
                                 prefill_batch_size=4,
                                 decode_steps=4)
         n_requests, prompt_len, out_len = 8, 128, 16
+    # Experiment knobs (batch-scaling studies on a live chip window
+    # without code churn between runs; defaults above are the served
+    # configuration the driver measures).
+    if os.environ.get("BENCH_MAX_SEQS"):
+        sched.max_num_seqs = int(os.environ["BENCH_MAX_SEQS"])
+    if os.environ.get("BENCH_NUM_PAGES"):
+        cache.num_pages = int(os.environ["BENCH_NUM_PAGES"])
+    if os.environ.get("BENCH_N_REQUESTS"):
+        n_requests = int(os.environ["BENCH_N_REQUESTS"])
     return (EngineConfig(model=model, cache=cache, scheduler=sched),
             n_requests, prompt_len, out_len)
 
@@ -385,7 +394,7 @@ def run_worker(impl: str, tpu: bool) -> None:
     if mfu is not None:
         extra["mfu"] = round(mfu, 4)
     print(json.dumps({
-        "metric": ("multi-round-qa-style req/s, 1B-class llama, "
+        "metric": (f"multi-round-qa-style req/s, {config.model.name}, "
                    "1 TPU chip" if tpu else
                    "multi-round-qa-style req/s, tiny llama, CPU fallback"),
         "value": round(req_per_s, 3),

@@ -1,0 +1,44 @@
+#!/bin/bash
+# Decode batch-scaling study (round 5, after window 2 banked the 8B
+# north star + QPS sweep). Rationale: on-chip decode at batch 32 is
+# weights-bound-ish (13.5 ms/token-step vs a ~3-4 ms HBM roofline,
+# results/decode_probe.json) — widening the decode batch amortizes
+# the per-step weight read over more sequences, so tok/s should scale
+# well below linearly in cost. Each phase is one bench.py worker at a
+# wider max_num_seqs (fresh compile per width: decode batch is a
+# static program shape). 8B last: its compile is the expensive one.
+#
+# Usage: bash benchmarks/chip_batchscale.sh
+cd "$(dirname "$0")/.." || exit 1
+OUT="benchmarks/results"
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+LOG="$OUT/batchscale_$STAMP"
+mkdir -p "$OUT"
+
+phase() { echo; echo "=== $1 ($(date -u +%H:%M:%S)) ==="; }
+
+phase "0: tunnel sanity"
+timeout -k 10 120 python -c "import jax; print('sanity', jax.device_get(jax.numpy.ones(4)+1))" || {
+  echo "NO TUNNEL — aborting"; exit 1; }
+
+run_cell() {  # name, extra env as K=V args
+  local name="$1"; shift
+  phase "1B $name"
+  env PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" BENCH_IMPLS=xla \
+      "$@" timeout -k 30 2400 \
+      python bench.py > "${LOG}_${name}.json" 2> "${LOG}_${name}.err"
+  echo "rc=$? headline:"; cat "${LOG}_${name}.json"
+}
+
+run_cell b64  BENCH_MAX_SEQS=64  BENCH_N_REQUESTS=96
+run_cell b128 BENCH_MAX_SEQS=128 BENCH_NUM_PAGES=640 BENCH_N_REQUESTS=192
+
+phase "8B batch 32 (vs banked batch 16)"
+env PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" BENCH_MODEL=8b \
+    BENCH_IMPLS=xla BENCH_MAX_SEQS=32 BENCH_N_REQUESTS=48 \
+    timeout -k 30 3000 \
+    python bench.py > "${LOG}_8b_b32.json" 2> "${LOG}_8b_b32.err"
+echo "rc=$? headline:"; cat "${LOG}_8b_b32.json"
+
+echo
+echo "=== done; artifacts: ${LOG}_* ==="

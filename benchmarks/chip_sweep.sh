@@ -11,7 +11,27 @@ cd "$(dirname "$0")/.." || exit 1
 IMPL="${1:-auto}"
 OUT="benchmarks/results/engine_sweep"
 mkdir -p "$OUT"
-PORT=8093
+# Pick a free port: the dev tunnel's relay squats much of 8082-8117
+# (observed 2026-07-31: an 8093 collision sent the whole sweep to the
+# relay — every request 404'd). Start high and verify.
+PORT="${SWEEP_PORT:-8923}"
+for _try in $(seq 1 100); do
+  python - "$PORT" <<'EOF'
+import socket, sys
+s = socket.socket()
+try:
+    s.bind(("127.0.0.1", int(sys.argv[1])))
+except OSError:
+    sys.exit(7)   # taken
+s.close(); sys.exit(0)  # free
+EOF
+  rc=$?
+  [ "$rc" -eq 0 ] && break
+  [ "$rc" -ne 7 ] && { echo "port probe broke (rc=$rc)"; exit 1; }
+  PORT=$((PORT + 1))
+done
+[ "$rc" -eq 0 ] || { echo "no free port in 100 tries"; exit 1; }
+echo "sweep server port: $PORT"
 
 python -m production_stack_tpu.engine.server \
   --model bench-1b --random-weights --port "$PORT" \

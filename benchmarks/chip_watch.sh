@@ -1,0 +1,30 @@
+#!/bin/bash
+# Standing tunnel watcher (docs/source/dev_guide/tpu_tunnel_runbook.md):
+# probe every 4 minutes with the canonical probe; on the first success
+# run the script given as $1 (default: benchmarks/chip_window2.sh),
+# then exit. Committed (rather than living in /tmp) because session
+# restarts kill background processes — whoever resumes relaunches:
+#
+#   nohup bash benchmarks/chip_watch.sh benchmarks/chip_batchscale.sh \
+#     > /tmp/chip_watch.log 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+TARGET="${1:-benchmarks/chip_window2.sh}"
+MAX_PROBES="${MAX_PROBES:-400}"   # ~26 h at 4-min cadence
+
+for i in $(seq 1 "$MAX_PROBES"); do
+  echo "[watch] probe $i/$MAX_PROBES ($(date -u +%H:%M:%S))"
+  if timeout -k 10 120 python -c "
+import jax
+d = jax.devices(); assert d and d[0].platform == 'tpu', d
+import jax.numpy as jnp
+print(float(jax.device_get((jnp.ones((8,8))@jnp.ones((8,8))).sum())))
+" 2>/dev/null; then
+    echo "[watch] TUNNEL UP ($(date -u +%H:%M:%S)) — running $TARGET"
+    bash "$TARGET"
+    echo "[watch] target done ($(date -u +%H:%M:%S))"
+    exit 0
+  fi
+  sleep 240
+done
+echo "[watch] gave up after $MAX_PROBES probes"
+exit 1
