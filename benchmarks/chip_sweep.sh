@@ -33,10 +33,15 @@ done
 [ "$rc" -eq 0 ] || { echo "no free port in 100 tries"; exit 1; }
 echo "sweep server port: $PORT"
 
+# max-model-len 2048 (not the bench.py 1024): 5 rounds of growing
+# byte-tokenized history reach ~1.8k tokens by round 4 (the first
+# sweep attempt 400'd rounds 2-4 at 1024). 768 pages = 3 GB KV
+# alongside the ~2.4 GB bf16 model; 32 seqs x 16 pages/seq = 512
+# worst-case concurrent demand fits with headroom for prefix reuse.
 python -m production_stack_tpu.engine.server \
   --model bench-1b --random-weights --port "$PORT" \
-  --page-size 128 --num-pages 512 --max-num-seqs 32 \
-  --max-model-len 1024 --prefill-chunk-size 512 \
+  --page-size 128 --num-pages 768 --max-num-seqs 32 \
+  --max-model-len 2048 --prefill-chunk-size 512 \
   --prefill-batch-size 8 --decode-steps 32 \
   --attention-impl "$IMPL" \
   > "$OUT/server.log" 2>&1 &
@@ -53,10 +58,10 @@ curl -s --max-time 5 "http://127.0.0.1:$PORT/health" >/dev/null || {
   echo "engine server did not come up; tail of log:"
   tail -20 "$OUT/server.log"; exit 1; }
 
-# Byte tokenizer: ~5-7 tokens/word, so the reference's 500-word
-# system prompt would blow the 1024-token model len. Use a
-# byte-budget-scaled workload (same shape, prompt ~600 + history
-# growth fits the window).
+# Byte-level encoding: ~5-7 tokens/word, so the reference's 500-word
+# system prompt alone would approach the 2048-token model len. Use a
+# byte-budget-scaled workload (same shape: ~600-token system prompt,
+# history growing to ~1.8k tokens by round 4 — inside the window).
 SWEEP_SYSTEM_PROMPT=80 SWEEP_CHAT_HISTORY=30 SWEEP_ANSWER_LEN=64 \
   bash benchmarks/sweep.sh "http://127.0.0.1:$PORT" bench-1b "$OUT"
 echo "=== engine sweep done; commit $OUT and fold the table into"

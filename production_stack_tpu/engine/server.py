@@ -1052,16 +1052,23 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
     if args.model in ("tiny-llama", "tiny-opt"):
         model_config = tiny_model_config(args.model.split("-")[1])
         params = None
-        tokenizer = get_tokenizer("byte")
+        # bench (not byte) tokenizer: random-weight greedy ids land
+        # uniformly in the 512 vocab, and ByteTokenizer.decode drops
+        # ids >= 256 — streaming clients would lose those deltas.
+        tokenizer = get_tokenizer("bench")
         served_name = args.served_model_name or args.model
     elif args.model == "bench-1b":
         # The 1B-class bench geometry (shared with bench.py via
-        # config.bench_1b_model_config), random weights + byte
+        # config.bench_1b_model_config), random weights + bench
         # tokenizer: lets benchmarks/chip_sweep.sh drive the real HTTP
-        # server at bench scale without a checkpoint on disk.
+        # server at bench scale without a checkpoint on disk. The
+        # bench tokenizer (not byte): random-weight greedy tokens are
+        # almost surely >= 256, which ByteTokenizer.decode drops —
+        # streaming clients would see zero non-empty deltas (no TTFT
+        # signal, gen_tokens 0).
         model_config = bench_1b_model_config()
         params = None
-        tokenizer = get_tokenizer("byte")
+        tokenizer = get_tokenizer("bench")
         served_name = args.served_model_name or args.model
     else:
         from production_stack_tpu.engine.weights import (

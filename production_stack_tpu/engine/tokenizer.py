@@ -46,6 +46,38 @@ class ByteTokenizer(BaseTokenizer):
         return 512
 
 
+class BenchTokenizer(ByteTokenizer):
+    """ByteTokenizer whose decode covers a full random-weights vocab.
+
+    A --random-weights bench server pairs a real model vocab (e.g.
+    32,128) with the dependency-free byte tokenizer (decode range
+    0-255) — greedy tokens under random weights are almost surely
+    >= 256, which ByteTokenizer.decode silently drops, so a streaming
+    client sees only empty content deltas: no TTFT signal and
+    gen_tokens == 0 (observed in the round-5 engine QPS sweep,
+    benchmarks/results/round5_notes.md). Here every id >= 258 decodes
+    to one printable ASCII char, so each generated token yields
+    exactly one non-empty delta — what a latency benchmark needs —
+    while encode stays byte-level (realistic prompt token counts).
+    """
+
+    def decode(self, token_ids: List[int]) -> str:
+        out: List[str] = []
+        run: List[int] = []  # contiguous byte-range ids
+        for t in token_ids:
+            if 0 <= t < 256:
+                run.append(t)
+                continue
+            if run:
+                out.append(bytes(run).decode("utf-8", errors="replace"))
+                run = []
+            if t >= 258:  # 256/257 are bos/eos (specials: skipped)
+                out.append(chr(33 + (t - 258) % 94))
+        if run:
+            out.append(bytes(run).decode("utf-8", errors="replace"))
+        return "".join(out)
+
+
 class HFTokenizer(BaseTokenizer):
     def __init__(self, path: str):
         from transformers import AutoTokenizer
@@ -77,9 +109,13 @@ class HFTokenizer(BaseTokenizer):
 
 
 def get_tokenizer(spec: Optional[str]) -> BaseTokenizer:
-    """spec: None/'byte' -> ByteTokenizer; otherwise a local HF path."""
+    """spec: None/'byte' -> ByteTokenizer; 'bench' -> BenchTokenizer
+    (full-vocab decode for random-weights servers); otherwise a local
+    HF path."""
     if spec in (None, "byte"):
         return ByteTokenizer()
+    if spec == "bench":
+        return BenchTokenizer()
     return HFTokenizer(spec)
 
 

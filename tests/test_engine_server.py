@@ -230,6 +230,30 @@ def test_chat_template_override():
     assert bad == default and tok.decode(bad) != ""
 
 
+def test_bench_tokenizer_full_vocab_decode():
+    """BenchTokenizer: every id >= 258 decodes to one printable char —
+    a random-weights bench server must stream a non-empty delta per
+    generated token (the ByteTokenizer dropped ids >= 256, so the
+    round-5 QPS sweep saw zero TTFT signal and gen_tokens == 0)."""
+    from production_stack_tpu.engine.tokenizer import (
+        BenchTokenizer,
+        get_tokenizer,
+    )
+    tok = get_tokenizer("bench")
+    assert isinstance(tok, BenchTokenizer)
+    # Byte-range behavior identical to ByteTokenizer.
+    assert tok.encode("hi") == [tok.BOS, 104, 105]
+    assert tok.decode([104, 105]) == "hi"
+    # Specials stay invisible; everything else is one printable char.
+    assert tok.decode([tok.BOS, tok.EOS]) == ""
+    for tid in (258, 1000, 32127):
+        s = tok.decode([tid])
+        assert len(s) == 1 and s.isprintable(), (tid, s)
+    # Mixed byte-range + high ids interleave in order.
+    assert tok.decode([104, 5000, 105]) == (
+        "h" + chr(33 + (5000 - 258) % 94) + "i")
+
+
 def test_n_choices_non_streaming():
     """n > 1 returns n independent choices with summed usage."""
     async def run(client):
