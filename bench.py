@@ -183,6 +183,8 @@ def _bench_config(tpu: bool):
         sched.max_num_seqs = int(os.environ["BENCH_MAX_SEQS"])
     if os.environ.get("BENCH_NUM_PAGES"):
         cache.num_pages = int(os.environ["BENCH_NUM_PAGES"])
+    if os.environ.get("BENCH_PAGE_SIZE"):
+        cache.page_size = int(os.environ["BENCH_PAGE_SIZE"])
     if os.environ.get("BENCH_N_REQUESTS"):
         n_requests = int(os.environ["BENCH_N_REQUESTS"])
     return (EngineConfig(model=model, cache=cache, scheduler=sched),

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Page-size sweep (round 5, after the decode ablation attributed the
+# step to paged-KV gather ~5.9 ms + scatter ~5.1 ms at page_size 128
+# — indexing overhead, not bandwidth: the written bytes are ~1 MB and
+# the gather's theoretical cost ~1.3 ms). Bigger pages mean fewer,
+# larger contiguous slices per row: at 1024 (= max_model_len) the
+# page table is one entry wide and the gather is a single 1 MB slice
+# per row per layer. Trade-off: prefix-cache sharing granularity
+# coarsens (the bench's 128-token shared prefix stops hitting above
+# ps=128) — measured here, decided on numbers.
+#
+# KV capacity held at 64k tokens per cell: pages = 65536 / page_size.
+#
+# Usage: bash benchmarks/chip_pagesize.sh
+cd "$(dirname "$0")/.." || exit 1
+OUT="benchmarks/results"
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+LOG="$OUT/pagesize_$STAMP"
+mkdir -p "$OUT"
+
+phase() { echo; echo "=== $1 ($(date -u +%H:%M:%S)) ==="; }
+
+phase "0: tunnel sanity"
+timeout -k 10 120 python -c "import jax; print('sanity', jax.device_get(jax.numpy.ones(4)+1))" || {
+  echo "NO TUNNEL — aborting"; exit 1; }
+
+for ps in 256 512 1024; do
+  phase "1B page_size=$ps"
+  env PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" BENCH_IMPLS=xla \
+      BENCH_PAGE_SIZE="$ps" BENCH_NUM_PAGES="$((65536 / ps))" \
+      timeout -k 30 2400 \
+      python bench.py > "${LOG}_ps${ps}.json" 2> "${LOG}_ps${ps}.err"
+  echo "rc=$? headline:"; cat "${LOG}_ps${ps}.json"
+done
+
+echo
+echo "=== done; artifacts: ${LOG}_* ==="

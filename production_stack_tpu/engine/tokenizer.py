@@ -61,6 +61,17 @@ class BenchTokenizer(ByteTokenizer):
     while encode stays byte-level (realistic prompt token counts).
     """
 
+    def __init__(self, vocab_size: int = 32128):
+        # The paired model's vocab (bench-1b default) — ByteTokenizer's
+        # inherited 512 would make any vocab-sized consumer (logit-bias
+        # masks, prompt validation) treat most servable ids as OOV.
+        super().__init__()
+        self._vocab_size = vocab_size
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab_size
+
     def decode(self, token_ids: List[int]) -> str:
         out: List[str] = []
         run: List[int] = []  # contiguous byte-range ids
