@@ -146,10 +146,13 @@ def _bench_config(tpu: bool):
         # KV per page: 2*32L*8kv*128d*128ps*2B = 16 MB -> 192 pages
         # ~= 3 GB cache alongside ~8 GB weights.
         cache = CacheConfig(page_size=128, num_pages=192)
+        # deferred_kv_writes: round-5 on-chip +8% at this config
+        # (3.30 vs 3.05 req/s — results/round5_notes.md).
         sched = SchedulerConfig(max_num_seqs=16, max_model_len=1024,
                                 prefill_chunk_size=512,
                                 prefill_batch_size=4,
-                                decode_steps=32)
+                                decode_steps=32,
+                                deferred_kv_writes=True)
         n_requests, prompt_len, out_len = 24, 512, 64
     elif tpu:
         from production_stack_tpu.engine.config import (
@@ -162,10 +165,13 @@ def _bench_config(tpu: bool):
         # Fat device programs, few host syncs: 32-wide decode with
         # 32-step on-device bursts (per-row budgets/stops evaluated in
         # the compiled program), 8-prompt batched prefill chunks.
+        # deferred_kv_writes: round-5 on-chip +15% at this config
+        # (12.76 vs 11.07 req/s — results/round5_notes.md).
         sched = SchedulerConfig(max_num_seqs=32, max_model_len=1024,
                                 prefill_chunk_size=512,
                                 prefill_batch_size=8,
-                                decode_steps=32)
+                                decode_steps=32,
+                                deferred_kv_writes=True)
         n_requests, prompt_len, out_len = 48, 512, 64
     else:  # CPU fallback: tiny model, same code path
         from production_stack_tpu.engine.config import tiny_model_config
@@ -187,6 +193,8 @@ def _bench_config(tpu: bool):
         cache.page_size = int(os.environ["BENCH_PAGE_SIZE"])
     if os.environ.get("BENCH_N_REQUESTS"):
         n_requests = int(os.environ["BENCH_N_REQUESTS"])
+    if os.environ.get("BENCH_DEFERRED"):
+        sched.deferred_kv_writes = bool(int(os.environ["BENCH_DEFERRED"]))
     return (EngineConfig(model=model, cache=cache, scheduler=sched),
             n_requests, prompt_len, out_len)
 
@@ -225,6 +233,12 @@ def run_worker(impl: str, tpu: bool) -> None:
         impl, layout = impl.rsplit("+", 1)
     config.cache.cache_layout = layout
     config.model.attention_impl = impl
+    if impl not in ("xla", "auto"):
+        # Mirror the server's 'auto' eligibility: the deferred burst
+        # uses the XLA paged+tail attention path, and the runner
+        # rejects other impls loudly — a BENCH_IMPLS=pallas attempt
+        # must still measure, not fail at construction.
+        config.scheduler.deferred_kv_writes = False
     engine = LLMEngine(config)
     # The engine's per-kernel probe may itself have degraded a path.
     impls = (config.model.attention_impl_decode
@@ -384,6 +398,8 @@ def run_worker(impl: str, tpu: bool) -> None:
         "param_count": params_n,
         "decode_batch": config.scheduler.max_num_seqs,
         "decode_burst": config.scheduler.decode_steps,
+        "deferred_kv_writes": config.scheduler.deferred_kv_writes,
+        "page_size": config.cache.page_size,
         # Open-loop phase: user arrivals derated so the offered
         # REQUEST load sits at ~70% of closed-loop capacity.
         "arrivals_users_per_s": round(user_rate, 2),

@@ -195,6 +195,14 @@ class SchedulerConfig:
     # Decode iterations fused into one compiled program (tokens feed
     # back on device; 1 host round-trip per K tokens). 1 = off.
     decode_steps: int = 1
+    # Deferred KV writes inside a decode burst: append each step's K/V
+    # to a dense [B, S, kv, d] tail (one-hot select, no scatter) and
+    # flush the tail to the pages ONCE per burst per layer. Motivated
+    # by the round-5 on-chip ablation (results/round5_notes.md): the
+    # per-step paged scatters cost ~5.1 of 11.1 ms for ~1 MB written.
+    # Llama-family single-runner path only (guarded in model_runner);
+    # requires decode_steps > 1.
+    deferred_kv_writes: bool = False
     max_queue_len: int = 1024
 
     def max_pages_per_seq(self, page_size: int) -> int:

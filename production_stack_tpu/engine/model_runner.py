@@ -22,6 +22,7 @@ from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.scheduler import DecodePlan, PrefillPlan
 from production_stack_tpu.engine.sequence import Sequence, decode_budget
 from production_stack_tpu.models.registry import get_model
+from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.sampling import (
     apply_penalties,
     sample_tokens,
@@ -211,6 +212,35 @@ class ModelRunner:
                 raise NotImplementedError(
                     "LoRA with context parallelism")
 
+        self._deferred = config.scheduler.deferred_kv_writes
+        if self._deferred:
+            # Deferred per-burst KV writes (ops/attention.write_to_tail
+            # + the kv_tail path in models/llama.forward): motivated by
+            # the round-5 ablation — per-step paged scatters cost ~5.1
+            # of 11.1 ms/step for ~1 MB written. Llama-family
+            # single-runner decode only; reject loudly otherwise.
+            if config.scheduler.decode_steps <= 1:
+                raise ValueError(
+                    "deferred_kv_writes needs decode_steps > 1 (the "
+                    "tail flushes once per multi-step burst)")
+            if (config.parallel.pipeline_parallel_size > 1
+                    or self._sp_size > 1):
+                raise NotImplementedError(
+                    "deferred_kv_writes with pipeline/context "
+                    "parallelism (the pp/sp runners use their own "
+                    "burst bodies)")
+            if model_config.architecture not in ("llama", "mistral",
+                                                 "qwen2"):
+                raise NotImplementedError(
+                    "deferred_kv_writes serves the llama family (got "
+                    f"{model_config.architecture!r})")
+            decode_impl = (model_config.attention_impl_decode
+                           or model_config.attention_impl)
+            if decode_impl not in ("xla", "auto"):
+                raise NotImplementedError(
+                    "deferred_kv_writes uses the XLA paged+tail "
+                    f"attention path (decode impl {decode_impl!r})")
+
         if params is None and model_config.quantization == "int8":
             # Direct int8 init: full-precision init + quantize peaks
             # at 3x the serving footprint on device and OOMs the 8B
@@ -322,7 +352,8 @@ class ModelRunner:
         # tokens; on a tunneled TPU (60 ms+ RTT per sync) this is the
         # difference between host-bound and device-bound serving.
         self._decode_burst_jit = jax.jit(
-            self._decode_burst_impl,
+            (self._decode_burst_deferred_impl if self._deferred
+             else self._decode_burst_impl),
             static_argnames=("num_steps", "want_logprobs"),
             donate_argnums=(1, 2),  # k_cache, v_cache
         )
@@ -554,6 +585,10 @@ class ModelRunner:
             # Zero-size placeholder keeps the carry structure uniform.
             counts0 = jnp.zeros((b, 0), jnp.int32)
 
+        sample_step = self._burst_sample_step(
+            b, penalties, seeding, temperature, top_p, top_k,
+            stop_tokens, budgets, want_logprobs)
+
         def body(carry, step_rng):
             tok, pos, kv, act, emitted, counts, kc, vc = carry
             logits, kc, vc = self._forward(
@@ -561,6 +596,31 @@ class ModelRunner:
                 kv, act[:, None], kc, vc, lora=lora,
                 lora_ids=lora_ids,
             )
+            out, sampled, emitted, counts, act_next = sample_step(
+                logits, step_rng, act, emitted, counts)
+            step = act_next.astype(pos.dtype)
+            return ((jnp.where(act, sampled, tok[:, 0])[:, None],
+                     pos + step[:, None], kv + step, act_next,
+                     emitted, counts, kc, vc), out)
+
+        rngs = jax.random.split(rng, num_steps)
+        emitted0 = jnp.zeros(active.shape, jnp.int32)
+        carry = (tokens, positions, kv_lens, active, emitted0,
+                 counts0, k_cache, v_cache)
+        (_, _, _, _, _, _, k_cache, v_cache), out = jax.lax.scan(
+            body, carry, rngs
+        )
+        return out, k_cache, v_cache
+
+    def _burst_sample_step(self, b, penalties, seeding, temperature,
+                           top_p, top_k, stop_tokens, budgets,
+                           want_logprobs):
+        """The burst bodies' shared logits -> (out, lifecycle) step:
+        penalties, (seeded) sampling, logprobs, occurrence counts,
+        stop/budget freeze. One definition so the eager and deferred
+        KV-write bursts cannot drift apart in sampling semantics."""
+
+        def sample_step(logits, step_rng, act, emitted, counts):
             row_logits = logits[:, 0, :]
             raw_logits = row_logits
             if penalties is not None:
@@ -595,18 +655,96 @@ class ModelRunner:
                 sampled[:, None] == stop_tokens, axis=-1
             )
             act_next = act & ~hit_stop & (emitted < budgets)
+            return out, sampled, emitted, counts, act_next
+
+        return sample_step
+
+    def _decode_burst_deferred_impl(self, params, k_cache, v_cache,
+                                    tokens, positions, page_table,
+                                    kv_lens, active, budgets,
+                                    stop_tokens, temperature, top_p,
+                                    top_k, rng, lora, lora_ids,
+                                    penalties, seeding, num_steps: int,
+                                    want_logprobs: bool = False):
+        """_decode_burst_impl with per-burst (not per-step) KV writes.
+
+        Same contract and carry discipline, except: each step's K/V
+        goes into dense per-layer tail buffers ([B, S, kv, d] one-hot
+        selects — ops/attention.write_to_tail) and attention covers
+        pages + tail positionally (paged_attention k_tail/v_tail);
+        the paged caches stay READ-ONLY through the scan (loop
+        invariants, not carry) and the tails flush to the pages with
+        one write_to_pages per layer at burst end. The round-5
+        on-chip ablation measured the per-step scatters at ~5.1 of
+        11.1 ms for ~1 MB of writes (results/round5_notes.md).
+
+        The pages hold exactly the pre-burst tokens throughout, so
+        the frozen cached-token count is positions[:, 0] (the first
+        burst token's absolute position) and tail slot s sits at
+        absolute position kv_lens0 + s.
+        """
+        b = active.shape[0]
+        m = self.config.model
+        if penalties is not None:
+            counts0, penalties = penalties[0], penalties[1:]
+        else:
+            counts0 = jnp.zeros((b, 0), jnp.int32)
+
+        kv_lens0 = positions[:, 0]  # pages hold this many tokens
+        tail_shape = (b, num_steps, m.num_key_value_heads, m.head_dim)
+        dtype = m.jax_dtype
+        k_tails0 = tuple(jnp.zeros(tail_shape, dtype)
+                         for _ in range(m.num_hidden_layers))
+        v_tails0 = tuple(jnp.zeros(tail_shape, dtype)
+                         for _ in range(m.num_hidden_layers))
+
+        sample_step = self._burst_sample_step(
+            b, penalties, seeding, temperature, top_p, top_k,
+            stop_tokens, budgets, want_logprobs)
+
+        def body(carry, step_rng):
+            tok, pos, act, emitted, counts, kt, vt = carry
+            logits, kt, vt = self._forward(
+                params, m, tok, pos, page_table, kv_lens0,
+                act[:, None], k_cache, v_cache, lora=lora,
+                lora_ids=lora_ids, kv_tail=(kt, vt),
+            )
+            out, sampled, emitted, counts, act_next = sample_step(
+                logits, step_rng, act, emitted, counts)
             step = act_next.astype(pos.dtype)
             return ((jnp.where(act, sampled, tok[:, 0])[:, None],
-                     pos + step[:, None], kv + step, act_next,
-                     emitted, counts, kc, vc), out)
+                     pos + step[:, None], act_next, emitted, counts,
+                     kt, vt), out)
 
         rngs = jax.random.split(rng, num_steps)
         emitted0 = jnp.zeros(active.shape, jnp.int32)
-        carry = (tokens, positions, kv_lens, active, emitted0,
-                 counts0, k_cache, v_cache)
-        (_, _, _, _, _, _, k_cache, v_cache), out = jax.lax.scan(
+        carry = (tokens, positions, active, emitted0, counts0,
+                 k_tails0, v_tails0)
+        (_, _, _, emitted, _, k_tails, v_tails), out = jax.lax.scan(
             body, carry, rngs
         )
+
+        # Flush: one batched scatter per layer for the whole burst.
+        tail_pos = kv_lens0[:, None] + jnp.arange(num_steps)[None, :]
+        tail_valid = (jnp.arange(num_steps)[None, :]
+                      < emitted[:, None])
+        if isinstance(k_cache, tuple):
+            k_cache = tuple(
+                write_to_pages(c, k_tails[l], page_table, tail_pos,
+                               tail_valid)
+                for l, c in enumerate(k_cache))
+            v_cache = tuple(
+                write_to_pages(c, v_tails[l], page_table, tail_pos,
+                               tail_valid)
+                for l, c in enumerate(v_cache))
+        else:
+            for l in range(m.num_hidden_layers):
+                k_cache = write_to_pages(k_cache, k_tails[l],
+                                         page_table, tail_pos,
+                                         tail_valid, layer=l)
+                v_cache = write_to_pages(v_cache, v_tails[l],
+                                         page_table, tail_pos,
+                                         tail_valid, layer=l)
         return out, k_cache, v_cache
 
     def _next_rng(self) -> jax.Array:

@@ -22,6 +22,7 @@ from production_stack_tpu.engine.config import ModelConfig
 from production_stack_tpu.ops.attention import (
     paged_attention,
     write_to_pages,
+    write_to_tail,
 )
 from production_stack_tpu.ops.rope import apply_rope
 
@@ -205,7 +206,7 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, page_table: jnp.ndarray,
             kv_lens: jnp.ndarray, valid: jnp.ndarray,
             k_cache: jnp.ndarray, v_cache: jnp.ndarray,
-            lora=None, lora_ids=None,
+            lora=None, lora_ids=None, kv_tail=None,
             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One model invocation over a (possibly padded) token block.
 
@@ -214,12 +215,24 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
       positions:  [B, T] absolute positions (0 for padded slots)
       page_table: [B, max_pages] physical page ids (page 0 = trash)
       kv_lens:    [B] valid cached tokens AFTER this block is written
+                  (deferred mode: the FROZEN pre-burst count — tail
+                  slots sit above it)
       valid:      [B, T] mask of real (non-padding) tokens
       k_cache/v_cache: [L, kv_heads, num_pages, head_dim, page_size]
       lora:       optional adapter stacks (engine/lora.py), layer-leading
       lora_ids:   [B] adapter slot per batch row (0 = base model)
+      kv_tail:    optional deferred-write burst tails
+                  ((k_tails, v_tails): L-tuples of [B, S, kv, d]).
+                  When given (decode bursts, T == 1), this step's K/V
+                  are appended to the tails instead of scattered into
+                  the pages (ops/attention.write_to_tail) and
+                  attention covers pages + tail; the caches return
+                  UNCHANGED and the updated tails are returned in the
+                  cache slots of the result tuple. The model runner
+                  flushes tails to pages once per burst.
 
-    Returns (logits [B, T, vocab], new_k_cache, new_v_cache).
+    Returns (logits [B, T, vocab], new_k_cache, new_v_cache) — or
+    (logits, new_k_tails, new_v_tails) in deferred mode.
     """
     from production_stack_tpu.engine.lora import lora_matmul
 
@@ -257,10 +270,30 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
         v = v.reshape(b, t, nkv, d)
         q = apply_rope(q, positions, config.rope_theta)
         k = apply_rope(k, positions, config.rope_theta)
-        attn, k_cache, v_cache = cached_attention(
-            config, q, k, v, k_cache, v_cache, page_table, positions,
-            kv_lens, valid, layer,
-        )
+        if kv_tail is not None:
+            k_tails, v_tails = kv_tail
+            slot = positions[:, 0] - kv_lens
+            act = valid[:, 0]
+            kt = write_to_tail(k_tails[layer], k, slot, act)
+            vt = write_to_tail(v_tails[layer], v, slot, act)
+            kc, vc = ((k_cache[layer], v_cache[layer])
+                      if isinstance(k_cache, (list, tuple))
+                      else (k_cache, v_cache))
+            attn = paged_attention(
+                q, kc, vc, page_table, positions, kv_lens,
+                layer=None if isinstance(k_cache, (list, tuple))
+                else layer,
+                k_tail=kt, v_tail=vt)
+            k_tails = (tuple(k_tails[:layer]) + (kt,)
+                       + tuple(k_tails[layer + 1:]))
+            v_tails = (tuple(v_tails[:layer]) + (vt,)
+                       + tuple(v_tails[layer + 1:]))
+            kv_tail = (k_tails, v_tails)
+        else:
+            attn, k_cache, v_cache = cached_attention(
+                config, q, k, v, k_cache, v_cache, page_table,
+                positions, kv_lens, valid, layer,
+            )
         x = x + lora_matmul(attn.reshape(b, t, nh * d), lp["wo"], ll,
                             "wo", lora_ids, lora_scale)
         # MLP block (SwiGLU)
@@ -271,7 +304,10 @@ def forward(params: Params, config: ModelConfig, tokens: jnp.ndarray,
                          lora_scale)
         x = x + lora_matmul(gate * up, lp["w_down"], ll, "w_down",
                             lora_ids, lora_scale)
-    new_k, new_v = k_cache, v_cache
+    if kv_tail is not None:
+        new_k, new_v = kv_tail
+    else:
+        new_k, new_v = k_cache, v_cache
 
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     head = params.get("lm_head")

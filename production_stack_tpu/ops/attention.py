@@ -89,11 +89,37 @@ def write_to_pages(cache: jnp.ndarray, new_kv: jnp.ndarray,
     return cache.at[layer, :, flat_pages, :, flat_offsets].set(flat_kv)
 
 
+def write_to_tail(tail: jnp.ndarray, new_kv: jnp.ndarray,
+                  slot: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
+    """One decode token into its burst-tail slot (deferred KV write).
+
+    The round-5 decode ablation (benchmarks/results/round5_notes.md)
+    measured the per-step paged scatters at ~5.1 of 11.1 ms — for
+    ~1 MB of writes. Deferred mode appends each step's K/V to a small
+    dense [B, S, kv, d] tail instead (a one-hot select over S<=32
+    slots — no scatter), and flushes the whole tail to the pages with
+    ONE write_to_pages call per layer at burst end.
+
+    Args:
+      tail:   [B, S, kv_heads, head_dim]
+      new_kv: [B, 1, kv_heads, head_dim] — this step's K or V
+      slot:   [B] int32 — tail slot per row (q_pos - frozen kv_len)
+      active: [B] bool — rows decoding this step (frozen rows rewrite
+              their last slot with identical values; harmless, keeps
+              the select mask trivial)
+    """
+    s = tail.shape[1]
+    hit = (jnp.arange(s)[None, :] == slot[:, None]) & active[:, None]
+    return jnp.where(hit[..., None, None], new_kv, tail)
+
+
 def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
                     v_cache_layer: jnp.ndarray, page_table: jnp.ndarray,
                     q_positions: jnp.ndarray,
                     kv_lens: jnp.ndarray,
-                    layer: "int | None" = None) -> jnp.ndarray:
+                    layer: "int | None" = None,
+                    k_tail: "jnp.ndarray | None" = None,
+                    v_tail: "jnp.ndarray | None" = None) -> jnp.ndarray:
     """Causal attention of q against a sequence's cached pages.
 
     Args:
@@ -105,6 +131,12 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
       page_table:  [B, max_pages]
       q_positions: [B, T] absolute positions of the queries
       kv_lens:     [B] number of valid cached tokens (>= max position + 1)
+      k_tail/v_tail: optional [B, S, kv_heads, head_dim] deferred-write
+                   burst tails holding tokens NOT yet flushed to the
+                   pages: tail slot s is absolute position
+                   ``kv_lens + s`` (kv_lens frozen for the burst), and
+                   masking is purely positional — unwritten slots sit
+                   at positions > every query and never attend.
 
     Returns [B, T, num_q_heads, head_dim].
     """
@@ -150,11 +182,37 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     mask = causal & in_len[:, None]  # [B, T, P, page]
     scores = jnp.where(mask[:, None, None], scores, NEG_INF)
 
-    # Softmax over the joint (P, page) token axis.
     shape = scores.shape
-    probs = jax.nn.softmax(
-        scores.reshape(*shape[:-2], p_cnt * page), axis=-1
-    ).reshape(shape)  # f32
+    flat = scores.reshape(*shape[:-2], p_cnt * page)
+
+    if k_tail is not None:
+        # Burst tail: S un-flushed tokens at positions kv_lens + s.
+        s_len = k_tail.shape[1]
+        t_scores = jnp.einsum(
+            "btkgd,bskd->bkgts", qg, k_tail,
+            preferred_element_type=jnp.float32,
+        ) * scale  # [B, kv, group, T, S]
+        tail_pos = (kv_lens[:, None]
+                    + jnp.arange(s_len)[None, :])  # [B, S]
+        t_mask = (tail_pos[:, None, :]
+                  <= q_positions[:, :, None])  # [B, T, S]
+        t_scores = jnp.where(t_mask[:, None, None], t_scores, NEG_INF)
+        # One softmax over the joint pages+tail token axis.
+        joint = jnp.concatenate([flat, t_scores], axis=-1)
+        probs = jax.nn.softmax(joint, axis=-1)
+        p_pages = probs[..., :p_cnt * page].reshape(shape)
+        p_tail = probs[..., p_cnt * page:]
+        out = jnp.einsum(
+            "bkgtpc,kbpdc->btkgd", p_pages.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        ) + jnp.einsum(
+            "bkgts,bskd->btkgd", p_tail.astype(v_tail.dtype), v_tail,
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(b, t, num_q_heads, head_dim).astype(q.dtype)
+
+    # Softmax over the joint (P, page) token axis.
+    probs = jax.nn.softmax(flat, axis=-1).reshape(shape)  # f32
     out = jnp.einsum(
         "bkgtpc,kbpdc->btkgd", probs.astype(v.dtype), v,
         preferred_element_type=jnp.float32,

@@ -1,0 +1,127 @@
+"""Deferred per-burst KV writes (SchedulerConfig.deferred_kv_writes):
+the tail-buffer burst must generate exactly what the per-step-write
+burst and single-step decoding generate.
+
+Motivation (benchmarks/results/round5_notes.md, round-5 on-chip
+ablation): per-step paged scatters cost ~5.1 of 11.1 ms/token-step
+for ~1 MB of writes; deferring them to one batched write per layer
+per burst removes that cost. Correctness risks covered here: tail
+attention masking (positional), mid-burst row freeze (stop/budget),
+page-boundary crossings inside a burst, flush-then-continue across
+bursts, seeded sampling, and the capability guards.
+"""
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+
+
+def _engine(decode_steps, deferred=False, max_num_seqs=4, arch="llama",
+            quantization=None, cache_layout="auto"):
+    model = tiny_model_config(arch)
+    if quantization:
+        model.quantization = quantization
+    config = EngineConfig(
+        model=model,
+        cache=CacheConfig(page_size=16, num_pages=128,
+                          cache_layout=cache_layout),
+        scheduler=SchedulerConfig(max_num_seqs=max_num_seqs,
+                                  max_model_len=256,
+                                  prefill_chunk_size=32,
+                                  decode_steps=decode_steps,
+                                  deferred_kv_writes=deferred),
+    )
+    return LLMEngine(config)
+
+
+def _gen(engine, prompts, **kw):
+    sampling = dict(max_tokens=12, temperature=0.0, ignore_eos=True)
+    sampling.update(kw)
+    seqs = []
+    for p in prompts:
+        sid = engine.add_request(p, SamplingParams(**sampling))
+        seqs.append(engine.sequences[sid])
+    while engine.has_work():
+        engine.step()
+    return [s.output_token_ids for s in seqs]
+
+
+def _prompts(sizes=(7, 20, 41), hi=500, seed=1):
+    rs = np.random.RandomState(seed)
+    return [[int(x) for x in rs.randint(1, hi, size=n)] for n in sizes]
+
+
+def test_deferred_matches_single_step_greedy():
+    prompts = _prompts()
+    expected = _gen(_engine(decode_steps=1), prompts)
+    got = _gen(_engine(decode_steps=4, deferred=True), prompts)
+    assert got == expected
+    assert all(len(t) == 12 for t in got)
+
+
+def test_deferred_matches_eager_burst_multi_burst():
+    """20 tokens at K=4 = 5 flush/continue cycles; page_size 16 puts
+    page-boundary crossings inside bursts for every row."""
+    prompts = _prompts(sizes=(15, 31, 16, 47))
+    eager = _gen(_engine(decode_steps=4), prompts, max_tokens=20)
+    deferred = _gen(_engine(decode_steps=4, deferred=True), prompts,
+                    max_tokens=20)
+    assert deferred == eager
+
+
+def test_deferred_stop_token_mid_burst():
+    """A row hitting its stop set mid-burst freezes; its tail slots
+    must not pollute the flush (valid = emitted count)."""
+    prompts = _prompts(sizes=(9, 12))
+    ref = _gen(_engine(decode_steps=1), prompts, max_tokens=16,
+               ignore_eos=False)
+    # Use each row's 3rd greedy token as its stop token so the stop
+    # fires mid-burst deterministically.
+    stops = [r[2] for r in ref]
+    eager, deferred = (
+        [_gen(_engine(decode_steps=8, deferred=d), [p],
+              max_tokens=16, stop_token_ids=[s], ignore_eos=False)[0]
+         for p, s in zip(prompts, stops)]
+        for d in (False, True))
+    assert deferred == eager
+    # The stop fired mid-burst: output ends at the stop token, short
+    # of the 16-token budget.
+    for t, s in zip(deferred, stops):
+        assert t[-1] == s and len(t) < 16
+
+
+def test_deferred_seeded_sampling_parity():
+    """Seeded stochastic sampling depends only on (seed, emitted
+    index), so deferred and eager bursts must sample identically."""
+    prompts = _prompts(sizes=(11, 23))
+    kw = dict(temperature=0.9, seed=1234, max_tokens=10)
+    eager = _gen(_engine(decode_steps=4), prompts, **kw)
+    deferred = _gen(_engine(decode_steps=4, deferred=True), prompts,
+                    **kw)
+    assert deferred == eager
+
+
+def test_deferred_int8_and_stacked_layout():
+    prompts = _prompts(sizes=(10, 33))
+    for layout in ("per_layer", "stacked"):
+        eager = _gen(_engine(decode_steps=4, cache_layout=layout,
+                             quantization="int8"), prompts)
+        deferred = _gen(_engine(decode_steps=4, deferred=True,
+                                cache_layout=layout,
+                                quantization="int8"), prompts)
+        assert deferred == eager, layout
+
+
+def test_deferred_guards():
+    with pytest.raises(ValueError, match="decode_steps"):
+        _engine(decode_steps=1, deferred=True)
+    with pytest.raises(NotImplementedError, match="llama family"):
+        _engine(decode_steps=4, deferred=True, arch="gpt2")
