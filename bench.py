@@ -233,12 +233,16 @@ def run_worker(impl: str, tpu: bool) -> None:
         impl, layout = impl.rsplit("+", 1)
     config.cache.cache_layout = layout
     config.model.attention_impl = impl
-    if impl not in ("xla", "auto"):
-        # Mirror the server's 'auto' eligibility: the deferred burst
-        # uses the XLA paged+tail attention path, and the runner
-        # rejects other impls loudly — a BENCH_IMPLS=pallas attempt
-        # must still measure, not fail at construction.
-        config.scheduler.deferred_kv_writes = False
+    if config.scheduler.deferred_kv_writes:
+        # The shared eligibility predicate (same one the server's
+        # 'auto' uses): a BENCH_IMPLS=pallas attempt must still
+        # measure, not fail at the runner's capability guard.
+        from production_stack_tpu.engine.model_runner import (
+            deferred_kv_eligible,
+        )
+        config.scheduler.deferred_kv_writes = deferred_kv_eligible(
+            config.model.architecture, config.scheduler.decode_steps,
+            impl)
     engine = LLMEngine(config)
     # The engine's per-kernel probe may itself have degraded a path.
     impls = (config.model.attention_impl_decode

@@ -13,14 +13,18 @@ code changes):
   no_kv_write   write_to_pages -> identity (skip the per-layer scatters)
   matmul_floor  both knocked out: weights/norms/rope/lm_head/sampling
   no_sample     full forward, sampling replaced by constant feedback
+  deferred      the kv_tail burst body (read-only caches in the scan,
+                one batched flush per layer at the end) — the served
+                deferred_kv_writes path
 
-All variants run b=32 rows x K=32 chained steps in ONE compiled
-program (lax.scan, caches donated) and sync once via device_get,
-subtracting a min-probed RTT — the honest tunnel timing protocol
-(docs/source/dev_guide/tpu_tunnel_runbook.md). Deltas vs `full` give
-the attribution; `matmul_floor` is the measured weights floor to
-compare against the analytic ~3-4 ms (853M bf16 params / 819 GB/s +
-lm_head).
+All variants run b=32 rows x K chained steps in ONE compiled program
+(lax.scan, caches donated) and are timed by PAIRED-LENGTH
+DIFFERENCING: wall(K=160) - wall(K=32) over 128 steps, which cancels
+the constant per-dispatch cost (tunnel RTT ~65 ms, host sync, scan
+setup) exactly (docs/source/dev_guide/tpu_tunnel_runbook.md). Deltas
+vs `full` give the attribution; `matmul_floor` is the measured
+weights floor to compare against the analytic ~3-4 ms (853M bf16
+params / 819 GB/s + lm_head).
 
 Run on a live chip:  python benchmarks/decode_ablation.py
 Artifact: benchmarks/results/decode_ablation.json + markdown stdout.
@@ -46,31 +50,6 @@ PROMPT = 512
 PAGE_SIZE = 128
 NUM_PAGES = 512
 TINY = False
-
-
-def _measure(fn, out_probe, repeats=3):
-    """min wall time of fn() + one sync, minus min-probed RTT."""
-    import jax
-
-    out = fn()
-    jax.device_get(out_probe(out))  # compile + warm
-    rtt = float("inf")
-    probe = out_probe(out)
-    for _ in range(3):
-        t0 = time.perf_counter()
-        jax.device_get(probe)
-        rtt = min(rtt, time.perf_counter() - t0)
-    samples = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = fn()
-        jax.device_get(out_probe(out))
-        total = time.perf_counter() - t0
-        if total > rtt:
-            samples.append(total - rtt)
-    # None (not 0.0): every sample under the RTT floor means
-    # "unmeasurable at this RTT", not "free".
-    return (min(samples) if samples else None), rtt
 
 
 def build_state():
@@ -126,23 +105,25 @@ def make_burst(m, variant: str, page_table, active):
     from production_stack_tpu.models import llama
     from production_stack_tpu.ops.sampling import sample_tokens
 
+    def sample(variant_tok, logits, step_rng):
+        if variant == "no_sample":
+            return variant_tok[:, 0]
+        return sample_tokens(
+            logits[:, 0, :], jnp.zeros((BATCH,)),
+            jnp.ones((BATCH,)),
+            jnp.zeros((BATCH,), jnp.int32), step_rng)
+
     def body(params, carry, step_rng):
         tok, pos, kvl, kc, vc = carry
         logits, kc, vc = llama.forward(
             params, m, tok, pos, page_table, kvl,
             active[:, None], kc, vc)
-        if variant == "no_sample":
-            sampled = tok[:, 0]
-        else:
-            sampled = sample_tokens(
-                logits[:, 0, :], jnp.zeros((BATCH,)),
-                jnp.ones((BATCH,)),
-                jnp.zeros((BATCH,), jnp.int32), step_rng)
+        sampled = sample(tok, logits, step_rng)
         return (sampled[:, None], pos + 1, kvl + 1, kc, vc), sampled
 
     def burst(params, tokens, positions, kv_lens, k_cache, v_cache,
-              rng):
-        rngs = jax.random.split(rng, BURST)
+              rng, num_steps):
+        rngs = jax.random.split(rng, num_steps)
         carry = (tokens, positions, kv_lens, k_cache, v_cache)
 
         def scan_body(c, r):
@@ -151,7 +132,54 @@ def make_burst(m, variant: str, page_table, active):
         (_, _, _, kc, vc), out = jax.lax.scan(scan_body, carry, rngs)
         return out, kc, vc
 
-    return jax.jit(burst, donate_argnums=(4, 5))
+    def burst_deferred(params, tokens, positions, kv_lens, k_cache,
+                       v_cache, rng, num_steps):
+        """The served deferred path, at the SERVED tail width: chains
+        of num_steps run as num_steps/BURST sequential BURST-wide
+        bursts with a flush between each — tail width must NOT scale
+        with the chain length or the paired-length differencing
+        overstates tail-attention work that serving never does
+        (mirrors model_runner._decode_burst_deferred_impl per burst).
+        """
+        from production_stack_tpu.ops.attention import write_to_pages
+
+        assert num_steps % BURST == 0
+        outs = []
+        for chunk in range(num_steps // BURST):
+            kv0 = positions[:, 0]
+            tails = tuple(
+                jnp.zeros((BATCH, BURST, m.num_key_value_heads,
+                           m.head_dim), m.jax_dtype)
+                for _ in range(m.num_hidden_layers))
+
+            def dbody(carry, step_rng, kv0=kv0):
+                tok, pos, kt, vt = carry
+                logits, kt, vt = llama.forward(
+                    params, m, tok, pos, page_table, kv0,
+                    active[:, None], k_cache, v_cache,
+                    kv_tail=(kt, vt))
+                sampled = sample(tok, logits, step_rng)
+                return (sampled[:, None], pos + 1, kt, vt), sampled
+
+            rng, sub = jax.random.split(rng)
+            rngs = jax.random.split(sub, BURST)
+            (tokens, positions, kt, vt), out = jax.lax.scan(
+                dbody, (tokens, positions, tails, tails), rngs)
+            outs.append(out)
+            tail_pos = kv0[:, None] + jnp.arange(BURST)[None, :]
+            tail_valid = jnp.ones((BATCH, BURST), bool)
+            k_cache = tuple(
+                write_to_pages(c, kt[i], page_table, tail_pos,
+                               tail_valid)
+                for i, c in enumerate(k_cache))
+            v_cache = tuple(
+                write_to_pages(c, vt[i], page_table, tail_pos,
+                               tail_valid)
+                for i, c in enumerate(v_cache))
+        return jnp.concatenate(outs, axis=0), k_cache, v_cache
+
+    fn = burst_deferred if variant == "deferred" else burst
+    return jax.jit(fn, donate_argnums=(4, 5), static_argnums=(7,))
 
 
 def run_variant(variant: str):
@@ -173,28 +201,41 @@ def run_variant(variant: str):
 
         import jax
 
+        # Paired-length differencing: (T_hi - T_lo) / (hi - lo) steps
+        # cancels the constant per-dispatch cost exactly (tunnel RTT
+        # ~65 ms — at burst 32 that masquerades as ~2 ms/step; the
+        # first version of this probe under-measured its RTT by
+        # re-fetching an already-fetched buffer).
+        n_lo, n_hi = BURST, BURST * 5
+        walls = {}
         burst = make_burst(m, variant, pt, active)
+        # Donated caches thread through both chain lengths (contents
+        # don't affect timing; re-donating avoids 2 GB copies/call).
+        state = {"kc": k_cache, "vc": v_cache}
+        for tag, n in (("lo", n_lo), ("hi", n_hi)):
 
-        def fn():
-            # Caches are donated: re-donate each call's returned
-            # buffers (rebuilding from host per call would dominate).
-            out, kc2, vc2 = burst(params, tokens, positions, kv_lens,
-                                  fn.kc, fn.vc, jax.random.PRNGKey(1))
-            fn.kc, fn.vc = kc2, vc2
-            return out
+            def fn():
+                out, kc2, vc2 = burst(
+                    params, tokens, positions, kv_lens,
+                    state["kc"], state["vc"], jax.random.PRNGKey(1),
+                    n)
+                state["kc"], state["vc"] = kc2, vc2
+                return out
 
-        fn.kc, fn.vc = k_cache, v_cache
-
-        wall, rtt = _measure(fn, lambda o: o[-1])
-        if wall is None:
-            return {"case": variant, "batch": BATCH, "burst": BURST,
-                    "below_rtt_floor": True,
-                    "rtt_ms": round(rtt * 1e3, 1)}
+            jax.device_get(fn()[-1])  # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.device_get(fn()[-1])
+                best = min(best, time.perf_counter() - t0)
+            walls[tag] = best
+        per = (walls["hi"] - walls["lo"]) / (n_hi - n_lo)
         return {
-            "case": variant, "batch": BATCH, "burst": BURST,
-            "wall_s_per_burst": round(wall, 4),
-            "ms_per_token_step": round(wall / BURST * 1e3, 2),
-            "rtt_ms": round(rtt * 1e3, 1),
+            "case": variant, "batch": BATCH,
+            "burst_lo": n_lo, "burst_hi": n_hi,
+            "ms_per_token_step": round(per * 1e3, 2),
+            "wall_lo_ms": round(walls["lo"] * 1e3, 1),
+            "wall_hi_ms": round(walls["hi"] * 1e3, 1),
         }
     finally:
         llama.paged_attention = orig_attn

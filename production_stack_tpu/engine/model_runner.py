@@ -64,6 +64,27 @@ PALLAS_DECODE_IN_AUTO = False
 # rejects top_logprobs > 20 with a 400).
 TOP_LOGPROBS_WIDTH = 20
 
+# Model families served by the deferred-KV-write burst (the kv_tail
+# path exists in models/llama.py, which also serves mistral/qwen2).
+DEFERRED_KV_FAMILIES = ("llama", "mistral", "qwen2")
+
+
+def deferred_kv_eligible(architecture: str, decode_steps: int,
+                         attention_impl: str, pipeline_parallel: int = 1,
+                         context_parallel: int = 1) -> bool:
+    """The ONE eligibility predicate for deferred KV writes.
+
+    Used by the runner's capability guard (which raises on explicit
+    ineligible 'on'), the server's '--deferred-kv-writes auto'
+    resolution, and bench.py's impl gating — one definition so the
+    three call sites cannot drift (e.g. re-enabling Pallas decode in
+    'auto' or adding an exclusion must flow to all of them)."""
+    return (decode_steps > 1
+            and architecture in DEFERRED_KV_FAMILIES
+            and attention_impl in ("xla", "auto")
+            and pipeline_parallel == 1
+            and context_parallel == 1)
+
 # PSTPU_TIMING=1: log every dispatch's wall time (dispatch ->
 # device_get of the sampled tokens, i.e. including device execution)
 # to stderr as "timing <kind> t=<window|bucket> <seconds>". The only
@@ -216,9 +237,12 @@ class ModelRunner:
         if self._deferred:
             # Deferred per-burst KV writes (ops/attention.write_to_tail
             # + the kv_tail path in models/llama.forward): motivated by
-            # the round-5 ablation — per-step paged scatters cost ~5.1
-            # of 11.1 ms/step for ~1 MB written. Llama-family
-            # single-runner decode only; reject loudly otherwise.
+            # the round-5 ablation — the per-step scatter + same-buffer
+            # gather interaction costs ~4.4 of 8.3 ms/step (XLA
+            # copy-insertion). Llama-family single-runner decode only;
+            # reject loudly otherwise. The SAME predicate drives the
+            # server's and bench's 'auto' resolution
+            # (deferred_kv_eligible) — keep them in lockstep.
             if config.scheduler.decode_steps <= 1:
                 raise ValueError(
                     "deferred_kv_writes needs decode_steps > 1 (the "
@@ -229,8 +253,7 @@ class ModelRunner:
                     "deferred_kv_writes with pipeline/context "
                     "parallelism (the pp/sp runners use their own "
                     "burst bodies)")
-            if model_config.architecture not in ("llama", "mistral",
-                                                 "qwen2"):
+            if model_config.architecture not in DEFERRED_KV_FAMILIES:
                 raise NotImplementedError(
                     "deferred_kv_writes serves the llama family (got "
                     f"{model_config.architecture!r})")

@@ -1059,13 +1059,13 @@ def _resolve_deferred_kv(args, model_config) -> bool:
         return True
     if args.deferred_kv_writes == "off":
         return False
-    decode_impl = args.attention_impl in ("auto", "xla")
-    return (args.decode_steps > 1
-            and model_config.architecture in ("llama", "mistral",
-                                              "qwen2")
-            and decode_impl
-            and args.pipeline_parallel_size == 1
-            and args.context_parallel_size == 1)
+    from production_stack_tpu.engine.model_runner import (
+        deferred_kv_eligible,
+    )
+    return deferred_kv_eligible(
+        model_config.architecture, args.decode_steps,
+        args.attention_impl, args.pipeline_parallel_size,
+        args.context_parallel_size)
 
 
 def build_engine_from_args(args) -> tuple[LLMEngine, str]:

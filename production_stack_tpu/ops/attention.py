@@ -104,9 +104,10 @@ def write_to_tail(tail: jnp.ndarray, new_kv: jnp.ndarray,
       tail:   [B, S, kv_heads, head_dim]
       new_kv: [B, 1, kv_heads, head_dim] — this step's K or V
       slot:   [B] int32 — tail slot per row (q_pos - frozen kv_len)
-      active: [B] bool — rows decoding this step (frozen rows rewrite
-              their last slot with identical values; harmless, keeps
-              the select mask trivial)
+      active: [B] bool — rows decoding this step; a frozen row's hit
+              mask is all-False, so its tail is untouched (its stale
+              slots stay masked out of attention positionally and out
+              of the flush by the emitted count)
     """
     s = tail.shape[1]
     hit = (jnp.arange(s)[None, :] == slot[:, None]) & active[:, None]
