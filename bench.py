@@ -195,6 +195,8 @@ def _bench_config(tpu: bool):
         n_requests = int(os.environ["BENCH_N_REQUESTS"])
     if os.environ.get("BENCH_DEFERRED"):
         sched.deferred_kv_writes = bool(int(os.environ["BENCH_DEFERRED"]))
+    if os.environ.get("BENCH_QUANT"):
+        model.quantization = os.environ["BENCH_QUANT"]
     return (EngineConfig(model=model, cache=cache, scheduler=sched),
             n_requests, prompt_len, out_len)
 
@@ -404,6 +406,7 @@ def run_worker(impl: str, tpu: bool) -> None:
         "decode_burst": config.scheduler.decode_steps,
         "deferred_kv_writes": config.scheduler.deferred_kv_writes,
         "page_size": config.cache.page_size,
+        "quantization": config.model.quantization,
         # Open-loop phase: user arrivals derated so the offered
         # REQUEST load sits at ~70% of closed-loop capacity.
         "arrivals_users_per_s": round(user_rate, 2),

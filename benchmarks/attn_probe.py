@@ -67,17 +67,36 @@ def build():
             kv_lens)
 
 
-def chain(step, carry0, xs_n=STEPS):
-    """Run ``step`` STEPS times in one jitted program; the q input is
-    perturbed per iteration so XLA cannot CSE the chain away."""
+def chain(step, xs_n=STEPS):
+    """Run ``step`` STEPS times in one jitted program with the OUTPUT
+    fed back into the next step's query.
+
+    Two liveness guarantees, both load-bearing (the first version of
+    this probe lacked them and produced a physically impossible
+    negative ms/step on one leg — the scan body's work was sliced
+    down to the single emitted element):
+      - the full output contributes to the carried q, so no part of
+        the per-step computation is dead;
+      - each step's inputs depend on the previous step's output, so
+        nothing loop-invariant about the attention math can be
+        hoisted out of the scan (the page table is additionally
+        rotated by i inside each case).
+    """
     import jax
+    import jax.numpy as jnp
 
-    def body(carry, i):
-        out = step(carry, i)
-        return carry, out[0, 0, 0]
+    def body(q, i):
+        out = step(q, i)  # [B,1,NH,D] (attend) or [B] (gather)
+        if out.ndim == 1:
+            contrib = out[:, None, None, None]
+        else:
+            contrib = out
+        q_next = (q + contrib.astype(jnp.float32) * 1e-6).astype(
+            q.dtype)
+        return q_next, out.reshape(-1)[0]
 
-    def prog(carry):
-        _, outs = jax.lax.scan(body, carry, jax.numpy.arange(xs_n))
+    def prog(q):
+        _, outs = jax.lax.scan(body, q, jax.numpy.arange(xs_n))
         return outs
 
     return jax.jit(prog)
@@ -103,27 +122,29 @@ def main(argv=None):
     ctx = PAGES_PER_SEQ * PS
     rows = []
 
-    def bump(qq, i):
-        return (qq + i.astype(qq.dtype) * 1e-3).astype(qq.dtype)
+    # Every case takes (q, i): q is the chain-carried query (output
+    # feedback — see chain()); the page table is rotated by i so the
+    # gather itself is loop-variant and cannot be hoisted. At i=0 the
+    # rotation is identity, so the parity checks compare like-for-like.
+    def pt_i(i):
+        return (pt + i) % NUM_PAGES
 
-    # 1. gather only (one layer's K pages), reduced to keep it
-    # honest. The table is rotated by i so the gather cannot be
-    # hoisted out of the chained loop (same cost, different pages).
-    def gather_dps(carry, i):
-        k = k_dps[:, (pt + i) % NUM_PAGES]  # [kv, B, P, d, ps]
-        return k.sum(axis=(0, 2, 3, 4))[:, None, None]
+    # 1. gather only (one layer's K pages), reduced (the sum keeps
+    # every gathered element live).
+    def gather_dps(qq, i):
+        k = k_dps[:, pt_i(i)]  # [kv, B, P, d, ps]
+        return k.sum(axis=(0, 2, 3, 4))
 
     # 2. the served path.
-    def attend_dps(carry, i):
-        return paged_attention(bump(q, i), k_dps, v_dps, pt, q_pos,
+    def attend_dps(qq, i):
+        return paged_attention(qq, k_dps, v_dps, pt_i(i), q_pos,
                                kv_lens)
 
     # 3. token-major layout, same math in its native order.
-    def attend_tm(carry, i):
-        qq = bump(q, i)
+    def attend_tm(qq, i):
         qg = qq.reshape(B, 1, KV, NH // KV, D)
-        k = k_tm[:, pt]  # [kv, B, P, ps, d]
-        v = v_tm[:, pt]
+        k = k_tm[:, pt_i(i)]  # [kv, B, P, ps, d]
+        v = v_tm[:, pt_i(i)]
         scores = jnp.einsum(
             "btkgd,kbpcd->bkgtpc", qg, k,
             preferred_element_type=jnp.float32) * scale
@@ -141,8 +162,7 @@ def main(argv=None):
         return out.reshape(B, 1, NH, D).astype(qq.dtype)
 
     # 4. dense per-row K/V: the no-gather bound.
-    def attend_dense(carry, i):
-        qq = bump(q, i)
+    def attend_dense(qq, i):
         qg = qq.reshape(B, 1, KV, NH // KV, D)
         scores = jnp.einsum(
             "btkgd,bckd->bkgtc", qg, k_dense,
@@ -158,12 +178,11 @@ def main(argv=None):
         return out.reshape(B, 1, NH, D).astype(qq.dtype)
 
     # 5. gather, flatten to dense shape, then dense math.
-    def attend_flat(carry, i):
-        qq = bump(q, i)
+    def attend_flat(qq, i):
         qg = qq.reshape(B, 1, KV, NH // KV, D)
-        k = jnp.transpose(k_dps[:, pt], (1, 2, 4, 0, 3)).reshape(
+        k = jnp.transpose(k_dps[:, pt_i(i)], (1, 2, 4, 0, 3)).reshape(
             B, ctx, KV, D)
-        v = jnp.transpose(v_dps[:, pt], (1, 2, 4, 0, 3)).reshape(
+        v = jnp.transpose(v_dps[:, pt_i(i)], (1, 2, 4, 0, 3)).reshape(
             B, ctx, KV, D)
         scores = jnp.einsum(
             "btkgd,bckd->bkgtc", qg, k,
@@ -182,10 +201,11 @@ def main(argv=None):
              ("attend_tm", attend_tm), ("attend_dense", attend_dense),
              ("attend_flat", attend_flat)]
 
-    # Numerical parity across implementations first (same inputs).
-    ref = np.asarray(attend_dps(None, jnp.int32(0)), np.float32)
+    # Numerical parity across implementations first (same inputs;
+    # i=0 makes the table rotation the identity).
+    ref = np.asarray(attend_dps(q, jnp.int32(0)), np.float32)
     for name, fn in cases[2:]:
-        got = np.asarray(fn(None, jnp.int32(0)), np.float32)
+        got = np.asarray(fn(q, jnp.int32(0)), np.float32)
         err = float(np.max(np.abs(got - ref)))
         print(f"# parity {name}: max|diff| = {err:.5f}")
         assert err < 0.1, (name, err)
@@ -198,14 +218,14 @@ def main(argv=None):
     # of inflation and all five implementations read ~2.1 ms/step.
     n_lo, n_hi = STEPS, STEPS * 5
     for name, fn in cases:
-        p_lo, p_hi = chain(fn, None, n_lo), chain(fn, None, n_hi)
+        p_lo, p_hi = chain(fn, n_lo), chain(fn, n_hi)
         walls = {}
         for tag, prog in (("lo", p_lo), ("hi", p_hi)):
-            jax.device_get(prog(None)[-1])  # compile + warm
+            jax.device_get(prog(q)[-1])  # compile + warm
             best = float("inf")
             for _ in range(3):
                 t0 = time.perf_counter()
-                jax.device_get(prog(None)[-1])
+                jax.device_get(prog(q)[-1])
                 best = min(best, time.perf_counter() - t0)
             walls[tag] = best
         per = (walls["hi"] - walls["lo"]) / (n_hi - n_lo)

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Post-recovery re-warm (run by chip_watch.sh when the tunnel comes
+# back): one driver-flow bench.py run with the served defaults. Two
+# purposes: (1) confirms the recovered tunnel serves the full engine
+# path end-to-end; (2) re-populates the XLA compile cache so the
+# driver's end-of-round bench compiles warm (a fresh heavy compile is
+# the observed wedge trigger — round5_notes.md). Nothing else: after
+# a wedge the tunnel is left ALONE for the driver.
+cd "$(dirname "$0")/.." || exit 1
+OUT="benchmarks/results"
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+env PSTPU_TIMING=1 BENCH_DEVICE_KIND="TPU v5 lite" \
+  timeout -k 30 3600 python bench.py \
+  > "$OUT/rewarm_${STAMP}.json" 2> "$OUT/rewarm_${STAMP}.err"
+echo "rc=$?"; cat "$OUT/rewarm_${STAMP}.json"

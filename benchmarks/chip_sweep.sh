@@ -9,7 +9,9 @@
 set -uo pipefail
 cd "$(dirname "$0")/.." || exit 1
 IMPL="${1:-auto}"
-OUT="benchmarks/results/engine_sweep"
+# SWEEP_OUT: land a variant run elsewhere (e.g. engine_sweep_deferred)
+# without clobbering the committed default curve.
+OUT="${SWEEP_OUT:-benchmarks/results/engine_sweep}"
 mkdir -p "$OUT"
 # Pick a free port: the dev tunnel's relay squats much of 8082-8117
 # (observed 2026-07-31: an 8093 collision sent the whole sweep to the
