@@ -78,6 +78,49 @@ def target_shapes(config: ModelConfig) -> Dict[str, Tuple[int, int]]:
     }
 
 
+# Row-parallel projections (input dim sharded over 'tp', closed by a
+# psum) across every supported family; everything else LoRA targets is
+# column-parallel (output dim sharded).
+ROW_PARALLEL_TARGETS = ("wo", "w_down", "fc2")
+
+
+def lora_stack_specs(lora_ab, leading_axis, on_mesh):
+    """PartitionSpecs for the adapter stacks inside a tp shard_map.
+
+    The ONE definition of how LoRA shards under tensor parallelism,
+    shared by the pp and sp serving bodies (parallel/
+    {pipeline,context}_serving.py): each target shards like its base
+    projection —
+      column-parallel: x replicated -> A replicated, B column-sharded
+        [L, S, r, out/tp] to match the projection's local out;
+      row-parallel:    x arrives with a LOCAL input shard -> A
+        row-sharded [L, S, in/tp, r] so x@A is a partial [.., r], B
+        replicated; the caller's psum sums base + delta partials.
+
+    Args:
+      lora_ab:      {"a": {target: ...}, "b": {target: ...}} stacks
+      leading_axis: mesh axis name sharding the stacks' L axis
+                    ("pp"), or None (sp: layers replicated)
+      on_mesh:      callable dropping axis names the mesh lacks
+                    (parallel/mesh.py _on_mesh partial) — degrades
+                    every spec to the leading axis alone on tp-less
+                    meshes
+    """
+    from jax.sharding import PartitionSpec as P
+
+    lead = leading_axis
+    return {
+        "a": {tgt: on_mesh(P(lead, None, "tp", None)
+                           if tgt in ROW_PARALLEL_TARGETS
+                           else P(lead))
+              for tgt in lora_ab["a"]},
+        "b": {tgt: on_mesh(P(lead, None, None, "tp")
+                           if tgt not in ROW_PARALLEL_TARGETS
+                           else P(lead))
+              for tgt in lora_ab["b"]},
+    }
+
+
 @dataclasses.dataclass
 class LoRAAdapter:
     """One loaded adapter: per-target (A [L, in, r], B [L, r, out])."""

@@ -179,16 +179,6 @@ class ModelRunner:
                 raise ValueError(
                     f"layers {model_config.num_hidden_layers} must "
                     f"divide by pipeline_parallel_size {pp}")
-            if (config.lora.enable
-                    and config.parallel.tensor_parallel_size > 1):
-                # pp-only LoRA is served (adapter stacks shard their L
-                # axis over pp like every layer param); composing with
-                # tp additionally needs the adapter B matrices
-                # column-sharded to match the projections — not yet
-                # validated, so reject loudly rather than miscompute.
-                raise NotImplementedError(
-                    "LoRA with pipeline x tensor parallelism (pp-only "
-                    "LoRA is supported)")
             tp = config.parallel.tensor_parallel_size
             if tp > 1 and (model_config.num_key_value_heads % tp
                            or model_config.num_attention_heads % tp):
@@ -229,9 +219,6 @@ class ModelRunner:
                 raise ValueError(
                     "sp x tp needs attention/kv heads divisible by "
                     f"tensor_parallel_size {sp_tp}")
-            if config.lora.enable:
-                raise NotImplementedError(
-                    "LoRA with context parallelism")
 
         self._deferred = config.scheduler.deferred_kv_writes
         if self._deferred:
@@ -387,11 +374,12 @@ class ModelRunner:
 
             def _sp_step(params, k_cache, v_cache, tokens, page_table,
                          valid, last_index, temperature, top_p, top_k,
-                         rng, penalties, seeding,
+                         rng, lora, lora_ids, penalties, seeding,
                          want_logprobs=False):
                 row_logits, k_cache, v_cache = sp_prefill_forward(
                     params, self.config.model, tokens, page_table,
                     valid, last_index, k_cache, v_cache,
+                    lora=lora, lora_ids=lora_ids,
                     mesh=self.mesh)
                 raw_logits = row_logits
                 if penalties is not None:
@@ -964,6 +952,9 @@ class ModelRunner:
         opt.update(self._seed_payload([seq], 1))
         penalties, seeding = self._optional_device_inputs(opt)
         want_lp = sp_params.logprobs
+        lora_ids = (None if self.lora_registry is None
+                    else jnp.asarray(
+                        np.asarray([seq.lora_id], np.int32)))
         sampled, self.k_cache, self.v_cache = self._sp_prefill_jit(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(tokens),
@@ -974,7 +965,8 @@ class ModelRunner:
                                    np.float32)),
             jnp.asarray(np.asarray([sp_params.top_p], np.float32)),
             jnp.asarray(np.asarray([sp_params.top_k], np.int32)),
-            self._next_rng(), penalties, seeding,
+            self._next_rng(), self._lora_stack, lora_ids,
+            penalties, seeding,
             want_logprobs=want_lp,
         )
         host = jax.device_get(sampled)

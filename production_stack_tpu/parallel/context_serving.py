@@ -58,7 +58,10 @@ from production_stack_tpu.models.gpt2 import (
 from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.ring_attention import ring_attention
 from production_stack_tpu.ops.rope import apply_rope
-from production_stack_tpu.parallel.pipeline_serving import _stage_layer
+from production_stack_tpu.parallel.pipeline_serving import (
+    _lora_mm,
+    _stage_layer,
+)
 
 Params = Dict[str, jnp.ndarray]
 
@@ -73,6 +76,7 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
                        tokens: jnp.ndarray, page_table: jnp.ndarray,
                        valid: jnp.ndarray, last_index: jnp.ndarray,
                        k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                       lora=None, lora_ids=None,
                        *, mesh: Mesh,
                        ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Whole-prompt prefill with the sequence sharded over ``sp``.
@@ -83,6 +87,14 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
       valid:      [B, T] mask of real tokens (False = padding)
       last_index: [B] index of each prompt's final token
       k/v_cache:  [L, kv, pages, d, page_size], replicated over sp
+      lora:       optional adapter stacks (engine/lora.py) — the LoRA
+                  delta is a per-row map over tokens, so sequence
+                  sharding passes through it untouched; under tp each
+                  target shards like its base projection (row-parallel
+                  targets shard A's input axis so x@A stays a local
+                  partial the existing psum closes; column-parallel
+                  targets shard B's output axis). Round-5 widening.
+      lora_ids:   [B] adapter slot per batch row (0 = base model)
 
     Returns (row_logits [B, vocab] at last_index, new_k, new_v).
     """
@@ -116,23 +128,12 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
     def psum_tp(x):
         return jax.lax.psum(x, "tp") if has_tp else x
 
-    def mm(x, w):
-        # int8 (weight, scale) pairs dequantize in the dot's epilogue
-        # (engine/quantization.py); per-output-channel scales commute
-        # with the row-parallel psum above.
-        if isinstance(w, tuple):
-            from production_stack_tpu.engine.quantization import (
-                dequant_matmul,
-            )
-            return dequant_matmul(x, w)
-        return x @ w
-
-    def llama_layer(x, lp_i, positions_l):
+    def llama_layer(x, lp_i, ll, ids, sc, positions_l):
         bl, tl = positions_l.shape
         a_in = rms_norm(x, lp_i["attn_norm"], config.rms_norm_eps)
-        q = mm(a_in, lp_i["wq"])
-        k = mm(a_in, lp_i["wk"])
-        v = mm(a_in, lp_i["wv"])
+        q = _lora_mm(a_in, lp_i["wq"], ll, "wq", ids, sc)
+        k = _lora_mm(a_in, lp_i["wk"], ll, "wk", ids, sc)
+        v = _lora_mm(a_in, lp_i["wv"], ll, "wv", ids, sc)
         if config.attention_bias:
             q, k, v = (q + lp_i["bq"], k + lp_i["bk"],
                        v + lp_i["bv"])
@@ -143,42 +144,58 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
         v = v.reshape(bl, tl, nkv, d)
         return x, q, k, v
 
-    def llama_post(x, attn, lp_i):
+    def llama_post(x, attn, lp_i, ll, ids, sc):
         bl, tl = attn.shape[:2]
         # wo / w_down are row-parallel ('tp' slices of the input dim):
         # each device holds a partial sum until the psum.
         x = x + psum_tp(
-            mm(attn.reshape(bl, tl, nh * d), lp_i["wo"]))
+            _lora_mm(attn.reshape(bl, tl, nh * d), lp_i["wo"], ll,
+                     "wo", ids, sc))
         m_in = rms_norm(x, lp_i["mlp_norm"], config.rms_norm_eps)
         return x + psum_tp(
-            mm(jax.nn.silu(mm(m_in, lp_i["w_gate"]))
-               * mm(m_in, lp_i["w_up"]), lp_i["w_down"]))
+            _lora_mm(
+                jax.nn.silu(_lora_mm(m_in, lp_i["w_gate"], ll,
+                                     "w_gate", ids, sc))
+                * _lora_mm(m_in, lp_i["w_up"], ll, "w_up", ids, sc),
+                lp_i["w_down"], ll, "w_down", ids, sc))
 
-    def gpt2_layer(x, lp_i, positions_l):
+    def gpt2_layer(x, lp_i, ll, ids, sc, positions_l):
         bl, tl = positions_l.shape
         a_in = layer_norm(x, lp_i["attn_norm_w"], lp_i["attn_norm_b"])
-        q = (mm(a_in, lp_i["wq"]) + lp_i["bq"]).reshape(bl, tl, nh, d)
-        k = (mm(a_in, lp_i["wk"]) + lp_i["bk"]).reshape(bl, tl, nkv, d)
-        v = (mm(a_in, lp_i["wv"]) + lp_i["bv"]).reshape(bl, tl, nkv, d)
+        q = (_lora_mm(a_in, lp_i["wq"], ll, "wq", ids, sc)
+             + lp_i["bq"]).reshape(bl, tl, nh, d)
+        k = (_lora_mm(a_in, lp_i["wk"], ll, "wk", ids, sc)
+             + lp_i["bk"]).reshape(bl, tl, nkv, d)
+        v = (_lora_mm(a_in, lp_i["wv"], ll, "wv", ids, sc)
+             + lp_i["bv"]).reshape(bl, tl, nkv, d)
         return x, q, k, v
 
-    def gpt2_post(x, attn, lp_i):
+    def gpt2_post(x, attn, lp_i, ll, ids, sc):
         bl, tl = attn.shape[:2]
         # Row-parallel wo/fc2 close with a psum; their biases are
         # replicated and must be added exactly once (after the psum).
         x = x + (psum_tp(
-            mm(attn.reshape(bl, tl, nh * d), lp_i["wo"]))
+            _lora_mm(attn.reshape(bl, tl, nh * d), lp_i["wo"], ll,
+                     "wo", ids, sc))
             + lp_i["bo"])
         m_in = layer_norm(x, lp_i["mlp_norm_w"], lp_i["mlp_norm_b"])
-        hidden = jax.nn.gelu(mm(m_in, lp_i["fc1"]) + lp_i["fc1_b"],
-                             approximate=True)
-        return x + (psum_tp(mm(hidden, lp_i["fc2"]))
+        hidden = jax.nn.gelu(
+            _lora_mm(m_in, lp_i["fc1"], ll, "fc1", ids, sc)
+            + lp_i["fc1_b"], approximate=True)
+        return x + (psum_tp(_lora_mm(hidden, lp_i["fc2"], ll, "fc2",
+                                     ids, sc))
                     + lp_i["fc2_b"])
 
     qkv_fn, post_fn = ((gpt2_layer, gpt2_post) if gpt2
                        else (llama_layer, llama_post))
 
-    def body(lp, shared_p, kc, vc, tokens_l, valid_l, page_table):
+    lora_ab = (None if lora is None
+               else {"a": lora["a"], "b": lora["b"]})
+    lora_scale = (None if lora is None
+                  else lora["scaling"][lora_ids])
+
+    def body(lp, shared_p, kc, vc, tokens_l, valid_l, page_table,
+             lora_ab, lora_ids, lora_scale):
         idx = jax.lax.axis_index("sp")
         bl, tl = tokens_l.shape
         positions_l = idx * tl + jnp.broadcast_to(
@@ -199,7 +216,10 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
         # static index (see models.llama.forward).
         for layer in range(config.num_hidden_layers):
             lp_i = _stage_layer(lp, layer)
-            x, q, k, v = qkv_fn(x, lp_i, positions_l)
+            ll = (None if lora_ab is None
+                  else jax.tree.map(lambda s: s[layer], lora_ab))
+            x, q, k, v = qkv_fn(x, lp_i, ll, lora_ids, lora_scale,
+                                positions_l)
             # O(T^2) mixing distributed around the ring; K/V shards
             # stay put, blocks rotate via ppermute.
             attn = ring_attention(q, k, v, "sp")
@@ -213,7 +233,7 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
             vc = write_to_pages(vc, v_full, page_table,
                                 positions_full, valid_full,
                                 layer=layer)
-            x = post_fn(x, attn, lp_i)
+            x = post_fn(x, attn, lp_i, ll, lora_ids, lora_scale)
         if gpt2:
             return (layer_norm(x, shared_p["final_norm_w"],
                                shared_p["final_norm_b"]), kc, vc)
@@ -233,17 +253,27 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
             return (spec, P(spec[0], spec[2]))
         return spec
 
+    # Adapter stacks replicate over sp (layers local everywhere);
+    # under tp each target shards like its base projection — the ONE
+    # sharding rule shared with pp x tp (engine/lora.py
+    # lora_stack_specs).
+    if lora_ab is None:
+        lora_ab_spec = repl
+    else:
+        from production_stack_tpu.engine.lora import lora_stack_specs
+        lora_ab_spec = lora_stack_specs(lora_ab, None, on_mesh)
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=({k: lp_spec(k) for k in layer_params},
                   {k: on_mesh(specs.get(k, repl)) for k in shared},
                   cache_sp, cache_sp, P(None, "sp"), P(None, "sp"),
-                  repl),
+                  repl, lora_ab_spec, repl, repl),
         out_specs=(P(None, "sp", None), cache_sp, cache_sp),
         check_vma=False,
     )
     hidden, new_k, new_v = fn(layer_params, shared, k_cache, v_cache,
-                              tokens, valid, page_table)
+                              tokens, valid, page_table,
+                              lora_ab, lora_ids, lora_scale)
     # LM head on the last-token rows only (B x H @ H x V).
     last_h = hidden[jnp.arange(b), last_index]
     head = shared.get("lm_head")

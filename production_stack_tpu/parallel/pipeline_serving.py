@@ -74,12 +74,15 @@ def _psum_tp(x, tp: int):
 
 
 def _lora_mm(x, w, ll, target, lora_ids, lora_scale):
-    """Projection with optional LoRA delta (pp-only meshes: adapters
-    ride replicated except their L axis, so the full-width delta adds
-    to a full-width base output — tp>1 is rejected at engine build).
-    ``w`` may be an int8 (weight, scale) pair: lora_matmul owns the
-    dense/dequant dispatch and returns the plain base matmul when
-    ``ll`` is None."""
+    """Projection with optional LoRA delta, shared by the pp and sp
+    shard_map bodies. Under tp the adapter stacks arrive sharded like
+    their base projections (engine/lora.py lora_stack_specs):
+    column-parallel targets add a local out/tp-wide delta to the local
+    base; row-parallel targets contract a LOCAL input shard against
+    the A shard, so base and delta are both partials the caller's
+    psum closes together. ``w`` may be an int8 (weight, scale) pair:
+    lora_matmul owns the dense/dequant dispatch and returns the plain
+    base matmul when ``ll`` is None."""
     if ll is None and not isinstance(w, tuple):
         return x @ w  # skip the helper import on the hot plain path
     from production_stack_tpu.engine.lora import lora_matmul
@@ -243,9 +246,6 @@ def pp_paged_forward(params: Params, config: ModelConfig,
     """
     S = mesh.shape["pp"]
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
-    if lora is not None and tp > 1:
-        raise NotImplementedError(
-            "LoRA with pipeline x tensor parallelism")
     b, t = tokens.shape
 
     # Pad the batch to a multiple of S so M == S always (every stage
@@ -358,9 +358,16 @@ def pp_paged_forward(params: Params, config: ModelConfig,
     shared_specs = {k: on_mesh(specs.get(k, P())) for k in shared}
     cache_spec = on_mesh(mesh_cache_spec(mesh))
     repl = P()
-    # Adapter stacks: leading L over pp (prefix spec covers every a/b
-    # leaf); ids/scaling replicate.
-    lora_ab_spec = P("pp")
+    # Adapter stacks: leading L over pp; under tp each target shards
+    # like its base projection (the shared rule —
+    # engine/lora.py lora_stack_specs). ids/scaling replicate.
+    # _on_mesh drops 'tp' on pp-only meshes, degrading every spec to
+    # the old P('pp').
+    if lora_ab is None:
+        lora_ab_spec = P("pp")
+    else:
+        from production_stack_tpu.engine.lora import lora_stack_specs
+        lora_ab_spec = lora_stack_specs(lora_ab, "pp", on_mesh)
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(lp_specs, shared_specs, cache_spec, cache_spec,

@@ -19,7 +19,9 @@ from production_stack_tpu.engine.engine import LLMEngine
 from production_stack_tpu.engine.sequence import SamplingParams
 
 
-def _engine(sp, threshold=64, family="llama", tp=1, quant="none"):
+def _engine(sp, threshold=64, family="llama", tp=1, quant="none",
+            lora=False):
+    from production_stack_tpu.engine.config import LoRAConfig
     from production_stack_tpu.parallel.mesh import build_mesh
 
     model = tiny_model_config(family)
@@ -33,11 +35,32 @@ def _engine(sp, threshold=64, family="llama", tp=1, quant="none"):
         parallel=ParallelConfig(context_parallel_size=sp,
                                 tensor_parallel_size=tp,
                                 long_prefill_threshold=threshold),
+        lora=(LoRAConfig(enable=True, max_loras=2, max_lora_rank=4)
+              if lora else LoRAConfig()),
     )
     mesh = (build_mesh(context_parallel_size=sp,
                        tensor_parallel_size=tp)
             if sp > 1 or tp > 1 else None)
-    return LLMEngine(config, mesh=mesh)
+    engine = LLMEngine(config, mesh=mesh)
+    if lora:
+        import numpy as np
+
+        from production_stack_tpu.engine.lora import (
+            LoRAAdapter,
+            target_shapes,
+        )
+        rs = np.random.RandomState(11)
+        pairs = {}
+        for tgt, (d_in, d_out) in target_shapes(model).items():
+            pairs[tgt] = (
+                rs.randn(model.num_hidden_layers, d_in, 4)
+                .astype(np.float32) * 0.05,
+                rs.randn(model.num_hidden_layers, 4, d_out)
+                .astype(np.float32) * 0.05,
+            )
+        engine.runner.lora_registry.register(LoRAAdapter(
+            name="adapter-x", rank=4, scaling=0.5, weights=pairs))
+    return engine
 
 
 def _sampling():
@@ -136,6 +159,44 @@ def test_sp_tp_quantized_matches_single_device():
         prompt, _sampling()).output_token_ids
     got = _engine(2, tp=2, quant="int8").generate(
         prompt, _sampling()).output_token_ids
+    assert got == ref
+
+
+def test_sp_lora_matches_single_device():
+    """sp + LoRA (round-5 widening — the last guarded hole in the
+    parallel matrix): the LoRA delta is a per-row map over tokens, so
+    the sequence sharding passes through it; adapter rows and
+    base-model rows must both reproduce the single-device LoRA
+    engine."""
+    prompt = list(range(2, 2 + 4 * 32 + 7))
+
+    def serve(engine):
+        outs = []
+        for name in (None, "adapter-x"):
+            seq = engine.generate(prompt, _sampling(), lora_name=name)
+            outs.append(seq.output_token_ids)
+        return outs
+
+    ref = serve(_engine(1, lora=True))
+    got = serve(_engine(4, lora=True))
+    assert got == ref
+
+
+def test_sp_tp_lora_matches_single_device():
+    """sp x tp + LoRA: adapter targets shard like their base
+    projections (row-parallel A input axis / column-parallel B output
+    axis) inside the ring body's shard_map."""
+    prompt = list(range(2, 2 + 4 * 32 + 11))
+
+    def serve(engine):
+        outs = []
+        for name in (None, "adapter-x"):
+            seq = engine.generate(prompt, _sampling(), lora_name=name)
+            outs.append(seq.output_token_ids)
+        return outs
+
+    ref = serve(_engine(1, lora=True))
+    got = serve(_engine(2, tp=2, lora=True))
     assert got == ref
 
 
