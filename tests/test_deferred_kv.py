@@ -120,6 +120,38 @@ def test_deferred_int8_and_stacked_layout():
         assert deferred == eager, layout
 
 
+def test_deferred_penalties_and_logprobs_parity():
+    """Penalties and logprob extraction run in the shared burst step
+    body (_burst_sample_step) — pin that the deferred path reproduces
+    the eager path's outputs AND per-token logprob records exactly."""
+    prompts = _prompts(sizes=(13, 27))
+    kw = dict(max_tokens=10, presence_penalty=0.8,
+              frequency_penalty=0.3, logprobs=True, top_logprobs=3)
+
+    def run(deferred):
+        engine = _engine(decode_steps=4, deferred=deferred)
+        seqs, lps = [], {}
+        for p in prompts:
+            sid = engine.add_request(p, SamplingParams(
+                temperature=0.0, ignore_eos=True, **kw))
+            seqs.append(engine.sequences[sid])
+            lps[sid] = []
+        while engine.has_work():
+            for out in engine.step():
+                if out.logprobs is not None:
+                    lps[out.seq_id].append(out.logprobs)
+        return [(s.output_token_ids, lps[s.seq_id]) for s in seqs]
+
+    eager = run(False)
+    deferred = run(True)
+    for (et, elp), (dt, dlp) in zip(eager, deferred):
+        assert dt == et
+        assert len(dlp) == len(elp) == 10
+        for (es, etop), (ds, dtop) in zip(elp, dlp):
+            assert abs(es - ds) < 1e-3
+            assert [t for t, _ in etop] == [t for t, _ in dtop]
+
+
 def test_deferred_guards():
     with pytest.raises(ValueError, match="decode_steps"):
         _engine(decode_steps=1, deferred=True)
