@@ -55,8 +55,10 @@ import pytest  # noqa: E402
 # Files can still mark themselves explicitly; this list saves each
 # slow module from repeating the boilerplate.
 _SLOW_MODULES = {
+    "test_70b_lowering",
     "test_abort",
     "test_batch_e2e",
+    "test_deferred_kv",
     "test_batched_prefill",
     "test_cache_layout",
     "test_context_parallel_serving",

@@ -50,6 +50,15 @@ def test_70b_tp8_serving_programs_lower():
     m = llama3_70b_config()
     mesh = build_mesh(tensor_parallel_size=8)
     specs = param_specs(m)
+    # Guard against silent replicated fallback: the spec table must
+    # actually cover the model's params with tp-sharded entries.
+    init_shapes_names = set(jax.eval_shape(
+        lambda key: llama.init_params(m, key),
+        jax.random.PRNGKey(0)).keys())
+    tp_specced = {k for k in init_shapes_names
+                  if "tp" in tuple(specs.get(k, P()))}
+    assert len(tp_specced) >= 5, (
+        f"param_specs covers only {sorted(tp_specced)} with tp")
 
     # Abstract weights with their serving shardings (no allocation).
     init_shapes = jax.eval_shape(
@@ -85,7 +94,13 @@ def test_70b_tp8_serving_programs_lower():
             cache, cache,
         )
         text = lowered.as_text()
-        assert "sharding" in text  # GSPMD annotations survived
+        # A replicated fallback (e.g. a param-name drift making every
+        # specs.get() miss) would still contain the word "sharding" —
+        # require a non-replicated tp annotation in the module, in
+        # either representation (Shardy '{"tp"}' / GSPMD 'devices=[').
+        assert '{"tp"}' in text or "devices=[" in text, (
+            "no non-replicated sharding annotation in lowered 70B "
+            "program")
         return lowered
 
     # Prefill chunk and decode step both lower at 70B scale.
