@@ -374,7 +374,7 @@ class ModelRunner:
 
             def _sp_step(params, k_cache, v_cache, tokens, page_table,
                          valid, last_index, temperature, top_p, top_k,
-                         rng, lora, lora_ids, penalties, seeding,
+                         rng, lora, lora_ids, penalties, seeding, bias,
                          want_logprobs=False):
                 row_logits, k_cache, v_cache = sp_prefill_forward(
                     params, self.config.model, tokens, page_table,
@@ -384,6 +384,8 @@ class ModelRunner:
                 raw_logits = row_logits
                 if penalties is not None:
                     row_logits = apply_penalties(row_logits, *penalties)
+                if bias is not None:
+                    row_logits = row_logits + bias
                 seeds, seed_on, emitted = (
                     seeding if seeding is not None
                     else (None, None, None))
@@ -524,7 +526,7 @@ class ModelRunner:
     def _step_impl(self, params, k_cache, v_cache, tokens, positions,
                    page_table, kv_lens, valid, last_index, temperature,
                    top_p, top_k, rng, lora, lora_ids, penalties,
-                   seeding, sample_index_mode: str,
+                   seeding, bias, sample_index_mode: str,
                    want_logprobs: bool = False):
         logits, k_cache, v_cache = self._forward(
             params, self.config.model, tokens, positions, page_table,
@@ -543,6 +545,10 @@ class ModelRunner:
             # None in the common no-penalty case so that path compiles
             # with zero penalty overhead.
             row_logits = apply_penalties(row_logits, *penalties)
+        if bias is not None:
+            # OpenAI logit_bias (dense [B, vocab], zero where unused);
+            # after penalties, before sampling; logprobs stay raw.
+            row_logits = row_logits + bias
         seeds, seed_on, emitted = (
             seeding if seeding is not None else (None, None, None))
         sampled = sample_tokens(row_logits, temperature, top_p, top_k,
@@ -561,7 +567,7 @@ class ModelRunner:
                            positions, page_table, kv_lens, active,
                            budgets, stop_tokens, temperature, top_p,
                            top_k, rng, lora, lora_ids, penalties,
-                           seeding, num_steps: int,
+                           seeding, bias, num_steps: int,
                            want_logprobs: bool = False):
         """K chained decode iterations in one program, with per-row
         lifecycle on device.
@@ -597,7 +603,7 @@ class ModelRunner:
             counts0 = jnp.zeros((b, 0), jnp.int32)
 
         sample_step = self._burst_sample_step(
-            b, penalties, seeding, temperature, top_p, top_k,
+            b, penalties, seeding, bias, temperature, top_p, top_k,
             stop_tokens, budgets, want_logprobs)
 
         def body(carry, step_rng):
@@ -623,9 +629,9 @@ class ModelRunner:
         )
         return out, k_cache, v_cache
 
-    def _burst_sample_step(self, b, penalties, seeding, temperature,
-                           top_p, top_k, stop_tokens, budgets,
-                           want_logprobs):
+    def _burst_sample_step(self, b, penalties, seeding, bias,
+                           temperature, top_p, top_k, stop_tokens,
+                           budgets, want_logprobs):
         """The burst bodies' shared logits -> (out, lifecycle) step:
         penalties, (seeded) sampling, logprobs, occurrence counts,
         stop/budget freeze. One definition so the eager and deferred
@@ -639,6 +645,10 @@ class ModelRunner:
                 row_logits = apply_penalties(
                     row_logits, counts, prompt_mask, presence,
                     frequency, repetition)
+            if bias is not None:
+                # OpenAI logit_bias: after penalties, before sampling;
+                # logprobs stay raw.
+                row_logits = row_logits + bias
             if seeding is not None:
                 # Seeded rows' randomness depends only on (seed,
                 # absolute emitted index), so reproducibility survives
@@ -675,7 +685,8 @@ class ModelRunner:
                                     kv_lens, active, budgets,
                                     stop_tokens, temperature, top_p,
                                     top_k, rng, lora, lora_ids,
-                                    penalties, seeding, num_steps: int,
+                                    penalties, seeding, bias,
+                                    num_steps: int,
                                     want_logprobs: bool = False):
         """_decode_burst_impl with per-burst (not per-step) KV writes.
 
@@ -710,7 +721,7 @@ class ModelRunner:
                          for _ in range(m.num_hidden_layers))
 
         sample_step = self._burst_sample_step(
-            b, penalties, seeding, temperature, top_p, top_k,
+            b, penalties, seeding, bias, temperature, top_p, top_k,
             stop_tokens, budgets, want_logprobs)
 
         def body(carry, step_rng):
@@ -788,7 +799,7 @@ class ModelRunner:
         lora_ids = payload.get("lora_ids")
         lora_ids = (None if lora_ids is None
                     else jnp.asarray(lora_ids))
-        penalties, seeding = self._optional_device_inputs(payload)
+        penalties, seeding, bias = self._optional_device_inputs(payload)
         want_lp = bool(payload.get("want_logprobs", False))
         if kind == 2 and t > 1:
             sampled, self.k_cache, self.v_cache = \
@@ -806,7 +817,7 @@ class ModelRunner:
                     jnp.asarray(payload["top_k"]),
                     jnp.asarray(payload["rng"]),
                     self._lora_stack, lora_ids, penalties, seeding,
-                    num_steps=t, want_logprobs=want_lp,
+                    bias, num_steps=t, want_logprobs=want_lp,
                 )
             return sampled  # [K, B] (+ logprob arrays when requested)
         sampled, self.k_cache, self.v_cache = self._step_jit(
@@ -821,7 +832,7 @@ class ModelRunner:
             jnp.asarray(payload["top_p"]),
             jnp.asarray(payload["top_k"]),
             jnp.asarray(payload["rng"]),
-            self._lora_stack, lora_ids, penalties, seeding,
+            self._lora_stack, lora_ids, penalties, seeding, bias,
             sample_index_mode=("last" if kind == 1 else "first"),
             want_logprobs=want_lp,
         )
@@ -893,9 +904,44 @@ class ModelRunner:
                 "seed_on": seed_on,
                 "seed_emitted": emitted}
 
+    def _bias_payload(self, seqs: "List[Optional[Sequence]]",
+                      pad_to: int) -> dict:
+        """Per-row logit-bias matrix, or {} when no row uses one (the
+        bias-free batch keeps its bias-free compiled program and pays
+        no [B, vocab] host->device transfer).
+
+        The matrix is constant while the batch's row composition is —
+        cached by (row seq_id, bias identity) so the single-step path
+        doesn't rebuild a [B, vocab] dense matrix per token (it still
+        rides each dispatch's payload: the multihost broadcast needs
+        the full input set — same trade the penalty mask makes)."""
+        if not any(s is not None and s.sampling.logit_bias
+                   for s in seqs):
+            return {}
+        key = (pad_to, tuple(
+            (s.seq_id, id(s.sampling.logit_bias))
+            if s is not None and s.sampling.logit_bias else None
+            for s in seqs))
+        cached = getattr(self, "_bias_cache", None)
+        if cached is not None and cached[0] == key:
+            return {"logit_bias": cached[1]}
+        v = self.config.model.vocab_size
+        bias = np.zeros((pad_to, v), np.float32)
+        for i, seq in enumerate(seqs):
+            if seq is None or not seq.sampling.logit_bias:
+                continue
+            for tid, b in seq.sampling.logit_bias.items():
+                # Out-of-vocab ids are rejected with a 400 at request
+                # time when the serving vocab is known (server.py); the
+                # guard here keeps direct-engine callers safe.
+                if 0 <= int(tid) < v:
+                    bias[i, int(tid)] = float(b)
+        self._bias_cache = (key, bias)
+        return {"logit_bias": bias}
+
     @staticmethod
     def _optional_device_inputs(payload: dict):
-        """(penalties, seeding) device tuples from a step payload."""
+        """(penalties, seeding, bias) device inputs from a payload."""
         penalties = None
         if "pen_prompt_mask" in payload:
             penalties = (
@@ -910,7 +956,9 @@ class ModelRunner:
             seeding = (jnp.asarray(payload["seed_rows"]),
                        jnp.asarray(payload["seed_on"]),
                        jnp.asarray(payload["seed_emitted"]))
-        return penalties, seeding
+        bias = (jnp.asarray(payload["logit_bias"])
+                if "logit_bias" in payload else None)
+        return penalties, seeding, bias
 
     def _dispatch(self, kind: int, t: int, payload: dict) -> jax.Array:
         if self.bridge is not None:
@@ -950,7 +998,8 @@ class ModelRunner:
         opt = {}
         opt.update(self._penalty_payload([seq], 1))
         opt.update(self._seed_payload([seq], 1))
-        penalties, seeding = self._optional_device_inputs(opt)
+        opt.update(self._bias_payload([seq], 1))
+        penalties, seeding, bias = self._optional_device_inputs(opt)
         want_lp = sp_params.logprobs
         lora_ids = (None if self.lora_registry is None
                     else jnp.asarray(
@@ -966,7 +1015,7 @@ class ModelRunner:
             jnp.asarray(np.asarray([sp_params.top_p], np.float32)),
             jnp.asarray(np.asarray([sp_params.top_k], np.int32)),
             self._next_rng(), self._lora_stack, lora_ids,
-            penalties, seeding,
+            penalties, seeding, bias,
             want_logprobs=want_lp,
         )
         host = jax.device_get(sampled)
@@ -1039,6 +1088,7 @@ class ModelRunner:
                          for c in chunks]
         payload.update(self._penalty_payload(sampling_rows, b))
         payload.update(self._seed_payload(sampling_rows, b))
+        payload.update(self._bias_payload(sampling_rows, b))
         want_lp = any(s is not None and s.sampling.logprobs
                       for s in sampling_rows)
         if want_lp:
@@ -1138,6 +1188,7 @@ class ModelRunner:
             payload["lora_ids"] = ids
         payload.update(self._penalty_payload(seqs, b))
         payload.update(self._seed_payload(seqs, b))
+        payload.update(self._bias_payload(seqs, b))
         want_lp = any(s.sampling.logprobs for s in seqs)
         if want_lp:
             payload["want_logprobs"] = True

@@ -5,7 +5,7 @@ from __future__ import annotations
 import enum
 import time
 from dataclasses import dataclass, field
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclass
@@ -35,6 +35,12 @@ class SamplingParams:
     # the compiled width, engine/model_runner.py TOP_LOGPROBS_WIDTH).
     logprobs: bool = False
     top_logprobs: int = 0
+    # OpenAI ``logit_bias``: {token_id: bias in [-100, 100]} added to
+    # the logits before sampling (after penalties; logprobs report the
+    # raw distribution per the OpenAI contract). Applied on device as
+    # a dense [B, vocab] add only when some row in the batch uses it
+    # (model_runner._bias_payload).
+    logit_bias: Optional[Dict[int, float]] = None
 
     @property
     def greedy(self) -> bool:

@@ -140,7 +140,9 @@ class AsyncEngine:
 # ---- request handling ------------------------------------------------------
 
 
-def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
+def _sampling_from_body(body: dict, max_model_len: int,
+                        vocab_size: "int | None" = None
+                        ) -> SamplingParams:
     max_tokens = body.get("max_tokens")
     if max_tokens is None:
         max_tokens = body.get("max_completion_tokens")
@@ -174,6 +176,37 @@ def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
         lp_flag = lp_top > 0
     else:
         lp_flag, lp_top = True, int(lp_req)
+    # OpenAI logit_bias: {"<token_id>": bias} with string keys (JSON
+    # object keys) and bias in [-100, 100], at most 300 entries.
+    raw_bias = body.get("logit_bias")
+    logit_bias = None
+    if raw_bias:
+        if not isinstance(raw_bias, dict):
+            raise ValueError("logit_bias must be an object mapping "
+                             "token ids to bias values")
+        if len(raw_bias) > 300:
+            raise ValueError("logit_bias supports at most 300 entries")
+        logit_bias = {}
+        for k, v in raw_bias.items():
+            try:
+                tid = int(k)
+                bv = float(v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"logit_bias entries must map integer token ids "
+                    f"to numbers (got {k!r}: {v!r})")
+            if not (-100.0 <= bv <= 100.0):
+                raise ValueError(
+                    f"logit_bias values must be in [-100, 100], got "
+                    f"{bv} for token {tid}")
+            if vocab_size is not None and not (0 <= tid < vocab_size):
+                # Reject like every other out-of-range param — a
+                # silently dropped ban (wrong tokenizer assumed) would
+                # succeed while doing nothing.
+                raise ValueError(
+                    f"logit_bias token id {tid} is outside the model "
+                    f"vocabulary (size {vocab_size})")
+            logit_bias[tid] = bv
     params = SamplingParams(
         max_tokens=min(int(max_tokens), max_model_len),
         temperature=1.0 if temperature is None else float(temperature),
@@ -189,6 +222,7 @@ def _sampling_from_body(body: dict, max_model_len: int) -> SamplingParams:
         seed=None if body.get("seed") is None else int(body["seed"]),
         logprobs=lp_flag,
         top_logprobs=lp_top,
+        logit_bias=logit_bias,
     )
     _validate_sampling(params)
     return params
@@ -388,7 +422,8 @@ class EngineServer:
                                  prompt_text: Optional[str] = None):
         try:
             sampling = _sampling_from_body(
-                body, self.engine.config.scheduler.max_model_len
+                body, self.engine.config.scheduler.max_model_len,
+                vocab_size=self.engine.config.model.vocab_size,
             )
         except (ValueError, TypeError) as e:
             return web.json_response(

@@ -77,6 +77,7 @@ class MultihostStepBridge:
     FLAG_PENALTIES = 1
     FLAG_SEEDING = 2
     FLAG_LOGPROBS = 4
+    FLAG_BIAS = 8
 
     def __init__(self, runner):
         self.runner = runner
@@ -142,6 +143,9 @@ class MultihostStepBridge:
             template["seed_rows"] = np.zeros((b,), np.int32)
             template["seed_on"] = np.zeros((b,), bool)
             template["seed_emitted"] = np.zeros((b,), np.int32)
+        if flags & self.FLAG_BIAS:
+            template["logit_bias"] = np.zeros(
+                (b, r.config.model.vocab_size), np.float32)
         return template
 
     # -- host 0 --------------------------------------------------------------
@@ -156,6 +160,8 @@ class MultihostStepBridge:
             flags |= self.FLAG_SEEDING
         if payload.get("want_logprobs"):
             flags |= self.FLAG_LOGPROBS
+        if "logit_bias" in payload:
+            flags |= self.FLAG_BIAS
         header = np.asarray([kind, t, flags], np.int32)
         multihost_utils.broadcast_one_to_all(header)
         if kind != KIND_SHUTDOWN:

@@ -66,6 +66,7 @@ _SLOW_MODULES = {
     "test_embeddings",
     "test_engine_server",
     "test_kv_offload",
+    "test_logit_bias",
     "test_lora",
     "test_model_parity",
     "test_multihost",
