@@ -374,8 +374,8 @@ class ModelRunner:
 
             def _sp_step(params, k_cache, v_cache, tokens, page_table,
                          valid, last_index, temperature, top_p, top_k,
-                         rng, lora, lora_ids, penalties, seeding, bias,
-                         want_logprobs=False):
+                         rng, lora, lora_ids, penalties, seeding,
+                         bias, suppress, want_logprobs=False):
                 row_logits, k_cache, v_cache = sp_prefill_forward(
                     params, self.config.model, tokens, page_table,
                     valid, last_index, k_cache, v_cache,
@@ -386,6 +386,9 @@ class ModelRunner:
                     row_logits = apply_penalties(row_logits, *penalties)
                 if bias is not None:
                     row_logits = row_logits + bias
+                if suppress is not None:
+                    row_logits = ModelRunner._apply_suppression(
+                        row_logits, suppress)
                 seeds, seed_on, emitted = (
                     seeding if seeding is not None
                     else (None, None, None))
@@ -526,7 +529,7 @@ class ModelRunner:
     def _step_impl(self, params, k_cache, v_cache, tokens, positions,
                    page_table, kv_lens, valid, last_index, temperature,
                    top_p, top_k, rng, lora, lora_ids, penalties,
-                   seeding, bias, sample_index_mode: str,
+                   seeding, bias, suppress, sample_index_mode: str,
                    want_logprobs: bool = False):
         logits, k_cache, v_cache = self._forward(
             params, self.config.model, tokens, positions, page_table,
@@ -549,6 +552,10 @@ class ModelRunner:
             # OpenAI logit_bias (dense [B, vocab], zero where unused);
             # after penalties, before sampling; logprobs stay raw.
             row_logits = row_logits + bias
+        if suppress is not None:
+            # min_tokens: stops cannot be generated while under the
+            # row's minimum (vLLM semantics; logprobs stay raw).
+            row_logits = self._apply_suppression(row_logits, suppress)
         seeds, seed_on, emitted = (
             seeding if seeding is not None else (None, None, None))
         sampled = sample_tokens(row_logits, temperature, top_p, top_k,
@@ -567,7 +574,7 @@ class ModelRunner:
                            positions, page_table, kv_lens, active,
                            budgets, stop_tokens, temperature, top_p,
                            top_k, rng, lora, lora_ids, penalties,
-                           seeding, bias, num_steps: int,
+                           seeding, bias, suppress, num_steps: int,
                            want_logprobs: bool = False):
         """K chained decode iterations in one program, with per-row
         lifecycle on device.
@@ -603,8 +610,8 @@ class ModelRunner:
             counts0 = jnp.zeros((b, 0), jnp.int32)
 
         sample_step = self._burst_sample_step(
-            b, penalties, seeding, bias, temperature, top_p, top_k,
-            stop_tokens, budgets, want_logprobs)
+            b, penalties, seeding, bias, suppress, temperature,
+            top_p, top_k, stop_tokens, budgets, want_logprobs)
 
         def body(carry, step_rng):
             tok, pos, kv, act, emitted, counts, kc, vc = carry
@@ -630,8 +637,8 @@ class ModelRunner:
         return out, k_cache, v_cache
 
     def _burst_sample_step(self, b, penalties, seeding, bias,
-                           temperature, top_p, top_k, stop_tokens,
-                           budgets, want_logprobs):
+                           suppress, temperature, top_p, top_k,
+                           stop_tokens, budgets, want_logprobs):
         """The burst bodies' shared logits -> (out, lifecycle) step:
         penalties, (seeded) sampling, logprobs, occurrence counts,
         stop/budget freeze. One definition so the eager and deferred
@@ -649,6 +656,12 @@ class ModelRunner:
                 # OpenAI logit_bias: after penalties, before sampling;
                 # logprobs stay raw.
                 row_logits = row_logits + bias
+            if suppress is not None:
+                # min_tokens: stops masked while under the minimum
+                # (emitted counts this burst's tokens on top of the
+                # payload-time remainder).
+                row_logits = self._apply_suppression(
+                    row_logits, suppress, emitted=emitted)
             if seeding is not None:
                 # Seeded rows' randomness depends only on (seed,
                 # absolute emitted index), so reproducibility survives
@@ -686,7 +699,7 @@ class ModelRunner:
                                     stop_tokens, temperature, top_p,
                                     top_k, rng, lora, lora_ids,
                                     penalties, seeding, bias,
-                                    num_steps: int,
+                                    suppress, num_steps: int,
                                     want_logprobs: bool = False):
         """_decode_burst_impl with per-burst (not per-step) KV writes.
 
@@ -721,8 +734,8 @@ class ModelRunner:
                          for _ in range(m.num_hidden_layers))
 
         sample_step = self._burst_sample_step(
-            b, penalties, seeding, bias, temperature, top_p, top_k,
-            stop_tokens, budgets, want_logprobs)
+            b, penalties, seeding, bias, suppress, temperature,
+            top_p, top_k, stop_tokens, budgets, want_logprobs)
 
         def body(carry, step_rng):
             tok, pos, act, emitted, counts, kt, vt = carry
@@ -799,7 +812,8 @@ class ModelRunner:
         lora_ids = payload.get("lora_ids")
         lora_ids = (None if lora_ids is None
                     else jnp.asarray(lora_ids))
-        penalties, seeding, bias = self._optional_device_inputs(payload)
+        penalties, seeding, bias, suppress = \
+            self._optional_device_inputs(payload)
         want_lp = bool(payload.get("want_logprobs", False))
         if kind == 2 and t > 1:
             sampled, self.k_cache, self.v_cache = \
@@ -817,7 +831,7 @@ class ModelRunner:
                     jnp.asarray(payload["top_k"]),
                     jnp.asarray(payload["rng"]),
                     self._lora_stack, lora_ids, penalties, seeding,
-                    bias, num_steps=t, want_logprobs=want_lp,
+                    bias, suppress, num_steps=t, want_logprobs=want_lp,
                 )
             return sampled  # [K, B] (+ logprob arrays when requested)
         sampled, self.k_cache, self.v_cache = self._step_jit(
@@ -833,6 +847,7 @@ class ModelRunner:
             jnp.asarray(payload["top_k"]),
             jnp.asarray(payload["rng"]),
             self._lora_stack, lora_ids, penalties, seeding, bias,
+            suppress,
             sample_index_mode=("last" if kind == 1 else "first"),
             want_logprobs=want_lp,
         )
@@ -919,7 +934,7 @@ class ModelRunner:
                    for s in seqs):
             return {}
         key = (pad_to, tuple(
-            (s.seq_id, id(s.sampling.logit_bias))
+            (s.seq_id, tuple(sorted(s.sampling.logit_bias.items())))
             if s is not None and s.sampling.logit_bias else None
             for s in seqs))
         cached = getattr(self, "_bias_cache", None)
@@ -939,9 +954,50 @@ class ModelRunner:
         self._bias_cache = (key, bias)
         return {"logit_bias": bias}
 
+    def _suppress_payload(self, seqs: "List[Optional[Sequence]]",
+                          pad_to: int) -> dict:
+        """min_tokens stop-suppression inputs, or {} when no row is
+        under its minimum: per-row stop-set ids (EOS included —
+        padded with -1 to STOP_SET_WIDTH) and the count of tokens the
+        row must still emit before a stop may be GENERATED. The
+        sampling steps mask those ids to -inf while under the
+        minimum; ids beyond the fixed width are protected by the host
+        finish guard (scheduler._append_token) instead."""
+        if not any(s is not None
+                   and s.sampling.min_tokens > len(s.output_token_ids)
+                   for s in seqs):
+            return {}
+        ids = np.full((pad_to, STOP_SET_WIDTH), -1, np.int32)
+        rem = np.zeros((pad_to,), np.int32)
+        for i, seq in enumerate(seqs):
+            if seq is None:
+                continue
+            r = seq.sampling.min_tokens - len(seq.output_token_ids)
+            if r <= 0:
+                continue
+            rem[i] = r
+            sids = seq.sampling.stop_token_ids[:STOP_SET_WIDTH]
+            ids[i, :len(sids)] = sids
+        return {"sup_ids": ids, "sup_rem": rem}
+
+    @staticmethod
+    def _apply_suppression(row_logits, suppress, emitted=None):
+        """Mask suppressed token ids to -inf for rows still under
+        their min_tokens. ``emitted`` (burst paths) counts tokens
+        emitted THIS dispatch on top of the payload-time remainder;
+        None (single-step/prefill: at most one token per dispatch)
+        means the payload-time remainder is current."""
+        ids, rem = suppress  # [B, W] (-1 padded), [B]
+        b = row_logits.shape[0]
+        under = (rem > 0) if emitted is None else (emitted < rem)
+        pen = jnp.where((ids >= 0) & under[:, None], -1e30, 0.0)
+        return row_logits.at[
+            jnp.arange(b)[:, None], jnp.clip(ids, 0)].add(pen)
+
     @staticmethod
     def _optional_device_inputs(payload: dict):
-        """(penalties, seeding, bias) device inputs from a payload."""
+        """(penalties, seeding, bias, suppress) device inputs from a
+        step payload; each is None when its keys are absent."""
         penalties = None
         if "pen_prompt_mask" in payload:
             penalties = (
@@ -958,7 +1014,10 @@ class ModelRunner:
                        jnp.asarray(payload["seed_emitted"]))
         bias = (jnp.asarray(payload["logit_bias"])
                 if "logit_bias" in payload else None)
-        return penalties, seeding, bias
+        suppress = ((jnp.asarray(payload["sup_ids"]),
+                     jnp.asarray(payload["sup_rem"]))
+                    if "sup_ids" in payload else None)
+        return penalties, seeding, bias, suppress
 
     def _dispatch(self, kind: int, t: int, payload: dict) -> jax.Array:
         if self.bridge is not None:
@@ -999,7 +1058,9 @@ class ModelRunner:
         opt.update(self._penalty_payload([seq], 1))
         opt.update(self._seed_payload([seq], 1))
         opt.update(self._bias_payload([seq], 1))
-        penalties, seeding, bias = self._optional_device_inputs(opt)
+        opt.update(self._suppress_payload([seq], 1))
+        penalties, seeding, bias, suppress = \
+            self._optional_device_inputs(opt)
         want_lp = sp_params.logprobs
         lora_ids = (None if self.lora_registry is None
                     else jnp.asarray(
@@ -1015,7 +1076,7 @@ class ModelRunner:
             jnp.asarray(np.asarray([sp_params.top_p], np.float32)),
             jnp.asarray(np.asarray([sp_params.top_k], np.int32)),
             self._next_rng(), self._lora_stack, lora_ids,
-            penalties, seeding, bias,
+            penalties, seeding, bias, suppress,
             want_logprobs=want_lp,
         )
         host = jax.device_get(sampled)
@@ -1089,6 +1150,7 @@ class ModelRunner:
         payload.update(self._penalty_payload(sampling_rows, b))
         payload.update(self._seed_payload(sampling_rows, b))
         payload.update(self._bias_payload(sampling_rows, b))
+        payload.update(self._suppress_payload(sampling_rows, b))
         want_lp = any(s is not None and s.sampling.logprobs
                       for s in sampling_rows)
         if want_lp:
@@ -1189,6 +1251,7 @@ class ModelRunner:
         payload.update(self._penalty_payload(seqs, b))
         payload.update(self._seed_payload(seqs, b))
         payload.update(self._bias_payload(seqs, b))
+        payload.update(self._suppress_payload(seqs, b))
         want_lp = any(s.sampling.logprobs for s in seqs)
         if want_lp:
             payload["want_logprobs"] = True

@@ -385,7 +385,14 @@ class Scheduler:
     def _append_token(self, seq: Sequence, token: int) -> None:
         seq.output_token_ids.append(token)
         stop_ids = seq.sampling.stop_token_ids
-        if not seq.sampling.ignore_eos and token in stop_ids:
+        # min_tokens: the device suppresses stop ids while under the
+        # minimum (model_runner._suppress_payload), but only up to
+        # STOP_SET_WIDTH of them — a wider set's overflow could still
+        # be sampled, and must not end the sequence early.
+        past_min = (len(seq.output_token_ids)
+                    > seq.sampling.min_tokens)
+        if (not seq.sampling.ignore_eos and token in stop_ids
+                and past_min):
             self._finish(seq, FinishReason.STOP)
             self.running.remove(seq)
         elif len(seq.output_token_ids) >= seq.sampling.max_tokens:

@@ -41,6 +41,12 @@ class SamplingParams:
     # a dense [B, vocab] add only when some row in the batch uses it
     # (model_runner._bias_payload).
     logit_bias: Optional[Dict[int, float]] = None
+    # vLLM ``min_tokens``: EOS and stop_token_ids cannot be GENERATED
+    # until this many tokens have been emitted — their logits are
+    # suppressed on device while under the minimum
+    # (model_runner._suppress_payload), matching vLLM's semantics
+    # (text-level stop strings are not gated, as in vLLM).
+    min_tokens: int = 0
 
     @property
     def greedy(self) -> bool:

@@ -223,6 +223,7 @@ def _sampling_from_body(body: dict, max_model_len: int,
         logprobs=lp_flag,
         top_logprobs=lp_top,
         logit_bias=logit_bias,
+        min_tokens=int(body.get("min_tokens") or 0),
     )
     _validate_sampling(params)
     return params
@@ -258,6 +259,10 @@ def _validate_sampling(p: SamplingParams) -> None:
     if not (0 <= p.top_logprobs <= 20):
         raise ValueError(
             f"top_logprobs must be in [0, 20], got {p.top_logprobs}")
+    if not (0 <= p.min_tokens <= p.max_tokens):
+        raise ValueError(
+            f"min_tokens must be in [0, max_tokens], got "
+            f"{p.min_tokens} with max_tokens {p.max_tokens}")
 
 
 class _StopStringScanner:

@@ -78,6 +78,7 @@ class MultihostStepBridge:
     FLAG_SEEDING = 2
     FLAG_LOGPROBS = 4
     FLAG_BIAS = 8
+    FLAG_SUPPRESS = 16
 
     def __init__(self, runner):
         self.runner = runner
@@ -146,6 +147,13 @@ class MultihostStepBridge:
         if flags & self.FLAG_BIAS:
             template["logit_bias"] = np.zeros(
                 (b, r.config.model.vocab_size), np.float32)
+        if flags & self.FLAG_SUPPRESS:
+            from production_stack_tpu.engine.model_runner import (
+                STOP_SET_WIDTH,
+            )
+            template["sup_ids"] = np.zeros(
+                (b, STOP_SET_WIDTH), np.int32)
+            template["sup_rem"] = np.zeros((b,), np.int32)
         return template
 
     # -- host 0 --------------------------------------------------------------
@@ -162,6 +170,8 @@ class MultihostStepBridge:
             flags |= self.FLAG_LOGPROBS
         if "logit_bias" in payload:
             flags |= self.FLAG_BIAS
+        if "sup_ids" in payload:
+            flags |= self.FLAG_SUPPRESS
         header = np.asarray([kind, t, flags], np.int32)
         multihost_utils.broadcast_one_to_all(header)
         if kind != KIND_SHUTDOWN:

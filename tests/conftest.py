@@ -68,6 +68,7 @@ _SLOW_MODULES = {
     "test_kv_offload",
     "test_logit_bias",
     "test_lora",
+    "test_min_tokens",
     "test_model_parity",
     "test_multihost",
     "test_multistep_decode",

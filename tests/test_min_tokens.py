@@ -1,0 +1,90 @@
+"""vLLM ``min_tokens``: EOS and stop_token_ids cannot be GENERATED
+until min_tokens tokens exist — suppressed on device while under the
+minimum (model_runner._suppress_payload / _apply_suppression), with a
+host finish guard for stop sets wider than the compiled width."""
+
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+
+
+def _engine(decode_steps=1, deferred=False):
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=128),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=256,
+                                  prefill_chunk_size=32,
+                                  decode_steps=decode_steps,
+                                  deferred_kv_writes=deferred),
+    ))
+
+
+PROMPT = list(range(5, 25))
+
+
+def _gen(engine, **kw):
+    sampling = dict(max_tokens=16, temperature=0.0)
+    sampling.update(kw)
+    return engine.generate(PROMPT, SamplingParams(**sampling))
+
+
+def _greedy_stop():
+    """The unconstrained greedy first token — used as a stop id so the
+    stop would fire immediately without min_tokens."""
+    seq = _gen(_engine(), max_tokens=1, ignore_eos=True)
+    return seq.output_token_ids[0]
+
+
+def test_min_tokens_defers_stop():
+    stop = _greedy_stop()
+    # Without min_tokens the stop fires on the first token.
+    base = _gen(_engine(), stop_token_ids=[stop])
+    assert len(base.output_token_ids) == 1
+    assert base.output_token_ids[-1] == stop
+    # With min_tokens=5 the stop id cannot appear in the first 5
+    # tokens at all (suppressed, not just non-terminal).
+    got = _gen(_engine(), stop_token_ids=[stop], min_tokens=5)
+    assert len(got.output_token_ids) >= 5
+    assert stop not in got.output_token_ids[:5]
+
+
+def test_min_tokens_parity_across_decode_paths():
+    stop = _greedy_stop()
+    kw = dict(stop_token_ids=[stop], min_tokens=6)
+    ref = _gen(_engine(), **kw).output_token_ids
+    burst = _gen(_engine(decode_steps=4), **kw).output_token_ids
+    deferred = _gen(_engine(decode_steps=4, deferred=True),
+                    **kw).output_token_ids
+    assert burst == ref
+    assert deferred == ref
+
+
+def test_min_tokens_then_stop_naturally():
+    """After the minimum, generation is unconstrained: with a stop on
+    every-greedy-token, the very next token after the minimum is the
+    (now permitted) greedy stop."""
+    stop = _greedy_stop()
+    got = _gen(_engine(decode_steps=4), stop_token_ids=[stop],
+               min_tokens=3)
+    out = got.output_token_ids
+    assert len(out) >= 3 and stop not in out[:3]
+    if got.finish_reason is not None and len(out) < 16:
+        assert out[-1] == stop  # finished BY the stop, post-minimum
+
+
+def test_min_tokens_validation():
+    from production_stack_tpu.engine.server import _sampling_from_body
+
+    p = _sampling_from_body({"min_tokens": 4, "max_tokens": 8}, 256)
+    assert p.min_tokens == 4
+    with pytest.raises(ValueError, match="min_tokens"):
+        _sampling_from_body({"min_tokens": 9, "max_tokens": 8}, 256)
+    with pytest.raises(ValueError, match="min_tokens"):
+        _sampling_from_body({"min_tokens": -1}, 256)

@@ -130,6 +130,8 @@ def test_bridge_template_matches_real_payloads():
             flags |= bridge.FLAG_LOGPROBS
         if "logit_bias" in payload:
             flags |= bridge.FLAG_BIAS
+        if "sup_ids" in payload:
+            flags |= bridge.FLAG_SUPPRESS
         arrays = {k: v for k, v in payload.items()
                   if k != "want_logprobs"}
         published.append((kind, t, flags, arrays))
@@ -138,9 +140,9 @@ def test_bridge_template_matches_real_payloads():
     bridge.publish = fake_publish
 
     engine.generate(list(range(1, 40)), SamplingParams(
-        max_tokens=6, temperature=0.7, ignore_eos=True, seed=7,
+        max_tokens=6, temperature=0.7, seed=7,
         presence_penalty=0.5, logprobs=True, top_logprobs=2,
-        logit_bias={9: -1.5},
+        logit_bias={9: -1.5}, min_tokens=4,
     ))
 
     assert published, "bridge.publish never called"
