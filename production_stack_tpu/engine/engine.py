@@ -55,11 +55,25 @@ class LLMEngine:
         if config.parallel.context_parallel_size > 1:
             sp_threshold = (config.parallel.long_prefill_threshold
                             or 2 * config.scheduler.prefill_chunk_size)
+        # Guided JSON decoding (engine/guided.py): built EAGERLY for
+        # byte-range tokenizers so multihost workers hold identical
+        # tables before the first guided payload arrives (a lazy
+        # host-0-only build would desync the step broadcast). HF
+        # subword tokenizers: None — the server rejects
+        # response_format json_object for them with a 400.
+        self.guided_fsm = None
+        from production_stack_tpu.engine.tokenizer import ByteTokenizer
+        if isinstance(self.tokenizer, ByteTokenizer):
+            from production_stack_tpu.engine.guided import build_json_fsm
+            self.guided_fsm = build_json_fsm(self.tokenizer)
         self.scheduler = Scheduler(
             config.scheduler, config.cache, self.cache_manager,
             sp_threshold=sp_threshold,
+            guided_advance=self._guided_advance,
         )
         self.runner = ModelRunner(config, mesh=mesh, params=params)
+        if self.guided_fsm is not None:
+            self.runner.set_guided_tables(self.guided_fsm)
         self.sequences: Dict[str, Sequence] = {}
         self._lock = threading.Lock()
         from production_stack_tpu.engine.metrics import EngineMetrics
@@ -145,6 +159,19 @@ class LLMEngine:
                 and self.tokenizer.eos_token_id not in stop_ids):
             stop_ids.append(self.tokenizer.eos_token_id)
         sampling.stop_token_ids = stop_ids
+        fsm_state = None
+        if sampling.guided is not None:
+            if sampling.guided != "json":
+                raise ValueError(
+                    f"unsupported guided mode {sampling.guided!r} "
+                    "(supported: 'json')")
+            if self.guided_fsm is None:
+                raise ValueError(
+                    "guided JSON decoding requires a byte-range "
+                    "tokenizer in this build (HF subword tokenizers "
+                    "need an outlines-style vocabulary DFA product — "
+                    "not yet supported)")
+            fsm_state = 0
         lora_id = 0
         if lora_name is not None:
             if self.runner.lora_registry is None:
@@ -158,6 +185,7 @@ class LLMEngine:
             lora_id=lora_id,
             cache_salt=(self.runner.lora_registry.cache_root(lora_id)
                         if lora_id else 0),
+            fsm_state=fsm_state,
         )
         with self._lock:
             self.sequences[seq.seq_id] = seq
@@ -252,6 +280,14 @@ class LLMEngine:
                            if seq.finish_reason else None),
             logprobs=logprobs,
         )
+
+    def _guided_advance(self, seq, token: int) -> None:
+        """Host mirror of the device automaton carry (scheduler hook);
+        tokens the automaton rejects (possible only via host-enforced
+        stop-set overflow) freeze the state rather than corrupt it."""
+        ns = self.guided_fsm.advance(seq.fsm_state, token)
+        if ns >= 0:
+            seq.fsm_state = ns
 
     # ---- metrics ----------------------------------------------------------
 

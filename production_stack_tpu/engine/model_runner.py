@@ -375,7 +375,7 @@ class ModelRunner:
             def _sp_step(params, k_cache, v_cache, tokens, page_table,
                          valid, last_index, temperature, top_p, top_k,
                          rng, lora, lora_ids, penalties, seeding,
-                         bias, suppress, want_logprobs=False):
+                         bias, suppress, fsm, want_logprobs=False):
                 row_logits, k_cache, v_cache = sp_prefill_forward(
                     params, self.config.model, tokens, page_table,
                     valid, last_index, k_cache, v_cache,
@@ -389,6 +389,9 @@ class ModelRunner:
                 if suppress is not None:
                     row_logits = ModelRunner._apply_suppression(
                         row_logits, suppress)
+                if fsm is not None:
+                    row_logits = self._apply_guided_mask(
+                        row_logits, fsm)
                 seeds, seed_on, emitted = (
                     seeding if seeding is not None
                     else (None, None, None))
@@ -519,6 +522,16 @@ class ModelRunner:
                     "serves via XLA attention: %s", name.upper(), err)
             setattr(model_config, f"attention_impl_{name}", impl)
 
+    def set_guided_tables(self, fsm) -> None:
+        """Device copies of the guided-decoding automaton tables
+        (engine/guided.py). Uploaded once at engine init; the
+        sampling steps gather mask[state] rows and advance
+        state = transition[state, token] inside the compiled
+        program (burst carry), so constrained rows run at full
+        burst speed."""
+        self._guided_trans = jnp.asarray(fsm.transition)
+        self._guided_mask = jnp.asarray(fsm.mask)
+
     @property
     def _lora_stack(self):
         return (None if self.lora_registry is None
@@ -529,7 +542,8 @@ class ModelRunner:
     def _step_impl(self, params, k_cache, v_cache, tokens, positions,
                    page_table, kv_lens, valid, last_index, temperature,
                    top_p, top_k, rng, lora, lora_ids, penalties,
-                   seeding, bias, suppress, sample_index_mode: str,
+                   seeding, bias, suppress, fsm,
+                   sample_index_mode: str,
                    want_logprobs: bool = False):
         logits, k_cache, v_cache = self._forward(
             params, self.config.model, tokens, positions, page_table,
@@ -556,6 +570,11 @@ class ModelRunner:
             # min_tokens: stops cannot be generated while under the
             # row's minimum (vLLM semantics; logprobs stay raw).
             row_logits = self._apply_suppression(row_logits, suppress)
+        if fsm is not None:
+            # Guided decoding: the automaton masks last (the
+            # grammar wins); host advances the state (one token
+            # per dispatch on this path).
+            row_logits = self._apply_guided_mask(row_logits, fsm)
         seeds, seed_on, emitted = (
             seeding if seeding is not None else (None, None, None))
         sampled = sample_tokens(row_logits, temperature, top_p, top_k,
@@ -574,7 +593,8 @@ class ModelRunner:
                            positions, page_table, kv_lens, active,
                            budgets, stop_tokens, temperature, top_p,
                            top_k, rng, lora, lora_ids, penalties,
-                           seeding, bias, suppress, num_steps: int,
+                           seeding, bias, suppress, fsm,
+                           num_steps: int,
                            want_logprobs: bool = False):
         """K chained decode iterations in one program, with per-row
         lifecycle on device.
@@ -612,26 +632,28 @@ class ModelRunner:
         sample_step = self._burst_sample_step(
             b, penalties, seeding, bias, suppress, temperature,
             top_p, top_k, stop_tokens, budgets, want_logprobs)
+        fsm0 = (jnp.zeros((0,), jnp.int32) if fsm is None else fsm)
 
         def body(carry, step_rng):
-            tok, pos, kv, act, emitted, counts, kc, vc = carry
+            tok, pos, kv, act, emitted, counts, fs, kc, vc = carry
             logits, kc, vc = self._forward(
                 params, self.config.model, tok, pos, page_table,
                 kv, act[:, None], kc, vc, lora=lora,
                 lora_ids=lora_ids,
             )
-            out, sampled, emitted, counts, act_next = sample_step(
-                logits, step_rng, act, emitted, counts)
+            out, sampled, emitted, counts, act_next, fs = \
+                sample_step(logits, step_rng, act, emitted, counts,
+                            fs)
             step = act_next.astype(pos.dtype)
             return ((jnp.where(act, sampled, tok[:, 0])[:, None],
                      pos + step[:, None], kv + step, act_next,
-                     emitted, counts, kc, vc), out)
+                     emitted, counts, fs, kc, vc), out)
 
         rngs = jax.random.split(rng, num_steps)
         emitted0 = jnp.zeros(active.shape, jnp.int32)
         carry = (tokens, positions, kv_lens, active, emitted0,
-                 counts0, k_cache, v_cache)
-        (_, _, _, _, _, _, k_cache, v_cache), out = jax.lax.scan(
+                 counts0, fsm0, k_cache, v_cache)
+        (_, _, _, _, _, _, _, k_cache, v_cache), out = jax.lax.scan(
             body, carry, rngs
         )
         return out, k_cache, v_cache
@@ -639,12 +661,15 @@ class ModelRunner:
     def _burst_sample_step(self, b, penalties, seeding, bias,
                            suppress, temperature, top_p, top_k,
                            stop_tokens, budgets, want_logprobs):
+        # ``fsm`` rides the burst carry: a zero-size placeholder
+        # means unguided (compiled without the table gathers).
         """The burst bodies' shared logits -> (out, lifecycle) step:
         penalties, (seeded) sampling, logprobs, occurrence counts,
         stop/budget freeze. One definition so the eager and deferred
         KV-write bursts cannot drift apart in sampling semantics."""
 
-        def sample_step(logits, step_rng, act, emitted, counts):
+        def sample_step(logits, step_rng, act, emitted, counts,
+                        fsm):
             row_logits = logits[:, 0, :]
             raw_logits = row_logits
             if penalties is not None:
@@ -662,6 +687,10 @@ class ModelRunner:
                 # payload-time remainder).
                 row_logits = self._apply_suppression(
                     row_logits, suppress, emitted=emitted)
+            if fsm.shape[0]:
+                # Guided decoding: the automaton masks last.
+                row_logits = self._apply_guided_mask(row_logits,
+                                                     fsm)
             if seeding is not None:
                 # Seeded rows' randomness depends only on (seed,
                 # absolute emitted index), so reproducibility survives
@@ -689,7 +718,16 @@ class ModelRunner:
                 sampled[:, None] == stop_tokens, axis=-1
             )
             act_next = act & ~hit_stop & (emitted < budgets)
-            return out, sampled, emitted, counts, act_next
+            if fsm.shape[0]:
+                # Constrained rows can only have sampled an in-table
+                # id (the mask forbids the rest); the clip keeps the
+                # gather in-bounds for unconstrained rows, whose fsm
+                # stays -1 via the where.
+                width = self._guided_trans.shape[1]
+                nxt = self._guided_trans[
+                    jnp.clip(fsm, 0), jnp.clip(sampled, 0, width - 1)]
+                fsm = jnp.where(act & (fsm >= 0), nxt, fsm)
+            return out, sampled, emitted, counts, act_next, fsm
 
         return sample_step
 
@@ -699,7 +737,7 @@ class ModelRunner:
                                     stop_tokens, temperature, top_p,
                                     top_k, rng, lora, lora_ids,
                                     penalties, seeding, bias,
-                                    suppress, num_steps: int,
+                                    suppress, fsm, num_steps: int,
                                     want_logprobs: bool = False):
         """_decode_burst_impl with per-burst (not per-step) KV writes.
 
@@ -736,26 +774,28 @@ class ModelRunner:
         sample_step = self._burst_sample_step(
             b, penalties, seeding, bias, suppress, temperature,
             top_p, top_k, stop_tokens, budgets, want_logprobs)
+        fsm0 = (jnp.zeros((0,), jnp.int32) if fsm is None else fsm)
 
         def body(carry, step_rng):
-            tok, pos, act, emitted, counts, kt, vt = carry
+            tok, pos, act, emitted, counts, fs, kt, vt = carry
             logits, kt, vt = self._forward(
                 params, m, tok, pos, page_table, kv_lens0,
                 act[:, None], k_cache, v_cache, lora=lora,
                 lora_ids=lora_ids, kv_tail=(kt, vt),
             )
-            out, sampled, emitted, counts, act_next = sample_step(
-                logits, step_rng, act, emitted, counts)
+            out, sampled, emitted, counts, act_next, fs = \
+                sample_step(logits, step_rng, act, emitted, counts,
+                            fs)
             step = act_next.astype(pos.dtype)
             return ((jnp.where(act, sampled, tok[:, 0])[:, None],
                      pos + step[:, None], act_next, emitted, counts,
-                     kt, vt), out)
+                     fs, kt, vt), out)
 
         rngs = jax.random.split(rng, num_steps)
         emitted0 = jnp.zeros(active.shape, jnp.int32)
-        carry = (tokens, positions, active, emitted0, counts0,
+        carry = (tokens, positions, active, emitted0, counts0, fsm0,
                  k_tails0, v_tails0)
-        (_, _, _, emitted, _, k_tails, v_tails), out = jax.lax.scan(
+        (_, _, _, emitted, _, _, k_tails, v_tails), out = jax.lax.scan(
             body, carry, rngs
         )
 
@@ -812,7 +852,7 @@ class ModelRunner:
         lora_ids = payload.get("lora_ids")
         lora_ids = (None if lora_ids is None
                     else jnp.asarray(lora_ids))
-        penalties, seeding, bias, suppress = \
+        penalties, seeding, bias, suppress, fsm = \
             self._optional_device_inputs(payload)
         want_lp = bool(payload.get("want_logprobs", False))
         if kind == 2 and t > 1:
@@ -831,7 +871,8 @@ class ModelRunner:
                     jnp.asarray(payload["top_k"]),
                     jnp.asarray(payload["rng"]),
                     self._lora_stack, lora_ids, penalties, seeding,
-                    bias, suppress, num_steps=t, want_logprobs=want_lp,
+                    bias, suppress, fsm,
+                    num_steps=t, want_logprobs=want_lp,
                 )
             return sampled  # [K, B] (+ logprob arrays when requested)
         sampled, self.k_cache, self.v_cache = self._step_jit(
@@ -847,7 +888,7 @@ class ModelRunner:
             jnp.asarray(payload["top_k"]),
             jnp.asarray(payload["rng"]),
             self._lora_stack, lora_ids, penalties, seeding, bias,
-            suppress,
+            suppress, fsm,
             sample_index_mode=("last" if kind == 1 else "first"),
             want_logprobs=want_lp,
         )
@@ -914,7 +955,7 @@ class ModelRunner:
                 # seeds, e.g. 1 and 0x80000001).
                 seeds[i] = int(seq.sampling.seed) & 0xFFFFFFFF
                 seed_on[i] = True
-            emitted[i] = len(seq.output_token_ids)
+            emitted[i] = seq.num_generated
         return {"seed_rows": seeds.view(np.int32),
                 "seed_on": seed_on,
                 "seed_emitted": emitted}
@@ -964,7 +1005,7 @@ class ModelRunner:
         minimum; ids beyond the fixed width are protected by the host
         finish guard (scheduler._append_token) instead."""
         if not any(s is not None
-                   and s.sampling.min_tokens > len(s.output_token_ids)
+                   and s.sampling.min_tokens > s.num_generated
                    for s in seqs):
             return {}
         ids = np.full((pad_to, STOP_SET_WIDTH), -1, np.int32)
@@ -972,7 +1013,7 @@ class ModelRunner:
         for i, seq in enumerate(seqs):
             if seq is None:
                 continue
-            r = seq.sampling.min_tokens - len(seq.output_token_ids)
+            r = seq.sampling.min_tokens - seq.num_generated
             if r <= 0:
                 continue
             rem[i] = r
@@ -994,10 +1035,42 @@ class ModelRunner:
         return row_logits.at[
             jnp.arange(b)[:, None], jnp.clip(ids, 0)].add(pen)
 
+    def _guided_payload(self, seqs: "List[Optional[Sequence]]",
+                        pad_to: int) -> dict:
+        """Per-row automaton states ([B] int32, -1 = unconstrained),
+        or {} when no row is guided (unguided batches keep their
+        table-free compiled program)."""
+        if not any(s is not None and s.fsm_state is not None
+                   for s in seqs):
+            return {}
+        state = np.full((pad_to,), -1, np.int32)
+        for i, seq in enumerate(seqs):
+            if seq is not None and seq.fsm_state is not None:
+                state[i] = seq.fsm_state
+        return {"fsm_state": state}
+
+    def _apply_guided_mask(self, row_logits, fsm):
+        """-inf every token the automaton disallows from each
+        constrained row's state (applied LAST — the grammar wins
+        over bias and penalties). The tables stop at the byte+special
+        width (guided.py TABLE_WIDTH); every id beyond it is
+        inadmissible for constrained rows, so the gathered rows pad
+        with False up to the vocab."""
+        constrained = fsm >= 0
+        st = jnp.clip(fsm, 0)
+        allowed = self._guided_mask[st]  # [B, table_width] bool
+        pad = row_logits.shape[-1] - allowed.shape[-1]
+        if pad > 0:
+            allowed = jnp.pad(allowed, ((0, 0), (0, pad)),
+                              constant_values=False)
+        return jnp.where(constrained[:, None] & ~allowed, -1e30,
+                         row_logits)
+
     @staticmethod
     def _optional_device_inputs(payload: dict):
-        """(penalties, seeding, bias, suppress) device inputs from a
-        step payload; each is None when its keys are absent."""
+        """(penalties, seeding, bias, suppress, fsm) device inputs
+        from a step payload; each is None when its keys are
+        absent."""
         penalties = None
         if "pen_prompt_mask" in payload:
             penalties = (
@@ -1017,7 +1090,9 @@ class ModelRunner:
         suppress = ((jnp.asarray(payload["sup_ids"]),
                      jnp.asarray(payload["sup_rem"]))
                     if "sup_ids" in payload else None)
-        return penalties, seeding, bias, suppress
+        fsm = (jnp.asarray(payload["fsm_state"])
+               if "fsm_state" in payload else None)
+        return penalties, seeding, bias, suppress, fsm
 
     def _dispatch(self, kind: int, t: int, payload: dict) -> jax.Array:
         if self.bridge is not None:
@@ -1059,7 +1134,8 @@ class ModelRunner:
         opt.update(self._seed_payload([seq], 1))
         opt.update(self._bias_payload([seq], 1))
         opt.update(self._suppress_payload([seq], 1))
-        penalties, seeding, bias, suppress = \
+        opt.update(self._guided_payload([seq], 1))
+        penalties, seeding, bias, suppress, fsm = \
             self._optional_device_inputs(opt)
         want_lp = sp_params.logprobs
         lora_ids = (None if self.lora_registry is None
@@ -1076,7 +1152,7 @@ class ModelRunner:
             jnp.asarray(np.asarray([sp_params.top_p], np.float32)),
             jnp.asarray(np.asarray([sp_params.top_k], np.int32)),
             self._next_rng(), self._lora_stack, lora_ids,
-            penalties, seeding, bias, suppress,
+            penalties, seeding, bias, suppress, fsm,
             want_logprobs=want_lp,
         )
         host = jax.device_get(sampled)
@@ -1151,6 +1227,7 @@ class ModelRunner:
         payload.update(self._seed_payload(sampling_rows, b))
         payload.update(self._bias_payload(sampling_rows, b))
         payload.update(self._suppress_payload(sampling_rows, b))
+        payload.update(self._guided_payload(sampling_rows, b))
         want_lp = any(s is not None and s.sampling.logprobs
                       for s in sampling_rows)
         if want_lp:
@@ -1252,6 +1329,7 @@ class ModelRunner:
         payload.update(self._seed_payload(seqs, b))
         payload.update(self._bias_payload(seqs, b))
         payload.update(self._suppress_payload(seqs, b))
+        payload.update(self._guided_payload(seqs, b))
         want_lp = any(s.sampling.logprobs for s in seqs)
         if want_lp:
             payload["want_logprobs"] = True

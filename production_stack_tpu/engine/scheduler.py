@@ -83,7 +83,12 @@ class StepPlan:
 class Scheduler:
     def __init__(self, config: SchedulerConfig, cache_config: CacheConfig,
                  cache_manager: PagedCacheManager,
-                 sp_threshold: Optional[int] = None):
+                 sp_threshold: Optional[int] = None,
+                 guided_advance=None):
+        # Optional hook(seq, token) advancing a guided-decoding
+        # automaton state as tokens are appended (engine/guided.py;
+        # the engine binds it so host state mirrors the device carry).
+        self.guided_advance = guided_advance
         self.config = config
         self.page_size = cache_config.page_size
         self.cache = cache_manager
@@ -340,6 +345,12 @@ class Scheduler:
         seq.pages = []
         seq.num_hashed_pages = 0
         # Recompute everything including generated tokens as "prompt".
+        # num_prior_output_tokens keeps every generated-so-far budget
+        # (max_tokens, min_tokens, seeded emitted index) counting
+        # across the fold; presence/frequency penalty counts restart
+        # (the folded tokens move to the repetition-penalty prompt
+        # mask instead — a documented approximation under preemption).
+        seq.num_prior_output_tokens += len(seq.output_token_ids)
         seq.prompt_token_ids = seq.all_token_ids
         seq.output_token_ids = []
         seq.num_computed_tokens = 0
@@ -384,18 +395,19 @@ class Scheduler:
 
     def _append_token(self, seq: Sequence, token: int) -> None:
         seq.output_token_ids.append(token)
+        if self.guided_advance is not None and seq.fsm_state is not None:
+            self.guided_advance(seq, token)
         stop_ids = seq.sampling.stop_token_ids
         # min_tokens: the device suppresses stop ids while under the
         # minimum (model_runner._suppress_payload), but only up to
         # STOP_SET_WIDTH of them — a wider set's overflow could still
         # be sampled, and must not end the sequence early.
-        past_min = (len(seq.output_token_ids)
-                    > seq.sampling.min_tokens)
+        past_min = seq.num_generated > seq.sampling.min_tokens
         if (not seq.sampling.ignore_eos and token in stop_ids
                 and past_min):
             self._finish(seq, FinishReason.STOP)
             self.running.remove(seq)
-        elif len(seq.output_token_ids) >= seq.sampling.max_tokens:
+        elif seq.num_generated >= seq.sampling.max_tokens:
             self._finish(seq, FinishReason.LENGTH)
             self.running.remove(seq)
         elif seq.total_len >= self.config.max_model_len:

@@ -47,6 +47,10 @@ class SamplingParams:
     # (model_runner._suppress_payload), matching vLLM's semantics
     # (text-level stop strings are not gated, as in vLLM).
     min_tokens: int = 0
+    # OpenAI ``response_format``: "json" = guided JSON decoding via
+    # the byte-level automaton (engine/guided.py); the device masks
+    # inadmissible tokens inside the sampling step. None = free text.
+    guided: Optional[str] = None
 
     @property
     def greedy(self) -> bool:
@@ -100,6 +104,18 @@ class Sequence:
     cache_salt: int = 0
     # Server-side stream hook (asyncio queue or callable), opaque here.
     output_sink: Any = None
+    # Guided-decoding automaton state (engine/guided.py); None for
+    # unconstrained rows. Host-side mirror of the device carry.
+    fsm_state: Optional[int] = None
+    # Generated tokens folded back into the prompt by preemption
+    # (scheduler._preempt): every "tokens generated so far" budget
+    # (max_tokens, min_tokens, seeded-sampling emitted index) must
+    # count these or a preempted sequence restarts its windows.
+    num_prior_output_tokens: int = 0
+
+    @property
+    def num_generated(self) -> int:
+        return self.num_prior_output_tokens + len(self.output_token_ids)
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -128,6 +144,6 @@ def decode_budget(seq: "Sequence", max_model_len: int) -> int:
     the device decode burst (model_runner._decode_burst_impl) must all
     agree on this number or the burst could write past its pages."""
     return min(
-        seq.sampling.max_tokens - len(seq.output_token_ids),
+        seq.sampling.max_tokens - seq.num_generated,
         max_model_len - seq.total_len,
     )

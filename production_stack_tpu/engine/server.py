@@ -224,9 +224,28 @@ def _sampling_from_body(body: dict, max_model_len: int,
         top_logprobs=lp_top,
         logit_bias=logit_bias,
         min_tokens=int(body.get("min_tokens") or 0),
+        guided=_guided_from_body(body),
     )
     _validate_sampling(params)
     return params
+
+
+def _guided_from_body(body: dict) -> "str | None":
+    """OpenAI ``response_format`` -> guided mode ('json' or None)."""
+    rf = body.get("response_format")
+    if rf is None:
+        return None
+    if not isinstance(rf, dict) or "type" not in rf:
+        raise ValueError(
+            "response_format must be an object with a 'type' field")
+    kind = rf["type"]
+    if kind == "text":
+        return None
+    if kind == "json_object":
+        return "json"
+    raise ValueError(
+        f"unsupported response_format type {kind!r} "
+        "(supported: 'text', 'json_object')")
 
 
 def _validate_sampling(p: SamplingParams) -> None:

@@ -79,6 +79,7 @@ class MultihostStepBridge:
     FLAG_LOGPROBS = 4
     FLAG_BIAS = 8
     FLAG_SUPPRESS = 16
+    FLAG_GUIDED = 32
 
     def __init__(self, runner):
         self.runner = runner
@@ -154,6 +155,11 @@ class MultihostStepBridge:
             template["sup_ids"] = np.zeros(
                 (b, STOP_SET_WIDTH), np.int32)
             template["sup_rem"] = np.zeros((b,), np.int32)
+        if flags & self.FLAG_GUIDED:
+            # Workers hold identical automaton tables (built eagerly
+            # at engine init — engine.py); only the per-row states
+            # ride the broadcast.
+            template["fsm_state"] = np.zeros((b,), np.int32)
         return template
 
     # -- host 0 --------------------------------------------------------------
@@ -172,6 +178,8 @@ class MultihostStepBridge:
             flags |= self.FLAG_BIAS
         if "sup_ids" in payload:
             flags |= self.FLAG_SUPPRESS
+        if "fsm_state" in payload:
+            flags |= self.FLAG_GUIDED
         header = np.asarray([kind, t, flags], np.int32)
         multihost_utils.broadcast_one_to_all(header)
         if kind != KIND_SHUTDOWN:

@@ -65,6 +65,7 @@ _SLOW_MODULES = {
     "test_e2e_router_engine",
     "test_embeddings",
     "test_engine_server",
+    "test_guided_json",
     "test_kv_offload",
     "test_logit_bias",
     "test_lora",

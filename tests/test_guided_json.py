@@ -1,0 +1,131 @@
+"""Guided JSON decoding (OpenAI ``response_format: json_object``):
+the byte-level automaton (engine/guided.py) masks inadmissible tokens
+inside the sampling step — on device, in the decode-burst scan carry —
+so even a RANDOM-weight model emits structurally valid JSON."""
+
+import json
+
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+
+
+def _engine(decode_steps=1, deferred=False):
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=128),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=256,
+                                  prefill_chunk_size=32,
+                                  decode_steps=decode_steps,
+                                  deferred_kv_writes=deferred),
+    ))
+
+
+PROMPT = list(range(5, 25))
+
+
+def _json_of(seq, engine) -> str:
+    # Keep only byte-range ids (the automaton forbids everything
+    # else anyway except EOS, which the stop set consumes).
+    return bytes(t for t in seq.output_token_ids if t < 256).decode(
+        "utf-8", "replace")
+
+
+def _gen(engine, **kw):
+    sampling = dict(max_tokens=120, temperature=0.8, seed=7,
+                    guided="json")
+    sampling.update(kw)
+    return engine.generate(PROMPT, SamplingParams(**sampling))
+
+
+def test_random_weights_emit_valid_json():
+    engine = _engine()
+    seq = _gen(engine)
+    text = _json_of(seq, engine)
+    if seq.finish_reason is not None and seq.finish_reason.value == "stop":
+        parsed = json.loads(text)  # structurally valid, starts as object
+        assert isinstance(parsed, dict)
+    else:
+        # Budget ran out mid-document: every prefix must still be a
+        # valid JSON prefix — re-walk it through the automaton.
+        fsm = engine.guided_fsm
+        s = 0
+        for t in seq.output_token_ids:
+            s = fsm.advance(s, t)
+            assert s >= 0
+
+
+def test_guided_parity_across_decode_paths():
+    ref = _gen(_engine()).output_token_ids
+    burst = _gen(_engine(decode_steps=4)).output_token_ids
+    deferred = _gen(_engine(decode_steps=4,
+                            deferred=True)).output_token_ids
+    assert burst == ref
+    assert deferred == ref
+
+
+def test_guided_and_free_rows_coexist():
+    """A guided row must not constrain (or be corrupted by) a free
+    row in the same batch."""
+    engine = _engine(decode_steps=4)
+    free_ref = engine.generate(PROMPT, SamplingParams(
+        max_tokens=12, temperature=0.0,
+        ignore_eos=True)).output_token_ids
+
+    engine2 = _engine(decode_steps=4)
+    seqs = []
+    for kw in (dict(max_tokens=12, temperature=0.0, ignore_eos=True),
+               dict(max_tokens=120, temperature=0.8, seed=7,
+                    guided="json")):
+        sid = engine2.add_request(PROMPT, SamplingParams(**kw))
+        seqs.append(engine2.sequences[sid])
+    while engine2.has_work():
+        engine2.step()
+    free, guided = seqs
+    assert free.output_token_ids == free_ref
+    fsm = engine2.guided_fsm
+    s = 0
+    for t in guided.output_token_ids:
+        s = fsm.advance(s, t)
+        assert s >= 0
+
+
+def test_greedy_guided_deterministic_and_valid():
+    a = _gen(_engine(decode_steps=4), temperature=0.0, seed=None)
+    b = _gen(_engine(decode_steps=4), temperature=0.0, seed=None)
+    assert a.output_token_ids == b.output_token_ids
+    fsm_state = 0
+    fsm = _engine().guided_fsm
+    for t in a.output_token_ids:
+        fsm_state = fsm.advance(fsm_state, t)
+        assert fsm_state >= 0
+
+
+def test_server_response_format_parsing():
+    from production_stack_tpu.engine.server import _sampling_from_body
+
+    assert _sampling_from_body(
+        {"response_format": {"type": "json_object"}}, 256
+    ).guided == "json"
+    assert _sampling_from_body(
+        {"response_format": {"type": "text"}}, 256).guided is None
+    assert _sampling_from_body({}, 256).guided is None
+    with pytest.raises(ValueError, match="unsupported response_format"):
+        _sampling_from_body(
+            {"response_format": {"type": "json_schema"}}, 256)
+    with pytest.raises(ValueError, match="must be an object"):
+        _sampling_from_body({"response_format": "json_object"}, 256)
+
+
+def test_guided_rejected_without_byte_tokenizer():
+    engine = _engine()
+    engine.guided_fsm = None  # simulate an HF-tokenizer engine
+    with pytest.raises(ValueError, match="byte-range tokenizer"):
+        engine.add_request(PROMPT, SamplingParams(guided="json"))

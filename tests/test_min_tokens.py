@@ -79,6 +79,41 @@ def test_min_tokens_then_stop_naturally():
         assert out[-1] == stop  # finished BY the stop, post-minimum
 
 
+def test_preemption_preserves_generation_budgets():
+    """KV-pressure preemption folds generated tokens back into the
+    prompt (scheduler._preempt); num_prior_output_tokens must keep
+    the max_tokens budget counting across the fold — a preempted
+    sequence must NOT restart its generation window (and by the same
+    counter, min_tokens and the seeded emitted index survive too)."""
+    from production_stack_tpu.engine.config import EngineConfig
+
+    engine = LLMEngine(EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=12,
+                          enable_prefix_caching=False),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
+                                  prefill_chunk_size=32,
+                                  decode_steps=4),
+    ))
+    seqs = []
+    for i in range(2):
+        sid = engine.add_request(
+            list(range(2, 42 + i)),
+            SamplingParams(max_tokens=48, temperature=0.0,
+                           ignore_eos=True))
+        seqs.append(engine.sequences[sid])
+    while engine.has_work():
+        engine.step()
+    assert engine.scheduler.num_preemptions >= 1, (
+        "test setup no longer forces preemption — shrink the cache")
+    finished = [s for s in seqs if s.finish_reason is not None
+                and s.finish_reason.value == "length"]
+    assert finished, "no sequence ran to its max_tokens budget"
+    for s in finished:
+        assert s.num_generated == 48, (
+            s.num_generated, s.num_prior_output_tokens)
+
+
 def test_min_tokens_validation():
     from production_stack_tpu.engine.server import _sampling_from_body
 

@@ -132,6 +132,8 @@ def test_bridge_template_matches_real_payloads():
             flags |= bridge.FLAG_BIAS
         if "sup_ids" in payload:
             flags |= bridge.FLAG_SUPPRESS
+        if "fsm_state" in payload:
+            flags |= bridge.FLAG_GUIDED
         arrays = {k: v for k, v in payload.items()
                   if k != "want_logprobs"}
         published.append((kind, t, flags, arrays))
@@ -142,7 +144,7 @@ def test_bridge_template_matches_real_payloads():
     engine.generate(list(range(1, 40)), SamplingParams(
         max_tokens=6, temperature=0.7, seed=7,
         presence_penalty=0.5, logprobs=True, top_logprobs=2,
-        logit_bias={9: -1.5}, min_tokens=4,
+        logit_bias={9: -1.5}, min_tokens=4, guided="json",
     ))
 
     assert published, "bridge.publish never called"

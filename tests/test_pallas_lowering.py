@@ -161,6 +161,7 @@ def test_decode_burst_program_lowers_for_tpu():
         jnp.zeros((b,), jnp.int32), jax.random.PRNGKey(0),
         None, None,   # lora, lora_ids
         None, None,   # penalties, seeding
+        None, None, None,  # bias, suppress, fsm
     )
     traced = jax.jit(
         runner._decode_burst_impl, static_argnames=("num_steps",)
