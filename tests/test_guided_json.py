@@ -108,6 +108,89 @@ def test_greedy_guided_deterministic_and_valid():
         assert fsm_state >= 0
 
 
+def test_automaton_accepts_all_json_dumps_output():
+    """Round-trip fuzz: every document the stdlib can produce (random
+    nested structures up to the automaton's depth cap, ASCII and raw
+    unicode) must walk the automaton byte-for-byte to DONE; one level
+    PAST the cap must be rejected (depth-limiting is mask-enforced,
+    not a crash)."""
+    import numpy as np
+
+    fsm = _engine().guided_fsm
+    max_depth = fsm.max_depth
+    rng = np.random.RandomState(0)
+
+    def rand_value(depth):
+        # Containers allowed right up to the cap: the top-level object
+        # is stack depth 1, so depth < max_depth exercises stacks of
+        # every legal size including max_depth itself.
+        kind = rng.randint(0, 7 if depth < max_depth else 5)
+        if kind == 0:
+            return rng.randint(-10**9, 10**9)
+        if kind == 1:
+            return float(rng.randn()) * 10.0 ** rng.randint(-8, 8)
+        if kind == 2:
+            return bool(rng.randint(2))
+        if kind == 3:
+            return None
+        if kind == 4:
+            chars = [chr(rng.randint(32, 127)) for _ in range(
+                rng.randint(0, 12))]
+            if rng.randint(2):
+                chars.append("é€\n\t\"\\")
+            return "".join(chars)
+        if kind == 5:
+            return [rand_value(depth + 1)
+                    for _ in range(rng.randint(0, 4))]
+        return {f"k{i}": rand_value(depth + 1)
+                for i in range(rng.randint(0, 4))}
+
+    deepest_seen = 0
+    for ensure_ascii in (True, False):
+        for trial in range(60):
+            doc = {f"k{i}": rand_value(1)
+                   for i in range(rng.randint(0, 5))}
+            text = json.dumps(doc, ensure_ascii=ensure_ascii)
+            depth = d = 0
+            in_str = esc = False
+            for ch in text:
+                if esc:
+                    esc = False
+                elif in_str:
+                    if ch == "\\":
+                        esc = True
+                    elif ch == '"':
+                        in_str = False
+                elif ch == '"':
+                    in_str = True
+                elif ch in "{[":
+                    d += 1
+                    depth = max(depth, d)
+                elif ch in "}]":
+                    d -= 1
+            deepest_seen = max(deepest_seen, depth)
+            s = 0
+            for b in text.encode("utf-8"):
+                ns = fsm.advance(s, b)
+                assert ns >= 0, (text, chr(b) if b < 128 else b, s)
+                s = ns
+            assert fsm.mask[s, fsm.eos_token_id], text
+    assert deepest_seen == max_depth, (
+        f"fuzz never reached the cap (deepest {deepest_seen})")
+
+    # One level PAST the cap: rejected at the opening bracket.
+    over = '{"a": ' + "[" * max_depth
+    s = 0
+    for i, b in enumerate(over.encode()):
+        ns = fsm.advance(s, b)
+        if ns < 0:
+            assert chr(b) == "[" and i == len(over) - 1
+            break
+        s = ns
+    else:
+        raise AssertionError("over-depth document was accepted")
+
+
 def test_server_response_format_parsing():
     from production_stack_tpu.engine.server import _sampling_from_body
 
