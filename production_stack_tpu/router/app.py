@@ -35,6 +35,11 @@ from production_stack_tpu.router.experimental.feature_gates import (
     initialize_feature_gates,
 )
 from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.router.resilience import (
+    ResilienceConfig,
+    get_resilience,
+    initialize_resilience,
+)
 from production_stack_tpu.router.routing.logic import (
     initialize_routing_logic,
 )
@@ -138,6 +143,22 @@ async def health(request: web.Request) -> web.Response:
             {"status": "Engine stats scraper is down."}, status=503
         )
     body = {"status": "healthy"}
+    mgr = get_resilience()
+    if mgr is not None:
+        endpoints = discovery.get_endpoint_info(include_unhealthy=True)
+        available = [
+            ep.url for ep in endpoints
+            if mgr.endpoint_available(ep.url)
+        ]
+        open_breakers = [
+            url for url, br in mgr.breaker_snapshot().items()
+            if int(br.state) != 0
+        ]
+        body["resilience"] = {
+            "endpoints_total": len(endpoints),
+            "endpoints_available": len(available),
+            "tripped_breakers": sorted(open_breakers),
+        }
     watcher = get_dynamic_config_watcher()
     if watcher is not None:
         config = watcher.get_current_config()
@@ -299,6 +320,20 @@ def initialize_all(app: web.Application, args) -> None:
             "k8s", namespace=args.k8s_namespace, port=args.k8s_port,
             label_selector=args.k8s_label_selector,
         )
+    initialize_resilience(ResilienceConfig(
+        max_retries=args.max_retries,
+        backend_connect_timeout=args.backend_connect_timeout,
+        backend_timeout=args.backend_timeout,
+        health_check_interval=args.health_check_interval,
+        health_check_timeout=args.health_check_timeout,
+        health_failure_threshold=args.health_failure_threshold,
+        health_success_threshold=args.health_success_threshold,
+        breaker_window=args.breaker_window,
+        breaker_min_volume=args.breaker_min_volume,
+        breaker_failure_rate=args.breaker_failure_rate,
+        breaker_open_base_s=args.breaker_open_seconds,
+        breaker_open_max_s=args.breaker_max_open_seconds,
+    ))
     initialize_engine_stats_scraper(args.engine_stats_interval)
     initialize_request_stats_monitor(args.request_stats_window)
     initialize_routing_logic(args.routing_logic,
@@ -326,10 +361,17 @@ def build_app(args=None) -> web.Application:
     app = web.Application(client_max_size=1024 ** 3)
 
     async def on_startup(app: web.Application):
+        mgr = get_resilience()
+        session_timeout = (
+            mgr.config.client_timeout() if mgr is not None
+            else aiohttp.ClientTimeout(total=None, sock_connect=30)
+        )
         app["backend_session"] = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
+            timeout=session_timeout,
             connector=aiohttp.TCPConnector(limit=0),
         )
+        if mgr is not None:
+            await mgr.start()
         if app.get("enable_batch_api"):
             processor = initialize_batch_processor(
                 app.get("batch_processor_kind", "local"),
@@ -339,6 +381,9 @@ def build_app(args=None) -> web.Application:
             app["batch_processor"] = processor
 
     async def on_cleanup(app: web.Application):
+        mgr = get_resilience()
+        if mgr is not None:
+            await mgr.stop()
         processor = app.get("batch_processor")
         if processor is not None:
             await processor.close()

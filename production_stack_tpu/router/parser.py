@@ -43,6 +43,55 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="Header key for session-sticky routing",
     )
 
+    # Resilience: retry-with-failover, backend timeouts, active health
+    # checking, circuit breaking (router/resilience.py; docs/resilience.md).
+    parser.add_argument(
+        "--max-retries", type=int, default=2,
+        help="Extra endpoints to try after a pre-first-byte failure "
+             "(0 disables failover)",
+    )
+    parser.add_argument(
+        "--backend-connect-timeout", type=float, default=30.0,
+        help="Seconds to establish a backend connection (0 = unbounded)",
+    )
+    parser.add_argument(
+        "--backend-timeout", type=float, default=600.0,
+        help="Total seconds for one backend request incl. streaming "
+             "(0 = unbounded)",
+    )
+    parser.add_argument(
+        "--health-check-interval", type=float, default=10.0,
+        help="Seconds between active /health probes of every endpoint "
+             "(0 disables active health checking)",
+    )
+    parser.add_argument("--health-check-timeout", type=float, default=2.0)
+    parser.add_argument(
+        "--health-failure-threshold", type=int, default=3,
+        help="Consecutive failed probes before an endpoint leaves rotation",
+    )
+    parser.add_argument(
+        "--health-success-threshold", type=int, default=1,
+        help="Consecutive successful probes before it returns",
+    )
+    parser.add_argument(
+        "--breaker-failure-rate", type=float, default=0.5,
+        help="Failure fraction over the outcome window that opens an "
+             "endpoint's circuit breaker",
+    )
+    parser.add_argument(
+        "--breaker-min-volume", type=int, default=3,
+        help="Minimum outcomes in the window before the breaker may open",
+    )
+    parser.add_argument("--breaker-window", type=int, default=20)
+    parser.add_argument(
+        "--breaker-open-seconds", type=float, default=2.0,
+        help="Base open duration before a half-open probe; doubles per "
+             "consecutive open (jittered, capped by "
+             "--breaker-max-open-seconds)",
+    )
+    parser.add_argument("--breaker-max-open-seconds", type=float,
+                        default=60.0)
+
     parser.add_argument("--engine-stats-interval", type=float, default=30.0)
     parser.add_argument("--request-stats-window", type=float, default=60.0)
     parser.add_argument("--log-stats", action="store_true")
@@ -102,3 +151,12 @@ def validate_args(args: argparse.Namespace) -> None:
             )
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("--session-key is required with session routing")
+    if args.max_retries < 0:
+        raise ValueError("--max-retries must be >= 0")
+    for name in ("backend_connect_timeout", "backend_timeout",
+                 "health_check_interval", "health_check_timeout",
+                 "breaker_open_seconds", "breaker_max_open_seconds"):
+        if getattr(args, name) < 0:
+            raise ValueError(f"--{name.replace('_', '-')} must be >= 0")
+    if not 0.0 < args.breaker_failure_rate <= 1.0:
+        raise ValueError("--breaker-failure-rate must be in (0, 1]")

@@ -1,0 +1,425 @@
+"""Router resilience layer: circuit breakers, active health checking,
+and the retry/timeout budget the proxy hot path consults.
+
+The reference production-stack keeps its OpenAI front door up while
+engine pods churn; this module is where that property lives in this
+stack. Three cooperating pieces, all endpoint-scoped:
+
+- ``CircuitBreaker``: closed -> open -> half-open per endpoint URL.
+  Opens when the failure rate over a sliding outcome window crosses a
+  threshold, stays open for an exponentially growing (jittered) backoff,
+  then admits a single half-open probe request whose outcome closes or
+  re-opens it.
+- ``HealthChecker``: a background asyncio task probing every discovered
+  endpoint's ``GET /health`` on an interval. N consecutive failures mark
+  the endpoint unhealthy; service discovery filters unhealthy endpoints
+  out of rotation before routing ever sees them.
+- ``ResilienceManager``: owns the breakers + checker + retry/timeout
+  config, and the counters the metrics service exports.
+
+All state is consulted from the router's single event loop (plus the
+metrics render handler on that same loop); a lock still guards breaker
+mutation so stats threads may read snapshots safely.
+
+Disabled-by-default for embedders: ``get_resilience()`` returns ``None``
+until ``initialize_resilience`` runs (the CLI app always initializes
+it), and every caller treats ``None`` as "no filtering, no retries" —
+the pre-resilience behavior.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import math
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional
+
+import aiohttp
+
+from production_stack_tpu.utils import SingletonMeta
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class ResilienceConfig:
+    """Knobs, mirrored 1:1 by router CLI flags (see parser.py)."""
+
+    # Retry-with-failover budget: how many *additional* endpoints a
+    # request may be re-routed to after a pre-first-byte failure.
+    max_retries: int = 2
+    # Per-request backend timeouts (seconds). 0 disables that bound.
+    backend_connect_timeout: float = 30.0
+    backend_timeout: float = 600.0
+    # Active health checking. interval 0 disables the prober.
+    health_check_interval: float = 10.0
+    health_check_timeout: float = 2.0
+    health_failure_threshold: int = 3
+    health_success_threshold: int = 1
+    # Circuit breaker.
+    breaker_window: int = 20
+    breaker_min_volume: int = 3
+    breaker_failure_rate: float = 0.5
+    breaker_open_base_s: float = 2.0
+    breaker_open_max_s: float = 60.0
+    breaker_jitter: float = 0.1
+    breaker_half_open_max: int = 1
+
+    def client_timeout(self) -> aiohttp.ClientTimeout:
+        return aiohttp.ClientTimeout(
+            total=self.backend_timeout or None,
+            sock_connect=self.backend_connect_timeout or None,
+        )
+
+
+class BreakerState(enum.IntEnum):
+    """IntEnum so the value doubles as the exported gauge sample."""
+
+    CLOSED = 0
+    HALF_OPEN = 1
+    OPEN = 2
+
+
+class CircuitBreaker:
+    """One endpoint's failure-rate breaker.
+
+    Outcomes land in a bounded deque; once at least ``breaker_min_volume``
+    outcomes are present and the failure fraction reaches
+    ``breaker_failure_rate``, the breaker opens. While open,
+    ``can_attempt`` stays False until the backoff elapses; the next
+    attempt then transitions to half-open and rides as the probe.
+    Consecutive opens double the backoff (capped, jittered) so a
+    flapping backend is probed ever more gently.
+    """
+
+    def __init__(self, config: ResilienceConfig, clock=time.monotonic,
+                 rng: Optional[random.Random] = None):
+        self._config = config
+        self._clock = clock
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self._window: Deque[bool] = deque(maxlen=config.breaker_window)
+        self._state = BreakerState.CLOSED
+        self._opened_at = 0.0
+        self._reopen_after = 0.0
+        self._consecutive_opens = 0
+        self._half_open_inflight = 0
+        self.opens_total = 0
+
+    @property
+    def state(self) -> BreakerState:
+        return self._state
+
+    def can_attempt(self) -> bool:
+        with self._lock:
+            if self._state == BreakerState.CLOSED:
+                return True
+            if self._state == BreakerState.OPEN:
+                return (self._clock() - self._opened_at
+                        >= self._reopen_after)
+            return (self._half_open_inflight
+                    < self._config.breaker_half_open_max)
+
+    def on_attempt(self) -> None:
+        """A request is actually being dispatched to this endpoint."""
+        with self._lock:
+            if (self._state == BreakerState.OPEN
+                    and self._clock() - self._opened_at
+                    >= self._reopen_after):
+                self._state = BreakerState.HALF_OPEN
+                self._half_open_inflight = 0
+                logger.info("Breaker half-open (probe admitted)")
+            if self._state == BreakerState.HALF_OPEN:
+                self._half_open_inflight += 1
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == BreakerState.HALF_OPEN:
+                self._state = BreakerState.CLOSED
+                self._window.clear()
+                self._consecutive_opens = 0
+                self._half_open_inflight = 0
+                logger.info("Breaker closed after successful probe")
+            elif self._state == BreakerState.CLOSED:
+                self._window.append(True)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == BreakerState.HALF_OPEN:
+                self._half_open_inflight = max(
+                    0, self._half_open_inflight - 1)
+                self._open_locked()
+            elif self._state == BreakerState.CLOSED:
+                self._window.append(False)
+                if (len(self._window) >= self._config.breaker_min_volume
+                        and (self._window.count(False) / len(self._window)
+                             >= self._config.breaker_failure_rate)):
+                    self._open_locked()
+
+    def _open_locked(self) -> None:
+        cfg = self._config
+        self._consecutive_opens += 1
+        self.opens_total += 1
+        backoff = min(
+            cfg.breaker_open_base_s * 2 ** (self._consecutive_opens - 1),
+            cfg.breaker_open_max_s,
+        )
+        if cfg.breaker_jitter:
+            backoff *= 1.0 + cfg.breaker_jitter * (
+                2.0 * self._rng.random() - 1.0)
+        self._state = BreakerState.OPEN
+        self._opened_at = self._clock()
+        self._reopen_after = backoff
+        self._window.clear()
+        self._half_open_inflight = 0
+        logger.warning("Breaker opened (open #%d, retry in %.2fs)",
+                       self._consecutive_opens, backoff)
+
+    def time_until_half_open(self) -> float:
+        with self._lock:
+            if self._state != BreakerState.OPEN:
+                return 0.0
+            return max(
+                0.0,
+                self._opened_at + self._reopen_after - self._clock(),
+            )
+
+
+@dataclass
+class EndpointHealth:
+    healthy: bool = True
+    consecutive_failures: int = 0
+    consecutive_successes: int = 0
+    probes_total: int = 0
+    failures_total: int = 0
+    last_probe_ok: Optional[bool] = None
+
+
+class HealthChecker:
+    """Active ``GET /health`` prober over every discovered endpoint.
+
+    Runs as an asyncio task on the router loop (``start``/``stop`` from
+    the app lifecycle). Endpoints the checker has never probed count as
+    healthy — a freshly discovered backend must not be blackholed while
+    waiting for its first probe.
+    """
+
+    def __init__(self, config: ResilienceConfig):
+        self._config = config
+        self._status: Dict[str, EndpointHealth] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._started = False
+
+    # -- lifecycle ----------------------------------------------------
+
+    async def start(self) -> None:
+        if self._task is not None:
+            return
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(
+                total=self._config.health_check_timeout),
+        )
+        self._started = True
+        self._task = asyncio.create_task(
+            self._run(), name="endpoint-health-checker")
+        logger.info("Health checker started (interval %.1fs)",
+                    self._config.health_check_interval)
+
+    async def stop(self) -> None:
+        self._started = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def is_running(self) -> bool:
+        """False only when started and the task has died or stopped."""
+        if not self._started:
+            return True
+        return self._task is not None and not self._task.done()
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.probe_all()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # keep the loop alive on any bug
+                logger.error("Health probe sweep failed: %s", e)
+            await asyncio.sleep(self._config.health_check_interval)
+
+    # -- probing ------------------------------------------------------
+
+    async def probe_all(self) -> None:
+        """One sweep over the currently discovered endpoints."""
+        from production_stack_tpu.router.service_discovery import (
+            get_service_discovery,
+        )
+        try:
+            endpoints = get_service_discovery().get_endpoint_info(
+                include_unhealthy=True)
+        except ValueError:
+            return
+        urls = [ep.url for ep in endpoints]
+        own_session = self._session is None
+        session = self._session or aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(
+                total=self._config.health_check_timeout),
+        )
+        try:
+            await asyncio.gather(
+                *(self._probe_one(session, url) for url in urls))
+        finally:
+            if own_session:
+                await session.close()
+        # Forget endpoints that left the pool so the map stays bounded.
+        for url in list(self._status):
+            if url not in urls:
+                del self._status[url]
+
+    async def _probe_one(self, session: aiohttp.ClientSession,
+                         url: str) -> None:
+        ok = False
+        try:
+            async with session.get(
+                f"{url}/health",
+                timeout=aiohttp.ClientTimeout(
+                    total=self._config.health_check_timeout),
+            ) as resp:
+                ok = resp.status < 400
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            ok = False
+        self.record_probe(url, ok)
+
+    def record_probe(self, url: str, ok: bool) -> None:
+        cfg = self._config
+        st = self._status.setdefault(url, EndpointHealth())
+        st.probes_total += 1
+        st.last_probe_ok = ok
+        if ok:
+            st.consecutive_failures = 0
+            st.consecutive_successes += 1
+            if (not st.healthy and st.consecutive_successes
+                    >= cfg.health_success_threshold):
+                st.healthy = True
+                logger.info("Endpoint %s back to healthy", url)
+        else:
+            st.failures_total += 1
+            st.consecutive_successes = 0
+            st.consecutive_failures += 1
+            if (st.healthy and st.consecutive_failures
+                    >= cfg.health_failure_threshold):
+                st.healthy = False
+                logger.warning(
+                    "Endpoint %s marked unhealthy after %d failed probes",
+                    url, st.consecutive_failures)
+
+    def is_healthy(self, url: str) -> bool:
+        st = self._status.get(url)
+        return True if st is None else st.healthy
+
+    def snapshot(self) -> Dict[str, EndpointHealth]:
+        return dict(self._status)
+
+
+class ResilienceManager:
+    """Facade the proxy, discovery, and metrics layers talk to."""
+
+    def __init__(self, config: Optional[ResilienceConfig] = None,
+                 clock=time.monotonic,
+                 rng: Optional[random.Random] = None):
+        self.config = config or ResilienceConfig()
+        self._clock = clock
+        self._rng = rng or random.Random(0x5E51)
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self.health: Optional[HealthChecker] = (
+            HealthChecker(self.config)
+            if self.config.health_check_interval > 0 else None
+        )
+        self.retries_total = 0
+        self.failovers_total = 0
+        self.shed_requests_total = 0
+
+    def breaker(self, url: str) -> CircuitBreaker:
+        br = self._breakers.get(url)
+        if br is None:
+            br = self._breakers[url] = CircuitBreaker(
+                self.config, clock=self._clock, rng=self._rng)
+        return br
+
+    def endpoint_available(self, url: str) -> bool:
+        if self.health is not None and not self.health.is_healthy(url):
+            return False
+        br = self._breakers.get(url)
+        return br is None or br.can_attempt()
+
+    def on_attempt(self, url: str) -> None:
+        self.breaker(url).on_attempt()
+
+    def record_success(self, url: str) -> None:
+        self.breaker(url).record_success()
+
+    def record_failure(self, url: str) -> None:
+        self.breaker(url).record_failure()
+
+    def retry_after_hint(self, urls: List[str]) -> int:
+        """Seconds until the soonest open breaker admits a probe (or the
+        next health sweep) — the ``Retry-After`` value for 503s."""
+        waits = [
+            self._breakers[u].time_until_half_open()
+            for u in urls if u in self._breakers
+        ]
+        waits = [w for w in waits if w > 0]
+        if not waits and self.health is not None:
+            waits = [self.config.health_check_interval]
+        return max(1, int(math.ceil(min(waits)))) if waits else 1
+
+    def breaker_snapshot(self) -> Dict[str, CircuitBreaker]:
+        return dict(self._breakers)
+
+    async def start(self) -> None:
+        if self.health is not None:
+            await self.health.start()
+
+    async def stop(self) -> None:
+        if self.health is not None:
+            await self.health.stop()
+
+
+class _ResilienceHolder(metaclass=SingletonMeta):
+    """SingletonMeta so the test harness resets it between tests."""
+
+    def __init__(self):
+        self.instance: Optional[ResilienceManager] = None
+
+
+def initialize_resilience(
+        config: Optional[ResilienceConfig] = None) -> ResilienceManager:
+    holder = _ResilienceHolder()
+    holder.instance = ResilienceManager(config)
+    return holder.instance
+
+
+def get_resilience() -> Optional[ResilienceManager]:
+    """None until initialized: callers fall back to pre-resilience
+    behavior (no filtering, no retries, session-default timeouts)."""
+    return _ResilienceHolder().instance
+
+
+def shutdown_resilience() -> None:
+    _ResilienceHolder().instance = None

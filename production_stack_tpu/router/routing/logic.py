@@ -40,6 +40,20 @@ logger = init_logger(__name__)
 RouteResult = Union[str, "asyncio.Future[str]"]
 
 
+def usable_endpoints(endpoints: List[EndpointInfo],
+                     exclude=()) -> List[EndpointInfo]:
+    """The endpoints a new attempt may target: not in *exclude* (URLs
+    already tried by this request), not marked unhealthy by the active
+    health checker, and not behind a tripped circuit breaker. With the
+    resilience layer uninitialized this is just the exclude filter."""
+    from production_stack_tpu.router.resilience import get_resilience
+    pool = [ep for ep in endpoints if ep.url not in exclude]
+    mgr = get_resilience()
+    if mgr is None:
+        return pool
+    return [ep for ep in pool if mgr.endpoint_available(ep.url)]
+
+
 class RoutingLogic(str, enum.Enum):
     ROUND_ROBIN = "roundrobin"
     SESSION_BASED = "session"

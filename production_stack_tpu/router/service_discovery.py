@@ -29,9 +29,16 @@ class EndpointInfo:
     model_names: List[str] = field(default_factory=list)
     added_timestamp: float = field(default_factory=time.time)
     pod_name: Optional[str] = None
+    # An empty model list historically meant "serves everything". That
+    # stays the default (static discovery without --static-models), but
+    # probed endpoints set wildcard=False so a model list that is
+    # *authoritatively* empty serves nothing instead of everything.
+    wildcard: bool = True
 
     def serves_model(self, model: str) -> bool:
-        return not self.model_names or model in self.model_names
+        if model in self.model_names:
+            return True
+        return not self.model_names and self.wildcard
 
 
 class ServiceDiscoveryType(str, enum.Enum):
@@ -40,11 +47,33 @@ class ServiceDiscoveryType(str, enum.Enum):
 
 
 class ServiceDiscovery:
-    def get_endpoint_info(self) -> List[EndpointInfo]:
+    def _list_endpoints(self) -> List[EndpointInfo]:
         raise NotImplementedError
 
+    def get_endpoint_info(
+            self, include_unhealthy: bool = False) -> List[EndpointInfo]:
+        """Discovered endpoints; by default filtered down to the ones the
+        active health checker (when enabled) currently believes alive, so
+        dead backends leave rotation for every discovery type — not just
+        the K8s pod-watch path."""
+        endpoints = self._list_endpoints()
+        if include_unhealthy:
+            return endpoints
+        from production_stack_tpu.router.resilience import get_resilience
+        mgr = get_resilience()
+        if mgr is None or mgr.health is None:
+            return endpoints
+        return [ep for ep in endpoints if mgr.health.is_healthy(ep.url)]
+
     def get_health(self) -> bool:
-        return True
+        """Liveness of the discovery machinery itself. With active health
+        checking enabled this reports the prober task's liveness instead
+        of being hardwired True."""
+        from production_stack_tpu.router.resilience import get_resilience
+        mgr = get_resilience()
+        if mgr is None or mgr.health is None:
+            return True
+        return mgr.health.is_running()
 
     def close(self) -> None:
         pass
@@ -69,7 +98,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
             for i, url in enumerate(urls)
         ]
 
-    def get_endpoint_info(self) -> List[EndpointInfo]:
+    def _list_endpoints(self) -> List[EndpointInfo]:
         return list(self._endpoints)
 
 
@@ -82,6 +111,14 @@ class K8sServiceDiscovery(ServiceDiscovery):
     """
 
     _MODEL_PROBE_TIMEOUT_S = 5.0
+    # Bounded re-probe schedule for pods whose /v1/models probe failed:
+    # they stay OUT of rotation (a failed probe must not degrade into
+    # wildcard "serves everything" routing) and are retried with
+    # exponential spacing until this many attempts, after which the pod
+    # waits for its next watch event to be considered again.
+    _REPROBE_BASE_S = 2.0
+    _REPROBE_MAX_ATTEMPTS = 5
+    _REPROBE_TICK_S = 0.5
 
     def __init__(self, namespace: str, port: int, label_selector: str):
         try:
@@ -101,12 +138,18 @@ class K8sServiceDiscovery(ServiceDiscovery):
         self.port = port
         self.label_selector = label_selector
         self._endpoints: Dict[str, EndpointInfo] = {}  # pod name -> info
+        # pod name -> (url, attempts, next_probe_at) for failed probes.
+        self._pending_probe: Dict[str, tuple] = {}
         self._lock = threading.Lock()
         self._running = True
         self._thread = threading.Thread(
             target=self._watch_pods, daemon=True, name="k8s-pod-watcher"
         )
         self._thread.start()
+        self._reprobe_thread = threading.Thread(
+            target=self._reprobe_loop, daemon=True, name="k8s-model-reprobe"
+        )
+        self._reprobe_thread.start()
 
     @staticmethod
     def _pod_is_ready(pod) -> bool:
@@ -115,16 +158,60 @@ class K8sServiceDiscovery(ServiceDiscovery):
             c.type == "Ready" and c.status == "True" for c in conditions
         )
 
-    def _probe_models(self, url: str) -> List[str]:
+    @classmethod
+    def _probe_models(cls, url: str) -> Optional[List[str]]:
+        """Model list served at *url*, or None when the probe failed —
+        never an empty list standing in for "unknown", which upstream
+        would misread as a wildcard endpoint serving every model."""
         try:
             resp = requests.get(
-                f"{url}/v1/models", timeout=self._MODEL_PROBE_TIMEOUT_S
+                f"{url}/v1/models", timeout=cls._MODEL_PROBE_TIMEOUT_S
             )
             resp.raise_for_status()
             return [m["id"] for m in resp.json().get("data", [])]
         except Exception as e:
             logger.warning("Model probe failed for %s: %s", url, e)
-            return []
+            return None
+
+    def _reprobe_loop(self) -> None:
+        """Retry failed model probes on a bounded exponential schedule;
+        the pod only enters rotation once a probe succeeds."""
+        while self._running:
+            time.sleep(self._REPROBE_TICK_S)
+            now = time.time()
+            with self._lock:
+                due = [
+                    (name, url, attempts)
+                    for name, (url, attempts, next_at)
+                    in self._pending_probe.items()
+                    if next_at <= now
+                ]
+            for name, url, attempts in due:
+                models = self._probe_models(url)
+                with self._lock:
+                    current = self._pending_probe.get(name)
+                    if current is None or current[0] != url:
+                        continue  # pod churned meanwhile
+                    if models is not None:
+                        del self._pending_probe[name]
+                        self._endpoints[name] = EndpointInfo(
+                            url=url, model_names=models, pod_name=name,
+                            wildcard=False,
+                        )
+                        logger.info("Engine pod up after re-probe: "
+                                    "%s -> %s (%s)", name, url, models)
+                    elif attempts + 1 >= self._REPROBE_MAX_ATTEMPTS:
+                        del self._pending_probe[name]
+                        logger.error(
+                            "Model probe for %s (%s) failed %d times; "
+                            "pod stays out of rotation until its next "
+                            "watch event", name, url, attempts + 1)
+                    else:
+                        self._pending_probe[name] = (
+                            url, attempts + 1,
+                            time.time()
+                            + self._REPROBE_BASE_S * 2 ** (attempts + 1),
+                        )
 
     def _watch_pods(self) -> None:
         from kubernetes import watch
@@ -157,21 +244,33 @@ class K8sServiceDiscovery(ServiceDiscovery):
             if known is None or known.url != url:
                 models = self._probe_models(url)
                 with self._lock:
-                    self._endpoints[name] = EndpointInfo(
-                        url=url, model_names=models, pod_name=name
-                    )
-                logger.info("Engine pod up: %s -> %s (%s)", name, url, models)
+                    if models is None:
+                        # Keep the pod out of rotation until a probe
+                        # succeeds; the re-probe loop picks it up.
+                        self._endpoints.pop(name, None)
+                        self._pending_probe[name] = (
+                            url, 0, time.time() + self._REPROBE_BASE_S)
+                    else:
+                        self._pending_probe.pop(name, None)
+                        self._endpoints[name] = EndpointInfo(
+                            url=url, model_names=models, pod_name=name,
+                            wildcard=False,
+                        )
+                if models is not None:
+                    logger.info("Engine pod up: %s -> %s (%s)",
+                                name, url, models)
         elif etype == "DELETED" or not ready:
             with self._lock:
+                self._pending_probe.pop(name, None)
                 if self._endpoints.pop(name, None) is not None:
                     logger.info("Engine pod removed: %s", name)
 
-    def get_endpoint_info(self) -> List[EndpointInfo]:
+    def _list_endpoints(self) -> List[EndpointInfo]:
         with self._lock:
             return list(self._endpoints.values())
 
     def get_health(self) -> bool:
-        return self._thread.is_alive()
+        return self._thread.is_alive() and super().get_health()
 
     def close(self) -> None:
         self._running = False

@@ -219,7 +219,8 @@ class LocalBatchProcessor(BatchProcessor):
         lines = [ln for ln in raw.decode().splitlines() if ln.strip()]
         info.total_requests = len(lines)
         outputs, errors = [], []
-        async with aiohttp.ClientSession() as session:
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=600)) as session:
             for line in lines:
                 if await self._is_cancelled(user_id, info.id):
                     logger.info("Batch %s cancelled mid-run", info.id)

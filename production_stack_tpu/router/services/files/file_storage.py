@@ -4,20 +4,53 @@ Layout: ``<base>/<user_id>/<file_id>`` for content plus a ``.meta.json``
 sidecar holding the OpenAI file metadata.
 """
 
+import asyncio
 import json
 import os
 import re
 import uuid
 from typing import List
 
-import aiofiles
-import aiofiles.os as aio_os
+try:
+    import aiofiles
+    import aiofiles.os as aio_os
+except ImportError:  # env without aiofiles: thread-offloaded stdlib IO
+    aiofiles = None
+    aio_os = None
 
 from production_stack_tpu.router.services.files.openai_files import OpenAIFile
 from production_stack_tpu.router.services.files.storage import (
     DEFAULT_STORAGE_PATH,
     Storage,
 )
+
+
+async def _read_file(path: str, mode: str):
+    if aiofiles is None:
+        def _read():
+            with open(path, mode) as f:
+                return f.read()
+        return await asyncio.to_thread(_read)
+    async with aiofiles.open(path, mode) as f:
+        return await f.read()
+
+
+async def _write_file(path: str, data, mode: str) -> None:
+    if aiofiles is None:
+        def _write():
+            with open(path, mode) as f:
+                f.write(data)
+        await asyncio.to_thread(_write)
+        return
+    async with aiofiles.open(path, mode) as f:
+        await f.write(data)
+
+
+async def _remove_file(path: str) -> None:
+    if aio_os is None:
+        await asyncio.to_thread(os.remove, path)
+    else:
+        await aio_os.remove(path)
 
 
 class FileStorage(Storage):
@@ -53,17 +86,14 @@ class FileStorage(Storage):
             id=file_id, filename=filename, bytes=len(content),
             purpose=purpose, user_id=user_id,
         )
-        async with aiofiles.open(content_path, "wb") as f:
-            await f.write(content)
-        async with aiofiles.open(meta_path, "w") as f:
-            await f.write(json.dumps(file.metadata()))
+        await _write_file(content_path, content, "wb")
+        await _write_file(meta_path, json.dumps(file.metadata()), "w")
         return file
 
     async def get_file(self, user_id: str, file_id: str) -> OpenAIFile:
         _, meta_path = self._paths(user_id, file_id)
         try:
-            async with aiofiles.open(meta_path, "r") as f:
-                meta = json.loads(await f.read())
+            meta = json.loads(await _read_file(meta_path, "r"))
         except FileNotFoundError:
             raise FileNotFoundError(f"File {file_id} not found") from None
         return OpenAIFile(
@@ -75,8 +105,7 @@ class FileStorage(Storage):
     async def get_file_content(self, user_id: str, file_id: str) -> bytes:
         content_path, _ = self._paths(user_id, file_id)
         try:
-            async with aiofiles.open(content_path, "rb") as f:
-                return await f.read()
+            return await _read_file(content_path, "rb")
         except FileNotFoundError:
             raise FileNotFoundError(f"File {file_id} not found") from None
 
@@ -93,6 +122,6 @@ class FileStorage(Storage):
     async def delete_file(self, user_id: str, file_id: str) -> None:
         for path in self._paths(user_id, file_id):
             try:
-                await aio_os.remove(path)
+                await _remove_file(path)
             except FileNotFoundError:
                 pass

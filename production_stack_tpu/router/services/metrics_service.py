@@ -43,6 +43,33 @@ avg_prefill_length = Gauge(
     "vllm:avg_prefill_length",
     "Average prompt length of routed requests (tokens)", _LBL)
 
+# -- resilience layer (router/resilience.py) --------------------------------
+circuit_breaker_state = Gauge(
+    "vllm:circuit_breaker_state",
+    "Circuit breaker state per endpoint (0=closed, 1=half-open, 2=open)",
+    _LBL)
+circuit_breaker_opens = Gauge(
+    "vllm:circuit_breaker_opens_total",
+    "Times this endpoint's circuit breaker has opened", _LBL)
+endpoint_healthy = Gauge(
+    "vllm:endpoint_healthy",
+    "Active health-probe verdict per endpoint (1=healthy)", _LBL)
+health_probe_failures = Gauge(
+    "vllm:health_probe_failures_total",
+    "Failed active health probes per endpoint", _LBL)
+request_retries = Gauge(
+    "vllm:request_retries_total",
+    "Proxy attempts that failed pre-first-byte and were retried/failed "
+    "over (router-wide)", [])
+request_failovers = Gauge(
+    "vllm:request_failovers_total",
+    "Requests that succeeded on a backend other than the first choice "
+    "(router-wide)", [])
+requests_shed = Gauge(
+    "vllm:requests_shed_total",
+    "Requests answered 503 because no endpoint was admittable "
+    "(router-wide)", [])
+
 
 def refresh_gauges() -> None:
     """Pull the latest snapshots into the gauge registry."""
@@ -75,11 +102,30 @@ def refresh_gauges() -> None:
             stat.queueing_delay)
         avg_prefill_length.labels(server=server).set(
             stat.avg_prefill_length)
+    from production_stack_tpu.router.resilience import get_resilience
+    mgr = get_resilience()
     try:
-        for ep in get_service_discovery().get_endpoint_info():
-            healthy_pods_total.labels(server=ep.url).set(1)
+        for ep in get_service_discovery().get_endpoint_info(
+                include_unhealthy=True):
+            up = mgr is None or mgr.endpoint_available(ep.url)
+            healthy_pods_total.labels(server=ep.url).set(1 if up else 0)
     except ValueError:
         pass
+    if mgr is not None:
+        for url, breaker in mgr.breaker_snapshot().items():
+            circuit_breaker_state.labels(server=url).set(
+                int(breaker.state))
+            circuit_breaker_opens.labels(server=url).set(
+                breaker.opens_total)
+        if mgr.health is not None:
+            for url, st in mgr.health.snapshot().items():
+                endpoint_healthy.labels(server=url).set(
+                    1 if st.healthy else 0)
+                health_probe_failures.labels(server=url).set(
+                    st.failures_total)
+        request_retries.set(mgr.retries_total)
+        request_failovers.set(mgr.failovers_total)
+        requests_shed.set(mgr.shed_requests_total)
 
 
 def render_exposition() -> tuple[bytes, str]:

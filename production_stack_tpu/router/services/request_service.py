@@ -7,10 +7,20 @@ stats lifecycle events per streamed chunk, fork's ``x-prefill-tokens``
 hint header (L199-203), HRA future await (L210-213), cleanup on
 disconnect. Implemented on aiohttp: the backend stream is forwarded
 chunk-by-chunk into a ``web.StreamResponse`` with no buffering.
+
+Resilience (router/resilience.py) threads through this path: candidate
+endpoints are filtered by health + circuit breaker, a pre-first-byte
+failure (connect error, timeout, 5xx) fails over to the next-best
+endpoint within a retry budget, per-request connect/total timeouts bound
+every backend call, and exhaustion returns 503 + ``Retry-After`` when no
+endpoint is currently admittable (vs 502 when attempts genuinely
+failed). A stream that has already sent its first byte downstream is
+NEVER retried.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 import uuid
@@ -19,6 +29,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.router.resilience import get_resilience
 from production_stack_tpu.router.service_discovery import (
     get_service_discovery,
 )
@@ -51,9 +62,42 @@ _RESPONSE_DROP_HEADERS = _HOP_HEADERS | {"content-encoding"}
 # Cap on response bytes buffered for the semantic cache store path.
 _CACHE_STORE_MAX_BYTES = 4 * 1024 * 1024
 
+# Network failure classes eligible for failover when raised before the
+# first response byte has been streamed to the client.
+_NETWORK_ERRORS = (
+    aiohttp.ClientError, asyncio.TimeoutError, TimeoutError,
+    ConnectionError, OSError,
+)
+
+
+class RetryableUpstreamError(Exception):
+    """Backend failed before the first byte reached the client: connect
+    error, timeout, or 5xx status. Safe to re-route elsewhere."""
+
+    def __init__(self, reason: str, status: Optional[int] = None):
+        super().__init__(reason)
+        self.status = status
+
+
+class _BackendStreamError(Exception):
+    """Backend died after bytes were already streamed downstream: the
+    breaker hears about it, but the request must not be retried."""
+
+
+class _ClientDisconnectedError(Exception):
+    """The downstream client went away: not the backend's fault, so no
+    breaker blame and no retry."""
+
 
 def _client_session(app: web.Application) -> aiohttp.ClientSession:
     return app["backend_session"]
+
+
+def _request_timeout(mgr) -> aiohttp.ClientTimeout:
+    if mgr is not None:
+        return mgr.config.client_timeout()
+    # Pre-resilience defaults (matches the session built in app.py).
+    return aiohttp.ClientTimeout(total=None, sock_connect=30)
 
 
 def _estimate_prefill_tokens(request: web.Request, body: bytes) -> int:
@@ -87,17 +131,32 @@ def _routable_prompt_text(payload: dict) -> "str | None":
     return None
 
 
-def _error(status: int, message: str) -> web.Response:
+def _error(status: int, message: str,
+           err_type: str = "invalid_request_error",
+           headers: Optional[dict] = None) -> web.Response:
     return web.json_response(
-        {"error": {"message": message, "type": "invalid_request_error"}},
-        status=status,
+        {"error": {"message": message, "type": err_type}},
+        status=status, headers=headers,
     )
+
+
+def _finish_span(span, status: str) -> None:
+    if span is None:
+        return
+    from production_stack_tpu.router.tracing import get_span_logger
+    span.finish(status)
+    sink = get_span_logger()
+    if sink is not None:
+        sink.emit(span)
 
 
 async def route_general_request(request: web.Request,
                                 endpoint_path: str) -> web.StreamResponse:
     """Proxy one OpenAI-API request to a chosen engine, streaming back."""
-    from production_stack_tpu.router.routing.logic import get_routing_logic
+    from production_stack_tpu.router.routing.logic import (
+        get_routing_logic,
+        usable_endpoints,
+    )
 
     in_router_time = time.time()
     request_id = request.headers.get("x-request-id") or str(uuid.uuid4())
@@ -115,58 +174,110 @@ async def route_general_request(request: web.Request,
     if rewritten is not body:
         body = rewritten
 
-    endpoints = [
-        ep for ep in get_service_discovery().get_endpoint_info()
+    discovery = get_service_discovery()
+    # Unknown model (404) is judged against every *discovered* endpoint;
+    # "known but currently unservable" (503 below) against healthy ones.
+    serving = [
+        ep for ep in discovery.get_endpoint_info(include_unhealthy=True)
         if ep.serves_model(model)
     ]
-    if not endpoints:
+    if not serving:
         return _error(
-            400, f"Model {model} not found on any serving engine"
+            404, f"Model {model} not found on any serving engine",
+            err_type="not_found_error",
         )
+    healthy = [
+        ep for ep in discovery.get_endpoint_info()
+        if ep.serves_model(model)
+    ]
 
-    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    mgr = get_resilience()
     monitor = get_request_stats_monitor()
-    request_stats = monitor.get_request_stats(time.time())
     monitor.on_request_arrival(request_id, in_router_time)
 
     from production_stack_tpu.router.tracing import start_span
     span = start_span(request_id, model, endpoint_path)
 
     num_prefill_tokens = _estimate_prefill_tokens(request, body)
-
     policy = get_routing_logic()
-    choice = policy.route_request(
-        endpoints, engine_stats, request_stats, request.headers,
-        request_id, num_prefill_tokens,
-        prompt_text=(_routable_prompt_text(payload)
-                     if policy.uses_prompt_text else None),
-    )
-    if hasattr(choice, "__await__"):
-        try:
-            server_url = await choice
-        except Exception as e:  # admission rejected (e.g. can never fit)
-            monitor.on_request_kill("<unrouted>", request_id)
-            if span is not None:
-                from production_stack_tpu.router.tracing import (
-                    get_span_logger,
-                )
-                span.finish("rejected")
-                sink = get_span_logger()
-                if sink is not None:
-                    sink.emit(span)
-            return _error(429, f"Request not admitted: {e}")
-    else:
-        server_url = choice
-    if span is not None:
-        span.on_routed(server_url)
-    queue_delay = time.time() - in_router_time
-    logger.debug("Routing %s to %s (queued %.1f ms)",
-                 request_id, server_url, queue_delay * 1e3)
-
+    prompt_text = (_routable_prompt_text(payload)
+                   if policy.uses_prompt_text else None)
     store_callback = _semantic_cache_store_callback(endpoint_path, payload)
-    return await _proxy_stream(
-        request, server_url, endpoint_path, body, request_id, policy,
-        store_callback, span=span,
+
+    max_attempts = 1 + (mgr.config.max_retries if mgr is not None else 0)
+    tried: set = set()
+    last_error: Optional[RetryableUpstreamError] = None
+    for attempt in range(max_attempts):
+        candidates = usable_endpoints(healthy, exclude=tried)
+        if not candidates:
+            break
+        engine_stats = get_engine_stats_scraper().get_engine_stats()
+        request_stats = monitor.get_request_stats(time.time())
+        choice = policy.route_request(
+            candidates, engine_stats, request_stats, request.headers,
+            request_id, num_prefill_tokens, prompt_text=prompt_text,
+        )
+        if hasattr(choice, "__await__"):
+            try:
+                server_url = await choice
+            except Exception as e:  # admission rejected (can never fit)
+                monitor.on_request_kill("<unrouted>", request_id)
+                _finish_span(span, "rejected")
+                return _error(429, f"Request not admitted: {e}")
+        else:
+            server_url = choice
+        if span is not None:
+            span.on_routed(server_url)
+        if attempt:
+            logger.info("Failover attempt %d: re-routing %s to %s",
+                        attempt, request_id, server_url)
+        queue_delay = time.time() - in_router_time
+        logger.debug("Routing %s to %s (queued %.1f ms)",
+                     request_id, server_url, queue_delay * 1e3)
+        if mgr is not None:
+            mgr.on_attempt(server_url)
+        try:
+            response = await _proxy_stream(
+                request, server_url, endpoint_path, body, request_id,
+                policy, store_callback, span=span, mgr=mgr,
+            )
+        except RetryableUpstreamError as e:
+            last_error = e
+            tried.add(server_url)
+            if mgr is not None:
+                mgr.record_failure(server_url)
+                mgr.retries_total += 1
+            logger.warning(
+                "Pre-stream failure from %s for %s (%s); %s",
+                server_url, request_id, e,
+                "failing over" if attempt + 1 < max_attempts
+                else "retry budget exhausted")
+            continue
+        if mgr is not None and attempt:
+            mgr.failovers_total += 1
+        return response
+
+    # Retry budget or candidate pool exhausted.
+    monitor.on_request_kill("<unrouted>", request_id)
+    _finish_span(span, "error")
+    if not usable_endpoints(healthy):
+        # Every serving endpoint is unhealthy or breaker-open: shed with
+        # a hint for when a probe slot next opens, so clients and
+        # autoscalers can tell "no capacity" from "broken upstream".
+        if mgr is not None:
+            mgr.shed_requests_total += 1
+        hint = (mgr.retry_after_hint([ep.url for ep in healthy or serving])
+                if mgr is not None else 1)
+        return _error(
+            503, f"No healthy endpoint currently serves model {model}",
+            err_type="service_unavailable_error",
+            headers={"Retry-After": str(hint)},
+        )
+    return _error(
+        502,
+        f"Upstream engine error after {len(tried)} attempt(s): "
+        f"{last_error}",
+        err_type="upstream_error",
     )
 
 
@@ -201,7 +312,10 @@ def _semantic_cache_store_callback(endpoint_path: str, payload: dict):
 async def _proxy_stream(request: web.Request, server_url: str,
                         endpoint_path: str, body: bytes, request_id: str,
                         policy, store_callback=None,
-                        span=None) -> web.StreamResponse:
+                        span=None, mgr=None) -> web.StreamResponse:
+    """One proxy attempt. Raises ``RetryableUpstreamError`` when the
+    backend failed before anything was streamed to the client; once the
+    client response is prepared, failures are terminal."""
     monitor = get_request_stats_monitor()
     session = _client_session(request.app)
     fwd_headers = {
@@ -213,12 +327,19 @@ async def _proxy_stream(request: web.Request, server_url: str,
     start_time = time.time()
     monitor.on_request_start(server_url, request_id, start_time)
     completed = False
+    prepared = False
     response: Optional[web.StreamResponse] = None
     try:
         async with session.request(
             request.method, f"{server_url}{endpoint_path}",
             data=body, headers=fwd_headers,
+            timeout=_request_timeout(mgr),
         ) as backend:
+            if backend.status >= 500:
+                raise RetryableUpstreamError(
+                    f"upstream returned {backend.status}",
+                    status=backend.status,
+                )
             response = web.StreamResponse(
                 status=backend.status,
                 headers={
@@ -226,10 +347,27 @@ async def _proxy_stream(request: web.Request, server_url: str,
                     if k.lower() not in _RESPONSE_DROP_HEADERS
                 },
             )
-            await response.prepare(request)
+            try:
+                await response.prepare(request)
+            except _NETWORK_ERRORS as e:
+                raise _ClientDisconnectedError(
+                    f"{type(e).__name__}: {e}") from e
+            prepared = True
             first_chunk = True
             cache_buffer = bytearray() if store_callback else None
-            async for chunk in backend.content.iter_any():
+            stream = backend.content.iter_any()
+            while True:
+                try:
+                    chunk = await stream.__anext__()
+                except StopAsyncIteration:
+                    break
+                except _NETWORK_ERRORS as e:
+                    # Mid-stream death: bytes are already downstream, so
+                    # failover is impossible — blame the backend, abort.
+                    if mgr is not None:
+                        mgr.record_failure(server_url)
+                    raise _BackendStreamError(
+                        f"{type(e).__name__}: {e}") from e
                 if not chunk:
                     continue
                 monitor.on_request_response(
@@ -246,25 +384,45 @@ async def _proxy_stream(request: web.Request, server_url: str,
             monitor.on_request_complete(server_url, request_id, time.time())
             completed = True
             await response.write_eof()
+            if mgr is not None:
+                mgr.record_success(server_url)
             if (cache_buffer is not None and backend.status == 200
                     and len(cache_buffer) < _CACHE_STORE_MAX_BYTES):
                 store_callback(bytes(cache_buffer))
+            _finish_span(span, "ok")
             return response
+    except RetryableUpstreamError:
+        raise
+    except _BackendStreamError as e:
+        logger.warning("Backend stream from %s died mid-response for "
+                       "%s: %s", server_url, request_id, e)
+        _finish_span(span, "killed")
+        raise
+    except _ClientDisconnectedError as e:
+        logger.info("Client gone before response start for %s via %s: %s",
+                    request_id, server_url, e)
+        _finish_span(span, "killed")
+        raise
+    except _NETWORK_ERRORS as e:
+        if not prepared:
+            # Connect error / timeout before the client saw anything.
+            raise RetryableUpstreamError(
+                f"{type(e).__name__}: {e}") from e
+        # Client-side write failure (disconnect): not the backend's
+        # fault — no breaker blame, no retry.
+        logger.info("Client connection lost for %s via %s: %s",
+                    request_id, server_url, e)
+        _finish_span(span, "killed")
+        raise
     except Exception as e:
         logger.warning("Proxy error for %s via %s: %s",
                        request_id, server_url, e)
+        _finish_span(span, "error")
         if response is None:
-            return _error(502, f"Upstream engine error: {e}")
+            return _error(502, f"Upstream engine error: {e}",
+                          err_type="upstream_error")
         raise
     finally:
         if not completed:
             monitor.on_request_kill(server_url, request_id)
         policy.on_request_complete(server_url)
-        if span is not None:
-            from production_stack_tpu.router.tracing import (
-                get_span_logger,
-            )
-            span.finish("ok" if completed else "killed")
-            sink = get_span_logger()
-            if sink is not None:
-                sink.emit(span)

@@ -42,8 +42,14 @@ class RequestSpan:
     end_ts: Optional[float] = None
     chunks: int = 0
     status: str = "ok"  # ok | killed | rejected | error
+    retries: int = 0
+    tried_backends: list = field(default_factory=list)
 
     def on_routed(self, backend: str) -> None:
+        if self.backend is not None and backend != self.backend:
+            # Failover: the previous backend failed pre-first-byte.
+            self.tried_backends.append(self.backend)
+            self.retries += 1
         self.backend = backend
         self.routed_ts = time.time()
 
@@ -72,6 +78,8 @@ class RequestSpan:
             "latency_ms": ms(self.arrival_ts, self.end_ts),
             "chunks": self.chunks,
             "status": self.status,
+            "retries": self.retries,
+            "tried_backends": list(self.tried_backends),
         })
 
 

@@ -6,6 +6,18 @@ configurable tokens/sec rate (``--speed``) after a configurable first-token
 delay (``--ttft``), and exposes a synthetic vLLM-style ``/metrics``
 exposition — so the full router stack can be exercised with zero TPUs.
 
+Fault injection (for resilience tests): ``--fault MODE`` at startup or
+``POST /fault {"mode": MODE}`` at runtime, with MODE one of
+
+- ``error500``       every API request answers 500 ( /health too )
+- ``hang``           accept the connection, never send a response
+- ``slow_first_token``  first token delayed by ``--fault-ttft`` seconds
+- ``abort_mid_stream``  stream a couple of chunks, then drop the socket
+- ``unhealthy``      API keeps working but /health answers 500
+- ``null``/absent    healthy (clears a previously set fault)
+
+Connection refusal needs no mode: point the router at an unbound port.
+
 Run: ``python -m production_stack_tpu.testing.fake_engine --port 9001``
 """
 
@@ -21,9 +33,15 @@ from typing import Optional
 from aiohttp import web
 
 
+FAULT_MODES = (
+    "error500", "hang", "slow_first_token", "abort_mid_stream", "unhealthy",
+)
+
+
 class FakeEngineState:
     def __init__(self, model: str, speed: float, ttft: float,
-                 max_tokens_default: int = 32):
+                 max_tokens_default: int = 32,
+                 fault: Optional[str] = None, fault_ttft: float = 5.0):
         self.model = model
         self.speed = speed  # tokens per second
         self.ttft = ttft  # seconds before first token
@@ -31,6 +49,26 @@ class FakeEngineState:
         self.running = 0
         self.waiting = 0
         self.total_served = 0
+        self.fault = fault  # one of FAULT_MODES or None
+        self.fault_ttft = fault_ttft  # slow_first_token delay
+        self.requests_received = 0  # API hits incl. faulted ones
+
+
+async def _apply_api_fault(state: FakeEngineState,
+                           request: web.Request) -> Optional[web.Response]:
+    """Returns an error response (or hangs) per the active fault mode;
+    None when the request should proceed normally."""
+    if state.fault == "error500":
+        return web.json_response(
+            {"error": {"message": "injected fault", "type": "server_error"}},
+            status=500,
+        )
+    if state.fault == "hang":
+        await asyncio.sleep(3600)
+        return web.json_response({"error": "hang elapsed"}, status=500)
+    if state.fault == "slow_first_token":
+        await asyncio.sleep(state.fault_ttft)
+    return None
 
 
 def _sse(payload: dict) -> bytes:
@@ -57,6 +95,10 @@ def _chunk(request_id: str, model: str, text: Optional[str],
 
 async def chat_completions(request: web.Request) -> web.StreamResponse:
     state: FakeEngineState = request.app["state"]
+    state.requests_received += 1
+    fault_resp = await _apply_api_fault(state, request)
+    if fault_resp is not None:
+        return fault_resp
     body = await request.json()
     n_tokens = int(
         body.get("max_tokens")
@@ -98,7 +140,13 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         await resp.prepare(request)
         await resp.write(_sse(_chunk(request_id, model, None,
                                      role="assistant")))
-        for word in words:
+        for i, word in enumerate(words):
+            if state.fault == "abort_mid_stream" and i >= 2:
+                # A couple of chunks are downstream; now drop the socket
+                # without a terminating chunk or [DONE].
+                if request.transport is not None:
+                    request.transport.close()
+                return resp
             await asyncio.sleep(1.0 / state.speed)
             await resp.write(_sse(_chunk(request_id, model, word)))
         await resp.write(_sse(_chunk(request_id, model, None,
@@ -113,6 +161,10 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
 
 async def completions(request: web.Request) -> web.Response:
     state: FakeEngineState = request.app["state"]
+    state.requests_received += 1
+    fault_resp = await _apply_api_fault(state, request)
+    if fault_resp is not None:
+        return fault_resp
     body = await request.json()
     n_tokens = int(body.get("max_tokens") or state.max_tokens_default)
     state.running += 1
@@ -148,7 +200,29 @@ async def models(request: web.Request) -> web.Response:
 
 
 async def health(request: web.Request) -> web.Response:
+    state: FakeEngineState = request.app["state"]
+    if state.fault in ("error500", "unhealthy"):
+        return web.json_response({"status": "injected fault"}, status=500)
+    if state.fault == "hang":
+        await asyncio.sleep(3600)
     return web.json_response({"status": "ok"})
+
+
+async def set_fault(request: web.Request) -> web.Response:
+    """Runtime fault control: POST /fault {"mode": "error500" | null}."""
+    state: FakeEngineState = request.app["state"]
+    body = await request.json()
+    mode = body.get("mode")
+    if mode is not None and mode not in FAULT_MODES:
+        return web.json_response(
+            {"error": f"unknown fault mode {mode!r}; "
+                      f"one of {list(FAULT_MODES)}"},
+            status=400,
+        )
+    state.fault = mode
+    if "fault_ttft" in body:
+        state.fault_ttft = float(body["fault_ttft"])
+    return web.json_response({"fault": state.fault})
 
 
 async def metrics(request: web.Request) -> web.Response:
@@ -170,14 +244,17 @@ async def metrics(request: web.Request) -> web.Response:
 
 
 def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
-                      ttft: float = 0.02) -> web.Application:
+                      ttft: float = 0.02, fault: Optional[str] = None,
+                      fault_ttft: float = 5.0) -> web.Application:
     app = web.Application()
-    app["state"] = FakeEngineState(model=model, speed=speed, ttft=ttft)
+    app["state"] = FakeEngineState(model=model, speed=speed, ttft=ttft,
+                                   fault=fault, fault_ttft=fault_ttft)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
+    app.router.add_post("/fault", set_fault)
     return app
 
 
@@ -190,8 +267,13 @@ def main(argv=None) -> None:
                         help="tokens per second")
     parser.add_argument("--ttft", type=float, default=0.02,
                         help="seconds before first token")
+    parser.add_argument("--fault", default=None, choices=FAULT_MODES,
+                        help="start with this fault mode active")
+    parser.add_argument("--fault-ttft", type=float, default=5.0,
+                        help="slow_first_token injected delay (seconds)")
     args = parser.parse_args(argv)
-    app = build_fake_engine(args.model, args.speed, args.ttft)
+    app = build_fake_engine(args.model, args.speed, args.ttft,
+                            fault=args.fault, fault_ttft=args.fault_ttft)
     web.run_app(app, host=args.host, port=args.port, print=None)
 
 

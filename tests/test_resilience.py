@@ -1,0 +1,482 @@
+"""Router resilience layer: circuit breakers, active health checking,
+retry-with-failover, timeouts, and status-code semantics — driven
+end-to-end through the fault-injecting fake engine.
+
+The acceptance scenario from the resilience issue lives in
+``test_failover_e2e_and_breaker_recovery``: three backends of which one
+refuses connections and one returns 500s; every client request must
+succeed via failover with zero 502s, both bad endpoints' breakers must
+open (visible in /metrics), and traffic must recover through half-open
+probes once the faults clear.
+"""
+
+import asyncio
+import socket
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.resilience import (
+    BreakerState,
+    CircuitBreaker,
+    ResilienceConfig,
+    get_resilience,
+    initialize_resilience,
+)
+from production_stack_tpu.router.service_discovery import (
+    EndpointInfo,
+    K8sServiceDiscovery,
+    initialize_service_discovery,
+)
+from production_stack_tpu.router.services.rewriter import (
+    initialize_request_rewriter,
+)
+from production_stack_tpu.router.stats.engine_stats import (
+    initialize_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.request_stats import (
+    get_request_stats_monitor,
+    initialize_request_stats_monitor,
+)
+from production_stack_tpu.testing.fake_engine import build_fake_engine
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def _breaker_cfg(**overrides):
+    defaults = dict(
+        breaker_window=10, breaker_min_volume=3, breaker_failure_rate=0.5,
+        breaker_open_base_s=1.0, breaker_open_max_s=8.0,
+        breaker_jitter=0.0, health_check_interval=0.0,
+    )
+    defaults.update(overrides)
+    return ResilienceConfig(**defaults)
+
+
+def _free_port_url() -> str:
+    """A URL on a port nothing listens on: connection refused."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+# ---- circuit breaker unit tests -------------------------------------------
+
+def test_breaker_opens_on_failure_rate():
+    clock = FakeClock()
+    br = CircuitBreaker(_breaker_cfg(), clock=clock)
+    assert br.state == BreakerState.CLOSED
+    br.record_failure()
+    br.record_failure()
+    assert br.state == BreakerState.CLOSED  # below min volume
+    assert br.can_attempt()
+    br.record_failure()
+    assert br.state == BreakerState.OPEN
+    assert not br.can_attempt()
+    assert 0 < br.time_until_half_open() <= 1.0
+
+
+def test_breaker_mixed_outcomes_below_rate_stay_closed():
+    clock = FakeClock()
+    br = CircuitBreaker(_breaker_cfg(), clock=clock)
+    for _ in range(6):
+        br.record_success()
+    for _ in range(3):
+        br.record_failure()
+    # 3/9 failures < 0.5 rate: stays closed.
+    assert br.state == BreakerState.CLOSED
+
+
+def test_breaker_half_open_probe_cycle_and_backoff_growth():
+    clock = FakeClock()
+    br = CircuitBreaker(_breaker_cfg(), clock=clock)
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == BreakerState.OPEN
+    first_backoff = br.time_until_half_open()
+    assert first_backoff == pytest.approx(1.0)
+
+    # Backoff not yet elapsed: no attempts admitted.
+    clock.advance(0.5)
+    assert not br.can_attempt()
+
+    # Elapsed: exactly one half-open probe slot.
+    clock.advance(0.6)
+    assert br.can_attempt()
+    br.on_attempt()
+    assert br.state == BreakerState.HALF_OPEN
+    assert not br.can_attempt()  # probe slot taken
+
+    # Failed probe: reopen with doubled backoff.
+    br.record_failure()
+    assert br.state == BreakerState.OPEN
+    assert br.time_until_half_open() == pytest.approx(2.0)
+
+    # Successful probe closes and resets the backoff ladder.
+    clock.advance(2.1)
+    br.on_attempt()
+    br.record_success()
+    assert br.state == BreakerState.CLOSED
+    assert br.can_attempt()
+    assert br.opens_total == 2
+
+
+def test_breaker_backoff_capped():
+    clock = FakeClock()
+    br = CircuitBreaker(_breaker_cfg(breaker_open_max_s=4.0), clock=clock)
+    for round_ in range(6):
+        if round_ == 0:
+            for _ in range(3):
+                br.record_failure()
+        else:
+            clock.advance(100.0)
+            br.on_attempt()
+            br.record_failure()
+    assert br.time_until_half_open() <= 4.0
+
+
+# ---- discovery semantics (wildcard fix, probe failure) --------------------
+
+def test_serves_model_wildcard_semantics():
+    # Historical wildcard: empty list + wildcard=True serves everything
+    # (static discovery without --static-models).
+    assert EndpointInfo(url="http://a").serves_model("anything")
+    # Authoritative empty list (probed): serves nothing.
+    assert not EndpointInfo(url="http://a", wildcard=False).serves_model("m")
+    assert EndpointInfo(
+        url="http://a", model_names=["m"], wildcard=False
+    ).serves_model("m")
+
+
+def test_probe_models_returns_none_on_failure():
+    # A refused connection must yield None ("unknown"), never [] — an
+    # empty list would previously wildcard-match every model.
+    assert K8sServiceDiscovery._probe_models(_free_port_url()) is None
+
+
+# ---- health checker -------------------------------------------------------
+
+async def test_health_checker_marks_and_recovers():
+    engine = TestServer(build_fake_engine(model="m1", speed=1000, ttft=0.0))
+    await engine.start_server()
+    url = f"http://127.0.0.1:{engine.port}"
+    try:
+        discovery = initialize_service_discovery(
+            "static", urls=[url], models=["m1"])
+        mgr = initialize_resilience(ResilienceConfig(
+            health_check_interval=5.0, health_check_timeout=1.0,
+            health_failure_threshold=2, health_success_threshold=2,
+        ))
+        checker = mgr.health
+        assert checker is not None
+
+        await checker.probe_all()
+        assert checker.is_healthy(url)
+        assert [ep.url for ep in discovery.get_endpoint_info()] == [url]
+
+        engine.app["state"].fault = "unhealthy"
+        await checker.probe_all()
+        assert checker.is_healthy(url)  # one failure < threshold
+        await checker.probe_all()
+        assert not checker.is_healthy(url)
+        # Dead backend left rotation (static discovery too, not just
+        # the K8s pod-watch path) but is still discoverable raw.
+        assert discovery.get_endpoint_info() == []
+        assert [ep.url for ep in
+                discovery.get_endpoint_info(include_unhealthy=True)] == [url]
+        # The discovery module itself is still healthy.
+        assert discovery.get_health()
+
+        engine.app["state"].fault = None
+        await checker.probe_all()
+        assert not checker.is_healthy(url)  # one success < threshold
+        await checker.probe_all()
+        assert checker.is_healthy(url)
+        assert [ep.url for ep in discovery.get_endpoint_info()] == [url]
+    finally:
+        await engine.close()
+
+
+# ---- router stack helper --------------------------------------------------
+
+async def _start_router(urls, models, config):
+    """Initialize the router singletons against *urls* and return a
+    started TestClient for the router app."""
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.routing.logic import (
+        initialize_routing_logic,
+    )
+
+    initialize_service_discovery("static", urls=urls, models=models)
+    initialize_request_stats_monitor(60.0)
+    initialize_engine_stats_scraper(3600.0)
+    initialize_routing_logic("roundrobin")
+    initialize_request_rewriter("noop")
+    initialize_resilience(config)
+    client = TestClient(TestServer(build_app()))
+    await client.start_server()
+    return client
+
+
+def _chat_body(model, stream=False, max_tokens=3):
+    return {
+        "model": model,
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": max_tokens,
+        "stream": stream,
+    }
+
+
+# ---- status-code semantics ------------------------------------------------
+
+async def test_unknown_model_404_vs_no_capacity_503():
+    engine = TestServer(build_fake_engine(model="m1", speed=1000, ttft=0.0))
+    await engine.start_server()
+    url = f"http://127.0.0.1:{engine.port}"
+    client = await _start_router([url], ["m1"], ResilienceConfig(
+        health_check_interval=5.0, health_failure_threshold=1,
+    ))
+    try:
+        # Unknown model: 404, not 400 — "wrong request".
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("no-such-model"))
+        assert resp.status == 404
+
+        # Body problems are still 400s.
+        resp = await client.post("/v1/chat/completions", json={"x": 1})
+        assert resp.status == 400
+
+        # Known model, healthy endpoint: serves fine.
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("m1"))
+        assert resp.status == 200
+
+        # Known model but its only endpoint failed health checks:
+        # 503 "no capacity" with a Retry-After hint, not 400/502.
+        mgr = get_resilience()
+        mgr.health.record_probe(url, False)
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("m1"))
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+        data = await resp.json()
+        assert "m1" in data["error"]["message"]
+    finally:
+        await client.close()
+        await engine.close()
+
+
+# ---- acceptance: failover, breakers, recovery -----------------------------
+
+async def test_failover_e2e_and_breaker_recovery():
+    good = TestServer(build_fake_engine(model="m1", speed=1000, ttft=0.0))
+    bad500 = TestServer(build_fake_engine(
+        model="m1", speed=1000, ttft=0.0, fault="error500"))
+    await good.start_server()
+    await bad500.start_server()
+    good_url = f"http://127.0.0.1:{good.port}"
+    bad500_url = f"http://127.0.0.1:{bad500.port}"
+    refused_url = _free_port_url()
+    urls = [refused_url, bad500_url, good_url]
+
+    client = await _start_router(urls, ["m1"] * 3, ResilienceConfig(
+        max_retries=2,
+        backend_connect_timeout=1.0, backend_timeout=10.0,
+        health_check_interval=0.0,  # breakers only: deterministic
+        breaker_min_volume=2, breaker_window=10,
+        breaker_failure_rate=0.5,
+        breaker_open_base_s=0.4, breaker_open_max_s=2.0,
+        breaker_jitter=0.0,
+    ))
+    statuses = []
+    try:
+        # Phase 1: two of three backends are broken. Every request must
+        # still succeed by failing over within its retry budget.
+        for _ in range(8):
+            resp = await client.post("/v1/chat/completions",
+                                     json=_chat_body("m1"))
+            statuses.append(resp.status)
+            await resp.read()
+        assert statuses == [200] * 8
+        assert good.app["state"].total_served == 8
+
+        # Both bad endpoints' breakers opened, visible in /metrics.
+        mgr = get_resilience()
+        assert mgr.breaker(refused_url).state == BreakerState.OPEN
+        assert mgr.breaker(bad500_url).state == BreakerState.OPEN
+        metrics = await (await client.get("/metrics")).text()
+        for bad in (refused_url, bad500_url):
+            assert (f'vllm:circuit_breaker_state'
+                    f'{{server="{bad}"}} 2.0') in metrics
+        assert f'vllm:circuit_breaker_state{{server="{good_url}"}} 0.0' \
+            in metrics
+        assert mgr.retries_total > 0
+
+        # /health surfaces the tripped breakers.
+        health = await (await client.get("/health")).json()
+        assert set(health["resilience"]["tripped_breakers"]) == {
+            refused_url, bad500_url}
+        assert health["resilience"]["endpoints_available"] == 1
+
+        # Phase 2: clear the 500 fault and wait out the backoff; traffic
+        # must flow back through a successful half-open probe.
+        bad500.app["state"].fault = None
+        deadline = time.monotonic() + 5.0
+        while (mgr.breaker(bad500_url).time_until_half_open() > 0
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
+        before = bad500.app["state"].total_served
+        recovery = []
+        for _ in range(8):
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("m1"))
+            recovery.append(r.status)
+            await r.read()
+        assert recovery == [200] * 8
+        assert bad500.app["state"].total_served > before
+        assert mgr.breaker(bad500_url).state == BreakerState.CLOSED
+        metrics = await (await client.get("/metrics")).text()
+        assert (f'vllm:circuit_breaker_state{{server="{bad500_url}"}} 0.0'
+                in metrics)
+        # Zero 502s across the whole scenario.
+        assert 502 not in statuses + recovery
+    finally:
+        await client.close()
+        await good.close()
+        await bad500.close()
+
+
+async def test_all_backends_down_returns_503_retry_after():
+    refused_a, refused_b = _free_port_url(), _free_port_url()
+    client = await _start_router(
+        [refused_a, refused_b], ["m1", "m1"], ResilienceConfig(
+            max_retries=2, backend_connect_timeout=0.5,
+            health_check_interval=0.0,
+            breaker_min_volume=1, breaker_failure_rate=0.1,
+            breaker_open_base_s=5.0, breaker_jitter=0.0,
+        ))
+    try:
+        # First request exhausts its budget against dead backends: the
+        # breakers trip (min_volume=1) and the error is upstream-shaped.
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("m1"))
+        assert resp.status in (502, 503)
+        # Now every breaker is open: shed with 503 + Retry-After.
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("m1"))
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+        mgr = get_resilience()
+        assert mgr.shed_requests_total >= 1
+    finally:
+        await client.close()
+
+
+async def test_hang_times_out_and_fails_over():
+    good = TestServer(build_fake_engine(model="m1", speed=1000, ttft=0.0))
+    hang = TestServer(build_fake_engine(
+        model="m1", speed=1000, ttft=0.0, fault="hang"))
+    await good.start_server()
+    await hang.start_server()
+    urls = [f"http://127.0.0.1:{hang.port}", f"http://127.0.0.1:{good.port}"]
+    client = await _start_router(urls, ["m1", "m1"], ResilienceConfig(
+        max_retries=1, backend_connect_timeout=1.0, backend_timeout=0.7,
+        health_check_interval=0.0, breaker_min_volume=2,
+        breaker_jitter=0.0,
+    ))
+    try:
+        start = time.monotonic()
+        # Two requests: round-robin guarantees at least one of them
+        # starts on the hanging backend and must time out + fail over.
+        for _ in range(2):
+            resp = await client.post("/v1/chat/completions",
+                                     json=_chat_body("m1"))
+            assert resp.status == 200
+            await resp.read()
+        elapsed = time.monotonic() - start
+        assert elapsed < 5.0  # bounded by the 0.7s total timeout, not ∞
+        assert good.app["state"].total_served == 2
+        assert get_resilience().retries_total >= 1
+    finally:
+        await client.close()
+        await good.close()
+        await hang.close()
+
+
+async def test_midstream_abort_never_retried():
+    """A stream that already sent its first byte downstream must not be
+    retried on another backend, but the breaker and the request-stats
+    kill accounting must both hear about the death."""
+    good = TestServer(build_fake_engine(model="m1", speed=2000, ttft=0.0))
+    abort = TestServer(build_fake_engine(
+        model="m1", speed=2000, ttft=0.0, fault="abort_mid_stream"))
+    await good.start_server()
+    await abort.start_server()
+    good_url = f"http://127.0.0.1:{good.port}"
+    abort_url = f"http://127.0.0.1:{abort.port}"
+    client = await _start_router(
+        [abort_url, good_url], ["m1", "m1"], ResilienceConfig(
+            max_retries=2, health_check_interval=0.0,
+            breaker_min_volume=5, breaker_jitter=0.0,
+        ))
+    try:
+        bodies = []
+        for _ in range(2):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json=_chat_body("m1", stream=True, max_tokens=8))
+            assert resp.status == 200  # headers were streamed pre-abort
+            try:
+                bodies.append(await resp.text())
+            except Exception:
+                bodies.append("")  # truncated stream may error on read
+        # Round-robin sent one request to each engine; the aborted one
+        # is truncated (no [DONE]), the other completed.
+        done_flags = sorted("data: [DONE]" in b for b in bodies)
+        assert done_flags == [False, True]
+        # No retry happened: each engine saw exactly one request, and
+        # the failover counters never moved.
+        assert good.app["state"].requests_received == 1
+        assert abort.app["state"].requests_received == 1
+        mgr = get_resilience()
+        assert mgr.retries_total == 0
+        assert mgr.failovers_total == 0
+        # The breaker heard about the mid-stream death...
+        assert mgr.breaker(abort_url)._window.count(False) == 1
+        # ...and kill accounting cleaned up the in-flight request.
+        stats = get_request_stats_monitor().get_request_stats(time.time())
+        assert stats[abort_url].in_prefill_requests == 0
+        assert stats[abort_url].in_decoding_requests == 0
+    finally:
+        await client.close()
+        await good.close()
+        await abort.close()
+
+
+# ---- tracing annotation ---------------------------------------------------
+
+def test_span_records_failover_backends():
+    import json as json_mod
+
+    from production_stack_tpu.router.tracing import RequestSpan
+
+    span = RequestSpan("rid", "m", "/v1/chat/completions")
+    span.on_routed("http://dead:1")
+    span.on_routed("http://alive:2")
+    span.finish("ok")
+    data = json_mod.loads(span.to_json())
+    assert data["retries"] == 1
+    assert data["tried_backends"] == ["http://dead:1"]
+    assert data["backend"] == "http://alive:2"
