@@ -56,8 +56,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     parser.add_argument(
         "--backend-timeout", type=float, default=600.0,
-        help="Total seconds for one backend request incl. streaming "
-             "(0 = unbounded)",
+        help="Seconds a backend may stall any single read (waiting for "
+             "the response or between streamed chunks) before the "
+             "request is aborted; streams that keep producing are "
+             "never cut off (0 = unbounded)",
     )
     parser.add_argument(
         "--health-check-interval", type=float, default=10.0,

@@ -55,6 +55,11 @@ class ResilienceConfig:
     # request may be re-routed to after a pre-first-byte failure.
     max_retries: int = 2
     # Per-request backend timeouts (seconds). 0 disables that bound.
+    # ``backend_timeout`` bounds each socket read (waiting for the
+    # response to start, and every inter-chunk gap while streaming) —
+    # NOT the total exchange, so a generation that keeps streaming can
+    # run arbitrarily long while a backend that goes silent still gets
+    # cut off.
     backend_connect_timeout: float = 30.0
     backend_timeout: float = 600.0
     # Active health checking. interval 0 disables the prober.
@@ -72,9 +77,14 @@ class ResilienceConfig:
     breaker_half_open_max: int = 1
 
     def client_timeout(self) -> aiohttp.ClientTimeout:
+        # sock_read (per-read stall bound) rather than total: a total
+        # deadline would expire mid-stream on any legitimately long
+        # generation (or while a slow client drains the response) and
+        # blame a healthy backend for it.
         return aiohttp.ClientTimeout(
-            total=self.backend_timeout or None,
+            total=None,
             sock_connect=self.backend_connect_timeout or None,
+            sock_read=self.backend_timeout or None,
         )
 
 
@@ -126,17 +136,40 @@ class CircuitBreaker:
             return (self._half_open_inflight
                     < self._config.breaker_half_open_max)
 
-    def on_attempt(self) -> None:
-        """A request is actually being dispatched to this endpoint."""
+    def on_attempt(self) -> bool:
+        """Atomically admit one dispatch to this endpoint. Returns False
+        when the breaker is still open or every half-open probe slot is
+        taken — the caller must skip the endpoint (``can_attempt`` is
+        only an advisory pre-filter; concurrent requests may race
+        between it and here). Every True return MUST be balanced by
+        exactly one of ``record_success`` / ``record_failure`` /
+        ``release_attempt``, else a probe slot leaks and the breaker
+        wedges in HALF_OPEN forever."""
         with self._lock:
-            if (self._state == BreakerState.OPEN
-                    and self._clock() - self._opened_at
-                    >= self._reopen_after):
+            if self._state == BreakerState.OPEN:
+                if (self._clock() - self._opened_at
+                        < self._reopen_after):
+                    return False
                 self._state = BreakerState.HALF_OPEN
                 self._half_open_inflight = 0
                 logger.info("Breaker half-open (probe admitted)")
             if self._state == BreakerState.HALF_OPEN:
+                if (self._half_open_inflight
+                        >= self._config.breaker_half_open_max):
+                    return False
                 self._half_open_inflight += 1
+            return True
+
+    def release_attempt(self) -> None:
+        """Balance an admitted attempt that ended with neither success
+        nor failure — client disconnect, handler cancellation, unknown
+        proxy error. Frees the half-open probe slot so the next request
+        can ride as the probe instead of the breaker staying HALF_OPEN
+        with its slot leaked."""
+        with self._lock:
+            if self._state == BreakerState.HALF_OPEN:
+                self._half_open_inflight = max(
+                    0, self._half_open_inflight - 1)
 
     def record_success(self) -> None:
         with self._lock:
@@ -368,8 +401,13 @@ class ResilienceManager:
         br = self._breakers.get(url)
         return br is None or br.can_attempt()
 
-    def on_attempt(self, url: str) -> None:
-        self.breaker(url).on_attempt()
+    def on_attempt(self, url: str) -> bool:
+        """Atomic admission; a True return must be balanced by exactly
+        one record_success / record_failure / release_attempt."""
+        return self.breaker(url).on_attempt()
+
+    def release_attempt(self, url: str) -> None:
+        self.breaker(url).release_attempt()
 
     def record_success(self, url: str) -> None:
         self.breaker(url).record_success()

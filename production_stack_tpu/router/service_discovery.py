@@ -138,8 +138,13 @@ class K8sServiceDiscovery(ServiceDiscovery):
         self.port = port
         self.label_selector = label_selector
         self._endpoints: Dict[str, EndpointInfo] = {}  # pod name -> info
-        # pod name -> (url, attempts, next_probe_at) for failed probes.
+        # pod name -> (url, attempts, next_probe_at, generation) for
+        # failed probes. The generation token is bumped every time the
+        # watch (re)registers the pod, so a re-probe that raced with a
+        # watch event can detect its snapshot is stale instead of
+        # clobbering the fresh entry with stale attempt counts.
         self._pending_probe: Dict[str, tuple] = {}
+        self._probe_generation = 0
         self._lock = threading.Lock()
         self._running = True
         self._thread = threading.Thread(
@@ -174,44 +179,51 @@ class K8sServiceDiscovery(ServiceDiscovery):
             return None
 
     def _reprobe_loop(self) -> None:
-        """Retry failed model probes on a bounded exponential schedule;
-        the pod only enters rotation once a probe succeeds."""
         while self._running:
             time.sleep(self._REPROBE_TICK_S)
-            now = time.time()
+            self._reprobe_pass(time.time())
+
+    def _reprobe_pass(self, now: float) -> None:
+        """Retry failed model probes on a bounded exponential schedule;
+        the pod only enters rotation once a probe succeeds. The probe
+        itself runs unlocked, so after re-acquiring the lock each entry
+        is revalidated by its generation token: a watch event that
+        churned or re-registered the pod meanwhile wins, and this pass's
+        stale snapshot is discarded."""
+        with self._lock:
+            due = [
+                (name, url, attempts, gen)
+                for name, (url, attempts, next_at, gen)
+                in self._pending_probe.items()
+                if next_at <= now
+            ]
+        for name, url, attempts, gen in due:
+            models = self._probe_models(url)
             with self._lock:
-                due = [
-                    (name, url, attempts)
-                    for name, (url, attempts, next_at)
-                    in self._pending_probe.items()
-                    if next_at <= now
-                ]
-            for name, url, attempts in due:
-                models = self._probe_models(url)
-                with self._lock:
-                    current = self._pending_probe.get(name)
-                    if current is None or current[0] != url:
-                        continue  # pod churned meanwhile
-                    if models is not None:
-                        del self._pending_probe[name]
-                        self._endpoints[name] = EndpointInfo(
-                            url=url, model_names=models, pod_name=name,
-                            wildcard=False,
-                        )
-                        logger.info("Engine pod up after re-probe: "
-                                    "%s -> %s (%s)", name, url, models)
-                    elif attempts + 1 >= self._REPROBE_MAX_ATTEMPTS:
-                        del self._pending_probe[name]
-                        logger.error(
-                            "Model probe for %s (%s) failed %d times; "
-                            "pod stays out of rotation until its next "
-                            "watch event", name, url, attempts + 1)
-                    else:
-                        self._pending_probe[name] = (
-                            url, attempts + 1,
-                            time.time()
-                            + self._REPROBE_BASE_S * 2 ** (attempts + 1),
-                        )
+                current = self._pending_probe.get(name)
+                if current is None or current[3] != gen:
+                    continue  # pod churned / re-registered meanwhile
+                if models is not None:
+                    del self._pending_probe[name]
+                    self._endpoints[name] = EndpointInfo(
+                        url=url, model_names=models, pod_name=name,
+                        wildcard=False,
+                    )
+                    logger.info("Engine pod up after re-probe: "
+                                "%s -> %s (%s)", name, url, models)
+                elif attempts + 1 >= self._REPROBE_MAX_ATTEMPTS:
+                    del self._pending_probe[name]
+                    logger.error(
+                        "Model probe for %s (%s) failed %d times; "
+                        "pod stays out of rotation until its next "
+                        "watch event", name, url, attempts + 1)
+                else:
+                    self._pending_probe[name] = (
+                        url, attempts + 1,
+                        time.time()
+                        + self._REPROBE_BASE_S * 2 ** (attempts + 1),
+                        gen,
+                    )
 
     def _watch_pods(self) -> None:
         from kubernetes import watch
@@ -248,8 +260,10 @@ class K8sServiceDiscovery(ServiceDiscovery):
                         # Keep the pod out of rotation until a probe
                         # succeeds; the re-probe loop picks it up.
                         self._endpoints.pop(name, None)
+                        self._probe_generation += 1
                         self._pending_probe[name] = (
-                            url, 0, time.time() + self._REPROBE_BASE_S)
+                            url, 0, time.time() + self._REPROBE_BASE_S,
+                            self._probe_generation)
                     else:
                         self._pending_probe.pop(name, None)
                         self._endpoints[name] = EndpointInfo(

@@ -11,11 +11,14 @@ chunk-by-chunk into a ``web.StreamResponse`` with no buffering.
 Resilience (router/resilience.py) threads through this path: candidate
 endpoints are filtered by health + circuit breaker, a pre-first-byte
 failure (connect error, timeout, 5xx) fails over to the next-best
-endpoint within a retry budget, per-request connect/total timeouts bound
-every backend call, and exhaustion returns 503 + ``Retry-After`` when no
-endpoint is currently admittable (vs 502 when attempts genuinely
-failed). A stream that has already sent its first byte downstream is
-NEVER retried.
+endpoint within a retry budget, per-request connect and read-stall
+timeouts bound every backend call (reads, not the total exchange — long
+generations that keep streaming are never cut off), and exhaustion
+returns 503 + ``Retry-After`` when no endpoint is currently admittable
+(vs 502 when attempts genuinely failed). A stream that has already sent
+its first byte downstream is NEVER retried. Every breaker admission
+(``on_attempt``) is balanced by exactly one success / failure / release
+in ``_proxy_stream``'s finally, so half-open probe slots cannot leak.
 """
 
 from __future__ import annotations
@@ -81,12 +84,25 @@ class RetryableUpstreamError(Exception):
 
 class _BackendStreamError(Exception):
     """Backend died after bytes were already streamed downstream: the
-    breaker hears about it, but the request must not be retried."""
+    breaker hears about it, but the request must not be retried.
+    Carries the prepared (partial) client response so the handler can
+    end the request without tripping aiohttp's unhandled-error path."""
+
+    def __init__(self, reason: str, response: web.StreamResponse):
+        super().__init__(reason)
+        self.response = response
 
 
 class _ClientDisconnectedError(Exception):
     """The downstream client went away: not the backend's fault, so no
-    breaker blame and no retry."""
+    breaker blame and no retry. ``response`` is the prepared client
+    response when the disconnect happened mid-write, None when the
+    client vanished before the response could even be prepared."""
+
+    def __init__(self, reason: str,
+                 response: Optional[web.StreamResponse] = None):
+        super().__init__(reason)
+        self.response = response
 
 
 def _client_session(app: web.Application) -> aiohttp.ClientSession:
@@ -207,7 +223,8 @@ async def route_general_request(request: web.Request,
     max_attempts = 1 + (mgr.config.max_retries if mgr is not None else 0)
     tried: set = set()
     last_error: Optional[RetryableUpstreamError] = None
-    for attempt in range(max_attempts):
+    attempts = 0
+    while attempts < max_attempts:
         candidates = usable_endpoints(healthy, exclude=tried)
         if not candidates:
             break
@@ -226,16 +243,24 @@ async def route_general_request(request: web.Request,
                 return _error(429, f"Request not admitted: {e}")
         else:
             server_url = choice
+        if mgr is not None and not mgr.on_attempt(server_url):
+            # Lost the half-open probe-slot race between the
+            # usable_endpoints filter and dispatch (a concurrent request
+            # took the probe): skip this endpoint without burning retry
+            # budget.
+            monitor.on_request_kill(server_url, request_id)
+            policy.on_request_complete(server_url)
+            tried.add(server_url)
+            continue
         if span is not None:
             span.on_routed(server_url)
-        if attempt:
+        if attempts:
             logger.info("Failover attempt %d: re-routing %s to %s",
-                        attempt, request_id, server_url)
+                        attempts, request_id, server_url)
         queue_delay = time.time() - in_router_time
         logger.debug("Routing %s to %s (queued %.1f ms)",
                      request_id, server_url, queue_delay * 1e3)
-        if mgr is not None:
-            mgr.on_attempt(server_url)
+        attempts += 1
         try:
             response = await _proxy_stream(
                 request, server_url, endpoint_path, body, request_id,
@@ -245,15 +270,30 @@ async def route_general_request(request: web.Request,
             last_error = e
             tried.add(server_url)
             if mgr is not None:
-                mgr.record_failure(server_url)
                 mgr.retries_total += 1
             logger.warning(
                 "Pre-stream failure from %s for %s (%s); %s",
                 server_url, request_id, e,
-                "failing over" if attempt + 1 < max_attempts
+                "failing over" if attempts < max_attempts
                 else "retry budget exhausted")
             continue
-        if mgr is not None and attempt:
+        except _BackendStreamError as e:
+            # Bytes already reached the client: no retry. Abort the
+            # connection so the client sees truncation rather than a
+            # falsely-complete body; aiohttp treats the resulting write
+            # failure as a premature disconnect (debug log), not an
+            # unhandled handler error.
+            if request.transport is not None:
+                request.transport.close()
+            return e.response
+        except _ClientDisconnectedError as e:
+            # Routine client disconnect: nothing to send and nobody to
+            # send it to — end quietly instead of surfacing a 500.
+            if e.response is not None:
+                return e.response
+            return web.Response(status=499,
+                                reason="Client Closed Request")
+        if mgr is not None and attempts > 1:
             mgr.failovers_total += 1
         return response
 
@@ -315,7 +355,13 @@ async def _proxy_stream(request: web.Request, server_url: str,
                         span=None, mgr=None) -> web.StreamResponse:
     """One proxy attempt. Raises ``RetryableUpstreamError`` when the
     backend failed before anything was streamed to the client; once the
-    client response is prepared, failures are terminal."""
+    client response is prepared, failures are terminal.
+
+    The caller has already admitted this attempt via ``mgr.on_attempt``;
+    the ``finally`` below balances that admission with exactly one
+    breaker verdict — success, failure, or (when the request ended with
+    no verdict on the backend: client disconnect, cancellation, unknown
+    error) a slot release — so a half-open probe can never leak."""
     monitor = get_request_stats_monitor()
     session = _client_session(request.app)
     fwd_headers = {
@@ -328,6 +374,9 @@ async def _proxy_stream(request: web.Request, server_url: str,
     monitor.on_request_start(server_url, request_id, start_time)
     completed = False
     prepared = False
+    # True = backend's fault, False = backend succeeded, None = no
+    # verdict (release the breaker admission without an outcome).
+    blame: Optional[bool] = None
     response: Optional[web.StreamResponse] = None
     try:
         async with session.request(
@@ -364,10 +413,8 @@ async def _proxy_stream(request: web.Request, server_url: str,
                 except _NETWORK_ERRORS as e:
                     # Mid-stream death: bytes are already downstream, so
                     # failover is impossible — blame the backend, abort.
-                    if mgr is not None:
-                        mgr.record_failure(server_url)
                     raise _BackendStreamError(
-                        f"{type(e).__name__}: {e}") from e
+                        f"{type(e).__name__}: {e}", response) from e
                 if not chunk:
                     continue
                 monitor.on_request_response(
@@ -384,16 +431,17 @@ async def _proxy_stream(request: web.Request, server_url: str,
             monitor.on_request_complete(server_url, request_id, time.time())
             completed = True
             await response.write_eof()
-            if mgr is not None:
-                mgr.record_success(server_url)
+            blame = False
             if (cache_buffer is not None and backend.status == 200
                     and len(cache_buffer) < _CACHE_STORE_MAX_BYTES):
                 store_callback(bytes(cache_buffer))
             _finish_span(span, "ok")
             return response
     except RetryableUpstreamError:
+        blame = True
         raise
     except _BackendStreamError as e:
+        blame = True
         logger.warning("Backend stream from %s died mid-response for "
                        "%s: %s", server_url, request_id, e)
         _finish_span(span, "killed")
@@ -406,6 +454,7 @@ async def _proxy_stream(request: web.Request, server_url: str,
     except _NETWORK_ERRORS as e:
         if not prepared:
             # Connect error / timeout before the client saw anything.
+            blame = True
             raise RetryableUpstreamError(
                 f"{type(e).__name__}: {e}") from e
         # Client-side write failure (disconnect): not the backend's
@@ -413,7 +462,8 @@ async def _proxy_stream(request: web.Request, server_url: str,
         logger.info("Client connection lost for %s via %s: %s",
                     request_id, server_url, e)
         _finish_span(span, "killed")
-        raise
+        raise _ClientDisconnectedError(
+            f"{type(e).__name__}: {e}", response) from e
     except Exception as e:
         logger.warning("Proxy error for %s via %s: %s",
                        request_id, server_url, e)
@@ -423,6 +473,15 @@ async def _proxy_stream(request: web.Request, server_url: str,
                           err_type="upstream_error")
         raise
     finally:
+        if mgr is not None:
+            # Exactly one verdict per admission — runs on every exit,
+            # including cancellation when the client goes away.
+            if blame is True:
+                mgr.record_failure(server_url)
+            elif blame is False:
+                mgr.record_success(server_url)
+            else:
+                mgr.release_attempt(server_url)
         if not completed:
             monitor.on_request_kill(server_url, request_id)
         policy.on_request_complete(server_url)

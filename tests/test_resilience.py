@@ -12,6 +12,7 @@ probes once the faults clear.
 
 import asyncio
 import socket
+import threading
 import time
 
 import pytest
@@ -133,6 +134,76 @@ def test_breaker_half_open_probe_cycle_and_backoff_growth():
     assert br.opens_total == 2
 
 
+def test_breaker_on_attempt_admission_is_atomic():
+    """The half-open cap is enforced at dispatch (on_attempt), not just
+    in the advisory can_attempt pre-filter: concurrent requests that all
+    saw can_attempt()==True race for the slot and only one wins."""
+    clock = FakeClock()
+    br = CircuitBreaker(_breaker_cfg(), clock=clock)
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == BreakerState.OPEN
+    # Backoff not elapsed: admission (not just the pre-filter) refuses.
+    assert not br.on_attempt()
+    assert br.state == BreakerState.OPEN
+
+    clock.advance(1.1)
+    # Both callers passed can_attempt before either dispatched.
+    assert br.can_attempt()
+    assert br.can_attempt()
+    assert br.on_attempt()       # wins the probe slot
+    assert not br.on_attempt()   # loser is turned away atomically
+    assert br.state == BreakerState.HALF_OPEN
+    assert br._half_open_inflight == 1
+
+
+def test_breaker_release_attempt_frees_probe_slot():
+    """An admitted probe whose request ends with neither success nor
+    failure (client disconnect) must release its slot — otherwise the
+    breaker wedges in HALF_OPEN forever and the endpoint is blackholed
+    until restart."""
+    clock = FakeClock()
+    br = CircuitBreaker(_breaker_cfg(), clock=clock)
+    for _ in range(3):
+        br.record_failure()
+    clock.advance(1.1)
+    assert br.on_attempt()
+    assert not br.on_attempt()  # slot taken
+    # Client disconnected mid-probe: no verdict on the backend.
+    br.release_attempt()
+    assert br.state == BreakerState.HALF_OPEN
+    assert br._half_open_inflight == 0
+    # The next request rides as the probe and can close the breaker.
+    assert br.can_attempt()
+    assert br.on_attempt()
+    br.record_success()
+    assert br.state == BreakerState.CLOSED
+
+
+def test_breaker_release_attempt_noop_when_closed():
+    br = CircuitBreaker(_breaker_cfg(), clock=FakeClock())
+    assert br.on_attempt()
+    br.release_attempt()  # no state to unwind when closed
+    assert br.state == BreakerState.CLOSED
+    assert br.can_attempt()
+
+
+def test_client_timeout_bounds_reads_not_total():
+    """--backend-timeout is a per-read stall bound, never a total
+    deadline: a legitimate generation longer than the flag must not be
+    aborted mid-stream (and blamed on a healthy backend)."""
+    t = ResilienceConfig(backend_connect_timeout=3.0,
+                         backend_timeout=42.0).client_timeout()
+    assert t.total is None
+    assert t.sock_connect == 3.0
+    assert t.sock_read == 42.0
+    unbounded = ResilienceConfig(backend_connect_timeout=0.0,
+                                 backend_timeout=0.0).client_timeout()
+    assert unbounded.total is None
+    assert unbounded.sock_connect is None
+    assert unbounded.sock_read is None
+
+
 def test_breaker_backoff_capped():
     clock = FakeClock()
     br = CircuitBreaker(_breaker_cfg(breaker_open_max_s=4.0), clock=clock)
@@ -164,6 +235,65 @@ def test_probe_models_returns_none_on_failure():
     # A refused connection must yield None ("unknown"), never [] — an
     # empty list would previously wildcard-match every model.
     assert K8sServiceDiscovery._probe_models(_free_port_url()) is None
+
+
+def _bare_k8s_discovery():
+    """A K8sServiceDiscovery with just the state _reprobe_pass touches —
+    no kubernetes client, no watch threads."""
+    sd = object.__new__(K8sServiceDiscovery)
+    sd._endpoints = {}
+    sd._pending_probe = {}
+    sd._probe_generation = 0
+    sd._lock = threading.Lock()
+    sd._running = False
+    return sd
+
+
+def test_reprobe_pass_success_promotes_pod():
+    sd = _bare_k8s_discovery()
+    sd._pending_probe["pod"] = ("http://10.0.0.1:8000", 1, 0.0, 1)
+    sd._probe_models = lambda url: ["m1"]
+    sd._reprobe_pass(now=10.0)
+    assert sd._pending_probe == {}
+    ep = sd._endpoints["pod"]
+    assert ep.url == "http://10.0.0.1:8000"
+    assert ep.model_names == ["m1"] and not ep.wildcard
+
+
+def test_reprobe_pass_discards_stale_generation():
+    """A watch event that re-registers the pod (same URL, generation
+    bumped, attempts reset) while a re-probe is in flight must win: the
+    stale pass may neither overwrite the fresh attempt count nor evict
+    the pod based on its stale one."""
+    sd = _bare_k8s_discovery()
+    url = "http://10.0.0.1:8000"
+    # One failure away from permanent eviction under the old counter.
+    sd._pending_probe["pod"] = (
+        url, K8sServiceDiscovery._REPROBE_MAX_ATTEMPTS - 1, 0.0, 7)
+
+    def probe(probed_url):
+        # Mid-probe, the watch re-registers the same pod URL afresh.
+        sd._probe_generation = 8
+        sd._pending_probe["pod"] = (url, 0, 9999.0, 8)
+        return None  # and this (stale) probe fails
+
+    sd._probe_models = probe
+    sd._reprobe_pass(now=10.0)
+    # The fresh registration survived untouched: not deleted, attempts
+    # still 0, schedule unchanged.
+    assert sd._pending_probe["pod"] == (url, 0, 9999.0, 8)
+    assert "pod" not in sd._endpoints
+
+
+def test_reprobe_pass_evicts_after_max_attempts():
+    sd = _bare_k8s_discovery()
+    sd._pending_probe["pod"] = (
+        "http://10.0.0.1:8000",
+        K8sServiceDiscovery._REPROBE_MAX_ATTEMPTS - 1, 0.0, 3)
+    sd._probe_models = lambda url: None
+    sd._reprobe_pass(now=10.0)
+    assert sd._pending_probe == {}
+    assert sd._endpoints == {}
 
 
 # ---- health checker -------------------------------------------------------
@@ -463,6 +593,85 @@ async def test_midstream_abort_never_retried():
         await client.close()
         await good.close()
         await abort.close()
+
+
+async def test_client_disconnect_during_half_open_probe_releases_slot():
+    """THE wedge scenario: a client that hangs up during the recovery
+    probe (common when clients time out during an outage) must release
+    the half-open slot — not leak it and blackhole the endpoint until a
+    router restart."""
+    engine = TestServer(build_fake_engine(model="m1", speed=5, ttft=0.0))
+    await engine.start_server()
+    url = f"http://127.0.0.1:{engine.port}"
+    client = await _start_router([url], ["m1"], ResilienceConfig(
+        max_retries=0, health_check_interval=0.0,
+        breaker_min_volume=1, breaker_failure_rate=0.1,
+        breaker_open_base_s=0.1, breaker_jitter=0.0,
+    ))
+    try:
+        mgr = get_resilience()
+        br = mgr.breaker(url)
+        br.record_failure()
+        assert br.state == BreakerState.OPEN
+        await asyncio.sleep(0.15)  # open backoff elapses
+
+        # The recovery probe: a slow stream whose client walks away.
+        resp = await client.post(
+            "/v1/chat/completions",
+            json=_chat_body("m1", stream=True, max_tokens=50))
+        assert resp.status == 200
+        await resp.content.readany()
+        resp.close()
+
+        # The probe slot must come back; the breaker may not wedge in
+        # HALF_OPEN with every future attempt refused.
+        deadline = time.monotonic() + 5.0
+        while (br._half_open_inflight > 0
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
+        assert br.state == BreakerState.HALF_OPEN
+        assert br._half_open_inflight == 0
+        assert br.can_attempt()
+
+        # The next request rides as the probe and closes the breaker.
+        r2 = await client.post("/v1/chat/completions",
+                               json=_chat_body("m1", max_tokens=2))
+        assert r2.status == 200
+        await r2.read()
+        assert br.state == BreakerState.CLOSED
+    finally:
+        await client.close()
+        await engine.close()
+
+
+async def test_long_stream_outlives_backend_timeout():
+    """--backend-timeout bounds per-read stalls, not the exchange: a
+    generation that streams for longer than the flag (with small
+    inter-chunk gaps) completes, and the healthy backend is not blamed."""
+    engine = TestServer(build_fake_engine(model="m1", speed=10, ttft=0.0))
+    await engine.start_server()
+    url = f"http://127.0.0.1:{engine.port}"
+    client = await _start_router([url], ["m1"], ResilienceConfig(
+        max_retries=0, backend_connect_timeout=1.0, backend_timeout=0.3,
+        health_check_interval=0.0, breaker_min_volume=1,
+        breaker_failure_rate=0.1, breaker_jitter=0.0,
+    ))
+    try:
+        start = time.monotonic()
+        resp = await client.post(
+            "/v1/chat/completions",
+            json=_chat_body("m1", stream=True, max_tokens=10))
+        body = await resp.text()
+        elapsed = time.monotonic() - start
+        assert resp.status == 200
+        assert "data: [DONE]" in body
+        assert elapsed > 0.3  # stream genuinely outlived the bound
+        mgr = get_resilience()
+        assert mgr.breaker(url).state == BreakerState.CLOSED
+        assert mgr.retries_total == 0
+    finally:
+        await client.close()
+        await engine.close()
 
 
 # ---- tracing annotation ---------------------------------------------------
