@@ -193,10 +193,24 @@ def _bench_config(tpu: bool):
         cache.page_size = int(os.environ["BENCH_PAGE_SIZE"])
     if os.environ.get("BENCH_N_REQUESTS"):
         n_requests = int(os.environ["BENCH_N_REQUESTS"])
+    if os.environ.get("BENCH_OUT_LEN"):
+        out_len = int(os.environ["BENCH_OUT_LEN"])
     if os.environ.get("BENCH_DEFERRED"):
         sched.deferred_kv_writes = bool(int(os.environ["BENCH_DEFERRED"]))
     if os.environ.get("BENCH_QUANT"):
         model.quantization = os.environ["BENCH_QUANT"]
+    if os.environ.get("BENCH_SPEC_K"):
+        # Draft-free speculative decoding (docs/speculative.md).
+        # Hybrid with the decode burst: drafting steps run the verify
+        # program, draft-less steps keep the decode_steps burst.
+        # Deferred KV is incompatible (verify writes draft KV
+        # eagerly).
+        k = int(os.environ["BENCH_SPEC_K"])
+        sched.speculative_k = k
+        if k > 0:
+            sched.deferred_kv_writes = False
+            sched.speculative_min_match = int(
+                os.environ.get("BENCH_SPEC_MIN_MATCH", "2"))
     return (EngineConfig(model=model, cache=cache, scheduler=sched),
             n_requests, prompt_len, out_len)
 
@@ -244,7 +258,7 @@ def run_worker(impl: str, tpu: bool) -> None:
         )
         config.scheduler.deferred_kv_writes = deferred_kv_eligible(
             config.model.architecture, config.scheduler.decode_steps,
-            impl)
+            impl, speculative_k=config.scheduler.speculative_k)
     engine = LLMEngine(config)
     # The engine's per-kernel probe may itself have degraded a path.
     impls = (config.model.attention_impl_decode
@@ -278,7 +292,51 @@ def run_worker(impl: str, tpu: bool) -> None:
     warm2 = engine.generate(
         make_prompt(-2)[:1] * follow_len, sampling())
     assert len(warm2.output_token_ids) == out_len
+    if config.scheduler.speculative_k > 0:
+        # A highly repetitive prompt drafts immediately, so the
+        # speculative verify program compiles during warmup instead
+        # of inside the measured phases.
+        engine.generate([5, 6, 7] * (prompt_len // 3), sampling())
     sys.stderr.write(f"[bench-worker {impl}] warmup done\n")
+
+    # Decode-rate instrumentation: wrap the decode dispatch (normal,
+    # burst and speculative-verify steps all enter run_decode) so
+    # decode tokens/s is measured over decode wall time only — req/s
+    # mixes prefill in and can't answer "did speculation speed up
+    # decode".
+    decode_stats = {"wall": 0.0, "tokens": 0}
+    _orig_run_decode = engine.runner.run_decode
+
+    def _timed_run_decode(plan):
+        t = time.time()
+        toks, lps = _orig_run_decode(plan)
+        decode_stats["wall"] += time.time() - t
+        decode_stats["tokens"] += sum(len(r) for r in toks)
+        return toks, lps
+
+    engine.runner.run_decode = _timed_run_decode
+
+    # Decode-rate phase: steady-state decode tokens/s at full batch
+    # occupancy (all slots submitted up front, 4x-length outputs so
+    # decode dominates). The closed/open phases below mix prefill,
+    # admission staggering and arrival pacing into their walls; this
+    # phase isolates the number the decode path (burst vs speculative
+    # verify) is actually responsible for.
+    decode_sp = lambda: SamplingParams(  # noqa: E731
+        max_tokens=4 * out_len, temperature=0.0, ignore_eos=True)
+    # Prompts here are the repetitive multi-round shape the feature
+    # targets (a per-request block replayed round after round, like a
+    # follow-up that quotes its history) — prompt-lookup drafts from
+    # exactly this repetition, while the spec-off run sees the same
+    # prompts and takes the plain burst path.
+    dr_seqs = [engine.sequences[engine.add_request(
+        make_prompt(500 + i)[:32] * (prompt_len // 32), decode_sp())]
+        for i in range(config.scheduler.max_num_seqs)]
+    while any(s.state not in (SequenceState.FINISHED,
+                              SequenceState.ABORTED) for s in dr_seqs):
+        engine.step()
+    decode_rate = (decode_stats["tokens"] / decode_stats["wall"]
+                   if decode_stats["wall"] > 0 else 0.0)
 
     # Optional profiler capture of the timed region (BENCH_PROFILE=
     # <dir>); inspect with tensorboard's profile plugin or xprof.
@@ -416,6 +474,19 @@ def run_worker(impl: str, tpu: bool) -> None:
         "arrivals_p50_queueing_s": round(pctl(queueing2, 0.5), 4),
         "arrivals_p50_prefill_s": round(pctl(prefill2, 0.5), 4),
     }
+    # Speculative-decoding report. decode_tokens_per_s is the
+    # dedicated decode-rate phase (spec-off runs report it too so the
+    # driver can compare like for like); the acceptance counters span
+    # the whole run.
+    st = engine.stats()
+    drafted = st["spec_decode_num_draft_tokens_total"]
+    accepted = st["spec_decode_num_accepted_tokens_total"]
+    extra["speculative_k"] = config.scheduler.speculative_k
+    extra["decode_tokens_per_s"] = round(decode_rate, 1)
+    extra["spec_draft_tokens"] = int(drafted)
+    extra["spec_accepted_tokens"] = int(accepted)
+    extra["spec_acceptance_rate"] = round(
+        accepted / drafted, 4) if drafted else 0.0
     if mfu is not None:
         extra["mfu"] = round(mfu, 4)
     print(json.dumps({
@@ -429,12 +500,13 @@ def run_worker(impl: str, tpu: bool) -> None:
     }))
 
 
-def _spawn_worker(impl: str, tpu: bool, timeout: int):
+def _spawn_worker(impl: str, tpu: bool, timeout: int, extra_env=None):
     """Run one benchmark worker; returns (result_dict | None, error)."""
     cmd = [sys.executable, os.path.abspath(__file__),
            "--worker", impl] + (["--tpu"] if tpu else [])
     env = dict(os.environ)
     env["BENCH_DEVICE_KIND"] = _PROBE_LOG.get("device_kind", "")
+    env.update(extra_env or {})
     try:
         out = subprocess.run(cmd, timeout=timeout, capture_output=True,
                              text=True, env=env)
@@ -495,7 +567,8 @@ def main() -> None:
     for impl in attempts:
         sys.stderr.write(f"[bench] running {impl} worker "
                          f"(timeout {timeout}s)...\n")
-        result, err = _spawn_worker(impl, tpu, timeout)
+        result, err = _spawn_worker(impl, tpu, timeout,
+                                    extra_env={"BENCH_SPEC_K": "0"})
         if result is not None:
             break
         errors[f"{impl}_error"] = err
@@ -503,6 +576,27 @@ def main() -> None:
             "[bench] " + "=" * 60 + "\n"
             f"[bench] WARNING: {err}\n"
             "[bench] " + "=" * 60 + "\n")
+
+    if result is not None:
+        # Second pass with draft-free speculative decoding on
+        # (docs/speculative.md), same impl and same subprocess-timeout
+        # harness. Its numbers ride in extra under spec_on_* so the
+        # top-level metric/value/vs_baseline schema is unchanged.
+        spec_k = os.environ.get("BENCH_SPEC_K", "8")
+        sys.stderr.write(f"[bench] running {impl} spec-on worker "
+                         f"(k={spec_k}, timeout {timeout}s)...\n")
+        spec_result, spec_err = _spawn_worker(
+            impl, tpu, timeout, extra_env={"BENCH_SPEC_K": spec_k})
+        if spec_result is not None:
+            se = spec_result.get("extra", {})
+            result["extra"]["spec_on_req_per_s"] = spec_result["value"]
+            for key in ("decode_tokens_per_s", "spec_acceptance_rate",
+                        "spec_draft_tokens", "spec_accepted_tokens",
+                        "speculative_k"):
+                result["extra"][f"spec_on_{key}"] = se.get(key)
+        else:
+            errors["spec_on_error"] = spec_err
+            sys.stderr.write(f"[bench] WARNING: {spec_err}\n")
 
     if result is None:
         # Never hang the driver: report the failure as the metric line.

@@ -203,6 +203,19 @@ class SchedulerConfig:
     # Llama-family single-runner path only (guarded in model_runner);
     # requires decode_steps > 1.
     deferred_kv_writes: bool = False
+    # Draft-free speculative decoding (prompt lookup, docs/
+    # speculative.md): propose up to K continuation tokens per row
+    # from each sequence's own n-gram history and verify all K+1
+    # positions in ONE fixed-shape forward pass. 0 = off. Composes
+    # with decode_steps > 1 as a hybrid — steps where the proposer
+    # drafted run the verify program, draft-less steps fall back to
+    # the multi-step decode burst. Incompatible with
+    # deferred_kv_writes (the verify step must write draft KV
+    # eagerly so later draft positions attend to earlier ones).
+    speculative_k: int = 0
+    # Minimum n-gram length the proposer must match in the sequence's
+    # history before drafting its continuation.
+    speculative_min_match: int = 2
     max_queue_len: int = 1024
 
     def max_pages_per_seq(self, page_size: int) -> int:
@@ -265,6 +278,15 @@ class EngineConfig:
     seed: int = 0
 
     def __post_init__(self):
+        if self.scheduler.speculative_k > 0:
+            if self.scheduler.deferred_kv_writes:
+                raise ValueError(
+                    "speculative_k is incompatible with "
+                    "deferred_kv_writes (the verify step writes draft "
+                    "KV eagerly so accepted tokens can attend to it; "
+                    "docs/speculative.md §interactions)")
+            if self.scheduler.speculative_min_match < 1:
+                raise ValueError("speculative_min_match must be >= 1")
         # Learned-position-embedding models (gpt2/opt) index a fixed
         # [max_positions, h] table; JAX clamps out-of-range gathers
         # silently, so positions past the table would all reuse the

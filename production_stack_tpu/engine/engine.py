@@ -250,16 +250,33 @@ class LLMEngine:
                             lp_rows[i] if lp_rows else None))
         else:
             token_lists, lp_lists = self.runner.run_decode(plan.decode)
+            now = time.time()
+            spec_drafts = plan.decode.drafts
             with self._lock:
+                drafted = accepted = 0
                 for i, (seq, toks) in enumerate(
                         zip(plan.decode.seqs, token_lists)):
+                    if spec_drafts is not None:
+                        # Device-level acceptance (each verify row
+                        # emits accepted + 1 tokens), counted before
+                        # any host-side stop truncation so the rate
+                        # reflects the model, not request budgets.
+                        drafted += len(spec_drafts[i])
+                        accepted += len(toks) - 1
+                    emitted = 0
                     for k, tok in enumerate(toks):
                         if seq.state != SequenceState.RUNNING:
                             break  # stop hit mid-window: drop the tail
                         self.scheduler.append_decode_token(seq, tok)
+                        emitted += 1
                         outputs.append(self._delta(
                             seq, tok,
                             lp_lists[i][k] if lp_lists else None))
+                    self.metrics.on_decode_tokens(seq, emitted, now)
+                    if spec_drafts is not None:
+                        self.scheduler.on_spec_executed(seq)
+                if spec_drafts is not None:
+                    self.metrics.on_spec_step(drafted, accepted)
         for out in outputs:
             if out.finished:
                 seq = self.sequences.pop(out.seq_id, None)
@@ -299,6 +316,10 @@ class LLMEngine:
             "gpu_prefix_cache_hit_rate":
                 self.cache_manager.prefix_hit_rate(),
             "num_preemptions_total": self.scheduler.num_preemptions,
+            "spec_decode_num_draft_tokens_total":
+                self.metrics.spec_draft_tokens_total,
+            "spec_decode_num_accepted_tokens_total":
+                self.metrics.spec_accepted_tokens_total,
         }
         if self.offload is not None:
             out.update({

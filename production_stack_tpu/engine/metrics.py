@@ -71,6 +71,39 @@ class EngineMetrics:
         self.prompt_tokens_total = 0
         self.generation_tokens_total = 0
         self.requests_total: Dict[str, int] = {}
+        # Speculative decoding (docs/speculative.md): cumulative draft
+        # tokens proposed and accepted; acceptance rate =
+        # accepted / drafted. Always rendered (0 when the feature is
+        # off) so the router scraper sees a stable metric surface.
+        self.spec_draft_tokens_total = 0
+        self.spec_accepted_tokens_total = 0
+
+    def on_spec_step(self, drafted: int, accepted: int) -> None:
+        """One speculative verify step's draft/accept counts."""
+        with self._lock:
+            self.spec_draft_tokens_total += drafted
+            self.spec_accepted_tokens_total += accepted
+
+    def on_decode_tokens(self, seq, n_tokens: int,
+                         now: float) -> None:
+        """Observe inter-token latency for one row's decode step.
+
+        A step that emitted ``m`` tokens for the row observes m
+        intervals of (now - prev)/m: multi-token steps (speculative
+        verify, decode bursts) are credited at their true per-token
+        cadence instead of one per-step or per-request mean."""
+        if n_tokens <= 0:
+            return
+        prev = (seq.last_token_time
+                if seq.last_token_time is not None
+                else seq.first_token_time)
+        seq.last_token_time = now
+        if prev is None:
+            return
+        dt = max(0.0, now - prev) / n_tokens
+        with self._lock:
+            for _ in range(n_tokens):
+                self.itl.observe(dt)
 
     def on_finished(self, seq) -> None:
         with self._lock:
@@ -90,10 +123,9 @@ class EngineMetrics:
                     self.prefill_time.observe(
                         seq.first_token_time
                         - seq.first_scheduled_time)
-                if seq.finish_time is not None and n_out > 1:
-                    self.itl.observe(
-                        (seq.finish_time - seq.first_token_time)
-                        / (n_out - 1))
+                # Inter-token latency is observed per token as decode
+                # steps complete (on_decode_tokens) — no per-request
+                # mean here, which would double-count.
             if seq.finish_time is not None:
                 self.e2e.observe(seq.finish_time - seq.arrival_time)
 
@@ -114,6 +146,14 @@ class EngineMetrics:
                 "# TYPE vllm:generation_tokens_total counter",
                 ("vllm:generation_tokens_total "
                  f"{self.generation_tokens_total}"),
+                ("# TYPE vllm:spec_decode_num_draft_tokens_total "
+                 "counter"),
+                ("vllm:spec_decode_num_draft_tokens_total "
+                 f"{self.spec_draft_tokens_total}"),
+                ("# TYPE vllm:spec_decode_num_accepted_tokens_total "
+                 "counter"),
+                ("vllm:spec_decode_num_accepted_tokens_total "
+                 f"{self.spec_accepted_tokens_total}"),
             ]
             # vLLM's success counter tracks completed requests only;
             # aborts go to a separate failure counter so reference

@@ -26,6 +26,7 @@ from production_stack_tpu.ops.attention import write_to_pages
 from production_stack_tpu.ops.sampling import (
     apply_penalties,
     sample_tokens,
+    spec_verify,
     token_logprobs,
 )
 from production_stack_tpu.parallel.mesh import (
@@ -71,19 +72,24 @@ DEFERRED_KV_FAMILIES = ("llama", "mistral", "qwen2")
 
 def deferred_kv_eligible(architecture: str, decode_steps: int,
                          attention_impl: str, pipeline_parallel: int = 1,
-                         context_parallel: int = 1) -> bool:
+                         context_parallel: int = 1,
+                         speculative_k: int = 0) -> bool:
     """The ONE eligibility predicate for deferred KV writes.
 
     Used by the runner's capability guard (which raises on explicit
     ineligible 'on'), the server's '--deferred-kv-writes auto'
     resolution, and bench.py's impl gating — one definition so the
     three call sites cannot drift (e.g. re-enabling Pallas decode in
-    'auto' or adding an exclusion must flow to all of them)."""
+    'auto' or adding an exclusion must flow to all of them).
+    Speculative decoding excludes deferral: the verify step must
+    write draft KV eagerly so later draft positions attend to
+    earlier ones (docs/speculative.md §interactions)."""
     return (decode_steps > 1
             and architecture in DEFERRED_KV_FAMILIES
             and attention_impl in ("xla", "auto")
             and pipeline_parallel == 1
-            and context_parallel == 1)
+            and context_parallel == 1
+            and speculative_k == 0)
 
 # PSTPU_TIMING=1: log every dispatch's wall time (dispatch ->
 # device_get of the sampled tokens, i.e. including device execution)
@@ -408,6 +414,76 @@ class ModelRunner:
             self._sp_prefill_jit = jax.jit(
                 _sp_step, donate_argnums=(1, 2),
                 static_argnames=("want_logprobs",))
+
+        # Speculative verify (docs/speculative.md): ONE fixed-shape
+        # program scores S = speculative_k + 1 positions per decode
+        # slot through the T>1 (prefill) attention path over the page
+        # table; the acceptance rule runs in-graph (spec_verify).
+        self.spec_width = 0
+        if config.scheduler.speculative_k > 0:
+            if (config.parallel.pipeline_parallel_size > 1
+                    or self._sp_size > 1):
+                raise NotImplementedError(
+                    "speculative decoding with pipeline/context "
+                    "parallelism (the pp/sp runners use their own "
+                    "step bodies)")
+            self.spec_width = config.scheduler.speculative_k + 1
+            # The Pallas prefill kernel may not lower at the thin
+            # (decode_width, S) verify shape (Mosaic tiling rules are
+            # shape-specific), so probe exactly that shape and degrade
+            # ONLY the verify program to XLA attention — real prefill
+            # keeps its measured-winner kernel.
+            spec_model = model_config
+            prefill_impl = (model_config.attention_impl_prefill
+                            or model_config.attention_impl)
+            if (prefill_impl.startswith("pallas")
+                    and jax.default_backend() != "cpu"):
+                err = self._spec_lowering_error(model_config, config)
+                if err is not None:
+                    logger.info(
+                        "Speculative verify serves via XLA attention "
+                        "(Pallas prefill failed lowering at the "
+                        "verify shape): %s", err)
+                    import copy
+                    spec_model = copy.copy(model_config)
+                    spec_model.attention_impl_prefill = "xla"
+            self._spec_model = spec_model
+            self._spec_jit = jax.jit(
+                self._spec_verify_impl,
+                static_argnames=("want_logprobs",),
+                donate_argnums=(1, 2),  # k_cache, v_cache
+            )
+
+    def _spec_lowering_error(self, model_config,
+                             config) -> Optional[str]:
+        """Probe the Pallas prefill kernel at the verify shape."""
+        from production_stack_tpu.ops.prefill_attention_pallas import (
+            paged_prefill_attention,
+        )
+        nh, nkv, d = (model_config.num_attention_heads,
+                      model_config.num_key_value_heads,
+                      model_config.head_dim)
+        dtype = model_config.jax_dtype
+        max_pages = config.scheduler.max_pages_per_seq(
+            config.cache.page_size)
+        if config.cache.cache_layout == "per_layer":
+            cache = jax.ShapeDtypeStruct(
+                (nkv, config.cache.num_pages, d,
+                 config.cache.page_size), dtype)
+            layer0 = None
+        else:
+            cache = jax.ShapeDtypeStruct(
+                (model_config.num_hidden_layers, nkv,
+                 config.cache.num_pages, d, config.cache.page_size),
+                dtype)
+            layer0 = jax.ShapeDtypeStruct((), np.int32)
+        b, s = self.decode_width, self.spec_width
+        return self._lowering_error(
+            paged_prefill_attention,
+            jax.ShapeDtypeStruct((b, s, nh, d), dtype), cache, cache,
+            jax.ShapeDtypeStruct((b, max_pages), np.int32),
+            jax.ShapeDtypeStruct((b, s), np.int32),
+            jax.ShapeDtypeStruct((b,), np.int32), layer0)
 
     @staticmethod
     def _lowering_error(fn, *args) -> Optional[str]:
@@ -822,6 +898,46 @@ class ModelRunner:
                                          tail_valid, layer=l)
         return out, k_cache, v_cache
 
+    def _spec_verify_impl(self, params, k_cache, v_cache, tokens,
+                          positions, page_table, kv_lens, valid,
+                          drafts, draft_lens, temperature, top_p,
+                          top_k, rng, lora, lora_ids,
+                          want_logprobs: bool = False):
+        """One fixed-shape speculative verify step.
+
+        ``tokens[i] = [last_committed, d_1 .. d_k]`` (padded) at
+        absolute positions total_len-1 .. total_len-1+k. The forward
+        writes the draft tokens' KV into the sequence's pages exactly
+        like a prefill chunk (invalid slots land in the trash page)
+        and attends causally, so ``logits[i, j]`` is the target
+        model's distribution for the token at offset j past the
+        committed length — all k+1 positions scored in ONE pass.
+
+        Rejected drafts need NO device rollback: their KV lives past
+        the committed length in private pages (prefix hashing only
+        ever covers prompt tokens — scheduler.on_prefill_executed),
+        causally invisible to every later query until the next step
+        overwrites those positions (docs/speculative.md §rollback).
+        """
+        logits, k_cache, v_cache = self._forward(
+            params, self._spec_model, tokens, positions, page_table,
+            kv_lens, valid, k_cache, v_cache,
+            lora=lora, lora_ids=lora_ids,
+        )
+        out = spec_verify(logits, drafts, draft_lens, temperature,
+                          top_p, top_k, rng)
+        if want_logprobs:
+            # OpenAI logprobs from the raw per-position distributions;
+            # positions past a row's emitted count are discarded by
+            # the host parse.
+            b, s, v = logits.shape
+            lp = token_logprobs(logits.reshape(b * s, v),
+                                jnp.clip(out, 0).reshape(b * s),
+                                TOP_LOGPROBS_WIDTH)
+            lp = tuple(x.reshape((b, s) + x.shape[1:]) for x in lp)
+            return (out,) + lp, k_cache, v_cache
+        return out, k_cache, v_cache
+
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
@@ -845,7 +961,10 @@ class ModelRunner:
         is the multi-step window; prefill uses it as the token bucket
         (already baked into the array shapes).
         """
-        from production_stack_tpu.parallel.distributed import KIND_EMBED
+        from production_stack_tpu.parallel.distributed import (
+            KIND_EMBED,
+            KIND_SPEC,
+        )
         if kind == KIND_EMBED:
             return self.embedder.run_chunk(payload["tokens"],
                                            payload["lengths"])
@@ -855,6 +974,27 @@ class ModelRunner:
         penalties, seeding, bias, suppress, fsm = \
             self._optional_device_inputs(payload)
         want_lp = bool(payload.get("want_logprobs", False))
+        if kind == KIND_SPEC:
+            # Speculative verify: the scheduler only plans eligible
+            # rows (no penalties/seeds/bias/min_tokens/guided), so
+            # the program compiles without those inputs.
+            sampled, self.k_cache, self.v_cache = self._spec_jit(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(payload["tokens"]),
+                jnp.asarray(payload["positions"]),
+                jnp.asarray(payload["page_table"]),
+                jnp.asarray(payload["kv_lens"]),
+                jnp.asarray(payload["valid"]),
+                jnp.asarray(payload["drafts"]),
+                jnp.asarray(payload["draft_lens"]),
+                jnp.asarray(payload["temperature"]),
+                jnp.asarray(payload["top_p"]),
+                jnp.asarray(payload["top_k"]),
+                jnp.asarray(payload["rng"]),
+                self._lora_stack, lora_ids,
+                want_logprobs=want_lp,
+            )
+            return sampled  # [B, S] (+ logprob arrays when requested)
         if kind == 2 and t > 1:
             sampled, self.k_cache, self.v_cache = \
                 self._decode_burst_jit(
@@ -1270,6 +1410,8 @@ class ModelRunner:
         window the burst program evaluates per-row budgets and stop
         sets on device, so one dispatch + one device_get covers up to
         ``window`` tokens per row even when rows finish mid-burst."""
+        if plan.drafts is not None:
+            return self._run_spec_decode(plan)
         seqs = plan.seqs[: self.decode_width]
         b = self.decode_width
         window = max(1, plan.window)
@@ -1362,6 +1504,99 @@ class ModelRunner:
                 row_l.append(
                     self._lp_entry(seq, slp[k, i], tids[k, i],
                                    tlps[k, i])
+                    if seq.sampling.logprobs else None)
+            token_lists.append(row_t)
+            lp_lists.append(row_l)
+        return token_lists, lp_lists
+
+    def _run_spec_decode(self, plan: DecodePlan
+                         ) -> Tuple[List[List[int]], Optional[list]]:
+        """One speculative verify dispatch (docs/speculative.md).
+
+        Every running row rides the same fixed [B, S] program: rows
+        with a draft verify it, rows without (draft_len 0) decode
+        exactly one token through the identical shape — occupancy and
+        acceptance counts never change the compiled program. Returns
+        each row's accepted prefix plus the bonus/resample token
+        (1..S tokens, order-correct). The scheduler guarantees row
+        eligibility and that pages cover total_len + draft_len.
+        """
+        from production_stack_tpu.parallel.distributed import KIND_SPEC
+        seqs = plan.seqs[: self.decode_width]
+        b = self.decode_width
+        s = self.spec_width
+
+        tokens = np.zeros((b, s), np.int32)
+        positions = np.zeros((b, s), np.int32)
+        valid = np.zeros((b, s), bool)
+        kv_lens = np.zeros((b,), np.int32)
+        drafts = np.full((b, s - 1), -1, np.int32)
+        draft_lens = np.zeros((b,), np.int32)
+        # Pad rows stay temperature 0 so an all-greedy batch keeps the
+        # verify rule's argmax-only fast path (ops/sampling.py).
+        temperature = np.zeros((b,), np.float32)
+        top_p = np.ones((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
+
+        for i, seq in enumerate(seqs):
+            d = plan.drafts[i]
+            n = 1 + len(d)
+            tokens[i, 0] = (seq.output_token_ids[-1]
+                           if seq.output_token_ids
+                           else seq.prompt_token_ids[-1])
+            tokens[i, 1:n] = d
+            positions[i, :n] = np.arange(seq.total_len - 1,
+                                         seq.total_len - 1 + n)
+            valid[i, :n] = True
+            kv_lens[i] = seq.total_len + len(d)
+            drafts[i, :len(d)] = d
+            draft_lens[i] = len(d)
+            temperature[i] = seq.sampling.temperature
+            top_p[i] = seq.sampling.top_p
+            top_k[i] = seq.sampling.top_k
+
+        payload = {
+            "tokens": tokens,
+            "positions": positions,
+            "valid": valid,
+            "page_table": self._page_table_rows(seqs, pad_to=b),
+            "kv_lens": kv_lens,
+            "last_index": np.zeros((b,), np.int32),
+            "temperature": temperature,
+            "top_p": top_p,
+            "top_k": top_k,
+            "rng": np.asarray(self._next_rng()),
+            "drafts": drafts,
+            "draft_lens": draft_lens,
+        }
+        if self.lora_registry is not None:
+            ids = np.zeros((b,), np.int32)
+            for i, seq in enumerate(seqs):
+                ids[i] = seq.lora_id
+            payload["lora_ids"] = ids
+        want_lp = any(q.sampling.logprobs for q in seqs)
+        if want_lp:
+            payload["want_logprobs"] = True
+
+        t0 = time.perf_counter() if _TIMING else 0.0
+        sampled = self._dispatch(KIND_SPEC, s, payload)
+        host = jax.device_get(sampled)
+        if _TIMING:
+            _timing_log("spec", s, time.perf_counter() - t0)
+        if not want_lp:
+            return [[int(t) for t in host[i] if t >= 0]
+                    for i in range(len(seqs))], None
+        toks, slp, tids, tlps = host
+        token_lists, lp_lists = [], []
+        for i, seq in enumerate(seqs):
+            row_t, row_l = [], []
+            for j in range(s):
+                if toks[i, j] < 0:
+                    break
+                row_t.append(int(toks[i, j]))
+                row_l.append(
+                    self._lp_entry(seq, slp[i, j], tids[i, j],
+                                   tlps[i, j])
                     if seq.sampling.logprobs else None)
             token_lists.append(row_t)
             lp_lists.append(row_l)

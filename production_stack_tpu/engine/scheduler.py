@@ -17,7 +17,7 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
 from production_stack_tpu.engine.config import (
     CacheConfig,
@@ -68,6 +68,10 @@ class DecodePlan:
     # here so page-capacity reservation and the runner's compiled
     # program agree on the same lookahead.
     window: int = 1
+    # Speculative verify step (docs/speculative.md): per-row draft
+    # tokens parallel to ``seqs`` ([] = plain single-token row inside
+    # the same fixed-shape program). None = normal decode.
+    drafts: Optional[List[List[int]]] = None
 
 
 @dataclass
@@ -110,6 +114,14 @@ class Scheduler:
         # Cumulative count of sequences preempted for KV-cache
         # pressure (vllm:num_preemptions_total parity).
         self.num_preemptions = 0
+        # Draft-free speculative decoding (docs/speculative.md): the
+        # prompt-lookup proposer drafts from each sequence's own
+        # history; None when the feature is off.
+        self.proposer = None
+        if config.speculative_k > 0:
+            from production_stack_tpu.engine.spec import NgramProposer
+            self.proposer = NgramProposer(
+                config.speculative_k, config.speculative_min_match)
 
     # ---- queue management -------------------------------------------------
 
@@ -184,6 +196,10 @@ class Scheduler:
             want_decode = bool(self.running)
         if want_decode:
             self._last_was_prefill = False
+            if self.proposer is not None:
+                plan = self._plan_spec()
+                if plan is not None:
+                    return StepPlan(decode=plan)
             window = self._decode_window()
             self._ensure_decode_capacity(window)
             if self.running:
@@ -193,6 +209,61 @@ class Scheduler:
                 return StepPlan(decode=DecodePlan(
                     seqs=list(self.running), window=window))
         return StepPlan()
+
+    def _plan_spec(self) -> Optional[DecodePlan]:
+        """Plan one speculative verify step, or None to fall back to
+        plain decode (no row drafted anything, or a row needs per-row
+        device inputs the verify program doesn't compile). Exactly two
+        decode-side programs ever compile: the S-wide verify and the
+        decode_steps-window decode/burst the fallback uses."""
+        for seq in self.running:
+            sp = seq.sampling
+            if (sp.needs_penalties or sp.seed is not None
+                    or sp.logit_bias
+                    or sp.min_tokens > seq.num_generated
+                    or seq.fsm_state is not None):
+                # Whole-step fallback: padding these rows through the
+                # verify shape would need the penalty/seed/bias/
+                # suppress/guided inputs compiled into it; the normal
+                # decode path already serves them.
+                return None
+        drafts: Dict[str, List[int]] = {}
+        for seq in self.running:
+            # Cap so emitted tokens (accepted + bonus) never exceed
+            # the row's budget — a draft the budget can't emit would
+            # also write KV past max_model_len.
+            d = self.proposer.propose(seq, self._seq_budget(seq) - 1)
+            if d:
+                drafts[seq.seq_id] = d
+        if not drafts:
+            return None
+        # Hybrid profitability gate (docs/speculative.md
+        # §interactions): a verify step displaces a decode_steps-deep
+        # burst, and rows without drafts emit one token instead of
+        # decode_steps. Take the spec step only when, at full
+        # acceptance, it can emit at least as many tokens as the
+        # burst it displaces (each row emits accepted+1, so the batch
+        # emits <= sum(draft lens) + rows); otherwise defer — the
+        # drafts regrow from the same history on a later step. With
+        # decode_steps == 1 this always passes.
+        window = max(1, self.config.decode_steps)
+        if (sum(len(d) for d in drafts.values()) + len(self.running)
+                < window * len(self.running)):
+            return None
+        # Reserve pages for 1 + draft_len tokens per row; preemption
+        # inside the pass may shrink `running` (victims' drafts are
+        # simply dropped with them).
+        self._ensure_decode_capacity(per_seq={
+            s.seq_id: 1 + len(drafts.get(s.seq_id, ()))
+            for s in self.running})
+        if not self.running:
+            return None
+        plan_drafts = [drafts.get(s.seq_id, [])
+                       for s in self.running]
+        if not any(plan_drafts):
+            return None
+        return DecodePlan(seqs=list(self.running), window=1,
+                          drafts=plan_drafts)
 
     def _decode_window(self) -> int:
         """The decode burst evaluates per-row budgets and stop sets on
@@ -314,12 +385,24 @@ class Scheduler:
             return 0
         return -(-(target_tokens - have) // self.page_size)
 
-    def _ensure_decode_capacity(self, lookahead: int = 1) -> None:
+    def _ensure_decode_capacity(self, lookahead: int = 1,
+                                per_seq: Optional[Dict[str, int]]
+                                = None) -> None:
         """Every running sequence needs page slots for its next decode
         window: min(lookahead, its own remaining budget) tokens — a
-        row near its budget reserves only what its burst can write."""
+        row near its budget reserves only what its burst can write.
+        ``per_seq`` (speculative plans) overrides the uniform lookahead
+        with a per-sequence one (1 + draft length)."""
         for seq in list(self.running):
-            ahead = max(1, min(lookahead, self._seq_budget(seq)))
+            if seq.state != SequenceState.RUNNING:
+                # Preempted earlier in this very pass (we iterate a
+                # snapshot): allocating pages to a WAITING victim
+                # would leak them when prefill re-allocates from
+                # scratch.
+                continue
+            ahead = (per_seq.get(seq.seq_id, 1) if per_seq is not None
+                     else lookahead)
+            ahead = max(1, min(ahead, self._seq_budget(seq)))
             needed = self._pages_needed(seq, seq.total_len + ahead)
             if needed == 0:
                 continue
@@ -385,6 +468,18 @@ class Scheduler:
             self.running.append(seq)
             self._append_token(seq, sampled_token)
 
+    def on_spec_executed(self, seq: Sequence) -> None:
+        """Post-verify accounting rollback (docs/speculative.md).
+
+        The verify pass computed KV through ``total_len_before +
+        draft_len`` positions, but only the accepted prefix + bonus
+        were appended; the committed-token count must reflect exactly
+        the kept tokens — the rejected tail's KV is junk past
+        ``total_len``, causally invisible and overwritten by the next
+        step. Never counting it is the state rollback."""
+        if seq.state == SequenceState.RUNNING:
+            seq.num_computed_tokens = seq.total_len
+
     def append_decode_token(self, seq: Sequence, token: int) -> bool:
         """Append one decoded token; returns False if the sequence is
         no longer running (remaining window tokens are discarded)."""
@@ -421,6 +516,8 @@ class Scheduler:
                      else SequenceState.FINISHED)
         seq.finish_reason = reason
         seq.finish_time = time.time()
+        if self.proposer is not None:
+            self.proposer.drop(seq.seq_id)
         if seq.pages:
             self.cache.free_sequence(seq.pages)
             seq.pages = []

@@ -1124,7 +1124,7 @@ def _resolve_deferred_kv(args, model_config) -> bool:
     return deferred_kv_eligible(
         model_config.architecture, args.decode_steps,
         args.attention_impl, args.pipeline_parallel_size,
-        args.context_parallel_size)
+        args.context_parallel_size, args.speculative_k)
 
 
 def build_engine_from_args(args) -> tuple[LLMEngine, str]:
@@ -1194,6 +1194,8 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             prefill_batch_size=args.prefill_batch_size,
             decode_steps=args.decode_steps,
             deferred_kv_writes=_resolve_deferred_kv(args, model_config),
+            speculative_k=args.speculative_k,
+            speculative_min_match=args.speculative_min_match,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -1260,6 +1262,17 @@ def parse_args(argv=None):
     parser.add_argument("--decode-steps", type=int, default=1,
                         help="Decode iterations fused per compiled "
                              "program (K tokens per host round-trip)")
+    parser.add_argument("--speculative-k", type=int, default=0,
+                        help="Draft-free speculative decoding: propose "
+                             "up to K tokens per row via prompt lookup "
+                             "and verify K+1 positions in one pass "
+                             "(docs/speculative.md). 0 = off. Draft-"
+                             "less steps fall back to the --decode-"
+                             "steps burst; incompatible with "
+                             "--deferred-kv-writes on")
+    parser.add_argument("--speculative-min-match", type=int, default=2,
+                        help="Minimum n-gram match length before the "
+                             "prompt-lookup proposer drafts")
     parser.add_argument("--deferred-kv-writes", default="auto",
                         choices=["auto", "on", "off"],
                         help="Defer decode KV writes to one batched "

@@ -64,6 +64,42 @@ def token_logprobs(logits: jnp.ndarray, sampled: jnp.ndarray,
     return sampled_lp, top_ids.astype(jnp.int32), top_lp
 
 
+def _mask_top_k_top_p(scaled: jnp.ndarray, top_p: jnp.ndarray,
+                      top_k: jnp.ndarray) -> jnp.ndarray:
+    """NEG_INF-mask every logit outside its row's top-k/top-p set.
+
+    Shared by ``sample_tokens`` and ``spec_verify`` so the sampling
+    and speculative-verification distributions cannot drift.
+
+    Args:
+      scaled: [B, vocab] temperature-scaled logits
+      top_p:  [B] (1.0 => disabled)
+      top_k:  [B] int32 (0 => disabled)
+    """
+    b, vocab = scaled.shape
+    # Rank of each logit within its row (0 = largest).
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+
+    # top-k: keep ranks < k (k==0 disables).
+    ranks = jnp.arange(vocab)[None, :]
+    k = jnp.where(top_k > 0, top_k, vocab)
+    topk_mask = ranks < k[:, None]
+
+    # top-p: keep the smallest prefix with cumulative prob >=
+    # top_p, always including the most likely token.
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+    topp_mask = (cumprobs - sorted_probs) < top_p[:, None]
+
+    keep_sorted = topk_mask & topp_mask
+    masked_sorted = jnp.where(keep_sorted, sorted_logits, NEG_INF)
+    # Scatter the mask back to vocab order.
+    return jnp.zeros_like(scaled).at[
+        jnp.arange(b)[:, None], sort_idx
+    ].set(masked_sorted)
+
+
 def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
                   top_p: jnp.ndarray, top_k: jnp.ndarray,
                   key: jax.Array,
@@ -124,28 +160,7 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
         return jax.vmap(jax.random.categorical)(keys, masked)
 
     def masked_sample():
-        # Rank of each logit within its row (0 = largest).
-        sort_idx = jnp.argsort(-scaled, axis=-1)
-        sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
-
-        # top-k: keep ranks < k (k==0 disables).
-        ranks = jnp.arange(vocab)[None, :]
-        k = jnp.where(top_k > 0, top_k, vocab)
-        topk_mask = ranks < k[:, None]
-
-        # top-p: keep the smallest prefix with cumulative prob >=
-        # top_p, always including the most likely token.
-        sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cumprobs = jnp.cumsum(sorted_probs, axis=-1)
-        topp_mask = (cumprobs - sorted_probs) < top_p[:, None]
-
-        keep_sorted = topk_mask & topp_mask
-        masked_sorted = jnp.where(keep_sorted, sorted_logits, NEG_INF)
-        # Scatter the mask back to vocab order.
-        masked = jnp.zeros_like(scaled).at[
-            jnp.arange(b)[:, None], sort_idx
-        ].set(masked_sorted)
-        return categorical(masked)
+        return categorical(_mask_top_k_top_p(scaled, top_p, top_k))
 
     def plain_sample():
         # No top-k/top-p anywhere in the batch: skip the vocab sort.
@@ -166,3 +181,103 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
     return jnp.where(temperature > 0, sampled, greedy_tokens).astype(
         jnp.int32
     )
+
+
+def spec_verify(logits: jnp.ndarray, drafts: jnp.ndarray,
+                draft_lens: jnp.ndarray, temperature: jnp.ndarray,
+                top_p: jnp.ndarray, top_k: jnp.ndarray,
+                key: jax.Array) -> jnp.ndarray:
+    """Vectorized speculative-decoding acceptance rule.
+
+    One verify forward pass scored S = K+1 positions per row: the
+    row's last committed token followed by its K draft tokens (padded
+    with invalid slots). ``logits[:, j]`` is the target model's
+    distribution for the token at offset j past the committed length.
+
+    Acceptance (Leviathan et al. rejection sampling with a
+    deterministic point-mass proposal — the n-gram draft):
+      * greedy rows (temperature 0): draft j is accepted iff it equals
+        the raw-logits argmax at offset j — the emitted stream is
+        byte-identical to non-speculative greedy decode.
+      * stochastic rows: draft j is accepted with probability
+        p_j(d_j) under the row's FULL sampling distribution
+        (temperature + top-k/top-p via the same mask as
+        ``sample_tokens``); on rejection the replacement is drawn from
+        the residual distribution (the draft token masked out), which
+        leaves the output distribution exactly the target model's.
+    Acceptance stops at the first rejection; the row always emits one
+    token beyond its accepted prefix (the resample, or the bonus token
+    when every draft was accepted), so progress is >= 1 token/step.
+
+    Args:
+      logits:      [B, S, vocab] raw logits
+      drafts:      [B, S-1] int32 draft tokens, -1 padded
+      draft_lens:  [B] int32 in [0, S-1]; 0 = plain decode row
+      temperature: [B] (0 => greedy)
+      top_p:       [B] (1.0 => disabled)
+      top_k:       [B] int32 (0 => disabled)
+      key:         PRNG key for acceptance draws + residual samples
+
+    Returns [B, S] int32: row i's emitted tokens in its first
+    ``accepted_i + 1`` slots, -1 beyond.
+    """
+    b, s, vocab = logits.shape
+    pos = jnp.arange(s)[None, :]
+    in_draft = pos[:, :-1] < draft_lens[:, None]  # [B, S-1]
+    dsafe = jnp.clip(drafts, 0)
+    stochastic = temperature > 0  # [B]
+
+    # Residual removal mask: at offset j the (rejected) draft token is
+    # excluded from the replacement draw. Greedy rows share it — a
+    # rejected draft is by definition not the argmax, so removal never
+    # changes the greedy winner; the padded final column (bonus
+    # position) removes nothing.
+    remove = (jax.nn.one_hot(dsafe, vocab, dtype=bool)
+              & in_draft[..., None])
+    remove = jnp.pad(remove, ((0, 0), (0, 1), (0, 0)))  # [B, S, V]
+
+    greedy_targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    greedy_final = jnp.argmax(
+        jnp.where(remove, NEG_INF, logits), axis=-1).astype(jnp.int32)
+    accept_greedy = (drafts == greedy_targets[:, :-1]) & in_draft
+
+    def greedy_only():
+        # All-greedy batch (the common serving case): two argmaxes,
+        # no softmax/sort/randomness — mirrors sample_tokens' fast
+        # path.
+        return accept_greedy, greedy_final
+
+    def with_stochastic():
+        safe_temp = jnp.where(stochastic, temperature, 1.0)
+        scaled = (logits / safe_temp[:, None, None]).reshape(
+            b * s, vocab)
+        masked = _mask_top_k_top_p(
+            scaled, jnp.repeat(top_p, s), jnp.repeat(top_k, s)
+        ).reshape(b, s, vocab)
+        probs = jax.nn.softmax(masked, axis=-1)
+        p_draft = jnp.take_along_axis(
+            probs[:, :-1], dsafe[..., None], axis=-1)[..., 0]
+        key_u, key_r = jax.random.split(key)
+        u = jax.random.uniform(key_u, (b, s - 1))
+        accept_st = u < p_draft
+        accept = jnp.where(stochastic[:, None], accept_st,
+                           accept_greedy[:, :] | False)
+        # Residual (and bonus) draw at every offset; only the offset
+        # at the first rejection / past the accepted prefix is used.
+        resampled = jax.random.categorical(
+            key_r,
+            jnp.where(remove, NEG_INF, masked).reshape(b * s, vocab),
+            axis=-1).reshape(b, s).astype(jnp.int32)
+        final = jnp.where(stochastic[:, None], resampled,
+                          greedy_final)
+        return accept & in_draft, final
+
+    accept, final = jax.lax.cond(jnp.any(stochastic),
+                                 with_stochastic, greedy_only)
+    # Accepted prefix length: drafts accept left-to-right until the
+    # first rejection.
+    a = jnp.cumprod(accept.astype(jnp.int32), axis=-1).sum(axis=-1)
+    drafts_padded = jnp.pad(drafts, ((0, 0), (0, 1)))
+    return jnp.where(
+        pos < a[:, None], drafts_padded,
+        jnp.where(pos == a[:, None], final, -1)).astype(jnp.int32)

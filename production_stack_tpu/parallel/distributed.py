@@ -33,6 +33,7 @@ KIND_SHUTDOWN = 0
 KIND_PREFILL = 1
 KIND_DECODE = 2
 KIND_EMBED = 3  # /v1/embeddings|score|rerank batches (engine/embeddings.py)
+KIND_SPEC = 4  # speculative verify step (docs/speculative.md)
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -107,6 +108,11 @@ class MultihostStepBridge:
             }
         if kind == KIND_PREFILL:
             b, tt = r.prefill_width, t
+        elif kind == KIND_SPEC:
+            # Verify steps score t = speculative_k + 1 positions per
+            # decode slot; t is static per engine config so the shape
+            # is derivable from the header.
+            b, tt = r.decode_width, t
         else:
             b, tt = r.decode_width, 1
         template = {
@@ -121,6 +127,11 @@ class MultihostStepBridge:
             "top_k": np.zeros((b,), np.int32),
             "rng": np.zeros((2,), np.uint32),
         }
+        if kind == KIND_SPEC:
+            # Draft tokens per row (-1 padded) + true draft lengths;
+            # the acceptance rule runs in-graph (ops/sampling.py).
+            template["drafts"] = np.zeros((b, t - 1), np.int32)
+            template["draft_lens"] = np.zeros((b,), np.int32)
         if kind == KIND_DECODE and t > 1:
             # Decode bursts carry per-row lifecycle state
             # (model_runner.run_decode); STOP_SET_WIDTH is fixed so

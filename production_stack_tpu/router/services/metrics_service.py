@@ -43,6 +43,17 @@ avg_prefill_length = Gauge(
     "vllm:avg_prefill_length",
     "Average prompt length of routed requests (tokens)", _LBL)
 
+# -- scraped engine counters (stats/engine_stats.py) ------------------------
+engine_prefix_cache_hit_rate = Gauge(
+    "vllm:engine_gpu_prefix_cache_hit_rate",
+    "Engine-reported prefix-cache hit rate (scraped)", _LBL)
+spec_decode_num_draft_tokens = Gauge(
+    "vllm:spec_decode_num_draft_tokens",
+    "Engine-reported speculative draft tokens (scraped)", _LBL)
+spec_decode_num_accepted_tokens = Gauge(
+    "vllm:spec_decode_num_accepted_tokens",
+    "Engine-reported accepted speculative tokens (scraped)", _LBL)
+
 # -- resilience layer (router/resilience.py) --------------------------------
 circuit_breaker_state = Gauge(
     "vllm:circuit_breaker_state",
@@ -102,6 +113,20 @@ def refresh_gauges() -> None:
             stat.queueing_delay)
         avg_prefill_length.labels(server=server).set(
             stat.avg_prefill_length)
+    from production_stack_tpu.router.stats.engine_stats import (
+        get_engine_stats_scraper,
+    )
+    try:
+        engine_stats = get_engine_stats_scraper().get_engine_stats()
+    except ValueError:  # scraper not initialized (some test rigs)
+        engine_stats = {}
+    for server, es in engine_stats.items():
+        engine_prefix_cache_hit_rate.labels(server=server).set(
+            es.kv_cache_hit_rate)
+        spec_decode_num_draft_tokens.labels(server=server).set(
+            es.spec_decode_num_draft_tokens)
+        spec_decode_num_accepted_tokens.labels(server=server).set(
+            es.spec_decode_num_accepted_tokens)
     from production_stack_tpu.router.resilience import get_resilience
     mgr = get_resilience()
     try:

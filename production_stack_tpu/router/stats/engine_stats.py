@@ -25,12 +25,18 @@ logger = init_logger(__name__)
 
 _SCRAPE_TIMEOUT_S = 5.0
 
-# Exposition name -> EngineStats attribute.
+# Exposition name -> EngineStats attribute. Counter samples keep
+# their rendered ``_total`` names through the parser, so the map keys
+# them as exposed.
 _METRIC_MAP = {
     "vllm:num_requests_running": "num_running_requests",
     "vllm:num_requests_waiting": "num_queuing_requests",
     "vllm:gpu_prefix_cache_hit_rate": "kv_cache_hit_rate",
     "vllm:gpu_cache_usage_perc": "kv_usage_perc",
+    "vllm:spec_decode_num_draft_tokens_total":
+        "spec_decode_num_draft_tokens",
+    "vllm:spec_decode_num_accepted_tokens_total":
+        "spec_decode_num_accepted_tokens",
 }
 
 
@@ -40,6 +46,10 @@ class EngineStats:
     num_queuing_requests: int = 0
     kv_cache_hit_rate: float = 0.0
     kv_usage_perc: float = 0.0
+    # Speculative decoding counters (engine docs/speculative.md);
+    # acceptance rate = accepted / drafted when drafted > 0.
+    spec_decode_num_draft_tokens: float = 0.0
+    spec_decode_num_accepted_tokens: float = 0.0
 
     @classmethod
     def from_prometheus_text(cls, text: str) -> "EngineStats":

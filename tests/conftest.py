@@ -80,6 +80,7 @@ _SLOW_MODULES = {
     "test_real_checkpoint_sharded",
     "test_ring_attention",
     "test_score_rerank",
+    "test_spec_decode",
     "test_tracing",
 }
 

@@ -1,0 +1,177 @@
+"""End-to-end speculative decoding: greedy parity with the
+non-speculative engine (byte-identical outputs), hybrid composition
+with decode bursts, executable-cache stability, and KV-page
+accounting when sequences end mid-speculation."""
+
+import numpy as np
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sequence import SamplingParams
+
+
+def _engine(spec_k, decode_steps=1, **sched_kw):
+    config = EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=128),
+        scheduler=SchedulerConfig(max_num_seqs=4,
+                                  max_model_len=256,
+                                  prefill_chunk_size=32,
+                                  decode_steps=decode_steps,
+                                  speculative_k=spec_k,
+                                  **sched_kw),
+    )
+    return LLMEngine(config)
+
+
+def _gen(engine, prompts, **kw):
+    sampling = dict(max_tokens=16, temperature=0.0, ignore_eos=True)
+    sampling.update(kw)
+    seqs = []
+    for p in prompts:
+        sid = engine.add_request(p, SamplingParams(**sampling))
+        seqs.append(engine.sequences[sid])
+    while engine.has_work():
+        engine.step()
+    return [s.output_token_ids for s in seqs]
+
+
+def _drafted(engine):
+    return engine.stats()["spec_decode_num_draft_tokens_total"]
+
+
+# Prompt mix: repetitive histories (the drafting case — includes one
+# longer than prefill_chunk_size so speculation follows a chunked
+# prefill) plus a random prompt (drafts rarely; exercises the
+# mixed-batch fallback rows).
+def _prompt_mix():
+    rs = np.random.RandomState(7)
+    return [
+        [5, 6, 7] * 12,
+        [9, 9, 9, 9, 9, 9, 9, 9],
+        [11, 12, 13, 14] * 20,  # 80 tokens > chunk 32
+        [int(x) for x in rs.randint(1, 500, size=23)],
+    ]
+
+
+def test_greedy_parity_byte_identical():
+    prompts = _prompt_mix()
+    expected = _gen(_engine(spec_k=0), prompts)
+    spec = _engine(spec_k=4)
+    got = _gen(spec, prompts)
+    assert got == expected
+    assert all(len(t) == 16 for t in got)
+    assert _drafted(spec) > 0
+
+
+def test_greedy_parity_hybrid_with_decode_bursts():
+    """speculative_k composes with decode_steps>1: steps with drafts
+    verify, draft-less steps burst — outputs stay byte-identical."""
+    prompts = _prompt_mix()
+    expected = _gen(_engine(spec_k=0, decode_steps=1), prompts)
+    hybrid = _engine(spec_k=4, decode_steps=4)
+    got = _gen(hybrid, prompts)
+    assert got == expected
+
+
+def test_hybrid_profitability_gate_still_drafts_when_worthwhile():
+    """A solo looping sequence drafts full-k, so the spec step beats
+    the 4-token burst it displaces and must actually be taken."""
+    engine = _engine(spec_k=6, decode_steps=4)
+    _gen(engine, [[5, 6, 7] * 12], max_tokens=24)
+    assert _drafted(engine) > 0
+
+
+def test_spec_respects_max_tokens_and_stop_tokens():
+    """Budgets and stop tokens must behave identically when the
+    stopping token arrives inside an accepted draft run (the emitted
+    tail past the stop is discarded)."""
+    prompt = [5, 6, 7] * 12
+    ref = _gen(_engine(spec_k=0), [prompt], max_tokens=20)[0]
+
+    got = _gen(_engine(spec_k=4), [prompt], max_tokens=13)[0]
+    assert got == ref[:13]
+
+    stop = ref[9]
+    kw = dict(max_tokens=20, ignore_eos=False, stop_token_ids=[stop])
+    base = _gen(_engine(spec_k=0), [prompt], **kw)[0]
+    spec = _gen(_engine(spec_k=4), [prompt], **kw)[0]
+    assert spec == base
+    assert spec[-1] == stop
+
+
+def test_stochastic_rows_fall_back_and_finish():
+    """Seeded stochastic rows are spec-ineligible (the whole step
+    falls back) but must still complete alongside greedy rows, and
+    the greedy row must keep parity."""
+    prompts = _prompt_mix()[:2]
+    solo = _gen(_engine(spec_k=0), [prompts[0]])[0]
+    engine = _engine(spec_k=4)
+    sids = [
+        engine.add_request(prompts[0], SamplingParams(
+            max_tokens=16, temperature=0.0, ignore_eos=True)),
+        engine.add_request(prompts[1], SamplingParams(
+            max_tokens=16, temperature=0.9, seed=42,
+            ignore_eos=True)),
+    ]
+    seqs = [engine.sequences[s] for s in sids]
+    while engine.has_work():
+        engine.step()
+    assert seqs[0].output_token_ids == solo
+    assert len(seqs[1].output_token_ids) == 16
+
+
+def test_no_recompilation_across_mixed_run():
+    """A long mixed prefill/decode/speculative run must not grow the
+    executable caches: decode + verify each compile ONE fixed shape
+    (plus prefill's pow-2 chunk buckets), and further steps reuse
+    them."""
+    engine = _engine(spec_k=4, decode_steps=4)
+    steps = {"n": 0}
+    orig_step = engine.step
+
+    def counting_step():
+        steps["n"] += 1
+        return orig_step()
+
+    engine.step = counting_step
+
+    _gen(engine, _prompt_mix(), max_tokens=24)
+    step_sizes = engine.runner._step_jit._cache_size()
+    spec_sizes = engine.runner._spec_jit._cache_size()
+    assert _drafted(engine) > 0
+
+    # Further waves, same shape mix, until the run passes 50 steps —
+    # the caches must never grow past the first wave's.
+    while steps["n"] < 50:
+        _gen(engine, _prompt_mix()[::-1], max_tokens=24)
+        assert engine.runner._step_jit._cache_size() == step_sizes
+        assert engine.runner._spec_jit._cache_size() == spec_sizes
+    assert steps["n"] >= 50
+    assert spec_sizes == 1
+
+
+def test_kv_pages_released_after_finish_mid_speculation():
+    """A sequence ending inside a speculative step (max_tokens hit on
+    an accepted draft) must release every page it held and leave
+    hashed pages reusable: a second identical prompt prefix-hits and
+    reproduces the output exactly."""
+    engine = _engine(spec_k=4)
+    cm = engine.cache_manager
+    assert cm.num_used_pages == 0
+
+    prompt = [5, 6, 7] * 12
+    first = _gen(engine, [prompt], max_tokens=13)[0]
+    assert _drafted(engine) > 0
+    assert cm.num_used_pages == 0, "pages leaked by mid-spec finish"
+
+    hits_before = cm.prefix_hit_tokens
+    second = _gen(engine, [prompt], max_tokens=13)[0]
+    assert second == first
+    assert cm.prefix_hit_tokens > hits_before
+    assert cm.num_used_pages == 0
