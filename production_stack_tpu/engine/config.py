@@ -216,6 +216,16 @@ class SchedulerConfig:
     # Minimum n-gram length the proposer must match in the sequence's
     # history before drafting its continuation.
     speculative_min_match: int = 2
+    # Overlapped async execution pipeline (docs/async_pipeline.md):
+    # plan and dispatch decode step N+1 — feeding step N's sampled
+    # tokens forward as a device array — before step N's results are
+    # read back to the host, so completion work (detokenize, stop
+    # checks, stream fan-out) overlaps device execution. Pure-decode
+    # single-token steps only; requires decode_steps == 1 and
+    # speculative_k == 0 (both already amortize host round-trips on
+    # device — the pipeline would race their host-side state).
+    # Greedy output is byte-identical to the synchronous loop.
+    async_scheduling: bool = False
     max_queue_len: int = 1024
 
     def max_pages_per_seq(self, page_size: int) -> int:
@@ -287,6 +297,28 @@ class EngineConfig:
                     "docs/speculative.md §interactions)")
             if self.scheduler.speculative_min_match < 1:
                 raise ValueError("speculative_min_match must be >= 1")
+        if self.scheduler.async_scheduling:
+            # Mirror of the spec x deferred exclusion: the async
+            # pipeline's plan-ahead assumes exactly one committed
+            # token per running row per in-flight step; a multi-step
+            # burst or speculative verify commits a data-dependent
+            # count the ahead plan cannot predict. The server's
+            # --async-scheduling auto resolves these conflicts off
+            # (async_scheduling_eligible); an explicit 'on' fails
+            # loudly here.
+            if self.scheduler.decode_steps > 1:
+                raise ValueError(
+                    "async_scheduling is incompatible with "
+                    "decode_steps > 1 (the plan-ahead step assumes "
+                    "one committed token per row per dispatch; "
+                    "docs/async_pipeline.md §interactions)")
+            if self.scheduler.speculative_k > 0:
+                raise ValueError(
+                    "async_scheduling is incompatible with "
+                    "speculative_k > 0 (verify steps commit a "
+                    "data-dependent token count the ahead plan "
+                    "cannot predict; docs/async_pipeline.md "
+                    "§interactions)")
         # Learned-position-embedding models (gpt2/opt) index a fixed
         # [max_positions, h] table; JAX clamps out-of-range gathers
         # silently, so positions past the table would all reuse the

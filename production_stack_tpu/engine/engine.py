@@ -78,6 +78,13 @@ class LLMEngine:
         self._lock = threading.Lock()
         from production_stack_tpu.engine.metrics import EngineMetrics
         self.metrics = EngineMetrics()
+        # Overlapped async pipeline state (docs/async_pipeline.md):
+        # at most ONE dispatched-but-unread decode step. ``_idle_mark``
+        # timestamps the moment the device drained its queue so the
+        # next dispatch can account the idle gap — the quantity the
+        # pipeline exists to shrink.
+        self._in_flight = None
+        self._idle_mark: Optional[float] = None
         self.offload = None
         if config.offload.enable:
             self._init_offload()
@@ -222,67 +229,213 @@ class LLMEngine:
                 self.metrics.on_finished(seq)
 
     def has_work(self) -> bool:
-        return self.scheduler.has_work()
+        # A dispatched-but-unread decode step is work: the loop must
+        # come back to reconcile it even if every row since finished.
+        return self._in_flight is not None or self.scheduler.has_work()
 
     # ---- engine step ------------------------------------------------------
 
     def step(self) -> List[StepOutput]:
-        """Plan + execute one device program; returns per-seq deltas."""
-        outputs: List[StepOutput] = []
+        """Plan + execute one device program; returns per-seq deltas.
+
+        ``scheduler.async_scheduling`` routes decode through the
+        overlapped plan -> dispatch -> complete pipeline
+        (docs/async_pipeline.md): step N+1 is planned and dispatched
+        before step N's tokens are read back, hiding scheduler/commit
+        host work behind the device step. Single-host only — the
+        multihost step bridge broadcasts host-resident numpy payloads.
+        """
+        if (self.config.scheduler.async_scheduling
+                and self.runner.bridge is None):
+            return self._step_async()
+        return self._step_sync()
+
+    def _plan_locked(self, outputs: List[StepOutput]):
         with self._lock:
             plan = self.scheduler.plan_step()
             for seq in self.scheduler.newly_aborted:
                 outputs.append(self._delta(seq, None))
             self.scheduler.newly_aborted.clear()
+        return plan
+
+    def _step_sync(self) -> List[StepOutput]:
+        outputs: List[StepOutput] = []
+        t0 = time.perf_counter()
+        plan = self._plan_locked(outputs)
         if plan.empty:
             for out in outputs:
                 self.sequences.pop(out.seq_id, None)
             return outputs
         if plan.prefill is not None:
-            sampled, lp_rows = self.runner.run_prefill(plan.prefill)
-            with self._lock:
-                for i, (chunk, token) in enumerate(
-                        zip(plan.prefill.chunks, sampled)):
-                    self.scheduler.on_prefill_executed(chunk, token)
-                    if chunk.is_last_chunk:
-                        outputs.append(self._delta(
-                            chunk.seq, token,
-                            lp_rows[i] if lp_rows else None))
+            wait_s = self._execute_prefill(plan, outputs)
         else:
-            token_lists, lp_lists = self.runner.run_decode(plan.decode)
-            now = time.time()
-            spec_drafts = plan.decode.drafts
-            with self._lock:
-                drafted = accepted = 0
-                for i, (seq, toks) in enumerate(
-                        zip(plan.decode.seqs, token_lists)):
-                    if spec_drafts is not None:
-                        # Device-level acceptance (each verify row
-                        # emits accepted + 1 tokens), counted before
-                        # any host-side stop truncation so the rate
-                        # reflects the model, not request budgets.
-                        drafted += len(spec_drafts[i])
-                        accepted += len(toks) - 1
-                    emitted = 0
-                    for k, tok in enumerate(toks):
-                        if seq.state != SequenceState.RUNNING:
-                            break  # stop hit mid-window: drop the tail
-                        self.scheduler.append_decode_token(seq, tok)
-                        emitted += 1
-                        outputs.append(self._delta(
-                            seq, tok,
-                            lp_lists[i][k] if lp_lists else None))
-                    self.metrics.on_decode_tokens(seq, emitted, now)
-                    if spec_drafts is not None:
-                        self.scheduler.on_spec_executed(seq)
+            wait_s = self._execute_decode_sync(plan, outputs)
+        self.metrics.on_pipeline_step(
+            host_s=(time.perf_counter() - t0) - wait_s,
+            device_wait_s=wait_s, ahead=False)
+        self._pop_finished(outputs)
+        return outputs
+
+    def _execute_prefill(self, plan, outputs) -> float:
+        td = time.perf_counter()
+        self._note_dispatch(td)
+        sampled, lp_rows = self.runner.run_prefill(plan.prefill)
+        tr = time.perf_counter()
+        self._idle_mark = tr
+        with self._lock:
+            for i, (chunk, token) in enumerate(
+                    zip(plan.prefill.chunks, sampled)):
+                self.scheduler.on_prefill_executed(chunk, token)
+                if chunk.is_last_chunk:
+                    outputs.append(self._delta(
+                        chunk.seq, token,
+                        lp_rows[i] if lp_rows else None))
+        return tr - td
+
+    def _execute_decode_sync(self, plan, outputs) -> float:
+        td = time.perf_counter()
+        self._note_dispatch(td)
+        token_lists, lp_lists = self.runner.run_decode(plan.decode)
+        tr = time.perf_counter()
+        self._idle_mark = tr
+        now = time.time()
+        spec_drafts = plan.decode.drafts
+        with self._lock:
+            drafted = accepted = 0
+            for i, (seq, toks) in enumerate(
+                    zip(plan.decode.seqs, token_lists)):
                 if spec_drafts is not None:
-                    self.metrics.on_spec_step(drafted, accepted)
+                    # Device-level acceptance (each verify row
+                    # emits accepted + 1 tokens), counted before
+                    # any host-side stop truncation so the rate
+                    # reflects the model, not request budgets.
+                    drafted += len(spec_drafts[i])
+                    accepted += len(toks) - 1
+                emitted = 0
+                for k, tok in enumerate(toks):
+                    if seq.state != SequenceState.RUNNING:
+                        break  # stop hit mid-window: drop the tail
+                    self.scheduler.append_decode_token(seq, tok)
+                    emitted += 1
+                    outputs.append(self._delta(
+                        seq, tok,
+                        lp_lists[i][k] if lp_lists else None))
+                self.metrics.on_decode_tokens(seq, emitted, now)
+                if spec_drafts is not None:
+                    self.scheduler.on_spec_executed(seq)
+            if spec_drafts is not None:
+                self.metrics.on_spec_step(drafted, accepted)
+        return tr - td
+
+    # ---- overlapped async pipeline (docs/async_pipeline.md) ---------------
+
+    def _step_async(self) -> List[StepOutput]:
+        """One pipeline turn, depth 1: when a decode step is in
+        flight, plan and dispatch its successor BEFORE reading its
+        results. The successor consumes the in-flight step's
+        sampled-token device array directly (DecodeStepHandle
+        .token_source), so the device starts step N+1 while the host
+        is still committing step N's tokens."""
+        handle = self._in_flight
+        if handle is not None:
+            t0 = time.perf_counter()
+            with self._lock:
+                rows = self.scheduler.plan_ahead(handle.rows)
+            if rows is not None:
+                self._in_flight = self.runner.dispatch_decode(
+                    rows, token_source=handle.token_source,
+                    ahead=True)
+                outputs, wait_s = self._complete(handle)
+                # No _idle_mark here: step N+1 was queued before step
+                # N's results were read — the device never idled.
+                self.metrics.on_pipeline_step(
+                    host_s=(time.perf_counter() - t0) - wait_s,
+                    device_wait_s=wait_s, ahead=True)
+                return outputs
+            # Pipeline break (prefill waiting / ineligible row / no
+            # boundary pages): drain the in-flight step, then let the
+            # next step() re-plan synchronously with full knowledge.
+            self._in_flight = None
+            self.metrics.set_inflight_depth(0)
+            outputs, wait_s = self._complete(handle)
+            self._idle_mark = time.perf_counter()
+            self.metrics.on_pipeline_step(
+                host_s=(time.perf_counter() - t0) - wait_s,
+                device_wait_s=wait_s, ahead=False)
+            return outputs
+        outputs: List[StepOutput] = []
+        t0 = time.perf_counter()
+        plan = self._plan_locked(outputs)
+        if plan.empty:
+            for out in outputs:
+                self.sequences.pop(out.seq_id, None)
+            return outputs
+        if plan.prefill is not None:
+            # Prefill stays synchronous: each chunk's commit feeds
+            # the next chunk's plan.
+            wait_s = self._execute_prefill(plan, outputs)
+            self.metrics.on_pipeline_step(
+                host_s=(time.perf_counter() - t0) - wait_s,
+                device_wait_s=wait_s, ahead=False)
+            self._pop_finished(outputs)
+            return outputs
+        # Pure-decode plan: dispatch and return without waiting.
+        # (async_scheduling forbids decode bursts and spec decode —
+        # config.__post_init__ — so the plan is always a single-step
+        # window with no drafts.)
+        self._note_dispatch(time.perf_counter())
+        self._in_flight = self.runner.dispatch_decode(
+            plan.decode.seqs[: self.runner.decode_width])
+        self.metrics.set_inflight_depth(1)
+        self.metrics.on_pipeline_step(
+            host_s=time.perf_counter() - t0, device_wait_s=0.0,
+            ahead=False)
+        self._pop_finished(outputs)
+        return outputs
+
+    def _complete(self, handle) -> tuple:
+        """Read back + reconcile one dispatched decode step: commit
+        tokens through the same scheduler path as the sync loop. Rows
+        that finished or were aborted mid-flight break out exactly as
+        there; plan-ahead boundary pages ride seq.pages and return
+        through the ordinary free_sequence path, so a mid-flight
+        abort leaks nothing."""
+        tw = time.perf_counter()
+        token_lists, lp_lists = handle.result()
+        wait_s = time.perf_counter() - tw
+        now = time.time()
+        outputs: List[StepOutput] = []
+        with self._lock:
+            for i, (seq, toks) in enumerate(
+                    zip(handle.rows, token_lists)):
+                if seq is None:  # plan-ahead masked slot
+                    continue
+                emitted = 0
+                for k, tok in enumerate(toks):
+                    if seq.state != SequenceState.RUNNING:
+                        break
+                    self.scheduler.append_decode_token(seq, tok)
+                    emitted += 1
+                    outputs.append(self._delta(
+                        seq, tok,
+                        lp_lists[i][k] if lp_lists else None))
+                self.metrics.on_decode_tokens(seq, emitted, now)
+        self._pop_finished(outputs)
+        return outputs, wait_s
+
+    def _pop_finished(self, outputs: List[StepOutput]) -> None:
         for out in outputs:
             if out.finished:
                 seq = self.sequences.pop(out.seq_id, None)
                 if seq is not None:
                     self.metrics.on_finished(seq)
-        return outputs
+
+    def _note_dispatch(self, now: float) -> None:
+        """Device-idle accounting: accumulate the gap between the
+        device draining its queue and the next dispatch."""
+        if self._idle_mark is not None:
+            self.metrics.on_device_idle(now - self._idle_mark)
+            self._idle_mark = None
 
     def _delta(self, seq: Sequence, token: Optional[int],
                logprobs: Optional[tuple] = None) -> StepOutput:
@@ -320,6 +473,18 @@ class LLMEngine:
                 self.metrics.spec_draft_tokens_total,
             "spec_decode_num_accepted_tokens_total":
                 self.metrics.spec_accepted_tokens_total,
+            "engine_step_host_seconds_total":
+                self.metrics.step_host_seconds_total,
+            "engine_step_device_wait_seconds_total":
+                self.metrics.step_device_wait_seconds_total,
+            "engine_device_idle_seconds_total":
+                self.metrics.device_idle_seconds_total,
+            "engine_pipeline_steps_total":
+                self.metrics.pipeline_steps_total,
+            "engine_pipeline_ahead_steps_total":
+                self.metrics.pipeline_ahead_steps_total,
+            "engine_async_inflight_depth":
+                self.metrics.async_inflight_depth,
         }
         if self.offload is not None:
             out.update({

@@ -77,12 +77,46 @@ class EngineMetrics:
         # off) so the router scraper sees a stable metric surface.
         self.spec_draft_tokens_total = 0
         self.spec_accepted_tokens_total = 0
+        # Overlapped async pipeline (docs/async_pipeline.md): per-step
+        # host vs device-wait seconds, the device-idle gap the
+        # pipeline hides, and how many steps were dispatched ahead of
+        # their predecessor's readback. Always rendered (0 when the
+        # feature is off) for a stable scrape surface. Overlap
+        # fraction = 1 - idle / host: ~0 synchronous, ->1 overlapped.
+        self.step_host_seconds_total = 0.0
+        self.step_device_wait_seconds_total = 0.0
+        self.device_idle_seconds_total = 0.0
+        self.pipeline_steps_total = 0
+        self.pipeline_ahead_steps_total = 0
+        self.async_inflight_depth = 0
 
     def on_spec_step(self, drafted: int, accepted: int) -> None:
         """One speculative verify step's draft/accept counts."""
         with self._lock:
             self.spec_draft_tokens_total += drafted
             self.spec_accepted_tokens_total += accepted
+
+    def on_pipeline_step(self, host_s: float, device_wait_s: float,
+                         ahead: bool) -> None:
+        """One engine step's host/device time split; ``ahead`` marks a
+        step whose successor was dispatched before its readback."""
+        with self._lock:
+            self.step_host_seconds_total += max(0.0, host_s)
+            self.step_device_wait_seconds_total += max(
+                0.0, device_wait_s)
+            self.pipeline_steps_total += 1
+            if ahead:
+                self.pipeline_ahead_steps_total += 1
+
+    def on_device_idle(self, gap_s: float) -> None:
+        """Device queue ran dry for ``gap_s`` before the next
+        dispatch (the cost the async pipeline exists to remove)."""
+        with self._lock:
+            self.device_idle_seconds_total += max(0.0, gap_s)
+
+    def set_inflight_depth(self, depth: int) -> None:
+        with self._lock:
+            self.async_inflight_depth = depth
 
     def on_decode_tokens(self, seq, n_tokens: int,
                          now: float) -> None:
@@ -154,6 +188,26 @@ class EngineMetrics:
                  "counter"),
                 ("vllm:spec_decode_num_accepted_tokens_total "
                  f"{self.spec_accepted_tokens_total}"),
+                "# TYPE vllm:engine_step_host_seconds_total counter",
+                ("vllm:engine_step_host_seconds_total "
+                 f"{self.step_host_seconds_total}"),
+                ("# TYPE vllm:engine_step_device_wait_seconds_total "
+                 "counter"),
+                ("vllm:engine_step_device_wait_seconds_total "
+                 f"{self.step_device_wait_seconds_total}"),
+                "# TYPE vllm:engine_device_idle_seconds_total counter",
+                ("vllm:engine_device_idle_seconds_total "
+                 f"{self.device_idle_seconds_total}"),
+                "# TYPE vllm:engine_pipeline_steps_total counter",
+                ("vllm:engine_pipeline_steps_total "
+                 f"{self.pipeline_steps_total}"),
+                ("# TYPE vllm:engine_pipeline_ahead_steps_total "
+                 "counter"),
+                ("vllm:engine_pipeline_ahead_steps_total "
+                 f"{self.pipeline_ahead_steps_total}"),
+                "# TYPE vllm:engine_async_inflight_depth gauge",
+                ("vllm:engine_async_inflight_depth "
+                 f"{self.async_inflight_depth}"),
             ]
             # vLLM's success counter tracks completed requests only;
             # aborts go to a separate failure counter so reference

@@ -91,6 +91,24 @@ def deferred_kv_eligible(architecture: str, decode_steps: int,
             and context_parallel == 1
             and speculative_k == 0)
 
+
+def async_scheduling_eligible(decode_steps: int, speculative_k: int,
+                              distributed: bool = False) -> bool:
+    """The ONE eligibility predicate for the overlapped async
+    execution pipeline (docs/async_pipeline.md).
+
+    Used by EngineConfig's hard validation error message, the server's
+    '--async-scheduling auto' resolution and bench.py's pass gating —
+    one definition so the call sites cannot drift (the
+    deferred_kv_eligible pattern). The pipeline's plan-ahead step
+    assumes every running row commits exactly one token per dispatch,
+    so multi-step bursts and speculative verify (data-dependent commit
+    counts) are out; multihost serving is out because the step
+    broadcast ships host-resident numpy payloads, while the ahead
+    dispatch feeds device-resident arrays forward."""
+    return (decode_steps == 1 and speculative_k == 0
+            and not distributed)
+
 # PSTPU_TIMING=1: log every dispatch's wall time (dispatch ->
 # device_get of the sampled tokens, i.e. including device execution)
 # to stderr as "timing <kind> t=<window|bucket> <seconds>". The only
@@ -107,6 +125,18 @@ def _timing_log(kind: str, t: int, wall: float) -> None:
     logger.info("timing %s t=%d %.4f", kind, t, wall)
 
 
+def _as_device(x):
+    """Identity for arrays already on device; transfer otherwise.
+
+    Payload entries arrive as jax.Arrays on the local dispatch path
+    (one fused device_put upstream) but as numpy on the multihost
+    broadcast path. ``jnp.asarray`` is semantically a no-op for the
+    former yet costs ~0.1 ms of dtype canonicalization per call —
+    ~1 ms per decode step across a payload — so skip it.
+    """
+    return x if isinstance(x, jax.Array) else jnp.asarray(x)
+
+
 def prefill_buckets(chunk_size: int) -> List[int]:
     buckets, b = [], 16
     while b < chunk_size:
@@ -114,6 +144,49 @@ def prefill_buckets(chunk_size: int) -> List[int]:
         b *= 2
     buckets.append(chunk_size)
     return buckets
+
+
+class DecodeStepHandle:
+    """One dispatched-but-unread single-step decode program.
+
+    Under JAX async dispatch the compiled program is already running
+    (or queued) on device; ``token_source`` exposes the sampled-token
+    device array so the NEXT step can consume it without a host round
+    trip, and ``result()`` performs the step's ONE blocking host read
+    — a single fused device_get of the sampled tokens plus, when
+    requested, all three logprob arrays — parsed exactly like the
+    synchronous path so sync and async consumers share one format.
+    """
+
+    def __init__(self, runner: "ModelRunner", rows, sampled,
+                 want_lp: bool):
+        self.runner = runner
+        # List[Optional[Sequence]]: None rows are plan-ahead slots
+        # whose sequence was already known to finish (dispatched as
+        # masked pad rows so row alignment with token_source holds).
+        self.rows = rows
+        self.sampled = sampled
+        self.want_lp = want_lp
+
+    @property
+    def token_source(self) -> jax.Array:
+        """The [B] sampled-token device array (async feed-forward)."""
+        return self.sampled[0] if self.want_lp else self.sampled
+
+    def result(self) -> Tuple[List[List[int]], Optional[list]]:
+        """Block on the step's one fused device_get and parse."""
+        host = jax.device_get(self.sampled)
+        n = len(self.rows)
+        if not self.want_lp:
+            return [[int(host[i])] for i in range(n)], None
+        toks, slp, tids, tlps = host
+        token_lists = [[int(toks[i])] for i in range(n)]
+        lp_lists = [
+            [self.runner._lp_entry(row, slp[i], tids[i], tlps[i])
+             if row is not None and row.sampling.logprobs else None]
+            for i, row in enumerate(self.rows)
+        ]
+        return token_lists, lp_lists
 
 
 class ModelRunner:
@@ -334,6 +407,22 @@ class ModelRunner:
             config.scheduler.prefill_chunk_size
         )
         self._rng = jax.random.PRNGKey(config.seed + 1)
+        # Reused host staging buffers for the single-step decode
+        # payload (dispatch_decode): the per-step numpy allocation
+        # shower is replaced by in-place fills + ONE fused
+        # jax.device_put of the whole input set. DOUBLE-buffered
+        # because the CPU backend may alias numpy memory into the
+        # device buffer zero-copy: a buffer set is refilled only
+        # after the step that consumed it has been completed
+        # (pipeline depth is 1, and the engine reads step N's result
+        # before dispatching N+2 — so set parity N mod 2 is free by
+        # the time it is reused).
+        self._decode_staging = None
+        self._staging_idx = 0
+        # (signature, {name: device array}) of the last dispatch's
+        # static per-row inputs; reused while the row set is unchanged
+        # (see dispatch_decode).
+        self._decode_static_cache = None
         # Multihost step broadcast (parallel/distributed.py); host 0's
         # engine sets this so every dispatch is mirrored to workers.
         self.bridge = None
@@ -621,6 +710,14 @@ class ModelRunner:
                    seeding, bias, suppress, fsm,
                    sample_index_mode: str,
                    want_logprobs: bool = False):
+        if tokens.ndim == 1:
+            # Single-step decode feeds [B] tokens so the async
+            # pipeline can consume the previous step's [B] sampled
+            # array verbatim — zero eager ops on the feed-forward.
+            # The reshape happens here, inside the traced program.
+            tokens = tokens[:, None]
+            positions = positions.reshape(tokens.shape)
+            valid = valid.reshape(tokens.shape)
         logits, k_cache, v_cache = self._forward(
             params, self.config.model, tokens, positions, page_table,
             kv_lens, valid, k_cache, v_cache,
@@ -970,7 +1067,7 @@ class ModelRunner:
                                            payload["lengths"])
         lora_ids = payload.get("lora_ids")
         lora_ids = (None if lora_ids is None
-                    else jnp.asarray(lora_ids))
+                    else _as_device(lora_ids))
         penalties, seeding, bias, suppress, fsm = \
             self._optional_device_inputs(payload)
         want_lp = bool(payload.get("want_logprobs", False))
@@ -980,17 +1077,17 @@ class ModelRunner:
             # the program compiles without those inputs.
             sampled, self.k_cache, self.v_cache = self._spec_jit(
                 self.params, self.k_cache, self.v_cache,
-                jnp.asarray(payload["tokens"]),
-                jnp.asarray(payload["positions"]),
-                jnp.asarray(payload["page_table"]),
-                jnp.asarray(payload["kv_lens"]),
-                jnp.asarray(payload["valid"]),
-                jnp.asarray(payload["drafts"]),
-                jnp.asarray(payload["draft_lens"]),
-                jnp.asarray(payload["temperature"]),
-                jnp.asarray(payload["top_p"]),
-                jnp.asarray(payload["top_k"]),
-                jnp.asarray(payload["rng"]),
+                _as_device(payload["tokens"]),
+                _as_device(payload["positions"]),
+                _as_device(payload["page_table"]),
+                _as_device(payload["kv_lens"]),
+                _as_device(payload["valid"]),
+                _as_device(payload["drafts"]),
+                _as_device(payload["draft_lens"]),
+                _as_device(payload["temperature"]),
+                _as_device(payload["top_p"]),
+                _as_device(payload["top_k"]),
+                _as_device(payload["rng"]),
                 self._lora_stack, lora_ids,
                 want_logprobs=want_lp,
             )
@@ -999,17 +1096,17 @@ class ModelRunner:
             sampled, self.k_cache, self.v_cache = \
                 self._decode_burst_jit(
                     self.params, self.k_cache, self.v_cache,
-                    jnp.asarray(payload["tokens"]),
-                    jnp.asarray(payload["positions"]),
-                    jnp.asarray(payload["page_table"]),
-                    jnp.asarray(payload["kv_lens"]),
-                    jnp.asarray(payload["active"]),
-                    jnp.asarray(payload["budgets"]),
-                    jnp.asarray(payload["stop_tokens"]),
-                    jnp.asarray(payload["temperature"]),
-                    jnp.asarray(payload["top_p"]),
-                    jnp.asarray(payload["top_k"]),
-                    jnp.asarray(payload["rng"]),
+                    _as_device(payload["tokens"]),
+                    _as_device(payload["positions"]),
+                    _as_device(payload["page_table"]),
+                    _as_device(payload["kv_lens"]),
+                    _as_device(payload["active"]),
+                    _as_device(payload["budgets"]),
+                    _as_device(payload["stop_tokens"]),
+                    _as_device(payload["temperature"]),
+                    _as_device(payload["top_p"]),
+                    _as_device(payload["top_k"]),
+                    _as_device(payload["rng"]),
                     self._lora_stack, lora_ids, penalties, seeding,
                     bias, suppress, fsm,
                     num_steps=t, want_logprobs=want_lp,
@@ -1017,16 +1114,16 @@ class ModelRunner:
             return sampled  # [K, B] (+ logprob arrays when requested)
         sampled, self.k_cache, self.v_cache = self._step_jit(
             self.params, self.k_cache, self.v_cache,
-            jnp.asarray(payload["tokens"]),
-            jnp.asarray(payload["positions"]),
-            jnp.asarray(payload["page_table"]),
-            jnp.asarray(payload["kv_lens"]),
-            jnp.asarray(payload["valid"]),
-            jnp.asarray(payload["last_index"]),
-            jnp.asarray(payload["temperature"]),
-            jnp.asarray(payload["top_p"]),
-            jnp.asarray(payload["top_k"]),
-            jnp.asarray(payload["rng"]),
+            _as_device(payload["tokens"]),
+            _as_device(payload["positions"]),
+            _as_device(payload["page_table"]),
+            _as_device(payload["kv_lens"]),
+            _as_device(payload["valid"]),
+            _as_device(payload["last_index"]),
+            _as_device(payload["temperature"]),
+            _as_device(payload["top_p"]),
+            _as_device(payload["top_k"]),
+            _as_device(payload["rng"]),
             self._lora_stack, lora_ids, penalties, seeding, bias,
             suppress, fsm,
             sample_index_mode=("last" if kind == 1 else "first"),
@@ -1065,11 +1162,14 @@ class ModelRunner:
             frequency[i] = sp.frequency_penalty
             repetition[i] = sp.repetition_penalty
             if sp.needs_penalties:
+                # Both asarray calls index host Python lists, not
+                # device arrays — nothing blocks on the device here.
                 if seq.output_token_ids:
                     np.add.at(
                         counts[i],
-                        np.asarray(seq.output_token_ids, np.int64), 1)
-                pmask[i, np.asarray(
+                        np.asarray(seq.output_token_ids,  # lint: allow-host-read
+                                   np.int64), 1)
+                pmask[i, np.asarray(  # lint: allow-host-read
                     seq.prompt_token_ids, np.int64)] = True
         return {"pen_counts": counts, "pen_prompt_mask": pmask,
                 "pen_presence": presence, "pen_frequency": frequency,
@@ -1214,23 +1314,23 @@ class ModelRunner:
         penalties = None
         if "pen_prompt_mask" in payload:
             penalties = (
-                jnp.asarray(payload["pen_counts"]),
-                jnp.asarray(payload["pen_prompt_mask"]),
-                jnp.asarray(payload["pen_presence"]),
-                jnp.asarray(payload["pen_frequency"]),
-                jnp.asarray(payload["pen_repetition"]),
+                _as_device(payload["pen_counts"]),
+                _as_device(payload["pen_prompt_mask"]),
+                _as_device(payload["pen_presence"]),
+                _as_device(payload["pen_frequency"]),
+                _as_device(payload["pen_repetition"]),
             )
         seeding = None
         if "seed_rows" in payload:
-            seeding = (jnp.asarray(payload["seed_rows"]),
-                       jnp.asarray(payload["seed_on"]),
-                       jnp.asarray(payload["seed_emitted"]))
-        bias = (jnp.asarray(payload["logit_bias"])
+            seeding = (_as_device(payload["seed_rows"]),
+                       _as_device(payload["seed_on"]),
+                       _as_device(payload["seed_emitted"]))
+        bias = (_as_device(payload["logit_bias"])
                 if "logit_bias" in payload else None)
-        suppress = ((jnp.asarray(payload["sup_ids"]),
-                     jnp.asarray(payload["sup_rem"]))
+        suppress = ((_as_device(payload["sup_ids"]),
+                     _as_device(payload["sup_rem"]))
                     if "sup_ids" in payload else None)
-        fsm = (jnp.asarray(payload["fsm_state"])
+        fsm = (_as_device(payload["fsm_state"])
                if "fsm_state" in payload else None)
         return penalties, seeding, bias, suppress, fsm
 
@@ -1402,6 +1502,153 @@ class ModelRunner:
 
     # ---- decode -----------------------------------------------------------
 
+    def _staging_set(self) -> dict:
+        """Next reusable host staging buffer set (double-buffered; see
+        __init__). Arrays are zero-reset here so None/pad rows are
+        masked (valid False) and read the trash page (table 0)."""
+        if self._decode_staging is None:
+            b, p = self.decode_width, self.max_pages_per_seq
+
+            def one():
+                buf = {
+                    # [B] not [B, 1]: the step program reshapes on
+                    # device, so an ahead dispatch can feed the
+                    # previous step's [B] sampled array directly.
+                    "tokens": np.zeros((b,), np.int32),
+                    "positions": np.zeros((b, 1), np.int32),
+                    "valid": np.zeros((b, 1), bool),
+                    "page_table": np.zeros((b, p), np.int32),
+                    "kv_lens": np.zeros((b,), np.int32),
+                    "last_index": np.zeros((b,), np.int32),
+                    "temperature": np.zeros((b,), np.float32),
+                    "top_p": np.ones((b,), np.float32),
+                    "top_k": np.zeros((b,), np.int32),
+                }
+                if self.lora_registry is not None:
+                    buf["lora_ids"] = np.zeros((b,), np.int32)
+                return buf
+
+            self._decode_staging = (one(), one())
+        st = self._decode_staging[self._staging_idx]
+        self._staging_idx ^= 1
+        for name, arr in st.items():
+            arr.fill(1 if name == "top_p" else 0)
+        return st
+
+    def dispatch_decode(self, rows, token_source=None,
+                        ahead: bool = False) -> DecodeStepHandle:
+        """Build and dispatch ONE single-step decode program with no
+        blocking host read anywhere on the path (the AST lint
+        tests/test_dispatch_path_lint.py enforces this statically).
+
+        The synchronous engine uses it too (run_decode's single-step
+        path), so sync and async greedy decoding share one dispatch
+        path and byte-exact parity is structural, not incidental.
+
+        ``rows``: the batch's sequences; None entries (plan-ahead
+        slots whose row is already known to finish) dispatch as
+        masked pad rows so the batch shape — and row alignment with
+        ``token_source`` — never changes. ``token_source``: the
+        previous step's sampled-token device array ([B]); when given,
+        this step's input tokens never touch the host. ``ahead``
+        shifts positions/kv_lens by the one token the in-flight step
+        will have committed by the time this program's inputs are
+        consumed.
+        """
+        if self.bridge is not None:
+            raise NotImplementedError(
+                "async dispatch over the multihost step bridge (the "
+                "step broadcast ships host-resident numpy payloads)")
+        b = self.decode_width
+        rows = list(rows)[:b]
+        st = self._staging_set()
+        off = 1 if ahead else 0
+        page_table = st["page_table"]
+        # During a pure-decode stretch only positions/kv_lens (+1 per
+        # step) and the input tokens actually change; the per-row
+        # static inputs (valid mask, page table, sampling knobs, lora
+        # ids) are reused as the *device arrays* of the previous
+        # dispatch while this signature — row identity, liveness
+        # pattern, and exact page list — is unchanged. Sampling params
+        # and lora ids are immutable after admission, so they need no
+        # signature term beyond the seq id.
+        sig = tuple((seq.seq_id, tuple(seq.pages))
+                    if seq is not None else None for seq in rows)
+        cached = self._decode_static_cache
+        reuse = cached is not None and cached[0] == sig
+        stochastic = False
+        for i, seq in enumerate(rows):
+            if seq is None:
+                continue
+            if token_source is None:
+                st["tokens"][i] = (seq.output_token_ids[-1]
+                                   if seq.output_token_ids
+                                   else seq.prompt_token_ids[-1])
+            st["positions"][i, 0] = seq.total_len - 1 + off
+            st["kv_lens"][i] = seq.total_len + off
+            sp = seq.sampling
+            if sp.temperature > 0:
+                stochastic = True
+            if reuse:
+                continue
+            st["valid"][i, 0] = True
+            st["temperature"][i] = sp.temperature
+            st["top_p"][i] = sp.top_p
+            st["top_k"][i] = sp.top_k
+            n = min(len(seq.pages), self.max_pages_per_seq)
+            page_table[i, :n] = seq.pages[:n]
+            if self.lora_registry is not None:
+                st["lora_ids"][i] = seq.lora_id
+        # ONE fused host->device transfer for the (changed part of
+        # the) input set — replaces the per-array jnp.asarray shower.
+        # An ahead dispatch additionally excludes the tokens buffer:
+        # its tokens are the previous step's sampled [B] int32 device
+        # array, consumed verbatim — no transfer, no eager
+        # cast/reshape (the step program reshapes on device).
+        dynamic = ("positions", "kv_lens") + (
+            ("tokens",) if token_source is None else ())
+        names = (dynamic if reuse else
+                 tuple(n for n in st
+                       if token_source is None or n != "tokens"))
+        # Static entries are snapshotted (.copy()): on the CPU
+        # backend device_put of a numpy array may be ZERO-copy, and
+        # the cached device arrays must not alias a staging buffer
+        # that later steps zero-reset and refill.
+        devs = jax.device_put(tuple(
+            st[n] if n in dynamic else st[n].copy() for n in names))
+        payload = dict(zip(names, devs))
+        if reuse:
+            payload.update(cached[1])
+        else:
+            self._decode_static_cache = (sig, {
+                n: payload[n] for n in payload
+                if n not in ("tokens", "positions", "kv_lens")})
+        if token_source is not None:
+            payload["tokens"] = token_source
+        # The rng key stays a device array (no host readback; the
+        # multihost numpy conversion is unreachable here). An
+        # all-greedy batch never consumes the key (temperature 0
+        # short-circuits sampling), so skip the per-step split — a
+        # real eager dispatch — and pass the stream head unadvanced.
+        payload["rng"] = self._next_rng() if stochastic else self._rng
+        if not ahead:
+            # Per-row optional inputs (penalties/seed/bias/suppress/
+            # guided) for the sync single-step path. Plan-ahead
+            # eligibility guarantees these are all {} for ahead
+            # dispatches (their host state is one token stale), so
+            # those skip the five row scans outright.
+            payload.update(self._penalty_payload(rows, b))
+            payload.update(self._seed_payload(rows, b))
+            payload.update(self._bias_payload(rows, b))
+            payload.update(self._suppress_payload(rows, b))
+            payload.update(self._guided_payload(rows, b))
+        want_lp = any(s is not None and s.sampling.logprobs
+                      for s in rows)
+        if want_lp:
+            payload["want_logprobs"] = True
+        sampled = self._dispatch(2, 1, payload)
+        return DecodeStepHandle(self, rows, sampled, want_lp)
+
     def run_decode(self, plan: DecodePlan
                    ) -> Tuple[List[List[int]], Optional[list]]:
         """One decode dispatch over all running sequences (padded
@@ -1415,6 +1662,16 @@ class ModelRunner:
         seqs = plan.seqs[: self.decode_width]
         b = self.decode_width
         window = max(1, plan.window)
+        if window == 1 and self.bridge is None:
+            # Single-host single-step decode rides the async
+            # pipeline's dispatch path (staged inputs, one fused
+            # transfer, one fused device_get) even in sync mode, so
+            # sync-vs-async parity is the same code path.
+            t0 = time.perf_counter() if _TIMING else 0.0
+            out = self.dispatch_decode(seqs).result()
+            if _TIMING:
+                _timing_log("decode", 1, time.perf_counter() - t0)
+            return out
         stop_w = STOP_SET_WIDTH
 
         tokens = np.zeros((b, 1), np.int32)

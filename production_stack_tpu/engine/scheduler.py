@@ -265,6 +265,76 @@ class Scheduler:
         return DecodePlan(seqs=list(self.running), window=1,
                           drafts=plan_drafts)
 
+    def plan_ahead(self, inflight_rows) -> Optional[List[
+            Optional[Sequence]]]:
+        """Plan decode step N+1 while step N is still in flight
+        (docs/async_pipeline.md): assume every running row commits
+        exactly one token, pre-allocate the boundary pages that
+        assumption needs, and return a row list ALIGNED to
+        ``inflight_rows`` (None = slot masked: the row is gone or
+        provably finishes when step N commits). The engine feeds step
+        N's sampled-token device array straight into step N+1, so row
+        slots must not shift.
+
+        Returns None to break the pipeline (the engine then completes
+        step N and re-plans synchronously with full knowledge):
+        - prefill work is waiting and could admit (matches
+          plan_step's want_prefill, so prefill never starves),
+        - a row needs per-token host state the ahead plan would
+          compute one token stale (penalties, seeded sampling,
+          logit_bias, min_tokens suppression, guided decoding — the
+          same exclusion set as _plan_spec),
+        - boundary pages cannot be allocated (never preempt with a
+          step in flight: the victim's pages are inputs of the
+          running program).
+        """
+        if self.waiting and len(self.running) < self.config.max_num_seqs:
+            return None
+        rows: List[Optional[Sequence]] = []
+        any_live = False
+        for seq in inflight_rows:
+            if seq is None or seq.state != SequenceState.RUNNING:
+                rows.append(None)
+                continue
+            sp = seq.sampling
+            if (sp.needs_penalties or sp.seed is not None
+                    or sp.logit_bias
+                    or sp.min_tokens > seq.num_generated + 1
+                    or seq.fsm_state is not None):
+                return None
+            if self._seq_budget(seq) <= 1:
+                # Step N's token exhausts the row's budget: it will
+                # finish with reason=length at reconcile. Mask the
+                # slot now — a live row here would write KV past the
+                # row's page budget.
+                rows.append(None)
+                continue
+            rows.append(seq)
+            any_live = True
+        if not any_live:
+            return None
+        for seq in rows:
+            if seq is None:
+                continue
+            # Post-commit convention: before a decode step, capacity
+            # covers total_len + 1 tokens; after step N commits,
+            # total_len grows by one, so reserve total_len + 2 now.
+            # The pages simply extend seq.pages — a finish/abort at
+            # reconcile returns them through the ordinary
+            # free_sequence path, no separate bookkeeping.
+            needed = self._pages_needed(seq, seq.total_len + 2)
+            if needed == 0:
+                continue
+            try:
+                seq.pages.extend(self.cache.allocate_pages(needed))
+            except OutOfPagesError:
+                # Pages already granted to earlier rows stay with
+                # them (they are those rows' legitimate next-step
+                # reservation; the sync re-plan reuses them).
+                return None
+        self._last_was_prefill = False
+        return rows
+
     def _decode_window(self) -> int:
         """The decode burst evaluates per-row budgets and stop sets on
         device (model_runner._decode_burst_impl), so the full window

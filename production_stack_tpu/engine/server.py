@@ -64,6 +64,10 @@ class AsyncEngine:
             target=self._run, daemon=True, name="engine-loop"
         )
         self._started = threading.Event()
+        # Wakes the loop out of its idle/backoff waits the moment new
+        # work arrives (submit/abort) instead of serving out a fixed
+        # sleep — cuts TTFT for requests that land on an idle engine.
+        self._wakeup = threading.Event()
         self.uptime_start = time.time()
 
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
@@ -105,12 +109,19 @@ class AsyncEngine:
                 outputs = self.engine.step()
             except Exception as e:
                 logger.exception("Engine step failed: %s", e)
-                time.sleep(0.05)
+                # Interruptible backoff: a new submission or abort
+                # wakes the loop immediately instead of serving out
+                # the full 50 ms.
+                self._wakeup.wait(0.05)
+                self._wakeup.clear()
                 continue
             if not outputs:
                 # Planner produced no executable work (e.g. transient
-                # KV-cache starvation): don't busy-spin.
-                time.sleep(0.002)
+                # KV-cache starvation, or an async dispatch that owes
+                # nothing yet): don't busy-spin, but let new arrivals
+                # cut the wait short.
+                self._wakeup.wait(0.002)
+                self._wakeup.clear()
             for out in outputs:
                 self._emit(out.seq_id, out)
 
@@ -127,6 +138,7 @@ class AsyncEngine:
         stream: asyncio.Queue = asyncio.Queue()
         self._streams[seq_id] = stream
         self._submit_q.put((prompt, sampling, seq_id, lora_name))
+        self._wakeup.set()
         return seq_id, stream
 
     def finish_stream(self, seq_id: str) -> None:
@@ -135,6 +147,7 @@ class AsyncEngine:
     def abort(self, seq_id: str) -> None:
         self.engine.abort_request(seq_id)
         self.finish_stream(seq_id)
+        self._wakeup.set()  # freed capacity: let the planner retry
 
 
 # ---- request handling ------------------------------------------------------
@@ -1127,6 +1140,27 @@ def _resolve_deferred_kv(args, model_config) -> bool:
         args.context_parallel_size, args.speculative_k)
 
 
+def _resolve_async_scheduling(args) -> bool:
+    """--async-scheduling auto|on|off -> bool.
+
+    'auto' enables the overlapped plan/dispatch/complete pipeline
+    (docs/async_pipeline.md) for pure single-step decode serving and
+    stays off where the pipeline cannot run: multi-step bursts and
+    speculative decoding already amortize the host round trip on
+    device (config validation rejects an explicit 'on' there), and
+    the multihost step bridge broadcasts host-resident payloads."""
+    if args.async_scheduling == "on":
+        return True
+    if args.async_scheduling == "off":
+        return False
+    from production_stack_tpu.engine.model_runner import (
+        async_scheduling_eligible,
+    )
+    return async_scheduling_eligible(
+        args.decode_steps, args.speculative_k,
+        distributed=args.distributed)
+
+
 def build_engine_from_args(args) -> tuple[LLMEngine, str]:
     mesh = None
     if args.model in ("tiny-llama", "tiny-opt"):
@@ -1196,6 +1230,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             deferred_kv_writes=_resolve_deferred_kv(args, model_config),
             speculative_k=args.speculative_k,
             speculative_min_match=args.speculative_min_match,
+            async_scheduling=_resolve_async_scheduling(args),
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -1273,6 +1308,16 @@ def parse_args(argv=None):
     parser.add_argument("--speculative-min-match", type=int, default=2,
                         help="Minimum n-gram match length before the "
                              "prompt-lookup proposer drafts")
+    parser.add_argument("--async-scheduling", default="auto",
+                        choices=["auto", "on", "off"],
+                        help="Overlapped async execution pipeline: "
+                             "plan + dispatch decode step N+1 before "
+                             "step N's tokens are read back, hiding "
+                             "host work behind the device step "
+                             "(docs/async_pipeline.md). 'auto' "
+                             "enables it for single-host single-step "
+                             "decode (off under --decode-steps > 1, "
+                             "--speculative-k > 0, --distributed)")
     parser.add_argument("--deferred-kv-writes", default="auto",
                         choices=["auto", "on", "off"],
                         help="Defer decode KV writes to one batched "

@@ -53,6 +53,29 @@ spec_decode_num_draft_tokens = Gauge(
 spec_decode_num_accepted_tokens = Gauge(
     "vllm:spec_decode_num_accepted_tokens",
     "Engine-reported accepted speculative tokens (scraped)", _LBL)
+engine_step_host_seconds = Gauge(
+    "vllm:engine_step_host_seconds",
+    "Engine-reported cumulative host-side step seconds (scraped)",
+    _LBL)
+engine_step_device_wait_seconds = Gauge(
+    "vllm:engine_step_device_wait_seconds",
+    "Engine-reported cumulative device-readback wait seconds "
+    "(scraped)", _LBL)
+engine_device_idle_seconds = Gauge(
+    "vllm:engine_device_idle_seconds",
+    "Engine-reported cumulative device-idle gap seconds (scraped)",
+    _LBL)
+engine_pipeline_steps = Gauge(
+    "vllm:engine_pipeline_steps",
+    "Engine-reported total engine steps (scraped)", _LBL)
+engine_pipeline_ahead_steps = Gauge(
+    "vllm:engine_pipeline_ahead_steps",
+    "Engine-reported steps whose successor was dispatched before "
+    "readback (scraped)", _LBL)
+engine_async_inflight_depth = Gauge(
+    "vllm:engine_async_inflight_depth",
+    "Engine-reported dispatched-but-unread decode steps (scraped)",
+    _LBL)
 
 # -- resilience layer (router/resilience.py) --------------------------------
 circuit_breaker_state = Gauge(
@@ -127,6 +150,18 @@ def refresh_gauges() -> None:
             es.spec_decode_num_draft_tokens)
         spec_decode_num_accepted_tokens.labels(server=server).set(
             es.spec_decode_num_accepted_tokens)
+        engine_step_host_seconds.labels(server=server).set(
+            es.engine_step_host_seconds)
+        engine_step_device_wait_seconds.labels(server=server).set(
+            es.engine_step_device_wait_seconds)
+        engine_device_idle_seconds.labels(server=server).set(
+            es.engine_device_idle_seconds)
+        engine_pipeline_steps.labels(server=server).set(
+            es.engine_pipeline_steps)
+        engine_pipeline_ahead_steps.labels(server=server).set(
+            es.engine_pipeline_ahead_steps)
+        engine_async_inflight_depth.labels(server=server).set(
+            es.engine_async_inflight_depth)
     from production_stack_tpu.router.resilience import get_resilience
     mgr = get_resilience()
     try:

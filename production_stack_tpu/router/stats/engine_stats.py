@@ -37,6 +37,16 @@ _METRIC_MAP = {
         "spec_decode_num_draft_tokens",
     "vllm:spec_decode_num_accepted_tokens_total":
         "spec_decode_num_accepted_tokens",
+    "vllm:engine_step_host_seconds_total":
+        "engine_step_host_seconds",
+    "vllm:engine_step_device_wait_seconds_total":
+        "engine_step_device_wait_seconds",
+    "vllm:engine_device_idle_seconds_total":
+        "engine_device_idle_seconds",
+    "vllm:engine_pipeline_steps_total": "engine_pipeline_steps",
+    "vllm:engine_pipeline_ahead_steps_total":
+        "engine_pipeline_ahead_steps",
+    "vllm:engine_async_inflight_depth": "engine_async_inflight_depth",
 }
 
 
@@ -50,6 +60,16 @@ class EngineStats:
     # acceptance rate = accepted / drafted when drafted > 0.
     spec_decode_num_draft_tokens: float = 0.0
     spec_decode_num_accepted_tokens: float = 0.0
+    # Async execution pipeline counters (engine
+    # docs/async_pipeline.md): host vs device-wait step time, device
+    # idle gap, and ahead-dispatched step counts. Overlap fraction =
+    # 1 - idle / host when host > 0.
+    engine_step_host_seconds: float = 0.0
+    engine_step_device_wait_seconds: float = 0.0
+    engine_device_idle_seconds: float = 0.0
+    engine_pipeline_steps: float = 0.0
+    engine_pipeline_ahead_steps: float = 0.0
+    engine_async_inflight_depth: float = 0.0
 
     @classmethod
     def from_prometheus_text(cls, text: str) -> "EngineStats":
