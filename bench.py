@@ -199,6 +199,12 @@ def _bench_config(tpu: bool):
         sched.deferred_kv_writes = bool(int(os.environ["BENCH_DEFERRED"]))
     if os.environ.get("BENCH_QUANT"):
         model.quantization = os.environ["BENCH_QUANT"]
+    if os.environ.get("BENCH_KV_DTYPE"):
+        # KV page storage dtype A/B (docs/kv_quantization.md). Both
+        # sides of the comparison get the same num_pages INPUT (= the
+        # same HBM byte budget); EngineConfig expands the int8 side's
+        # page count ~2x at those bytes.
+        cache.kv_cache_dtype = os.environ["BENCH_KV_DTYPE"]
     if os.environ.get("BENCH_SPEC_K"):
         # Draft-free speculative decoding (docs/speculative.md).
         # Hybrid with the decode burst: drafting steps run the verify
@@ -536,6 +542,18 @@ def run_worker(impl: str, tpu: bool) -> None:
     extra["pipeline_ahead_steps"] = int(
         st["engine_pipeline_ahead_steps_total"])
     extra["pipeline_steps"] = int(st["engine_pipeline_steps_total"])
+    # KV page storage report (docs/kv_quantization.md): page budget
+    # after any int8 expansion, worst-case KV bytes per decode step,
+    # and the analytic decode-batch ceiling at this page budget (how
+    # many full-length sequences the cache can hold at once).
+    extra["kv_cache_dtype"] = config.cache.resolved_kv_dtype()
+    extra["kv_page_capacity"] = int(
+        st["engine_kv_cache_page_capacity"])
+    extra["kv_bytes_per_decode_step"] = int(
+        st["engine_kv_bytes_per_decode_step"])
+    pages_per_seq = -(-(prompt_len + out_len) // config.cache.page_size)
+    extra["kv_max_decode_batch"] = (
+        extra["kv_page_capacity"] // pages_per_seq)
     if mfu is not None:
         extra["mfu"] = round(mfu, 4)
     print(json.dumps({
@@ -674,6 +692,31 @@ def main() -> None:
                         "engine_step_host_s", "engine_device_idle_s",
                         "pipeline_ahead_steps", "pipeline_steps"):
                 result["extra"][f"{tag}_{key}"] = ae.get(key)
+
+        # KV-dtype A/B (docs/kv_quantization.md): same impl and
+        # harness, same page_size/num_pages input on both sides (=
+        # the same HBM byte budget) — kv_cache_dtype is the only
+        # variable, and the int8 side's EngineConfig expands its page
+        # count ~2x at those bytes. Numbers ride in extra under
+        # kv_bf16_* / kv_int8_*: decode rate for the <=5%% regression
+        # check, page capacity + analytic max decode batch for the
+        # capacity win.
+        for tag, dt in (("kv_bf16", "bf16"), ("kv_int8", "int8")):
+            sys.stderr.write(f"[bench] running {impl} {tag} worker "
+                             f"(timeout {timeout}s)...\n")
+            kv_result, kv_err = _spawn_worker(
+                impl, tpu, timeout,
+                extra_env={"BENCH_SPEC_K": "0", "BENCH_KV_DTYPE": dt})
+            if kv_result is None:
+                errors[f"{tag}_error"] = kv_err
+                sys.stderr.write(f"[bench] WARNING: {kv_err}\n")
+                continue
+            ke = kv_result.get("extra", {})
+            result["extra"][f"{tag}_req_per_s"] = kv_result["value"]
+            for key in ("decode_tokens_per_s", "kv_page_capacity",
+                        "kv_bytes_per_decode_step",
+                        "kv_max_decode_batch"):
+                result["extra"][f"{tag}_{key}"] = ke.get(key)
 
     if result is None:
         # Never hang the driver: report the failure as the metric line.

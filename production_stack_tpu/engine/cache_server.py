@@ -68,11 +68,56 @@ class BlobStore:
             }
 
 
+def _validate_payload(blob: bytes):
+    """Decode-side guard for inbound KV payloads.
+
+    Returns an error string (-> 400) for anything that is not a
+    well-formed per-array msgpack frame with allowlisted dtypes and
+    shape-consistent buffers, so a corrupt or malicious payload can
+    neither crash the server nor poison a pod restoring it.
+    """
+    import msgpack
+
+    from production_stack_tpu.engine.offload import (
+        ALLOWED_WIRE_DTYPES,
+        _np_dtype,
+    )
+    try:
+        obj = msgpack.unpackb(blob)
+    except Exception:
+        return "payload is not valid msgpack"
+    if not isinstance(obj, dict) or not isinstance(
+            obj.get("arrays"), list) or not obj["arrays"]:
+        return "payload missing 'arrays' list"
+    for a in obj["arrays"]:
+        if not isinstance(a, dict):
+            return "array entry is not a map"
+        dtype_name = a.get("dtype")
+        if dtype_name not in ALLOWED_WIRE_DTYPES:
+            return f"dtype {dtype_name!r} not in allowlist"
+        shape = a.get("shape")
+        data = a.get("data")
+        if (not isinstance(shape, list) or not isinstance(data, bytes)
+                or not all(isinstance(d, int) and d >= 0
+                           for d in shape)):
+            return "array entry missing shape/data"
+        n = _np_dtype(dtype_name).itemsize
+        for d in shape:
+            n *= d
+        if n != len(data):
+            return "array data size does not match shape/dtype"
+    return None
+
+
 def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
     store = BlobStore(max_bytes)
 
     async def put_kv(request: web.Request) -> web.Response:
         blob = await request.read()
+        err = _validate_payload(blob)
+        if err is not None:
+            return web.json_response(
+                {"error": {"message": err}}, status=400)
         store.put(request.match_info["key"], blob)
         return web.Response(status=200)
 

@@ -177,9 +177,38 @@ class CacheConfig:
     #                buffer (67 MB vs 2.1 GB operands at the 1B bench
     #                config) and donation aliases buffers 1:1.
     cache_layout: str = "auto"
+    # KV page storage dtype (docs/kv_quantization.md):
+    #   auto / bf16 -> pages in the model compute dtype (bf16 in
+    #                  serving; an f32 model keeps f32 pages) — the
+    #                  two spellings are synonyms so --kv-cache-dtype
+    #                  bf16 states the default explicitly.
+    #   int8        -> pages quantized on write (symmetric per-slot
+    #                  scales, ops/quant_kv.py) and dequantized
+    #                  in-kernel; the page budget is expanded to spend
+    #                  the SAME HBM bytes (~2x pages at bf16 widths).
+    kv_cache_dtype: str = "auto"
 
     def max_tokens(self) -> int:
         return self.page_size * self.num_pages
+
+    def resolved_kv_dtype(self) -> str:
+        """'int8' or 'bf16' (the full-precision family; the actual
+        page dtype is the model compute dtype)."""
+        return "int8" if self.kv_cache_dtype == "int8" else "bf16"
+
+    def kv_slot_bytes(self, model: "ModelConfig") -> int:
+        """HBM bytes one cached token costs per kv head per k-or-v
+        plane: head_dim values plus, for int8, one f32 scale."""
+        if self.resolved_kv_dtype() == "int8":
+            return model.head_dim + 4
+        return model.head_dim * jnp.dtype(model.jax_dtype).itemsize
+
+    def kv_bytes_per_token(self, model: "ModelConfig") -> int:
+        """Total KV bytes appended per committed token (k and v,
+        all layers, all kv heads)."""
+        return (2 * model.num_hidden_layers
+                * model.num_key_value_heads
+                * self.kv_slot_bytes(model))
 
 
 @dataclasses.dataclass
@@ -288,6 +317,34 @@ class EngineConfig:
     seed: int = 0
 
     def __post_init__(self):
+        if self.cache.kv_cache_dtype not in ("auto", "bf16", "int8"):
+            raise ValueError(
+                "cache.kv_cache_dtype must be 'auto', 'bf16' or "
+                f"'int8' (got {self.cache.kv_cache_dtype!r})")
+        if self.cache.resolved_kv_dtype() == "int8":
+            if (self.parallel.pipeline_parallel_size > 1
+                    or self.parallel.context_parallel_size > 1):
+                raise ValueError(
+                    "kv_cache_dtype='int8' is incompatible with "
+                    "pipeline/context parallelism (the pp shard split "
+                    "and the sp ring walk move plain cache arrays, "
+                    "not QuantKV pytrees; docs/kv_quantization.md "
+                    "§interactions)")
+            # Spend the SAME HBM byte budget on more (narrower)
+            # pages: a full-precision slot is head_dim * itemsize
+            # bytes, an int8 slot head_dim + 4 (f32 scale) — ~1.9x
+            # more pages at bf16 widths. Guarded by a sentinel on the
+            # CacheConfig object because dataclasses.replace(self)
+            # re-runs __post_init__ on the SAME CacheConfig instance.
+            if not getattr(self.cache, "_kv_pages_expanded", False):
+                full_slot = (self.model.head_dim
+                             * jnp.dtype(self.model.jax_dtype).itemsize)
+                expanded = (self.cache.num_pages * full_slot
+                            // (self.model.head_dim + 4))
+                self.cache = dataclasses.replace(
+                    self.cache, num_pages=max(expanded,
+                                              self.cache.num_pages))
+                self.cache._kv_pages_expanded = True
         if self.scheduler.speculative_k > 0:
             if self.scheduler.deferred_kv_writes:
                 raise ValueError(

@@ -90,6 +90,8 @@ class LLMEngine:
             self._init_offload()
 
     def _init_offload(self) -> None:
+        import numpy as np
+
         from production_stack_tpu.engine.offload import (
             HostKVPool,
             KVOffloadManager,
@@ -97,9 +99,15 @@ class LLMEngine:
         )
         remote = (RemoteKVClient(self.config.offload.remote_url)
                   if self.config.offload.remote_url else None)
+        # Tier keys are namespaced by the actual page storage format
+        # (int8 vs the model dtype) so pods with different
+        # --kv-cache-dtype sharing a remote cache never alias.
+        kv_dtype = ("int8" if self.runner.kv_quantized
+                    else str(np.dtype(self.config.model.jax_dtype)))
         self.offload = KVOffloadManager(
             host_pool=HostKVPool(self.config.offload.host_pool_bytes),
             remote=remote,
+            kv_dtype=kv_dtype,
         )
         self.cache_manager.evict_listener = self._on_page_evicted
         self.scheduler.restore_hook = self._restore_offloaded_prefix
@@ -108,8 +116,10 @@ class LLMEngine:
                     ", remote tier" if remote else "")
 
     def _on_page_evicted(self, page_id: int, page_hash) -> None:
-        k_page, v_page = self.runner.read_page(page_id)
-        self.offload.offload_page(page_hash, k_page, v_page)
+        # 2 arrays for full-precision pages, 4 (data + scales) for
+        # int8 pages; the tiers carry the tuple opaquely.
+        payload = self.runner.read_page(page_id)
+        self.offload.offload_page(page_hash, *payload)
 
     def _restore_offloaded_prefix(self, prompt_token_ids,
                                   matched_pages, cache_salt=0):
@@ -135,7 +145,12 @@ class LLMEngine:
         restored = []
         for page_id, page_hash in zip(pages, remaining[:n]):
             payload = self.offload.fetch(page_hash)
-            if payload is None:  # tier raced an eviction: stop here
+            expected_arity = 4 if self.runner.kv_quantized else 2
+            if payload is None or len(payload) != expected_arity:
+                # Tier raced an eviction, or a payload with the wrong
+                # arity for this pod's page format: stop here (the
+                # dtype-namespaced keys make the latter unreachable
+                # short of tier corruption).
                 self.cache_manager.free_sequence(
                     pages[len(restored):]
                 )
@@ -485,6 +500,15 @@ class LLMEngine:
                 self.metrics.pipeline_ahead_steps_total,
             "engine_async_inflight_depth":
                 self.metrics.async_inflight_depth,
+            # KV quantization telemetry (docs/kv_quantization.md):
+            # post-expansion page budget and worst-case KV bytes a
+            # full decode batch writes per step.
+            "engine_kv_cache_page_capacity":
+                self.config.cache.num_pages - 1,
+            "engine_kv_bytes_per_decode_step":
+                self.config.scheduler.max_num_seqs
+                * self.config.cache.kv_bytes_per_token(
+                    self.config.model),
         }
         if self.offload is not None:
             out.update({

@@ -10,6 +10,12 @@ the trash page that padded writes land on (ops/attention.write_to_pages).
 Capacity metrics feed the engine's ``/metrics``:
 ``vllm:gpu_cache_usage_perc`` and ``vllm:gpu_prefix_cache_hit_rate``
 (scraped by the router, reference engine_stats.py:46-55).
+
+Page accounting is storage-dtype agnostic: with ``--kv-cache-dtype
+int8`` the EngineConfig expands ``num_pages`` ~2x at the same HBM byte
+budget (engine/config.py) before this manager is built, and content
+hashes/refcounts are over token ids, so quantized and full-precision
+pods share identical prefix-cache semantics.
 """
 
 from __future__ import annotations
@@ -151,9 +157,12 @@ class PagedCacheManager:
 
         Returns the page ids (ref-counted up; caller owns them).
         """
-        self.prefix_query_tokens += len(token_ids)
         if not self.config.enable_prefix_caching:
+            # Don't count queries the cache never sees: inflating the
+            # denominator here would drag the reported hit rate toward
+            # zero on pods running with prefix caching disabled.
             return []
+        self.prefix_query_tokens += len(token_ids)
         matched: List[int] = []
         # Never match the *entire* prompt: the final token must be
         # recomputed so prefill produces logits for sampling.

@@ -23,6 +23,11 @@ from production_stack_tpu.engine.scheduler import DecodePlan, PrefillPlan
 from production_stack_tpu.engine.sequence import Sequence, decode_budget
 from production_stack_tpu.models.registry import get_model
 from production_stack_tpu.ops.attention import write_to_pages
+from production_stack_tpu.ops.quant_kv import (
+    QuantKV,
+    quant_cache_struct,
+    quant_cache_zeros,
+)
 from production_stack_tpu.ops.sampling import (
     apply_penalties,
     sample_tokens,
@@ -195,6 +200,13 @@ class ModelRunner:
         self.config = config
         self.mesh = mesh
         model_config = config.model
+        # int8 paged KV (docs/kv_quantization.md): pages stored as
+        # QuantKV pytrees (int8 data + per-slot f32 scales); the write
+        # path quantizes in-graph and the attention impls dequantize
+        # in-kernel. Resolved once here — everything downstream
+        # (cache creation, lowering probes, read/write_page, offload
+        # payload arity) keys off this flag.
+        self.kv_quantized = config.cache.resolved_kv_dtype() == "int8"
         if config.cache.cache_layout == "auto":
             # Measured default (benchmarks/results/decode_probe.json,
             # TPU v5e, 2026-07-31): per_layer decode bursts run 2.0x
@@ -370,6 +382,12 @@ class ModelRunner:
             config.cache.page_size,
         )
         dtype = model_config.jax_dtype
+
+        def _fresh_cache(shape):
+            if self.kv_quantized:
+                return shard_cache(quant_cache_zeros(shape), mesh)
+            return shard_cache(jnp.zeros(shape, dtype), mesh)
+
         self.cache_layout = config.cache.cache_layout
         if self.cache_layout == "per_layer":
             # A tuple of L per-layer buffers instead of one stacked
@@ -383,16 +401,14 @@ class ModelRunner:
                     "parallelism (pp shards the stacked L axis; use "
                     "the stacked layout)")
             self.k_cache = tuple(
-                shard_cache(jnp.zeros(cache_shape[1:], dtype), mesh)
+                _fresh_cache(cache_shape[1:])
                 for _ in range(model_config.num_hidden_layers))
             self.v_cache = tuple(
-                shard_cache(jnp.zeros(cache_shape[1:], dtype), mesh)
+                _fresh_cache(cache_shape[1:])
                 for _ in range(model_config.num_hidden_layers))
         elif self.cache_layout == "stacked":
-            self.k_cache = shard_cache(jnp.zeros(cache_shape, dtype),
-                                       mesh)
-            self.v_cache = shard_cache(jnp.zeros(cache_shape, dtype),
-                                       mesh)
+            self.k_cache = _fresh_cache(cache_shape)
+            self.v_cache = _fresh_cache(cache_shape)
         else:
             raise ValueError(
                 "cache.cache_layout must be 'auto', 'stacked' or "
@@ -556,16 +572,16 @@ class ModelRunner:
         max_pages = config.scheduler.max_pages_per_seq(
             config.cache.page_size)
         if config.cache.cache_layout == "per_layer":
-            cache = jax.ShapeDtypeStruct(
-                (nkv, config.cache.num_pages, d,
-                 config.cache.page_size), dtype)
+            cache_shape = (nkv, config.cache.num_pages, d,
+                           config.cache.page_size)
             layer0 = None
         else:
-            cache = jax.ShapeDtypeStruct(
-                (model_config.num_hidden_layers, nkv,
-                 config.cache.num_pages, d, config.cache.page_size),
-                dtype)
+            cache_shape = (model_config.num_hidden_layers, nkv,
+                           config.cache.num_pages, d,
+                           config.cache.page_size)
             layer0 = jax.ShapeDtypeStruct((), np.int32)
+        cache = (quant_cache_struct(cache_shape) if self.kv_quantized
+                 else jax.ShapeDtypeStruct(cache_shape, dtype))
         b, s = self.decode_width, self.spec_width
         return self._lowering_error(
             paged_prefill_attention,
@@ -608,16 +624,16 @@ class ModelRunner:
         # through SMEM prefetch). Per-layer layout: one layer's buffer
         # with no layer operand.
         if config.cache.cache_layout == "per_layer":
-            cache = jax.ShapeDtypeStruct(
-                (nkv, config.cache.num_pages, d,
-                 config.cache.page_size), dtype)
+            cache_shape = (nkv, config.cache.num_pages, d,
+                           config.cache.page_size)
             layer0 = None
         else:
-            cache = jax.ShapeDtypeStruct(
-                (model_config.num_hidden_layers, nkv,
-                 config.cache.num_pages, d, config.cache.page_size),
-                dtype)
+            cache_shape = (model_config.num_hidden_layers, nkv,
+                           config.cache.num_pages, d,
+                           config.cache.page_size)
             layer0 = jax.ShapeDtypeStruct((), np.int32)
+        cache = (quant_cache_struct(cache_shape) if self.kv_quantized
+                 else jax.ShapeDtypeStruct(cache_shape, dtype))
 
         if config.cache.page_size % 128:
             # The kernels DMA [head_dim, page_size] page slices out of
@@ -1861,13 +1877,30 @@ class ModelRunner:
 
     # ---- page-granular IO (offload tiers) ---------------------------------
 
-    def read_page(self, page_id: int) -> Tuple[np.ndarray, np.ndarray]:
+    def read_page(self, page_id: int) -> Tuple[np.ndarray, ...]:
         """Copy one page's KV out of HBM: [L, kv, d, page_size] each.
 
         The offload serde page format is layer-stacked regardless of
         the HBM layout, so tiers and the remote cache server stay
-        layout-agnostic.
+        layout-agnostic.  Quantized caches return a 4-tuple
+        (k, v, k_scale, v_scale) with [L, kv, page_size] scales.
         """
+        if self.kv_quantized:
+            if self.cache_layout == "per_layer":
+                k = np.stack(jax.device_get(
+                    [kc.data[:, page_id] for kc in self.k_cache]))
+                v = np.stack(jax.device_get(
+                    [vc.data[:, page_id] for vc in self.v_cache]))
+                ks = np.stack(jax.device_get(
+                    [kc.scale[:, page_id] for kc in self.k_cache]))
+                vs = np.stack(jax.device_get(
+                    [vc.scale[:, page_id] for vc in self.v_cache]))
+                return k, v, ks, vs
+            k = jax.device_get(self.k_cache.data[:, :, page_id])
+            v = jax.device_get(self.v_cache.data[:, :, page_id])
+            ks = jax.device_get(self.k_cache.scale[:, :, page_id])
+            vs = jax.device_get(self.v_cache.scale[:, :, page_id])
+            return k, v, ks, vs
         if self.cache_layout == "per_layer":
             k = np.stack(jax.device_get(
                 [kc[:, page_id] for kc in self.k_cache]))
@@ -1879,8 +1912,14 @@ class ModelRunner:
         return k, v
 
     def write_page(self, page_id: int, k_page: np.ndarray,
-                   v_page: np.ndarray) -> None:
+                   v_page: np.ndarray,
+                   k_scale: Optional[np.ndarray] = None,
+                   v_scale: Optional[np.ndarray] = None) -> None:
         """Restore one page's KV into HBM (donated in-place update)."""
+        if self.kv_quantized:
+            self._write_page_quantized(page_id, k_page, v_page,
+                                       k_scale, v_scale)
+            return
         if not hasattr(self, "_write_page_jit"):
             self._write_page_jit = jax.jit(
                 lambda cache, page, pid:
@@ -1908,6 +1947,48 @@ class ModelRunner:
         self.v_cache = self._write_page_jit(
             self.v_cache, jnp.asarray(v_page), page_id
         )
+
+    def _write_page_quantized(self, page_id: int, k_page: np.ndarray,
+                              v_page: np.ndarray, k_scale: np.ndarray,
+                              v_scale: np.ndarray) -> None:
+        if k_scale is None or v_scale is None:
+            raise ValueError(
+                "quantized cache restore requires k_scale/v_scale")
+        if not hasattr(self, "_write_page_q_jit"):
+            self._write_page_q_jit = jax.jit(
+                lambda cache, page, scale, pid: QuantKV(
+                    cache.data.at[:, :, pid].set(
+                        page.astype(jnp.int8)),
+                    cache.scale.at[:, :, pid].set(
+                        scale.astype(jnp.float32))),
+                donate_argnums=(0,),
+            )
+            self._write_layer_page_q_jit = jax.jit(
+                lambda cache, page, scale, pid: QuantKV(
+                    cache.data.at[:, pid].set(
+                        page.astype(jnp.int8)),
+                    cache.scale.at[:, pid].set(
+                        scale.astype(jnp.float32))),
+                donate_argnums=(0,),
+            )
+        if self.cache_layout == "per_layer":
+            self.k_cache = tuple(
+                self._write_layer_page_q_jit(
+                    kc, jnp.asarray(k_page[layer]),
+                    jnp.asarray(k_scale[layer]), page_id)
+                for layer, kc in enumerate(self.k_cache))
+            self.v_cache = tuple(
+                self._write_layer_page_q_jit(
+                    vc, jnp.asarray(v_page[layer]),
+                    jnp.asarray(v_scale[layer]), page_id)
+                for layer, vc in enumerate(self.v_cache))
+            return
+        self.k_cache = self._write_page_q_jit(
+            self.k_cache, jnp.asarray(k_page), jnp.asarray(k_scale),
+            page_id)
+        self.v_cache = self._write_page_q_jit(
+            self.v_cache, jnp.asarray(v_page), jnp.asarray(v_scale),
+            page_id)
 
     def _page_table_rows(self, seqs: List[Sequence],
                          pad_to: Optional[int] = None) -> np.ndarray:

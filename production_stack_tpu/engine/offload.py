@@ -32,22 +32,51 @@ from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
 
-# A page's KV payload: (k, v), each [L, kv_heads, head_dim, page_size]
-# (the head-major cache layout, model_runner.read_page).
-PagePayload = Tuple[np.ndarray, np.ndarray]
+# A page's KV payload: (k, v) each [L, kv_heads, head_dim, page_size]
+# (the head-major cache layout, model_runner.read_page), or the
+# quantized 4-tuple (k, v, k_scale, v_scale) with int8 data and
+# [L, kv_heads, page_size] float32 scales. Tiers treat the payload as
+# an opaque tuple of arrays; arity and dtypes round-trip verbatim.
+PagePayload = Tuple[np.ndarray, ...]
 
 # Wire-format version, folded into every tier key so pods running a
 # different KV page layout (e.g. across a rolling upgrade against a
 # shared remote cache) can never restore each other's bytes into the
 # wrong axis order. Bump whenever PagePayload layout changes.
-KV_WIRE_VERSION = 2
+KV_WIRE_VERSION = 3
+
+# Page dtypes a cache server will accept (engine/cache_server.py
+# validates inbound payloads against this before storing them).
+ALLOWED_WIRE_DTYPES = ("float32", "float16", "bfloat16", "int8")
 
 
-def _stable_key(page_hash: PageHash) -> str:
-    """Serializable, process-independent key for a chain hash."""
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype from a wire name, including the ml_dtypes extensions.
+
+    ``np.dtype("bfloat16")`` raises TypeError — bfloat16 is registered
+    by ml_dtypes, not numpy — so bf16 pages coming back from the
+    remote tier must resolve through the ml_dtypes namespace.
+    """
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise TypeError(f"unsupported KV wire dtype {name!r}")
+
+
+def _stable_key(page_hash: PageHash, kv_dtype: str = "") -> str:
+    """Serializable, process-independent key for a chain hash.
+
+    ``kv_dtype`` namespaces the key by page storage format so int8 and
+    full-precision pods sharing a remote cache can never restore each
+    other's payloads into a mismatched cache.
+    """
     import hashlib
     parent, tokens = page_hash
-    raw = (f"v{KV_WIRE_VERSION}:{parent}:"
+    raw = (f"v{KV_WIRE_VERSION}:{kv_dtype}:{parent}:"
            f"{','.join(map(str, tokens))}").encode()
     return hashlib.sha256(raw).hexdigest()
 
@@ -71,15 +100,14 @@ class HostKVPool:
         return self._bytes
 
     def put(self, key: str, payload: PagePayload) -> None:
-        k, v = payload
-        size = k.nbytes + v.nbytes
+        size = sum(a.nbytes for a in payload)
         with self._lock:
             if key in self._pool:
                 self._pool.move_to_end(key)
                 return
             while self._bytes + size > self.max_bytes and self._pool:
-                _, (ek, ev) = self._pool.popitem(last=False)
-                self._bytes -= ek.nbytes + ev.nbytes
+                _, evicted = self._pool.popitem(last=False)
+                self._bytes -= sum(a.nbytes for a in evicted)
             if size <= self.max_bytes:
                 self._pool[key] = payload
                 self._bytes += size
@@ -114,10 +142,16 @@ class RemoteKVClient:
 
     def put(self, key: str, payload: PagePayload) -> bool:
         import msgpack
-        k, v = payload
+        # Per-array framing: each page array carries its own
+        # shape/dtype, so mixed-dtype payloads (int8 data + float32
+        # scales) and bf16 pages serialize without a shared dtype.
         body = msgpack.packb({
-            "k": k.tobytes(), "v": v.tobytes(),
-            "shape": list(k.shape), "dtype": str(k.dtype),
+            "version": KV_WIRE_VERSION,
+            "arrays": [
+                {"data": a.tobytes(), "shape": list(a.shape),
+                 "dtype": str(a.dtype)}
+                for a in payload
+            ],
         })
         try:
             resp = self._session.put(
@@ -138,11 +172,11 @@ class RemoteKVClient:
             if resp.status_code != 200:
                 return None
             obj = msgpack.unpackb(resp.content)
-            shape = tuple(obj["shape"])
-            dtype = np.dtype(obj["dtype"])
-            k = np.frombuffer(obj["k"], dtype).reshape(shape)
-            v = np.frombuffer(obj["v"], dtype).reshape(shape)
-            return k, v
+            return tuple(
+                np.frombuffer(a["data"], _np_dtype(a["dtype"]))
+                .reshape(tuple(a["shape"]))
+                for a in obj["arrays"]
+            )
         except Exception as e:
             logger.warning("Remote KV get failed: %s", e)
             return None
@@ -161,36 +195,45 @@ class KVOffloadManager:
     """Moves KV pages between HBM and the offload tiers.
 
     Engine integration points:
-    - ``offload_page(page_hash, k_page, v_page)``: called when a hashed
-      page is evicted from HBM (numpy arrays, already device_get).
+    - ``offload_page(page_hash, *payload)``: called when a hashed page
+      is evicted from HBM (numpy arrays, already device_get; 2 arrays
+      for full-precision pages, 4 for int8 pages with scales).
     - ``lookup_chain(hashes)``: longest prefix of page hashes available
       in host/remote tiers (after the in-HBM prefix match misses).
     - ``fetch(page_hash)``: payload for restoration (device_put done by
       the model runner, which owns the device arrays).
+
+    ``kv_dtype`` is folded into every tier key (see _stable_key) so
+    pods storing pages in different formats never alias.
     """
 
     def __init__(self, host_pool: Optional[HostKVPool] = None,
                  remote: Optional[RemoteKVClient] = None,
-                 write_through_remote: bool = True):
+                 write_through_remote: bool = True,
+                 kv_dtype: str = ""):
         self.host = host_pool or HostKVPool()
         self.remote = remote
         self.write_through_remote = write_through_remote
+        self.kv_dtype = kv_dtype
         self.restored_pages = 0
         self.offloaded_pages = 0
 
-    def offload_page(self, page_hash: PageHash, k_page: np.ndarray,
-                     v_page: np.ndarray) -> None:
-        key = _stable_key(page_hash)
-        self.host.put(key, (k_page, v_page))
+    def _key(self, page_hash: PageHash) -> str:
+        return _stable_key(page_hash, self.kv_dtype)
+
+    def offload_page(self, page_hash: PageHash,
+                     *payload: np.ndarray) -> None:
+        key = self._key(page_hash)
+        self.host.put(key, payload)
         self.offloaded_pages += 1
         if self.remote is not None and self.write_through_remote:
-            self.remote.put(key, (k_page, v_page))
+            self.remote.put(key, payload)
 
     def lookup_chain(self, hashes: List[PageHash]) -> int:
         """How many leading pages of *hashes* can be restored."""
         n = 0
         for page_hash in hashes:
-            key = _stable_key(page_hash)
+            key = self._key(page_hash)
             if self.host.contains(key):
                 n += 1
                 continue
@@ -201,7 +244,7 @@ class KVOffloadManager:
         return n
 
     def fetch(self, page_hash: PageHash) -> Optional[PagePayload]:
-        key = _stable_key(page_hash)
+        key = self._key(page_hash)
         payload = self.host.get(key)
         if payload is not None:
             return payload

@@ -15,6 +15,15 @@ is rescaled per channel.
 
 Serving-path only: the dense encode/training forwards use the
 unquantized layout (the Embedder refuses quantized params).
+
+Weights are one of the two int8 serving knobs; the other is the KV
+cache. ``--kv-cache-dtype int8`` (CacheConfig.kv_cache_dtype) stores
+KV *pages* as int8 with per-slot per-head scales — quantized on the
+page write path (ops/attention.write_to_pages), dequantized in-kernel
+on the attention read path — and expands the page budget ~2x at the
+same HBM bytes. The two compose freely: this module covers weight
+streaming bandwidth, the KV knob covers cache capacity + decode read
+bandwidth (ops/quant_kv.py, docs/kv_quantization.md).
 """
 
 from __future__ import annotations

@@ -1087,6 +1087,21 @@ class EngineServer:
         lines.append("# TYPE vllm:num_preemptions_total counter")
         lines.append("vllm:num_preemptions_total "
                      f"{float(stats['num_preemptions_total'])}")
+        # KV quantization telemetry: page budget after any int8
+        # expansion, worst-case KV bytes written per decode step, and
+        # the storage dtype as a labeled one-hot gauge so dashboards
+        # can group pods by KV format.
+        lines.append("# TYPE vllm:engine_kv_cache_page_capacity gauge")
+        lines.append("vllm:engine_kv_cache_page_capacity "
+                     f"{float(stats['engine_kv_cache_page_capacity'])}")
+        lines.append("# TYPE vllm:engine_kv_bytes_per_decode_step gauge")
+        lines.append(
+            "vllm:engine_kv_bytes_per_decode_step "
+            f"{float(stats['engine_kv_bytes_per_decode_step'])}")
+        kv_dtype = self.engine.config.cache.resolved_kv_dtype()
+        lines.append("# TYPE vllm:engine_kv_cache_dtype gauge")
+        lines.append("vllm:engine_kv_cache_dtype{kv_dtype=\""
+                     f"{kv_dtype}\"}} 1.0")
         # vLLM-parity request-latency histograms + token counters.
         lines.extend(self.engine.metrics.render())
         lines.append("")
@@ -1220,6 +1235,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             num_pages=args.num_pages,
             enable_prefix_caching=not args.disable_prefix_caching,
             cache_layout=args.cache_layout,
+            kv_cache_dtype=args.kv_cache_dtype,
         ),
         scheduler=SchedulerConfig(
             max_num_seqs=args.max_num_seqs,
@@ -1283,6 +1299,14 @@ def parse_args(argv=None):
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--page-size", type=int, default=16)
     parser.add_argument("--num-pages", type=int, default=512)
+    parser.add_argument("--kv-cache-dtype", default="auto",
+                        choices=["auto", "bf16", "int8"],
+                        help="KV page storage dtype. 'auto'/'bf16' "
+                             "store pages in the model dtype; 'int8' "
+                             "quantizes pages with per-slot per-head "
+                             "scales and expands the page budget "
+                             "~2x at the same HBM bytes "
+                             "(docs/kv_quantization.md)")
     parser.add_argument("--cache-layout", default="auto",
                         choices=["auto", "stacked", "per_layer"],
                         help="KV cache HBM layout: auto (measured "

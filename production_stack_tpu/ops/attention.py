@@ -18,7 +18,21 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from production_stack_tpu.ops.quant_kv import QuantKV, quantize_kv
+
 NEG_INF = -1e30
+
+# Every attention implementation with a paged-KV read path. The
+# quantized-coverage lint (tests/test_kv_parity_coverage_lint.py)
+# requires a bf16-vs-int8 parity test naming each function here, so a
+# new kernel cannot silently skip int8 coverage.
+ATTENTION_IMPLS = {
+    "xla": ("production_stack_tpu.ops.attention", "paged_attention"),
+    "pallas_decode": ("production_stack_tpu.ops.paged_attention_pallas",
+                      "paged_decode_attention"),
+    "pallas_prefill": ("production_stack_tpu.ops.prefill_attention_pallas",
+                       "paged_prefill_attention"),
+}
 
 
 def gather_pages(cache_layer: jnp.ndarray,
@@ -81,6 +95,29 @@ def write_to_pages(cache: jnp.ndarray, new_kv: jnp.ndarray,
     physical_page = jnp.where(valid, physical_page, 0)
     flat_pages = physical_page.reshape(-1)
     flat_offsets = offset.reshape(-1)
+    if isinstance(cache, QuantKV):
+        # Quantize-on-write: one symmetric int8 scale per (token,
+        # kv_head) row lands in the scale tensor's matching page slot,
+        # so incremental writes never rescale a neighbour.
+        q8, kv_scale = quantize_kv(new_kv)  # [B,T,kv,d] i8 / [B,T,kv]
+        flat_q8 = q8.reshape(b * t, *q8.shape[2:])
+        flat_scale = kv_scale.reshape(b * t, kv_scale.shape[2])
+        if layer is None:
+            data = cache.data.at[:, flat_pages, :, flat_offsets].set(
+                flat_q8)
+            # Adjacent advanced indices (page, slot) keep the result
+            # in place — updates are [kv, B*T], hence the transpose.
+            scale = cache.scale.at[:, flat_pages, flat_offsets].set(
+                flat_scale.T)
+        else:
+            data = cache.data.at[
+                layer, :, flat_pages, :, flat_offsets].set(flat_q8)
+            # The static layer index makes the advanced indices
+            # non-adjacent again: updates broadcast to the front as
+            # [B*T, kv].
+            scale = cache.scale.at[
+                layer, :, flat_pages, flat_offsets].set(flat_scale)
+        return QuantKV(data, scale)
     # Advanced indices on the page and token-slot dims broadcast to
     # the front: the updates shape is [B*T, kv, d].
     flat_kv = new_kv.reshape(b * t, *new_kv.shape[2:])
@@ -157,6 +194,17 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
 
     k = gather_pages(k_cache_layer, page_table)  # [kv, B, P, d, page]
     v = gather_pages(v_cache_layer, page_table)
+    quantized = isinstance(k, QuantKV)
+    if quantized:
+        # int8 pages: keep the matmul operands int8 (dequant BEFORE
+        # the gather would materialize the whole cache in f32, the
+        # same hazard as the convert-hoist note below) and fold the
+        # per-slot scales in afterwards — exact, because each scale
+        # varies only over non-contracted score axes. Broadcast shape
+        # [B, kv, 1(group), 1(T), P, page].
+        k_scale_b = k.scale.transpose(1, 0, 2, 3)[:, :, None, None]
+        v_scale_b = v.scale.transpose(1, 0, 2, 3)[:, :, None, None]
+        k, v = k.data, v.data
     p_cnt, page = k.shape[2], k.shape[4]
 
     qg = q.reshape(b, t, num_kv_heads, group, head_dim)
@@ -174,6 +222,8 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
         "btkgd,kbpdc->bkgtpc", qg, k,
         preferred_element_type=jnp.float32,
     ) * scale
+    if quantized:
+        scores = scores * k_scale_b  # fold k dequant into the logits
 
     token_pos = (jnp.arange(p_cnt)[:, None] * page
                  + jnp.arange(page)[None, :])  # [P, page]
@@ -203,8 +253,15 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
         probs = jax.nn.softmax(joint, axis=-1)
         p_pages = probs[..., :p_cnt * page].reshape(shape)
         p_tail = probs[..., p_cnt * page:]
+        if quantized:
+            # v dequant folds into the probabilities (f32 — casting to
+            # the cache dtype would truncate to int8); the burst tail
+            # itself stays full precision.
+            p_pages = p_pages * v_scale_b
+        else:
+            p_pages = p_pages.astype(v.dtype)
         out = jnp.einsum(
-            "bkgtpc,kbpdc->btkgd", p_pages.astype(v.dtype), v,
+            "bkgtpc,kbpdc->btkgd", p_pages, v,
             preferred_element_type=jnp.float32,
         ) + jnp.einsum(
             "bkgts,bskd->btkgd", p_tail.astype(v_tail.dtype), v_tail,
@@ -214,8 +271,12 @@ def paged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
 
     # Softmax over the joint (P, page) token axis.
     probs = jax.nn.softmax(flat, axis=-1).reshape(shape)  # f32
+    if quantized:
+        probs = probs * v_scale_b  # fold v dequant; keep f32
+    else:
+        probs = probs.astype(v.dtype)
     out = jnp.einsum(
-        "bkgtpc,kbpdc->btkgd", probs.astype(v.dtype), v,
+        "bkgtpc,kbpdc->btkgd", probs, v,
         preferred_element_type=jnp.float32,
     )
     return out.reshape(b, t, num_q_heads, head_dim).astype(q.dtype)

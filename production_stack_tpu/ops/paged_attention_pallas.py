@@ -57,6 +57,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from production_stack_tpu.ops.quant_kv import QuantKV
+
+try:  # jax >= 0.5 spelling
+    _HBM = pltpu.MemorySpace.HBM
+except AttributeError:  # jax 0.4.x: ANY keeps the operand un-blocked in HBM
+    _HBM = pltpu.TPUMemorySpace.ANY
+
 NEG_INF = -1e30
 
 # Minimum sublane count for the query-group axis: fp32 tiles are
@@ -69,20 +76,18 @@ _PAGES_PER_CHUNK = 4
 
 
 def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
-                   k_hbm, v_hbm,
-                   o_ref, k_out, v_out,
-                   k_scratch, v_scratch, m_ref, l_ref, acc_ref,
-                   sem, *, page_size: int, pages_per_chunk: int,
+                   k_hbm, v_hbm, ks_hbm, vs_hbm,
+                   o_ref,
+                   k_scratch, v_scratch, ks_scratch, vs_scratch,
+                   m_ref, l_ref, acc_ref,
+                   sem, ssem, *, page_size: int, pages_per_chunk: int,
                    group_pad: int, head_dim: int, max_pages: int,
-                   has_layer: bool):
-    # k_out/v_out alias k_hbm/v_hbm (input_output_aliases below): the
-    # kernel never writes them — the aliasing exists so the caller can
-    # thread the cache THROUGH the custom call. Without it the cache
-    # buffer is both a custom-call operand and the target of the next
-    # layer's scatter, and XLA's copy-insertion breaks the apparent
-    # interference with a full-cache copy per layer (measured ~158
-    # ms/decode-step on v5e for the 1B bench config).
-    del k_out, v_out
+                   has_layer: bool, quantized: bool):
+    # ks_hbm/vs_hbm carry the per-slot f32 dequant scales of an int8
+    # cache (ops/quant_kv.py), pre-reshaped by the wrapper to
+    # [.., pages, 1, page_size] so each page's scale row DMAs as the
+    # same 2-D (sublane, lane) tile shape as the data pages; they (and
+    # their scratch/semaphore) are None for a full-precision cache.
     b = pl.program_id(0)
     h = pl.program_id(1)
     c = pages_per_chunk
@@ -111,7 +116,7 @@ def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
         else:
             k_src = k_hbm.at[h, pid]
             v_src = v_hbm.at[h, pid]
-        return (
+        copies = [
             pltpu.make_async_copy(
                 k_src,
                 k_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
@@ -122,13 +127,34 @@ def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
                 v_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
                 sem.at[1, slot, j],
             ),
-        )
+        ]
+        if quantized:
+            if has_layer:
+                ks_src = ks_hbm.at[layer_ref[0], h, pid]
+                vs_src = vs_hbm.at[layer_ref[0], h, pid]
+            else:
+                ks_src = ks_hbm.at[h, pid]
+                vs_src = vs_hbm.at[h, pid]
+            copies += [
+                pltpu.make_async_copy(
+                    ks_src,
+                    ks_scratch.at[
+                        slot, :, pl.ds(j * page_size, page_size)],
+                    ssem.at[0, slot, j],
+                ),
+                pltpu.make_async_copy(
+                    vs_src,
+                    vs_scratch.at[
+                        slot, :, pl.ds(j * page_size, page_size)],
+                    ssem.at[1, slot, j],
+                ),
+            ]
+        return copies
 
     def issue(slot, chunk_idx):
         for j in range(c):
-            dk, dv = dma(slot, chunk_idx, j)
-            dk.start()
-            dv.start()
+            for cp in dma(slot, chunk_idx, j):
+                cp.start()
 
     # Padded batch rows have kv_len == 0 -> num_chunks == 0: nothing
     # may be issued for them — an unwaited DMA leaks its semaphore
@@ -154,9 +180,8 @@ def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
                 issue(1 - slot, chunk_idx + 1)
 
             for j in range(c):
-                dk, dv = dma(slot, chunk_idx, j)
-                dk.wait()
-                dv.wait()
+                for cp in dma(slot, chunk_idx, j):
+                    cp.wait()
 
             k = k_scratch[slot].astype(jnp.float32)  # [D, C*P]
             v = v_scratch[slot].astype(jnp.float32)  # [D, C*P]
@@ -165,6 +190,11 @@ def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale  # [G_pad, C*P]
+            if quantized:
+                # Fold the k dequant scales into the logits: exact,
+                # since each scale is constant along the contracted
+                # head_dim axis. [1, C*P] broadcasts over the group.
+                scores = scores * ks_scratch[slot]
 
             token_pos = (chunk_idx * chunk_tokens
                          + jax.lax.broadcasted_iota(
@@ -180,6 +210,10 @@ def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
             l_ref[...] = l_ref[...] * alpha + jnp.sum(
                 probs, axis=-1, keepdims=True
             )
+            if quantized:
+                # v dequant folds into the probabilities before the
+                # pv contraction (per-token scales, constant along d).
+                probs = probs * vs_scratch[slot]
             # pv: [G_pad, D] — contract the token axis of both sides.
             pv = jax.lax.dot_general(
                 probs, v,
@@ -226,10 +260,23 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
             "[L, ...] cache WITH layer, or a per-layer [kv, ...] "
             f"cache WITHOUT (got ndim={k_cache_layer.ndim}, "
             f"layer={layer!r})")
+    quantized = isinstance(k_cache_layer, QuantKV)
+    if quantized:
+        k_data, v_data = k_cache_layer.data, v_cache_layer.data
+        scale_shape = k_cache_layer.scale.shape
+        # [.., pages, ps] -> [.., pages, 1, ps]: scale DMAs then move
+        # 2-D (1, page_size) tiles, the same (sublane, lane) slicing
+        # discipline as the data pages. Pure bitcast — last axis is
+        # contiguous either way.
+        sshape = scale_shape[:-1] + (1, scale_shape[-1])
+        k_scale = k_cache_layer.scale.reshape(sshape)
+        v_scale = v_cache_layer.scale.reshape(sshape)
+    else:
+        k_data, v_data = k_cache_layer, v_cache_layer
     layer_arr = jnp.asarray(
         [0 if layer is None else layer], jnp.int32)
     b, num_q_heads, head_dim = q.shape
-    num_kv_heads, _, _, page_size = k_cache_layer.shape[-4:]
+    num_kv_heads, _, _, page_size = k_data.shape[-4:]
     group = num_q_heads // num_kv_heads
     group_pad = max(group, _MIN_GROUP)
     c = _PAGES_PER_CHUNK
@@ -253,19 +300,55 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
             qg, ((0, 0), (0, 0), (0, group_pad - group), (0, 0))
         )
 
-    kernel = functools.partial(
+    base_kernel = functools.partial(
         _decode_kernel, page_size=page_size, pages_per_chunk=c,
         group_pad=group_pad, head_dim=head_dim, max_pages=max_pages,
-        has_layer=has_layer,
+        has_layer=has_layer, quantized=quantized,
     )
-    if not has_layer:
-        # No pass-through cache outputs: splice placeholder refs into
-        # the kernel's (o_ref, k_out, v_out, *scratch) signature.
-        base_kernel = kernel
+    n_cache_in = 4 if quantized else 2
+    # Pass-through cache outputs (stacked form) exist only so the
+    # caller can thread the cache THROUGH the custom call via
+    # input/output aliasing: without it the cache buffer is both a
+    # custom-call operand and the target of the next layer's scatter,
+    # and XLA's copy-insertion breaks the apparent interference with a
+    # full-cache copy per layer (measured ~158 ms/decode-step on v5e
+    # for the 1B bench config). The kernel never touches them, so this
+    # adapter strips them (and splices None for the quant-only refs)
+    # before calling the canonical kernel signature.
+    n_pass = n_cache_in if has_layer else 0
 
-        def kernel(pt, kl, la, q, k, v, o_ref, *scratch):
-            base_kernel(pt, kl, la, q, k, v, o_ref, None, None,
-                        *scratch)
+    def kernel(pt, kl, la, q_ref, *refs):
+        cache_in = refs[:n_cache_in]
+        o_ref = refs[n_cache_in]
+        scratch = refs[n_cache_in + 1 + n_pass:]
+        if quantized:
+            k, v, ks, vs = cache_in
+            (k_s, v_s, ks_s, vs_s, m, l, acc, sem, ssem) = scratch
+        else:
+            k, v = cache_in
+            ks = vs = ks_s = vs_s = ssem = None
+            (k_s, v_s, m, l, acc, sem) = scratch
+        base_kernel(pt, kl, la, q_ref, k, v, ks, vs, o_ref,
+                    k_s, v_s, ks_s, vs_s, m, l, acc, sem, ssem)
+
+    hbm = pl.BlockSpec(memory_space=_HBM)
+    scratch_shapes = [
+        pltpu.VMEM((2, head_dim, c * page_size), k_data.dtype),
+        pltpu.VMEM((2, head_dim, c * page_size), v_data.dtype),
+    ]
+    if quantized:
+        scratch_shapes += [
+            pltpu.VMEM((2, 1, c * page_size), jnp.float32),  # k scale
+            pltpu.VMEM((2, 1, c * page_size), jnp.float32),  # v scale
+        ]
+    scratch_shapes += [
+        pltpu.VMEM((group_pad, 1), jnp.float32),  # m
+        pltpu.VMEM((group_pad, 1), jnp.float32),  # l
+        pltpu.VMEM((group_pad, head_dim), jnp.float32),  # acc
+        pltpu.SemaphoreType.DMA((2, 2, c)),
+    ]
+    if quantized:
+        scratch_shapes += [pltpu.SemaphoreType.DMA((2, 2, c))]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # page_table, kv_lens, layer
@@ -276,54 +359,52 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
                 (1, 1, group_pad, head_dim),
                 lambda bi, hi, pt, kl, la: (bi, hi, 0, 0),
             ),
-            # Full KV cache stays in HBM; the kernel DMAs pages itself.
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ],
+            # Full KV cache (and int8 scales) stays in HBM; the kernel
+            # DMAs pages itself.
+        ] + [hbm] * n_cache_in,
         out_specs=[
             pl.BlockSpec(
                 (1, 1, group_pad, head_dim),
                 lambda bi, hi, pt, kl, la: (bi, hi, 0, 0),
             ),
-        ] + ([
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ] if has_layer else []),
-        scratch_shapes=[
-            pltpu.VMEM((2, head_dim, c * page_size),
-                       k_cache_layer.dtype),
-            pltpu.VMEM((2, head_dim, c * page_size),
-                       v_cache_layer.dtype),
-            pltpu.VMEM((group_pad, 1), jnp.float32),  # m
-            pltpu.VMEM((group_pad, 1), jnp.float32),  # l
-            pltpu.VMEM((group_pad, head_dim), jnp.float32),  # acc
-            pltpu.SemaphoreType.DMA((2, 2, c)),
-        ],
+        ] + [hbm] * n_pass,
+        scratch_shapes=scratch_shapes,
     )
 
     out_shape = [jax.ShapeDtypeStruct(
         (b, num_kv_heads, group_pad, head_dim), q.dtype)]
+    operands = [page_table, kv_lens, layer_arr, qg, k_data, v_data]
+    if quantized:
+        operands += [k_scale, v_scale]
     if has_layer:
         out_shape += [
-            jax.ShapeDtypeStruct(
-                k_cache_layer.shape, k_cache_layer.dtype),
-            jax.ShapeDtypeStruct(
-                v_cache_layer.shape, v_cache_layer.dtype),
+            jax.ShapeDtypeStruct(k_data.shape, k_data.dtype),
+            jax.ShapeDtypeStruct(v_data.shape, v_data.dtype),
         ]
+        if quantized:
+            out_shape += [
+                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+            ]
+    # Inputs count scalar-prefetch operands: (page_table, kv_lens,
+    # layer, q, k, v[, ks, vs]) -> cache operands starting at 4 alias
+    # outputs starting at 1. Only the stacked (engine) form aliases:
+    # 4D callers keep using their caches afterwards, and aliasing a
+    # still-live value would force the copy it exists to avoid.
+    aliases = ({4 + i: 1 + i for i in range(n_cache_in)}
+               if has_layer else {})
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         grid_spec=grid_spec,
-        # Inputs count scalar-prefetch operands: (page_table, kv_lens,
-        # layer, q, k, v) -> k=4, v=5 alias outputs 1, 2. Only the
-        # stacked (engine) form aliases: 4D callers keep using their
-        # caches afterwards, and aliasing a still-live value would
-        # force the copy it exists to avoid.
-        input_output_aliases={4: 1, 5: 2} if has_layer else {},
+        input_output_aliases=aliases,
         interpret=interpret,
-    )(page_table, kv_lens, layer_arr, qg, k_cache_layer,
-      v_cache_layer)
+    )(*operands)
     out = res[0][:, :, :group].reshape(b, num_q_heads, head_dim)
     if has_layer:
+        if quantized:
+            return (out,
+                    QuantKV(res[1], res[3].reshape(scale_shape)),
+                    QuantKV(res[2], res[4].reshape(scale_shape)))
         return out, res[1], res[2]
     return out

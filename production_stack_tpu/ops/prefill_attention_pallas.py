@@ -42,6 +42,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from production_stack_tpu.ops.quant_kv import QuantKV
+
+try:  # jax >= 0.5 spelling
+    _HBM = pltpu.MemorySpace.HBM
+except AttributeError:  # jax 0.4.x: ANY keeps the operand un-blocked in HBM
+    _HBM = pltpu.TPUMemorySpace.ANY
+
 NEG_INF = -1e30
 
 # Pages per DMA burst (2 x 128-token pages = a 256-token KV tile per
@@ -52,16 +59,16 @@ _PAGES_PER_CHUNK = 2
 
 def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
                     layer_ref, q_ref,
-                    k_hbm, v_hbm, o_ref, k_out, v_out,
+                    k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
                     m_ref, l_ref, acc_ref,
-                    k_scratch, v_scratch, sem, *,
+                    k_scratch, v_scratch, ks_scratch, vs_scratch,
+                    sem, ssem, *,
                     page_size: int, pages_per_chunk: int, group: int,
                     chunk: int, head_dim: int, max_pages: int,
-                    has_layer: bool):
-    # k_out/v_out alias the cache inputs so the caller can thread the
-    # cache through the custom call (see ops/paged_attention_pallas.py
-    # _decode_kernel for the copy-insertion rationale); never written.
-    del k_out, v_out
+                    has_layer: bool, quantized: bool):
+    # ks_hbm/vs_hbm carry the per-slot f32 dequant scales of an int8
+    # cache (ops/quant_kv.py), pre-reshaped by the wrapper to
+    # [.., pages, 1, page_size]; None for a full-precision cache.
     b = pl.program_id(0)
     h = pl.program_id(1)
     c = pages_per_chunk
@@ -84,7 +91,7 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
         else:
             k_src = k_hbm.at[h, pid]
             v_src = v_hbm.at[h, pid]
-        return (
+        copies = [
             pltpu.make_async_copy(
                 k_src,
                 k_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
@@ -95,13 +102,34 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
                 v_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
                 sem.at[1, slot, j],
             ),
-        )
+        ]
+        if quantized:
+            if has_layer:
+                ks_src = ks_hbm.at[layer_ref[0], h, pid]
+                vs_src = vs_hbm.at[layer_ref[0], h, pid]
+            else:
+                ks_src = ks_hbm.at[h, pid]
+                vs_src = vs_hbm.at[h, pid]
+            copies += [
+                pltpu.make_async_copy(
+                    ks_src,
+                    ks_scratch.at[
+                        slot, :, pl.ds(j * page_size, page_size)],
+                    ssem.at[0, slot, j],
+                ),
+                pltpu.make_async_copy(
+                    vs_src,
+                    vs_scratch.at[
+                        slot, :, pl.ds(j * page_size, page_size)],
+                    ssem.at[1, slot, j],
+                ),
+            ]
+        return copies
 
     def issue(slot, chunk_idx):
         for j in range(c):
-            dk, dv = dma(slot, chunk_idx, j)
-            dk.start()
-            dv.start()
+            for cp in dma(slot, chunk_idx, j):
+                cp.start()
 
     # Padded rows (kv_len == 0 -> num_chunks == 0) must not issue the
     # warmup DMAs: the loop never waits them, and an unwaited DMA
@@ -133,9 +161,8 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
                 issue(1 - slot, chunk_idx + 1)
 
             for j in range(c):
-                dk, dv = dma(slot, chunk_idx, j)
-                dk.wait()
-                dv.wait()
+                for cp in dma(slot, chunk_idx, j):
+                    cp.wait()
 
             k = k_scratch[slot].astype(jnp.float32)  # [D, C*P]
             v = v_scratch[slot].astype(jnp.float32)
@@ -144,6 +171,11 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale  # [G*T, C*P]
+            if quantized:
+                # k dequant folds into the logits ([1, C*P] broadcast
+                # over the G*T rows); exact — the scale is constant
+                # along the contracted head_dim axis.
+                scores = scores * ks_scratch[slot]
 
             token_pos = (chunk_idx * chunk_tokens
                          + jax.lax.broadcasted_iota(
@@ -160,6 +192,8 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
             l_ref[...] = l_ref[...] * alpha + jnp.sum(
                 probs, axis=-1, keepdims=True
             )
+            if quantized:
+                probs = probs * vs_scratch[slot]  # fold v dequant
             pv = jax.lax.dot_general(
                 probs, v,
                 dimension_numbers=(((1,), (1,)), ((), ())),
@@ -207,10 +241,21 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
             "[L, ...] cache WITH layer, or a per-layer [kv, ...] "
             f"cache WITHOUT (got ndim={k_cache_layer.ndim}, "
             f"layer={layer!r})")
+    quantized = isinstance(k_cache_layer, QuantKV)
+    if quantized:
+        k_data, v_data = k_cache_layer.data, v_cache_layer.data
+        scale_shape = k_cache_layer.scale.shape
+        # [.., pages, ps] -> [.., pages, 1, ps]: scale DMAs then move
+        # 2-D (1, page_size) tiles like the data pages (free bitcast).
+        sshape = scale_shape[:-1] + (1, scale_shape[-1])
+        k_scale = k_cache_layer.scale.reshape(sshape)
+        v_scale = v_cache_layer.scale.reshape(sshape)
+    else:
+        k_data, v_data = k_cache_layer, v_cache_layer
     layer_arr = jnp.asarray(
         [0 if layer is None else layer], jnp.int32)
     b, t, num_q_heads, head_dim = q.shape
-    num_kv_heads, _, _, page_size = k_cache_layer.shape[-4:]
+    num_kv_heads, _, _, page_size = k_data.shape[-4:]
     group = num_q_heads // num_kv_heads
     c = _PAGES_PER_CHUNK
 
@@ -231,19 +276,48 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     # scalar prefetch); positions are rebuilt as start + iota.
     q_start = q_positions[:, 0]
 
-    kernel = functools.partial(
+    base_kernel = functools.partial(
         _prefill_kernel, page_size=page_size, pages_per_chunk=c,
         group=group, chunk=t, head_dim=head_dim, max_pages=max_pages,
-        has_layer=has_layer,
+        has_layer=has_layer, quantized=quantized,
     )
-    if not has_layer:
-        # No pass-through cache outputs: splice placeholder refs into
-        # the kernel's (o_ref, k_out, v_out, *scratch) signature.
-        base_kernel = kernel
+    n_cache_in = 4 if quantized else 2
+    # Stacked-form pass-through cache outputs exist only for the
+    # input/output aliasing (see paged_decode_attention); the kernel
+    # never touches them, so this adapter strips them (and splices
+    # None for the quant-only refs) before the canonical signature.
+    n_pass = n_cache_in if has_layer else 0
 
-        def kernel(pt, kl, qs, la, q, k, v, o_ref, *scratch):
-            base_kernel(pt, kl, qs, la, q, k, v, o_ref, None, None,
-                        *scratch)
+    def kernel(pt, kl, qs, la, q_ref, *refs):
+        cache_in = refs[:n_cache_in]
+        o_ref = refs[n_cache_in]
+        scratch = refs[n_cache_in + 1 + n_pass:]
+        if quantized:
+            k, v, ks, vs = cache_in
+            (m, l, acc, k_s, v_s, ks_s, vs_s, sem, ssem) = scratch
+        else:
+            k, v = cache_in
+            ks = vs = ks_s = vs_s = ssem = None
+            (m, l, acc, k_s, v_s, sem) = scratch
+        base_kernel(pt, kl, qs, la, q_ref, k, v, ks, vs, o_ref,
+                    m, l, acc, k_s, v_s, ks_s, vs_s, sem, ssem)
+
+    hbm = pl.BlockSpec(memory_space=_HBM)
+    scratch_shapes = [
+        pltpu.VMEM((group * t, 1), jnp.float32),  # m
+        pltpu.VMEM((group * t, 1), jnp.float32),  # l
+        pltpu.VMEM((group * t, head_dim), jnp.float32),  # acc
+        pltpu.VMEM((2, head_dim, c * page_size), k_data.dtype),
+        pltpu.VMEM((2, head_dim, c * page_size), v_data.dtype),
+    ]
+    if quantized:
+        scratch_shapes += [
+            pltpu.VMEM((2, 1, c * page_size), jnp.float32),  # k scale
+            pltpu.VMEM((2, 1, c * page_size), jnp.float32),  # v scale
+        ]
+    scratch_shapes += [pltpu.SemaphoreType.DMA((2, 2, c))]
+    if quantized:
+        scratch_shapes += [pltpu.SemaphoreType.DMA((2, 2, c))]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,  # page_table, kv_lens, q_start, layer
@@ -253,54 +327,52 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
                 (1, 1, group * t, head_dim),
                 lambda bi, hi, pt, kl, qs, la: (bi, hi, 0, 0),
             ),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ],
+        ] + [hbm] * n_cache_in,
         out_specs=[
             pl.BlockSpec(
                 (1, 1, group * t, head_dim),
                 lambda bi, hi, pt, kl, qs, la: (bi, hi, 0, 0),
             ),
-        ] + ([
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ] if has_layer else []),
-        scratch_shapes=[
-            pltpu.VMEM((group * t, 1), jnp.float32),  # m
-            pltpu.VMEM((group * t, 1), jnp.float32),  # l
-            pltpu.VMEM((group * t, head_dim), jnp.float32),  # acc
-            pltpu.VMEM((2, head_dim, c * page_size),
-                       k_cache_layer.dtype),
-            pltpu.VMEM((2, head_dim, c * page_size),
-                       v_cache_layer.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, c)),
-        ],
+        ] + [hbm] * n_pass,
+        scratch_shapes=scratch_shapes,
     )
 
     out_shape = [jax.ShapeDtypeStruct(
         (b, num_kv_heads, group * t, head_dim), q.dtype)]
+    operands = [page_table, kv_lens, q_start, layer_arr, qg,
+                k_data, v_data]
+    if quantized:
+        operands += [k_scale, v_scale]
     if has_layer:
         out_shape += [
-            jax.ShapeDtypeStruct(
-                k_cache_layer.shape, k_cache_layer.dtype),
-            jax.ShapeDtypeStruct(
-                v_cache_layer.shape, v_cache_layer.dtype),
+            jax.ShapeDtypeStruct(k_data.shape, k_data.dtype),
+            jax.ShapeDtypeStruct(v_data.shape, v_data.dtype),
         ]
+        if quantized:
+            out_shape += [
+                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+            ]
+    # Inputs count scalar-prefetch operands: (page_table, kv_lens,
+    # q_start, layer, q, k, v[, ks, vs]) -> cache operands starting at
+    # 5 alias outputs starting at 1. Only the stacked (engine) form
+    # aliases — see paged_decode_attention.
+    aliases = ({5 + i: 1 + i for i in range(n_cache_in)}
+               if has_layer else {})
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         grid_spec=grid_spec,
-        # Inputs count scalar-prefetch operands: (page_table, kv_lens,
-        # q_start, layer, q, k, v) -> k=5, v=6 alias outputs 1, 2.
-        # Only the stacked (engine) form aliases — see
-        # paged_decode_attention.
-        input_output_aliases={5: 1, 6: 2} if has_layer else {},
+        input_output_aliases=aliases,
         interpret=interpret,
-    )(page_table, kv_lens, q_start, layer_arr, qg, k_cache_layer,
-      v_cache_layer)
+    )(*operands)
     out = (res[0].reshape(b, num_kv_heads, group, t, head_dim)
            .transpose(0, 3, 1, 2, 4)
            .reshape(b, t, num_q_heads, head_dim))
     if has_layer:
+        if quantized:
+            return (out,
+                    QuantKV(res[1], res[3].reshape(scale_shape)),
+                    QuantKV(res[2], res[4].reshape(scale_shape)))
         return out, res[1], res[2]
     return out

@@ -1,0 +1,108 @@
+"""Quantized paged-KV container: int8 pages + per-slot f32 scales.
+
+Layout (docs/kv_quantization.md): the ``data`` leaf keeps the exact
+page layout of a full-precision cache — ``[kv_heads, num_pages,
+head_dim, page_size]`` per layer, or stacked with a leading layer
+axis — but stored as int8. The ``scale`` leaf drops the head_dim axis:
+one f32 symmetric scale per (layer, kv_head, page, page_slot), i.e.
+``[kv_heads, num_pages, page_size]`` / ``[L, kv, pages, page_size]``.
+Per-slot granularity makes incremental page writes (decode commit,
+spec-decode eager drafts, deferred-burst flush) exact: writing one
+token slot never rescales a neighbour's values.
+
+``QuantKV`` is deliberately NOT a tuple/NamedTuple: the runner and
+models distinguish per-layer caches from stacked ones with
+``isinstance(cache, (list, tuple))``, so the container must read as a
+single array-like object. It delegates ``ndim``/``shape``/``dtype`` to
+the data leaf so rank checks and ``shape[-1]`` (page_size) probes work
+unchanged, and ``__getitem__`` applies the same index to both leaves —
+valid for every index the stack uses (``[layer]``, ``[:, page_table]``,
+``[:, page_id]``, ``[:, :, page_id]``), all of which touch only the
+leading ``[L?, kv, pages]`` axes the two leaves share.
+
+Registered as a pytree so it flows through jit/donation/device_get and
+``jax.ShapeDtypeStruct`` lowering probes for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Mirrors quantize_weight (engine/quantization.py): symmetric int8
+# with an amax/127 scale, floored so all-zero slots stay invertible.
+_QMAX = 127.0
+_SCALE_FLOOR = 1e-8
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantKV:
+    """int8 KV pages plus their per-(head, page, slot) f32 scales."""
+
+    __slots__ = ("data", "scale")
+
+    def __init__(self, data, scale):
+        self.data = data
+        self.scale = scale
+
+    # -- pytree protocol ------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- array-like façade (delegates to the data leaf) -----------------
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def __getitem__(self, idx):
+        # Same index on both leaves: callers only index the shared
+        # leading [layer?, kv_head, page] axes (asserted by use sites,
+        # not here — this stays trace-safe under jit).
+        return QuantKV(self.data[idx], self.scale[idx])
+
+    def __repr__(self):
+        return (f"QuantKV(data={getattr(self.data, 'shape', self.data)},"
+                f" scale={getattr(self.scale, 'shape', self.scale)})")
+
+
+def quantize_kv(x: jnp.ndarray):
+    """Quantize new KV rows ``[..., head_dim]`` to (int8, f32 scale).
+
+    The scale is an amax over the trailing head_dim axis — one scale
+    per (token, kv_head) row, matching the per-slot scale layout of
+    the cache. Returns ``(q, scale)`` with ``q`` int8 shaped like
+    ``x`` and ``scale`` f32 shaped ``x.shape[:-1]``.
+    """
+    x32 = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x32), axis=-1) / _QMAX
+    scale = jnp.maximum(scale, _SCALE_FLOOR)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def quant_cache_zeros(shape, scale_dtype=jnp.float32):
+    """Fresh all-zero quantized cache for page layout ``shape`` =
+    ``[..., num_pages, head_dim, page_size]``."""
+    scale_shape = shape[:-2] + (shape[-1],)
+    return QuantKV(jnp.zeros(shape, jnp.int8),
+                   jnp.zeros(scale_shape, scale_dtype))
+
+
+def quant_cache_struct(shape, scale_dtype=jnp.float32):
+    """ShapeDtypeStruct twin of :func:`quant_cache_zeros` for
+    lowering probes (the runner's pallas feasibility checks)."""
+    scale_shape = shape[:-2] + (shape[-1],)
+    return QuantKV(jax.ShapeDtypeStruct(shape, jnp.int8),
+                   jax.ShapeDtypeStruct(scale_shape, scale_dtype))

@@ -162,9 +162,24 @@ def cache_spec(mesh: Optional[Mesh] = None) -> P:
     return spec if mesh is None else _on_mesh(spec, mesh)
 
 
-def shard_cache(cache: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
+def shard_cache(cache, mesh: Optional[Mesh]):
     if mesh is None:
         return cache
+    from production_stack_tpu.ops.quant_kv import QuantKV
+    if isinstance(cache, QuantKV):
+        # int8 pages + per-slot scales: data shards like a full-precision
+        # cache; the scale tensor lacks the head_dim axis, so its spec
+        # drops that (always-replicated) entry.
+        if cache.data.ndim == 4:
+            data_spec = _on_mesh(P("tp", None, None, None), mesh)
+            scale_spec = _on_mesh(P("tp", None, None), mesh)
+        else:
+            data_spec = cache_spec(mesh)
+            scale_spec = P(*data_spec[:3], data_spec[4])
+        return QuantKV(
+            jax.device_put(cache.data, NamedSharding(mesh, data_spec)),
+            jax.device_put(cache.scale,
+                           NamedSharding(mesh, scale_spec)))
     if cache.ndim == 4:
         # Per-layer buffer [kv_heads, pages, head_dim, page_size]
         # (CacheConfig.cache_layout='per_layer'): heads over tp; no L

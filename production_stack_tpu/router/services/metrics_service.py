@@ -76,6 +76,18 @@ engine_async_inflight_depth = Gauge(
     "vllm:engine_async_inflight_depth",
     "Engine-reported dispatched-but-unread decode steps (scraped)",
     _LBL)
+engine_kv_cache_page_capacity = Gauge(
+    "vllm:engine_kv_cache_page_capacity",
+    "Engine-reported KV page budget after any int8 expansion "
+    "(scraped)", _LBL)
+engine_kv_bytes_per_decode_step = Gauge(
+    "vllm:engine_kv_bytes_per_decode_step",
+    "Engine-reported worst-case KV bytes written per decode step "
+    "(scraped)", _LBL)
+engine_kv_cache_dtype = Gauge(
+    "vllm:engine_kv_cache_dtype",
+    "Engine-reported KV page storage dtype as a one-hot labeled "
+    "gauge (scraped)", ["server", "kv_dtype"])
 
 # -- resilience layer (router/resilience.py) --------------------------------
 circuit_breaker_state = Gauge(
@@ -162,6 +174,14 @@ def refresh_gauges() -> None:
             es.engine_pipeline_ahead_steps)
         engine_async_inflight_depth.labels(server=server).set(
             es.engine_async_inflight_depth)
+        engine_kv_cache_page_capacity.labels(server=server).set(
+            es.engine_kv_cache_page_capacity)
+        engine_kv_bytes_per_decode_step.labels(server=server).set(
+            es.engine_kv_bytes_per_decode_step)
+        if es.engine_kv_cache_dtype:
+            engine_kv_cache_dtype.labels(
+                server=server,
+                kv_dtype=es.engine_kv_cache_dtype).set(1)
     from production_stack_tpu.router.resilience import get_resilience
     mgr = get_resilience()
     try:

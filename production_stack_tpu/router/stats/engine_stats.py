@@ -47,6 +47,14 @@ _METRIC_MAP = {
     "vllm:engine_pipeline_ahead_steps_total":
         "engine_pipeline_ahead_steps",
     "vllm:engine_async_inflight_depth": "engine_async_inflight_depth",
+    # KV quantization telemetry (engine docs/kv_quantization.md):
+    # post-expansion page budget and worst-case bytes written per
+    # decode step. The storage dtype itself travels as a label on
+    # vllm:engine_kv_cache_dtype (handled in from_prometheus_text).
+    "vllm:engine_kv_cache_page_capacity":
+        "engine_kv_cache_page_capacity",
+    "vllm:engine_kv_bytes_per_decode_step":
+        "engine_kv_bytes_per_decode_step",
 }
 
 
@@ -70,12 +78,25 @@ class EngineStats:
     engine_pipeline_steps: float = 0.0
     engine_pipeline_ahead_steps: float = 0.0
     engine_async_inflight_depth: float = 0.0
+    # KV page storage (engine docs/kv_quantization.md): page budget
+    # after any int8 expansion, worst-case KV write bytes per decode
+    # step, and the storage dtype ("bf16"/"int8"; "" until scraped).
+    engine_kv_cache_page_capacity: float = 0.0
+    engine_kv_bytes_per_decode_step: float = 0.0
+    engine_kv_cache_dtype: str = ""
 
     @classmethod
     def from_prometheus_text(cls, text: str) -> "EngineStats":
         stats = cls()
         for family in text_string_to_metric_families(text):
             for sample in family.samples:
+                if (sample.name == "vllm:engine_kv_cache_dtype"
+                        and sample.value == 1.0):
+                    # One-hot labeled gauge: the label carries the
+                    # dtype string.
+                    stats.engine_kv_cache_dtype = sample.labels.get(
+                        "kv_dtype", "")
+                    continue
                 attr = _METRIC_MAP.get(sample.name)
                 if attr is not None:
                     current = getattr(stats, attr)

@@ -67,6 +67,20 @@ def test_offload_manager_chain_lookup():
     assert mgr.fetch(hashes[2]) is None
 
 
+def _wire_body(payload):
+    import msgpack
+
+    from production_stack_tpu.engine.offload import KV_WIRE_VERSION
+    return msgpack.packb({
+        "version": KV_WIRE_VERSION,
+        "arrays": [
+            {"data": a.tobytes(), "shape": list(a.shape),
+             "dtype": str(a.dtype)}
+            for a in payload
+        ],
+    })
+
+
 def test_cache_server_roundtrip():
     """PUT/GET/HEAD against the remote cache server over HTTP."""
     import msgpack
@@ -77,18 +91,15 @@ def test_cache_server_roundtrip():
         await client.start_server()
         try:
             k, v = _payload(5)
-            body = msgpack.packb({
-                "k": k.tobytes(), "v": v.tobytes(),
-                "shape": list(k.shape), "dtype": str(k.dtype),
-            })
-            put = await client.put("/kv/abc", data=body)
+            put = await client.put("/kv/abc", data=_wire_body((k, v)))
             assert put.status == 200
             head = await client.head("/kv/abc")
             assert head.status == 200
             got = await client.get("/kv/abc")
             assert got.status == 200
             obj = msgpack.unpackb(await got.read())
-            k2 = np.frombuffer(obj["k"], np.float32).reshape(k.shape)
+            a = obj["arrays"][0]
+            k2 = np.frombuffer(a["data"], np.float32).reshape(k.shape)
             np.testing.assert_array_equal(k, k2)
             missing = await client.get("/kv/nope")
             assert missing.status == 404
@@ -99,11 +110,146 @@ def test_cache_server_roundtrip():
     asyncio.run(run())
 
 
-def _make_engine(num_pages, offload=True):
+def test_cache_server_rejects_bad_payloads():
+    """Decode-side allowlist: junk bytes, disallowed dtypes, and
+    shape/byte-count mismatches all 400 instead of getting stored (or
+    crashing the server)."""
+    import msgpack
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def run():
+        client = TestClient(TestServer(build_cache_server(1024 ** 2)))
+        await client.start_server()
+        try:
+            bad = [
+                b"\x00not msgpack at all",
+                msgpack.packb({"no": "arrays"}),
+                # float64 is not an allowed page dtype.
+                _wire_body((np.zeros((2, 2), np.float64),)),
+                # byte count disagrees with shape*itemsize.
+                msgpack.packb({"arrays": [{
+                    "data": b"\x00" * 7, "shape": [2, 2],
+                    "dtype": "float32"}]}),
+                # negative dim.
+                msgpack.packb({"arrays": [{
+                    "data": b"", "shape": [-1], "dtype": "int8"}]}),
+            ]
+            for i, body in enumerate(bad):
+                resp = await client.put(f"/kv/bad{i}", data=body)
+                assert resp.status == 400, f"payload {i} accepted"
+                assert (await client.head(f"/kv/bad{i}")).status == 404
+            # A valid payload still lands.
+            ok = await client.put(
+                "/kv/good", data=_wire_body(_payload(1)))
+            assert ok.status == 200
+        finally:
+            await client.close()
+    asyncio.run(run())
+
+
+def _dtype_payloads():
+    """One payload per page storage format the tiers must carry:
+    float32 and bfloat16 full-precision (k, v) pairs, and the int8
+    4-tuple with float32 scales."""
+    import ml_dtypes
+    rng = np.random.RandomState(7)
+    f32 = tuple(rng.randn(2, 2, 32, 16).astype(np.float32)
+                for _ in range(2))
+    bf16 = tuple(rng.randn(2, 2, 32, 16).astype(ml_dtypes.bfloat16)
+                 for _ in range(2))
+    int8 = (
+        rng.randint(-127, 128, (2, 2, 32, 16)).astype(np.int8),
+        rng.randint(-127, 128, (2, 2, 32, 16)).astype(np.int8),
+        rng.rand(2, 2, 16).astype(np.float32),
+        rng.rand(2, 2, 16).astype(np.float32),
+    )
+    return {"float32": f32, "bfloat16": bf16, "int8": int8}
+
+
+def test_host_pool_roundtrip_all_dtypes():
+    pool = HostKVPool(max_bytes=64 * 1024 ** 2)
+    payloads = _dtype_payloads()
+    for name, payload in payloads.items():
+        pool.put(name, payload)
+    for name, payload in payloads.items():
+        got = pool.get(name)
+        assert len(got) == len(payload)
+        for a, b in zip(payload, got):
+            assert b.dtype == a.dtype
+            np.testing.assert_array_equal(
+                a.view(np.uint8), b.view(np.uint8))
+    # Byte accounting covers every array in the tuple.
+    assert pool.used_bytes == sum(
+        a.nbytes for p in payloads.values() for a in p)
+
+
+def test_remote_client_roundtrip_all_dtypes():
+    """RemoteKVClient against a live cache server: every page dtype —
+    including bfloat16, which np.dtype() alone cannot resolve — must
+    round-trip byte-exact through the msgpack wire."""
+    import threading
+
+    from aiohttp import web
+
+    from production_stack_tpu.engine.offload import RemoteKVClient
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_box = {}
+
+    def serve():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(build_cache_server(64 * 1024 ** 2))
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        port_box["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    assert started.wait(10)
+    try:
+        client = RemoteKVClient(
+            f"http://127.0.0.1:{port_box['port']}")
+        for name, payload in _dtype_payloads().items():
+            assert client.put(name, payload), name
+            assert client.contains(name)
+            got = client.get(name)
+            assert got is not None and len(got) == len(payload)
+            for a, b in zip(payload, got):
+                assert b.dtype == a.dtype, name
+                np.testing.assert_array_equal(
+                    a.view(np.uint8), b.view(np.uint8))
+        assert client.get("missing") is None
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+
+
+def test_stable_key_namespaced_by_dtype_and_manager_isolation():
+    page_hash = (0, (1, 2, 3))
+    keys = {_stable_key(page_hash, dt)
+            for dt in ("", "float32", "bfloat16", "int8")}
+    assert len(keys) == 4
+    # Two managers sharing one host pool but with different kv_dtype
+    # never see each other's pages.
+    pool = HostKVPool()
+    m_int8 = KVOffloadManager(host_pool=pool, kv_dtype="int8")
+    m_bf16 = KVOffloadManager(host_pool=pool, kv_dtype="bfloat16")
+    m_int8.offload_page(page_hash, *_payload(1))
+    assert m_int8.fetch(page_hash) is not None
+    assert m_bf16.fetch(page_hash) is None
+
+
+def _make_engine(num_pages, offload=True, kv_dtype="auto"):
     model = tiny_model_config("llama")
     return LLMEngine(EngineConfig(
         model=model,
-        cache=CacheConfig(page_size=16, num_pages=num_pages),
+        cache=CacheConfig(page_size=16, num_pages=num_pages,
+                          kv_cache_dtype=kv_dtype),
         scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=256,
                                   prefill_chunk_size=64),
         offload=OffloadConfig(enable=offload,
@@ -135,6 +281,43 @@ def test_engine_restores_evicted_prefix_from_host_pool():
     assert engine.offload.offloaded_pages > 0
 
     # Same shared prefix again: must restore from the host pool.
+    restored_before = engine.offload.restored_pages
+    again = engine.generate(shared + [99, 98], sampling())
+    assert engine.offload.restored_pages > restored_before
+    assert again.output_token_ids == expected
+
+
+def test_engine_restores_quantized_pages_from_host_pool():
+    """The eviction/restore cycle with --kv-cache-dtype int8: 4-array
+    payloads (data + scales) move through the host pool and land back
+    in HBM with identical generation output."""
+    sampling = lambda: SamplingParams(  # noqa: E731
+        max_tokens=4, temperature=0.0, ignore_eos=True)
+    shared = list(range(1, 65))  # 64 tokens = 4 full pages
+
+    ref_engine = _make_engine(num_pages=64, offload=False,
+                              kv_dtype="int8")
+    expected = ref_engine.generate(
+        shared + [99, 98], sampling()).output_token_ids
+
+    # num_pages input 5 expands to ~17 int8 pages — small enough that
+    # the filler prompts below force the shared prefix out to the
+    # host pool.
+    engine = _make_engine(num_pages=5, kv_dtype="int8")
+    assert engine.runner.kv_quantized
+    assert 10 < engine.config.cache.num_pages < 32
+    first = engine.generate(shared + [99, 98], sampling())
+    assert first.output_token_ids == expected
+
+    for i in range(4):
+        engine.generate([200 + i] * 80, sampling())
+    assert engine.offload.offloaded_pages > 0
+    # The offloaded payloads are the quantized 4-tuples.
+    some = next(iter(engine.offload.host._pool.values()))
+    assert len(some) == 4
+    assert some[0].dtype == np.int8
+    assert some[2].dtype == np.float32
+
     restored_before = engine.offload.restored_pages
     again = engine.generate(shared + [99, 98], sampling())
     assert engine.offload.restored_pages > restored_before

@@ -251,3 +251,118 @@ def test_engine_generates_identically_with_pallas_decode(tmp_path):
         return seq.output_token_ids
 
     assert gen("pallas-interpret") == gen("xla")
+
+
+# ---- int8 quantized KV pages (docs/kv_quantization.md) ----------------------
+
+
+def _quantize_cache(cache):
+    """Quantize a [kv, pages, d, ps] (or [L, ...]) cache per
+    (page, slot, head) row — the exact layout write_to_pages emits."""
+    from production_stack_tpu.ops.quant_kv import QuantKV, quantize_kv
+    perm = ((0, 1, 3, 2) if cache.ndim == 4 else (0, 1, 2, 4, 3))
+    q, scale = quantize_kv(jnp.transpose(cache, perm))
+    return QuantKV(jnp.transpose(q, perm), scale)
+
+
+def test_paged_decode_attention_int8_parity():
+    """bf16-vs-int8 parity for paged_decode_attention: on the SAME
+    quantized cache the kernel must match the XLA reference exactly,
+    and track the full-precision answer within the rounding budget."""
+    q, k_cache, v_cache, page_table, kv_lens = _setup(seed=17)
+    k8, v8 = _quantize_cache(k_cache), _quantize_cache(v_cache)
+    out = paged_decode_attention(
+        q, k8, v8, page_table, kv_lens, interpret=True
+    )
+    ref = paged_attention(
+        q[:, None], k8, v8, page_table,
+        (kv_lens - 1)[:, None], kv_lens,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    full = paged_attention(
+        q[:, None], k_cache, v_cache, page_table,
+        (kv_lens - 1)[:, None], kv_lens,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(full), atol=0.15
+    )
+
+
+def test_paged_prefill_attention_int8_parity():
+    from production_stack_tpu.ops.prefill_attention_pallas import (
+        paged_prefill_attention,
+    )
+    (q, k_cache, v_cache, page_table, positions,
+     kv_lens) = _prefill_setup(seed=19)
+    k8, v8 = _quantize_cache(k_cache), _quantize_cache(v_cache)
+    out = paged_prefill_attention(
+        q, k8, v8, page_table, positions, kv_lens, interpret=True)
+    ref = paged_attention(
+        q, k8, v8, page_table, positions, kv_lens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    full = paged_attention(
+        q, k_cache, v_cache, page_table, positions, kv_lens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(full), atol=0.15
+    )
+
+
+def test_decode_int8_stacked_cache_layer_form():
+    """Stacked quantized caches flow through the aliased layer form:
+    output matches the per-layer slice, and BOTH leaves (int8 data +
+    scales) hand back through unchanged."""
+    q, k_cache, v_cache, page_table, kv_lens = _setup(seed=29)
+    L, layer = 3, 2
+    rng = np.random.RandomState(31)
+    k5 = _quantize_cache(jnp.asarray(
+        rng.randn(L, *k_cache.shape).astype(np.float32)))
+    v5 = _quantize_cache(jnp.asarray(
+        rng.randn(L, *v_cache.shape).astype(np.float32)))
+    out, k_thru, v_thru = paged_decode_attention(
+        q, k5, v5, page_table, kv_lens, layer=layer, interpret=True
+    )
+    ref = paged_decode_attention(
+        q, k5[layer], v5[layer], page_table, kv_lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    for thru, src in ((k_thru, k5), (v_thru, v5)):
+        np.testing.assert_array_equal(np.asarray(thru.data),
+                                      np.asarray(src.data))
+        np.testing.assert_array_equal(np.asarray(thru.scale),
+                                      np.asarray(src.scale))
+
+
+def test_prefill_int8_stacked_cache_layer_form():
+    from production_stack_tpu.ops.prefill_attention_pallas import (
+        paged_prefill_attention,
+    )
+    (q, k_cache, v_cache, page_table, positions,
+     kv_lens) = _prefill_setup(seed=37)
+    L, layer = 3, 1
+    rng = np.random.RandomState(41)
+    k5 = _quantize_cache(jnp.asarray(
+        rng.randn(L, *k_cache.shape).astype(np.float32)))
+    v5 = _quantize_cache(jnp.asarray(
+        rng.randn(L, *v_cache.shape).astype(np.float32)))
+    out, k_thru, v_thru = paged_prefill_attention(
+        q, k5, v5, page_table, positions, kv_lens, layer=layer,
+        interpret=True
+    )
+    ref = paged_prefill_attention(
+        q, k5[layer], v5[layer], page_table, positions, kv_lens,
+        interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    for thru, src in ((k_thru, k5), (v_thru, v5)):
+        np.testing.assert_array_equal(np.asarray(thru.data),
+                                      np.asarray(src.data))
+        np.testing.assert_array_equal(np.asarray(thru.scale),
+                                      np.asarray(src.scale))

@@ -167,3 +167,75 @@ def test_decode_burst_program_lowers_for_tpu():
         runner._decode_burst_impl, static_argnames=("num_steps",)
     ).trace(*args, num_steps=8)
     traced.lower(lowering_platforms=("tpu",))
+
+
+def _quantize_lowering_cache(cache):
+    from production_stack_tpu.ops.quant_kv import QuantKV, quantize_kv
+    perm = (0, 1, 3, 2)
+    q, scale = quantize_kv(jnp.transpose(cache, perm))
+    return QuantKV(jnp.transpose(q, perm), scale)
+
+
+def test_decode_kernel_int8_lowers_for_tpu():
+    """paged_decode_attention over int8 QuantKV pages (extra scale
+    DMAs + VMEM scratch) must pass the Mosaic lowering rules."""
+    from production_stack_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention,
+    )
+    q, kc, vc, pt, kl = _decode_args()
+    _lower_for_tpu(
+        paged_decode_attention, q,
+        _quantize_lowering_cache(kc), _quantize_lowering_cache(vc),
+        pt, kl)
+
+
+def test_prefill_kernel_int8_lowers_for_tpu():
+    from production_stack_tpu.ops.prefill_attention_pallas import (
+        paged_prefill_attention,
+    )
+    q, kc, vc, pt, pos, kl = _prefill_args()
+    _lower_for_tpu(
+        paged_prefill_attention, q,
+        _quantize_lowering_cache(kc), _quantize_lowering_cache(vc),
+        pt, pos, kl)
+
+
+def test_decode_burst_program_int8_lowers_for_tpu():
+    """The fused decode burst with --kv-cache-dtype int8 and pallas
+    attention: quantize-on-commit + in-kernel dequant + QuantKV
+    carries through lax.scan must lower as one TPU program."""
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from production_stack_tpu.engine.model_runner import ModelRunner
+
+    model = tiny_model_config("llama")
+    model.attention_impl = "pallas"
+    config = EngineConfig(
+        model=model,
+        cache=CacheConfig(page_size=128, num_pages=32,
+                          kv_cache_dtype="int8"),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=256,
+                                  prefill_chunk_size=64,
+                                  decode_steps=8),
+    )
+    runner = ModelRunner(config)
+    assert runner.kv_quantized
+    b = 4
+    args = (
+        runner.params, runner.k_cache, runner.v_cache,
+        jnp.zeros((b, 1), jnp.int32), jnp.zeros((b, 1), jnp.int32),
+        jnp.zeros((b, runner.max_pages_per_seq), jnp.int32),
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+        jnp.zeros((b,), jnp.int32),
+        jnp.full((b, 16), -1, jnp.int32),
+        jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32),
+        jnp.zeros((b,), jnp.int32), jax.random.PRNGKey(0),
+        None, None,   # lora, lora_ids
+        None, None,   # penalties, seeding
+        None, None, None,  # bias, suppress, fsm
+    )
+    traced = jax.jit(
+        runner._decode_burst_impl, static_argnames=("num_steps",)
+    ).trace(*args, num_steps=8)
+    traced.lower(lowering_platforms=("tpu",))
