@@ -398,6 +398,72 @@ class EngineConfig:
             )
 
 
+# ---- staticcheck config-contract markers -------------------------------
+# Read statically by staticcheck/analyzers/config_contract.py (keep
+# them literals). Every field reachable from EngineConfig must map to
+# a tpu-engine CLI flag by naming convention, appear in
+# CLI_FLAG_ALIASES, or be declared INTERNAL here — so "operators
+# can't reach this knob" is always a decision, never an accident.
+
+CLI_FLAG_ALIASES = {
+    # field path                    flag that sets it
+    "model.name": "--model",
+    "cache.enable_prefix_caching": "--disable-prefix-caching",
+    "lora.enable": "--enable-lora",
+    "offload.enable": "--enable-kv-offload",
+    "offload.host_pool_bytes": "--kv-host-pool-bytes",
+    "offload.remote_url": "--kv-remote-url",
+}
+
+INTERNAL_FIELDS = {
+    # ModelConfig architecture hyperparameters are owned by the
+    # checkpoint's HF config.json (from_hf_config) — a CLI override
+    # would desync weights from geometry.
+    "model.architecture",
+    "model.vocab_size",
+    "model.hidden_size",
+    "model.intermediate_size",
+    "model.num_hidden_layers",
+    "model.num_attention_heads",
+    "model.num_key_value_heads",
+    "model.head_dim",
+    "model.max_position_embeddings",
+    "model.rms_norm_eps",
+    "model.rope_theta",
+    "model.tie_word_embeddings",
+    "model.do_layer_norm_before",
+    "model.activation",
+    "model.attention_bias",
+    "model.num_local_experts",
+    "model.num_experts_per_tok",
+    # Per-shape kernel overrides resolved by the model runner's
+    # compile probe, not operator-set (--attention-impl is the knob).
+    "model.attention_impl_decode",
+    "model.attention_impl_prefill",
+    # Data parallelism is derived mesh residue (devices not consumed
+    # by tp/pp/sp), never requested directly.
+    "parallel.data_parallel_size",
+}
+
+# Mutually-exclusive feature combos: (field_a, field_b, token). The
+# analyzer requires a config-time `raise ValueError` in this module
+# whose message contains `token`, AND a pytest.raises test under
+# tests/ referencing both `token` and field_b's name — deleting
+# either the rejection or its test is a staticcheck failure.
+EXCLUSIVITY_RULES = (
+    ("cache.kv_cache_dtype", "parallel.pipeline_parallel_size",
+     "kv_cache_dtype"),
+    ("cache.kv_cache_dtype", "parallel.context_parallel_size",
+     "kv_cache_dtype"),
+    ("scheduler.speculative_k", "scheduler.deferred_kv_writes",
+     "deferred_kv"),
+    ("scheduler.async_scheduling", "scheduler.decode_steps",
+     "decode_steps"),
+    ("scheduler.async_scheduling", "scheduler.speculative_k",
+     "speculative_k"),
+)
+
+
 def bench_1b_model_config() -> ModelConfig:
     """The 1B-class llama geometry the TPU bench serves (bench.py) and
     benchmarks/chip_sweep.sh's ``--model bench-1b`` server runs — one

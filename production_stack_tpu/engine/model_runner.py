@@ -726,7 +726,10 @@ class ModelRunner:
                    seeding, bias, suppress, fsm,
                    sample_index_mode: str,
                    want_logprobs: bool = False):
-        if tokens.ndim == 1:
+        # Deliberate two-shape specialization ([B] decode feed-forward
+        # vs [B, T] prefill/burst): exactly two traces, cached for the
+        # process lifetime — not a per-step retrace.
+        if tokens.ndim == 1:  # lint: allow-tracer-hygiene
             # Single-step decode feeds [B] tokens so the async
             # pipeline can consume the previous step's [B] sampled
             # array verbatim — zero eager ops on the feed-forward.

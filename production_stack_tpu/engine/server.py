@@ -1247,6 +1247,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             speculative_k=args.speculative_k,
             speculative_min_match=args.speculative_min_match,
             async_scheduling=_resolve_async_scheduling(args),
+            max_queue_len=args.max_queue_len,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -1264,6 +1265,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             max_loras=args.max_loras,
             max_lora_rank=args.max_lora_rank,
         ),
+        seed=args.seed,
     )
     engine = LLMEngine(config, mesh=mesh, params=params,
                        tokenizer=tokenizer)
@@ -1397,6 +1399,12 @@ def parse_args(argv=None):
                         default=2 * 1024 ** 3)
     parser.add_argument("--kv-remote-url", default=None,
                         help="Remote shared KV cache server URL")
+    parser.add_argument("--max-queue-len", type=int, default=1024,
+                        help="Waiting-queue depth before submissions "
+                             "are rejected (scheduler backpressure)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="Base RNG seed for sampled requests "
+                             "without a per-request seed")
     return parser.parse_args(argv)
 
 

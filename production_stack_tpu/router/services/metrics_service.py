@@ -47,6 +47,14 @@ avg_prefill_length = Gauge(
 engine_prefix_cache_hit_rate = Gauge(
     "vllm:engine_gpu_prefix_cache_hit_rate",
     "Engine-reported prefix-cache hit rate (scraped)", _LBL)
+engine_num_requests_running = Gauge(
+    "vllm:engine_num_requests_running",
+    "Engine-reported running requests (scraped; the unlabeled "
+    "vllm:num_requests_running is the router's own live-traffic "
+    "view)", _LBL)
+engine_gpu_cache_usage_perc = Gauge(
+    "vllm:engine_gpu_cache_usage_perc",
+    "Engine-reported KV cache usage fraction (scraped)", _LBL)
 spec_decode_num_draft_tokens = Gauge(
     "vllm:spec_decode_num_draft_tokens",
     "Engine-reported speculative draft tokens (scraped)", _LBL)
@@ -158,6 +166,17 @@ def refresh_gauges() -> None:
     for server, es in engine_stats.items():
         engine_prefix_cache_hit_rate.labels(server=server).set(
             es.kv_cache_hit_rate)
+        # Engine-authoritative queue/occupancy numbers: waiting depth
+        # backs the declared num_requests_waiting gauge (the router
+        # cannot see an engine's internal queue from its own traffic),
+        # running/usage re-export under engine_* names beside the
+        # router-computed views.
+        num_requests_waiting.labels(server=server).set(
+            es.num_queuing_requests)
+        engine_num_requests_running.labels(server=server).set(
+            es.num_running_requests)
+        engine_gpu_cache_usage_perc.labels(server=server).set(
+            es.kv_usage_perc)
         spec_decode_num_draft_tokens.labels(server=server).set(
             es.spec_decode_num_draft_tokens)
         spec_decode_num_accepted_tokens.labels(server=server).set(

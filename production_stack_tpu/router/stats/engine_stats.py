@@ -57,6 +57,26 @@ _METRIC_MAP = {
         "engine_kv_bytes_per_decode_step",
 }
 
+# Engine metrics the router deliberately does NOT scrape: request
+# latency histograms and lifecycle counters are read by cluster
+# Prometheus straight off each engine's /metrics (the router's
+# per-request stats monitor computes its own latency view from live
+# traffic). Listed here so the staticcheck metrics-contract analyzer
+# can tell a decided drop from silent drift — a NEW engine metric
+# must be added to _METRIC_MAP or to this set.
+_ROUTER_UNSCRAPED = frozenset({
+    "vllm:time_to_first_token_seconds",
+    "vllm:time_per_output_token_seconds",
+    "vllm:e2e_request_latency_seconds",
+    "vllm:request_queue_time_seconds",
+    "vllm:request_prefill_time_seconds",
+    "vllm:prompt_tokens_total",
+    "vllm:generation_tokens_total",
+    "vllm:request_success_total",
+    "vllm:request_failure_total",
+    "vllm:num_preemptions_total",
+})
+
 
 @dataclass
 class EngineStats:

@@ -1,0 +1,17 @@
+"""AST-based static analyzers for the stack's structural invariants.
+
+``python -m production_stack_tpu.staticcheck`` runs the suite;
+docs/static_analysis.md is the rule catalog. Import surface for
+tests and tooling:
+
+- ``Project`` / ``run_rules`` / ``Finding`` / ``REGISTRY`` (core)
+- ``baseline`` module (fingerprint ledger)
+"""
+
+from production_stack_tpu.staticcheck.core import (  # noqa: F401
+    Finding,
+    Project,
+    REGISTRY,
+    rule,
+    run_rules,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from production_stack_tpu.staticcheck.cli import main
+
+sys.exit(main())

@@ -1,0 +1,15 @@
+"""Analyzer modules. Importing this package registers every rule in
+``staticcheck.core.REGISTRY`` (each module's ``@rule`` decorator runs
+at import). Add a new analyzer by dropping a module here and
+importing it below — see docs/static_analysis.md.
+"""
+
+from production_stack_tpu.staticcheck.analyzers import (  # noqa: F401
+    async_blocking,
+    config_contract,
+    dispatch_path,
+    kv_parity,
+    metrics_contract,
+    network_timeout,
+    tracer_hygiene,
+)

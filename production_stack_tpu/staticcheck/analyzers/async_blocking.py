@@ -1,0 +1,117 @@
+"""Rule ``async-blocking``: no blocking calls inside ``async def``
+bodies across router/ and engine/server.py.
+
+The router and engine server are single-event-loop aiohttp apps: one
+``time.sleep`` or synchronous ``requests.get`` inside a coroutine
+stalls EVERY in-flight request (and the health prober, and the
+breaker timers) for its full duration. Blocking work belongs on
+worker threads (the scraper/prober pattern) or behind
+``loop.run_in_executor``/``asyncio.to_thread``. Flags, inside
+``async def`` bodies only:
+
+- ``time.sleep(...)`` (use ``await asyncio.sleep``),
+- any ``requests.*`` call (use the shared aiohttp session),
+- ``urllib.request.*`` / ``socket.*`` connect-ish calls,
+- ``subprocess.run/call/check_call/check_output`` and ``os.system``,
+- synchronous ``open(...)`` (use aiofiles; small-config reads may be
+  waived).
+
+Nested *sync* ``def``s inside a coroutine are skipped: they are
+values, commonly handed to ``run_in_executor``; if one is called
+inline the call site itself is still scanned. Waive a justified case
+with ``# lint: allow-async-blocking`` on the call line.
+
+Generalizes the PR1 timeout lint / PR3 dispatch lint approach to the
+whole async surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    recv_name,
+    rule,
+    tail_name,
+)
+
+SCOPE = (
+    "production_stack_tpu/router/**/*.py",
+    "production_stack_tpu/engine/server.py",
+)
+
+_SUBPROCESS_CALLS = {"run", "call", "check_call", "check_output"}
+
+
+def blocking_reason(call: ast.Call) -> str:
+    """Why this call blocks the event loop ('' if it doesn't)."""
+    func = call.func
+    name = tail_name(func)
+    recv = recv_name(func)
+    if name == "sleep" and recv in ("time", ""):
+        if recv == "time" or isinstance(func, ast.Name):
+            return ("time.sleep blocks the event loop — "
+                    "await asyncio.sleep")
+    if recv == "requests":
+        return ("synchronous requests.* blocks the event loop — use "
+                "the shared aiohttp session")
+    if recv in ("urlopen", "urllib") or name == "urlopen":
+        return "urllib blocks the event loop"
+    if recv == "socket" and name in ("create_connection",
+                                     "getaddrinfo", "gethostbyname"):
+        return "blocking socket call on the event loop"
+    if recv == "subprocess" and name in _SUBPROCESS_CALLS:
+        return ("subprocess.* blocks the event loop — use "
+                "asyncio.create_subprocess_exec")
+    if recv == "os" and name == "system":
+        return "os.system blocks the event loop"
+    if isinstance(func, ast.Name) and func.id == "open":
+        return ("synchronous open() on the event loop — use aiofiles "
+                "(waivable for small local config reads)")
+    return ""
+
+
+def _walk_async_body(node: ast.AST):
+    """Statements reachable on the coroutine's own frame: descend
+    everything except nested function/class definitions (nested sync
+    defs are values, often executor targets; nested coroutines get
+    their own visit)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            continue
+        yield child
+        yield from _walk_async_body(child)
+
+
+def async_blocking_calls(tree: ast.AST):
+    """(async_fn, call, reason) triples for a module tree."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for sub in [stmt, *_walk_async_body(stmt)]:
+                if isinstance(sub, ast.Call):
+                    reason = blocking_reason(sub)
+                    if reason:
+                        yield node, sub, reason
+
+
+@rule("async-blocking",
+      "no blocking calls (sleep/requests/sync IO) in async def bodies")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files(*SCOPE):
+        if sf.tree is None:
+            continue
+        for fn, call, reason in async_blocking_calls(sf.tree):
+            findings.append(sf.finding(
+                "async-blocking", call,
+                f"in async def {fn.name}: {reason}"))
+    return findings

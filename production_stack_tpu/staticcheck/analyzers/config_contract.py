@@ -1,0 +1,245 @@
+"""Rule ``config-contract``: EngineConfig, the CLI and the docs must
+agree; feature exclusivity is rejected at config time and tested.
+
+Operators drive the engine through ``tpu-engine`` flags; the config
+dataclasses are the source of truth; docs are the contract surface.
+These drift independently (a field added without a flag is
+unreachable in deployment; a flag without docs is unused; an
+exclusivity check without a test rots). Checks, all static:
+
+1. every dataclass field reachable from ``EngineConfig`` maps to a
+   CLI flag in engine/server.py ``parse_args`` — by naming convention
+   (``scheduler.max_num_seqs`` -> ``--max-num-seqs``), via the
+   ``CLI_FLAG_ALIASES`` marker in engine/config.py, or is listed in
+   the ``INTERNAL_FIELDS`` marker (derived / HF-config-owned values);
+2. the markers themselves are honest: aliases point at real flags,
+   ``INTERNAL_FIELDS``/alias keys name real fields;
+3. every entry in ``EXCLUSIVITY_RULES`` (feature-gate pairs like
+   int8 KV x pipeline parallelism) has (a) a config-time
+   ``raise ValueError`` in engine/config.py whose message contains
+   the rule's token and (b) a test in tests/ that exercises
+   ``pytest.raises`` and references both the token and the second
+   field — so the rejection can never be deleted silently;
+4. every ``--flag`` appears in the docs (docs/**/*.md or README.md);
+   docs/engine_flags.md is the canonical flag table.
+
+Cross-file contract findings (line 0); fixed by code/markers/docs,
+not waiver comments.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    rule,
+    string_constants,
+    referenced_names,
+    tail_name,
+)
+
+CONFIG_FILE = "production_stack_tpu/engine/config.py"
+SERVER_FILE = "production_stack_tpu/engine/server.py"
+DOC_PATTERNS = ("docs/**/*.md", "*.md")
+TEST_PATTERN = "tests/test_*.py"
+
+# EngineConfig sections whose dataclass fields are operator surface.
+_SECTION_CLASSES = {
+    "model": "ModelConfig",
+    "cache": "CacheConfig",
+    "scheduler": "SchedulerConfig",
+    "parallel": "ParallelConfig",
+    "lora": "LoRAConfig",
+    "offload": "OffloadConfig",
+}
+
+
+def _module_literal(tree: ast.AST, name: str):
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name) and target.id == name:
+                    return stmt.value
+    return None
+
+
+def _literal_value(node):
+    try:
+        return ast.literal_eval(node) if node is not None else None
+    except (ValueError, TypeError):
+        return None
+
+
+def _dataclass_fields(tree: ast.AST) -> Dict[str, Set[str]]:
+    """{class name: field names} for every class in the module."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out[node.name] = {
+                stmt.target.id for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)}
+    return out
+
+
+def _cli_flags(tree: ast.AST) -> Set[str]:
+    flags: Set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and tail_name(node.func) == "add_argument"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("--")):
+            flags.add(node.args[0].value)
+    return flags
+
+
+def _raise_messages(tree: ast.AST) -> List[str]:
+    """Joined string constants of every ``raise ValueError(...)``."""
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Raise) and node.exc is not None
+                and isinstance(node.exc, ast.Call)
+                and tail_name(node.exc.func) == "ValueError"):
+            out.append(" ".join(string_constants(node.exc)))
+    return out
+
+
+def _raises_test_pools(project: Project) -> List[Tuple[str, str]]:
+    """(test id, joined reference pool) for every test function that
+    uses pytest.raises."""
+    pools = []
+    for sf in project.files(TEST_PATTERN):
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not node.name.startswith("test_"):
+                continue
+            refs = referenced_names(node)
+            if "raises" not in refs:
+                continue
+            pools.append((f"{sf.relpath}::{node.name}",
+                          " ".join(sorted(refs))))
+    return pools
+
+
+def _finding(path: str, message: str) -> Finding:
+    return Finding(rule="config-contract", path=path, line=0,
+                   message=message)
+
+
+@rule("config-contract",
+      "EngineConfig fields <-> CLI flags <-> docs; exclusivity pairs "
+      "rejected and tested")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    config = project.source(CONFIG_FILE)
+    server = project.source(SERVER_FILE)
+    for path, sf in ((CONFIG_FILE, config), (SERVER_FILE, server)):
+        if sf is None or sf.tree is None:
+            findings.append(_finding(
+                path, "config-contract surface file missing — if the "
+                      "layer moved, update "
+                      "staticcheck/analyzers/config_contract.py"))
+    if findings:
+        return findings
+
+    classes = _dataclass_fields(config.tree)
+    fields: Set[str] = set()
+    for section, cls in _SECTION_CLASSES.items():
+        for field in classes.get(cls, set()):
+            fields.add(f"{section}.{field}")
+    for field in classes.get("EngineConfig", set()):
+        if field not in _SECTION_CLASSES:
+            fields.add(field)
+
+    flags = _cli_flags(server.tree)
+    aliases = _literal_value(
+        _module_literal(config.tree, "CLI_FLAG_ALIASES")) or {}
+    internal = _literal_value(
+        _module_literal(config.tree, "INTERNAL_FIELDS")) or set()
+    exclusivity = _literal_value(
+        _module_literal(config.tree, "EXCLUSIVITY_RULES")) or ()
+
+    # (1) field -> flag | alias | internal marker.
+    for field in sorted(fields):
+        guess = "--" + field.rsplit(".", 1)[-1].replace("_", "-")
+        if guess in flags:
+            continue
+        if field in aliases:
+            if aliases[field] not in flags:
+                findings.append(_finding(
+                    CONFIG_FILE,
+                    f"CLI_FLAG_ALIASES maps {field} to "
+                    f"{aliases[field]}, which parse_args does not "
+                    "define"))
+            continue
+        if field in internal:
+            continue
+        findings.append(_finding(
+            CONFIG_FILE,
+            f"config field {field} has no CLI flag ({guess} not in "
+            "parse_args), no CLI_FLAG_ALIASES entry and no "
+            "INTERNAL_FIELDS marker — operators cannot reach it, "
+            "and nothing says that is intentional"))
+
+    # (2) honest markers.
+    for field in sorted(set(internal) | set(aliases)):
+        if field not in fields:
+            findings.append(_finding(
+                CONFIG_FILE,
+                f"marker references unknown config field {field} — "
+                "stale INTERNAL_FIELDS/CLI_FLAG_ALIASES entry"))
+
+    # (3) exclusivity pairs: config-time rejection + a test.
+    messages = _raise_messages(config.tree)
+    pools = _raises_test_pools(project)
+    for entry in exclusivity:
+        try:
+            field_a, field_b, token = entry
+        except (TypeError, ValueError):
+            findings.append(_finding(
+                CONFIG_FILE,
+                f"malformed EXCLUSIVITY_RULES entry {entry!r} — "
+                "expected (field_a, field_b, token)"))
+            continue
+        for f in (field_a, field_b):
+            if f not in fields:
+                findings.append(_finding(
+                    CONFIG_FILE,
+                    f"EXCLUSIVITY_RULES references unknown field {f}"))
+        if not any(token in msg for msg in messages):
+            findings.append(_finding(
+                CONFIG_FILE,
+                f"exclusivity {field_a} x {field_b}: no config-time "
+                f"raise ValueError mentioning '{token}' in "
+                "engine/config.py — the combination is no longer "
+                "rejected"))
+        tail_b = field_b.rsplit(".", 1)[-1]
+        if not any(token in pool and tail_b in pool
+                   for _, pool in pools):
+            findings.append(_finding(
+                CONFIG_FILE,
+                f"exclusivity {field_a} x {field_b}: no pytest.raises "
+                f"test referencing both '{token}' and '{tail_b}' "
+                "under tests/ — the rejection is untested"))
+
+    # (4) every flag documented.
+    doc_text = "\n".join(
+        sf.text for sf in project.files(*DOC_PATTERNS))
+    for flag in sorted(flags):
+        if not re.search(re.escape(flag) + r"(?![\w-])", doc_text):
+            findings.append(_finding(
+                SERVER_FILE,
+                f"CLI flag {flag} appears in no markdown doc "
+                "(docs/**/*.md, README.md) — add it to "
+                "docs/engine_flags.md"))
+    return findings

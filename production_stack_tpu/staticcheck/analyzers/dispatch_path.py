@@ -1,0 +1,108 @@
+"""Rule ``host-read``: no blocking host reads on the decode dispatch
+path.
+
+The overlapped async pipeline (docs/async_pipeline.md) only hides
+host work if ``ModelRunner.dispatch_decode`` and everything it calls
+stays purely dispatching: building a payload, one fused host->device
+transfer, launching the jitted step. A single ``np.asarray(device
+array)``, ``jax.device_get`` or ``.block_until_ready()`` anywhere on
+that path silently re-serializes the pipeline — the step "works" but
+the overlap is gone, which no functional test notices. Inside the
+DISPATCH_PATH functions of engine/model_runner.py this flags:
+
+- ``np.asarray(...)`` / ``np.array(...)`` (device->host copy when fed
+  a device array),
+- ``jax.device_get(...)`` / ``device_get(...)``,
+- ``<anything>.block_until_ready()`` and ``<array>.item()``.
+
+``int(...)`` / ``float(...)`` of host scalars are fine and not
+flagged. A deliberate host read carries ``# lint: allow-host-read``
+on the call line. The DISPATCH_PATH set must track reality: a listed
+name missing from model_runner.py is itself a finding, so a renamed
+function cannot silently fall out of coverage.
+
+Migrated from tests/test_dispatch_path_lint.py (PR 3), now a thin
+wrapper over this rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    recv_name,
+    rule,
+    tail_name,
+)
+
+RUNNER = "production_stack_tpu/engine/model_runner.py"
+
+# Every function the async dispatch path runs through. run_decode /
+# result() are NOT here: they are the sync completion side and their
+# device_get is the one intended blocking read.
+DISPATCH_PATH = {
+    "dispatch_decode",
+    "_staging_set",
+    "_dispatch",
+    "execute_payload",
+    "_optional_device_inputs",
+    "_penalty_payload",
+    "_seed_payload",
+    "_bias_payload",
+    "_suppress_payload",
+    "_guided_payload",
+    "_next_rng",
+    "_as_device",
+}
+
+
+def is_blocking_call(call: ast.Call) -> bool:
+    func = call.func
+    name = tail_name(func)
+    recv = recv_name(func)
+    if recv == "np" and name in ("asarray", "array"):
+        return True
+    if name == "device_get":  # jax.device_get or bare import
+        return True
+    if isinstance(func, ast.Attribute) and name in (
+            "block_until_ready", "item"):
+        return True
+    return False
+
+
+def dispatch_path_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in DISPATCH_PATH:
+                yield node
+
+
+@rule("host-read",
+      "no blocking host reads inside the async dispatch path")
+def check(project: Project) -> List[Finding]:
+    sf = project.source(RUNNER)
+    if sf is None or sf.tree is None:
+        return []
+    findings: List[Finding] = []
+    seen = set()
+    for fn in dispatch_path_functions(sf.tree):
+        seen.add(fn.name)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and is_blocking_call(node):
+                findings.append(sf.finding(
+                    "host-read", node,
+                    f"blocking host read in {fn.name} re-serializes "
+                    "the async pipeline — move it to result()/"
+                    "completion (docs/async_pipeline.md)"))
+    missing = DISPATCH_PATH - seen
+    if missing:
+        findings.append(Finding(
+            rule="host-read", path=RUNNER, line=0,
+            message="DISPATCH_PATH names not found in "
+                    f"model_runner.py: {sorted(missing)} — update "
+                    "staticcheck/analyzers/dispatch_path.py so the "
+                    "lint tracks the real call graph"))
+    return findings

@@ -1,0 +1,128 @@
+"""Rule ``kv-parity``: every attention impl has bf16-vs-int8 parity
+coverage.
+
+The int8 KV cache (docs/kv_quantization.md) dequantizes inside each
+attention implementation — XLA reference and both Pallas kernels. A
+new impl that skips the QuantKV branch passes every full-precision
+test and silently serves garbage under ``--kv-cache-dtype int8``.
+Checks, statically:
+
+- the ``ATTENTION_IMPLS`` registry literal in ops/attention.py
+  exists, and for each registered ``(module, func)`` there is at
+  least one test function under tests/ with ``int8``/``quant`` in
+  its name that references ``func`` (name, attribute or string —
+  covers getattr-by-name and parametrize ids);
+- every ``ops/*attention*.py`` module defining a top-level
+  ``paged_*`` entry point is registered — a new kernel module cannot
+  dodge the lint by not registering (ring_attention consumes raw
+  q/k/v, defines no ``paged_*``, and is gated off from int8 at
+  config level).
+
+The importlib half of the old lint (registry entries resolve to real
+callables) stays in tests/test_kv_parity_coverage_lint.py — it needs
+imports, which staticcheck deliberately never does.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    referenced_names,
+    rule,
+)
+
+REGISTRY_FILE = "production_stack_tpu/ops/attention.py"
+OPS_PATTERN = "production_stack_tpu/ops/*.py"
+TEST_PATTERN = "tests/test_*.py"
+
+
+def registry_entries(tree: ast.AST) -> Dict[str, Tuple[str, str]]:
+    """The ATTENTION_IMPLS literal: {key: (module, func)}."""
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        for target in stmt.targets:
+            if (isinstance(target, ast.Name)
+                    and target.id == "ATTENTION_IMPLS"):
+                try:
+                    value = ast.literal_eval(stmt.value)
+                except (ValueError, TypeError):
+                    return {}
+                if isinstance(value, dict):
+                    return {k: tuple(v) for k, v in value.items()}
+    return {}
+
+
+def int8_test_pools(project: Project) -> List[Tuple[str, set]]:
+    """(test id, reference pool) for every int8/quant-named test."""
+    out = []
+    for sf in project.files(TEST_PATTERN):
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not node.name.startswith("test_"):
+                continue
+            if "int8" not in node.name and "quant" not in node.name:
+                continue
+            out.append((f"{sf.relpath}::{node.name}",
+                        referenced_names(node)))
+    return out
+
+
+@rule("kv-parity",
+      "every registered attention impl has an int8 parity test")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    sf = project.source(REGISTRY_FILE)
+    if sf is None or sf.tree is None:
+        return [Finding(
+            rule="kv-parity", path=REGISTRY_FILE, line=0,
+            message="ops/attention.py missing — update "
+                    "staticcheck/analyzers/kv_parity.py if the "
+                    "registry moved")]
+    impls = registry_entries(sf.tree)
+    if not impls:
+        findings.append(Finding(
+            rule="kv-parity", path=REGISTRY_FILE, line=0,
+            message="ATTENTION_IMPLS registry literal not found — "
+                    "the int8 parity lint has nothing to walk"))
+        return findings
+
+    tests = int8_test_pools(project)
+    if not tests:
+        findings.append(Finding(
+            rule="kv-parity", path="tests", line=0,
+            message="no int8/quant-named test functions found under "
+                    "tests/"))
+    for key, (module, func_name) in sorted(impls.items()):
+        if not any(func_name in refs for _, refs in tests):
+            findings.append(Finding(
+                rule="kv-parity", path=REGISTRY_FILE, line=0,
+                message=f"{key} ({module}.{func_name}): no test "
+                        "function with int8/quant in its name "
+                        f"references {func_name} — add a parity test "
+                        "over QuantKV pages"))
+
+    registered_stems = {m.rsplit(".", 1)[-1] for m, _ in impls.values()}
+    for ops_sf in project.files(OPS_PATTERN):
+        if "attention" not in ops_sf.relpath or ops_sf.tree is None:
+            continue
+        stem = ops_sf.relpath.rsplit("/", 1)[-1][:-3]
+        paged = any(isinstance(n, ast.FunctionDef)
+                    and n.name.startswith("paged_")
+                    for n in ops_sf.tree.body)
+        if paged and stem not in registered_stems:
+            findings.append(Finding(
+                rule="kv-parity", path=ops_sf.relpath, line=0,
+                message=f"ops/{stem}.py defines a paged_* entry "
+                        "point but is not in ATTENTION_IMPLS — "
+                        "register it so the int8 parity lint covers "
+                        "it"))
+    return findings

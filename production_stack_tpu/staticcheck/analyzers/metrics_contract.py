@@ -1,0 +1,179 @@
+"""Rule ``metrics-contract``: engine metrics must round-trip through
+the router, or be dropped *explicitly*.
+
+The metric pipeline crosses three layers that only agree by string
+convention: the engine renders Prometheus text (engine/metrics.py +
+engine/server.py /metrics), the router scraper parses the names it
+knows (router/stats/engine_stats.py ``_METRIC_MAP``), and the metrics
+service re-exports the scraped values as labeled gauges
+(router/services/metrics_service.py ``refresh_gauges``). PRs 2-4 each
+added engine gauges by hand in all three places; one forgotten edit
+means a dashboard silently reads 0 forever. Checks:
+
+- every ``vllm:*`` name the engine emits is either a ``_METRIC_MAP``
+  key / specially-parsed name in engine_stats.py, or listed in its
+  ``_ROUTER_UNSCRAPED`` set (the explicit "cluster Prometheus reads
+  this directly, the router does not" marker);
+- every name the scraper reads is actually emitted by the engine
+  (no scraping ghosts);
+- every ``_METRIC_MAP`` target attribute is a real ``EngineStats``
+  field;
+- every ``EngineStats`` field is consumed somewhere in
+  metrics_service.py (scraped-but-never-re-exported drift).
+
+These are cross-file contract findings (line 0 on the file that must
+change); the fix is code or an explicit marker, not a waiver comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    rule,
+    string_constants,
+)
+
+ENGINE_FILES = (
+    "production_stack_tpu/engine/metrics.py",
+    "production_stack_tpu/engine/server.py",
+)
+SCRAPER_FILE = "production_stack_tpu/router/stats/engine_stats.py"
+SERVICE_FILE = "production_stack_tpu/router/services/metrics_service.py"
+
+_NAME_RE = re.compile(r"vllm:[A-Za-z0-9_]+")
+
+
+def _metric_names(tree: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for s in string_constants(tree):
+        names.update(_NAME_RE.findall(s))
+    return names
+
+
+def _assigned_literal(tree: ast.AST, name: str):
+    """The ast node assigned to module-level ``name`` (None if
+    absent)."""
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name) and target.id == name:
+                    return stmt.value
+        elif isinstance(stmt, ast.AnnAssign):
+            if (isinstance(stmt.target, ast.Name)
+                    and stmt.target.id == name):
+                return stmt.value
+    return None
+
+
+def _dict_str_entries(node) -> dict:
+    """{key: value} for the string-literal entries of a dict node."""
+    out = {}
+    if isinstance(node, ast.Dict):
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                if isinstance(v, ast.Constant) and isinstance(
+                        v.value, str):
+                    out[k.value] = v.value
+    return out
+
+
+def _str_elements(node) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(
+                    el.value, str):
+                out.add(el.value)
+    elif isinstance(node, ast.Call):  # frozenset({...}) / set([...])
+        for arg in node.args:
+            out |= _str_elements(arg)
+    return out
+
+
+def _class_fields(tree: ast.AST, class_name: str) -> Set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {stmt.target.id for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)}
+    return set()
+
+
+def _attribute_tails(tree: ast.AST) -> Set[str]:
+    return {node.attr for node in ast.walk(tree)
+            if isinstance(node, ast.Attribute)}
+
+
+@rule("metrics-contract",
+      "engine metrics round-trip scraper and re-export, or are "
+      "dropped explicitly")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def missing(path):
+        return Finding(
+            rule="metrics-contract", path=path, line=0,
+            message="metrics-contract surface file missing — if the "
+                    "layer moved, update "
+                    "staticcheck/analyzers/metrics_contract.py")
+
+    emitted: Set[str] = set()
+    for path in ENGINE_FILES:
+        sf = project.source(path)
+        if sf is None or sf.tree is None:
+            findings.append(missing(path))
+            continue
+        emitted |= _metric_names(sf.tree)
+
+    scraper = project.source(SCRAPER_FILE)
+    service = project.source(SERVICE_FILE)
+    if scraper is None or scraper.tree is None:
+        findings.append(missing(SCRAPER_FILE))
+    if service is None or service.tree is None:
+        findings.append(missing(SERVICE_FILE))
+    if findings:
+        return findings
+
+    metric_map = _dict_str_entries(
+        _assigned_literal(scraper.tree, "_METRIC_MAP"))
+    unscraped = _str_elements(
+        _assigned_literal(scraper.tree, "_ROUTER_UNSCRAPED"))
+    # Names the scraper handles outside _METRIC_MAP (e.g. the labeled
+    # kv-dtype gauge special-cased in from_prometheus_text) still
+    # appear as string literals in the module.
+    scraped = _metric_names(scraper.tree)
+    stats_fields = _class_fields(scraper.tree, "EngineStats")
+
+    for name in sorted(emitted - scraped - unscraped):
+        findings.append(Finding(
+            rule="metrics-contract", path=SCRAPER_FILE, line=0,
+            message=f"engine emits {name} but the router scraper "
+                    "neither reads it (_METRIC_MAP / "
+                    "from_prometheus_text) nor lists it in "
+                    "_ROUTER_UNSCRAPED — add it to one so the drop "
+                    "is a decision, not drift"))
+    for name in sorted(scraped - emitted - unscraped):
+        findings.append(Finding(
+            rule="metrics-contract", path=SCRAPER_FILE, line=0,
+            message=f"router scraper references {name} but no engine "
+                    "file emits it — stale map entry or renamed "
+                    "metric"))
+    for name, attr in sorted(metric_map.items()):
+        if attr not in stats_fields:
+            findings.append(Finding(
+                rule="metrics-contract", path=SCRAPER_FILE, line=0,
+                message=f"_METRIC_MAP maps {name} to EngineStats."
+                        f"{attr}, which is not a declared field"))
+    consumed = _attribute_tails(service.tree)
+    for attr in sorted(stats_fields - consumed):
+        findings.append(Finding(
+            rule="metrics-contract", path=SERVICE_FILE, line=0,
+            message=f"EngineStats.{attr} is scraped but never "
+                    "consumed in metrics_service.py — the value dies "
+                    "in the router instead of being re-exported"))
+    return findings

@@ -1,0 +1,75 @@
+"""Rule ``no-timeout``: every outbound network call under
+``production_stack_tpu/router/`` must carry an explicit timeout.
+
+The resilience layer's bounded-wait guarantee (docs/resilience.md)
+regresses silently otherwise. Flags:
+
+- ``requests.<verb>(...)`` without a ``timeout=`` keyword,
+- ``aiohttp.ClientSession(...)`` / ``ClientSession(...)`` constructors
+  without a ``timeout=`` keyword (session default),
+- ``<anything named *session*>.<verb>(...)`` without ``timeout=``.
+
+Waive an intentionally unbounded call with ``# lint: allow-no-timeout``
+on the call line (rare; justify in review).
+
+Migrated from tests/test_network_timeout_lint.py (PR 1), which is now
+a thin wrapper over this rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    rule,
+    tail_name,
+)
+
+_HTTP_VERBS = {"get", "post", "put", "patch", "delete", "head",
+               "request"}
+
+SCOPE = ("production_stack_tpu/router/**/*.py",)
+
+
+def has_timeout_kw(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords) or any(
+        kw.arg is None for kw in call.keywords  # **kwargs: trust it
+    )
+
+
+def is_network_call(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id == "ClientSession"
+    if not isinstance(func, ast.Attribute):
+        return False
+    recv = tail_name(func.value)
+    if recv == "requests" and func.attr in _HTTP_VERBS:
+        return True
+    if recv == "aiohttp" and func.attr == "ClientSession":
+        return True
+    if "session" in recv.lower() and func.attr in _HTTP_VERBS:
+        return True
+    return False
+
+
+@rule("no-timeout",
+      "outbound network calls in router/ need an explicit timeout=")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files(*SCOPE):
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not is_network_call(node) or has_timeout_kw(node):
+                continue
+            findings.append(sf.finding(
+                "no-timeout", node,
+                "network call without explicit timeout= (bounded-wait "
+                "guarantee, docs/resilience.md)"))
+    return findings

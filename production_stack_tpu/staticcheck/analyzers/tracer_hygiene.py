@@ -1,0 +1,193 @@
+"""Rule ``tracer-hygiene``: recompile/host-sync hazards in traced
+code (ops/ and engine/model_runner.py).
+
+"Zero per-step recompiles" and "no host sync inside a step" are the
+invariants the whole serving stack's latency story rests on
+(docs/async_pipeline.md, CHANGES.md PRs 2-4). They regress invisibly:
+a ``float(x)`` on a traced value either throws a ConcretizationError
+in prod or — worse — silently forces a retrace per shape/value when
+the argument happens to be weakly typed. Flags:
+
+1. inside *traced functions* (see below):
+   - ``.item()`` anywhere — a device->host sync (or trace error),
+   - ``bool()/int()/float()/len()``-driven branching: one of these
+     calls inside an ``if``/``while`` test or ternary condition,
+   - ``if``/``while`` tests on ``.shape``/``.ndim`` — trace-time
+     specialization; legitimate bucketing must carry a waiver so
+     every retrace trigger is deliberate and reviewed,
+   - any ``while`` loop whose test is not a compile-time constant —
+     Python loops on traced state either fail to trace or unroll
+     unboundedly (use ``lax.while_loop``/``fori_loop``);
+2. at module scope of every file in scope: eager ``jnp.*`` calls —
+   module import must not allocate on or talk to the accelerator
+   (``jnp.dtype`` is exempt: it is host metadata).
+
+*Traced functions* are found statically: functions decorated with
+``jax.jit``/``functools.partial(jax.jit, ...)``, functions passed to
+``jax.jit(...)`` by name (including ``self._fn`` method references
+and either arm of a conditional expression), kernels passed to
+``pl.pallas_call`` (including ``partial(kernel, ...)``), and every
+``def`` nested inside one of those.
+
+Waiver: ``# lint: allow-tracer-hygiene`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    recv_name,
+    rule,
+    tail_name,
+)
+
+SCOPE = (
+    "production_stack_tpu/ops/*.py",
+    "production_stack_tpu/engine/model_runner.py",
+)
+
+_COERCIONS = {"bool", "int", "float", "len"}
+
+
+def _is_jit_reference(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` / ``partial(jax.jit, ...)``."""
+    if tail_name(node) == "jit":
+        return True
+    if isinstance(node, ast.Call) and tail_name(node.func) == "partial":
+        return bool(node.args) and tail_name(node.args[0]) == "jit"
+    return False
+
+
+def _target_tails(node: ast.AST) -> Set[str]:
+    """Function names referenced by a jit/pallas_call argument:
+    ``fn`` / ``self._fn`` / ``partial(fn, ...)`` / ``a if c else b``."""
+    if isinstance(node, ast.IfExp):
+        return _target_tails(node.body) | _target_tails(node.orelse)
+    if isinstance(node, ast.Call) and tail_name(node.func) == "partial":
+        return _target_tails(node.args[0]) if node.args else set()
+    tail = tail_name(node)
+    return {tail} if tail else set()
+
+
+def traced_function_names(tree: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_reference(dec):
+                    names.add(node.name)
+        elif isinstance(node, ast.Call):
+            callee = tail_name(node.func)
+            if callee == "jit" and node.args:
+                names |= _target_tails(node.args[0])
+            elif callee == "pallas_call" and node.args:
+                names |= _target_tails(node.args[0])
+    return names
+
+
+def traced_functions(tree: ast.AST):
+    """FunctionDef nodes that are traced, including defs nested in a
+    traced function."""
+    traced = traced_function_names(tree)
+
+    def visit(node, inside):
+        for child in ast.iter_child_nodes(node):
+            is_fn = isinstance(child, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+            now_inside = inside or (is_fn and child.name in traced)
+            if is_fn and now_inside:
+                yield child
+            yield from visit(child, now_inside)
+
+    yield from visit(tree, False)
+
+
+def _test_findings(sf, fn, test, kind: str) -> List[Finding]:
+    out: List[Finding] = []
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call):
+            callee = sub.func
+            if (isinstance(callee, ast.Name)
+                    and callee.id in _COERCIONS
+                    and sub.args
+                    and not isinstance(sub.args[0], ast.Constant)):
+                out.append(sf.finding(
+                    "tracer-hygiene", sub,
+                    f"{callee.id}()-driven {kind} in traced function "
+                    f"{fn.name}: concretizes a traced value (host "
+                    "sync / retrace); use lax.cond/select or keep it "
+                    "device-side"))
+            # .item() in a test is reported by the generic .item()
+            # walk below — not doubled here.
+        elif (isinstance(sub, ast.Attribute)
+                and sub.attr in ("shape", "ndim")):
+            out.append(sf.finding(
+                "tracer-hygiene", sub,
+                f"shape-dependent {kind} in traced function "
+                f"{fn.name}: retraces per shape — waive if this "
+                "bucketing is deliberate"))
+    return out
+
+
+def check_tree(sf) -> List[Finding]:
+    """All tracer-hygiene findings for one parsed file."""
+    tree = sf.tree
+    if tree is None:
+        return []
+    findings: List[Finding] = []
+
+    # (2) eager jnp work at module scope.
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for sub in ast.walk(stmt):
+            if (isinstance(sub, ast.Call)
+                    and recv_name(sub.func) == "jnp"
+                    and tail_name(sub.func) != "dtype"):
+                findings.append(sf.finding(
+                    "tracer-hygiene", sub,
+                    f"eager jnp.{tail_name(sub.func)}() at module "
+                    "scope runs on the accelerator at import time — "
+                    "build constants inside the traced function or "
+                    "lazily"))
+
+    # (1) hazards inside traced functions.
+    for fn in traced_functions(tree):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                kind = ("while-loop test"
+                        if isinstance(node, ast.While) else "branch")
+                findings.extend(_test_findings(sf, fn, node.test, kind))
+                if (isinstance(node, ast.While)
+                        and not isinstance(node.test, ast.Constant)):
+                    findings.append(sf.finding(
+                        "tracer-hygiene", node,
+                        f"Python while-loop in traced function "
+                        f"{fn.name}: traces unboundedly or fails — "
+                        "use lax.while_loop/fori_loop"))
+            elif isinstance(node, ast.IfExp):
+                findings.extend(
+                    _test_findings(sf, fn, node.test,
+                                   "conditional expression"))
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"):
+                findings.append(sf.finding(
+                    "tracer-hygiene", node,
+                    f".item() in traced function {fn.name}: "
+                    "device->host sync inside the step"))
+    return findings
+
+
+@rule("tracer-hygiene",
+      "no recompile/host-sync hazards in jitted or pallas code")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files(*SCOPE):
+        findings.extend(check_tree(sf))
+    return findings

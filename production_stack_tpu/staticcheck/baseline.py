@@ -1,0 +1,64 @@
+"""Checked-in baseline of legacy findings.
+
+New analyzers land with teeth immediately: findings already in the
+tree when a rule is introduced are recorded here by fingerprint, and
+only findings *outside* the baseline fail the CLI. The workflow
+(docs/static_analysis.md):
+
+- fix or waive findings where possible — the baseline is a debt
+  ledger, not a waiver mechanism;
+- ``python -m production_stack_tpu.staticcheck --update-baseline``
+  rewrites the file from the current tree (review the diff: a grown
+  baseline is a regression you are choosing to accept);
+- an entry whose finding disappears is pruned on the next
+  ``--update-baseline`` and never hides anything meanwhile.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Iterable, List, Set
+
+from production_stack_tpu.staticcheck.core import Finding
+
+BASELINE_RELPATH = "production_stack_tpu/staticcheck/baseline.json"
+
+
+def baseline_path(root) -> pathlib.Path:
+    return pathlib.Path(root) / BASELINE_RELPATH
+
+
+def load_fingerprints(root) -> Set[str]:
+    path = baseline_path(root)
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return {entry["fingerprint"] for entry in data.get("findings", [])}
+
+
+def split_new(findings: Iterable[Finding],
+              fingerprints: Set[str]):
+    """(new, baselined) partition of ``findings``."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        (old if f.fingerprint() in fingerprints else new).append(f)
+    return new, old
+
+
+def write(root, findings: Iterable[Finding]) -> pathlib.Path:
+    path = baseline_path(root)
+    entries = [
+        {
+            "fingerprint": f.fingerprint(),
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+        }
+        for f in sorted(findings,
+                        key=lambda f: (f.path, f.line, f.rule))
+    ]
+    path.write_text(json.dumps(
+        {"version": 1, "findings": entries}, indent=2) + "\n")
+    return path

@@ -1,0 +1,100 @@
+"""``python -m production_stack_tpu.staticcheck`` — run the analyzers.
+
+Exit-code contract (relied on by .github/workflows/ci.yml and the
+pre-commit hook):
+
+- 0: no findings outside the checked-in baseline (the tree is clean);
+- 1: new findings — listed on stdout (human) or in the ``findings``
+  array (``--json``);
+- 2: usage or internal error (unknown rule, unreadable root, ...).
+
+``--update-baseline`` rewrites baseline.json from the current tree
+and exits 0; review that diff like code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from production_stack_tpu.staticcheck import baseline as baseline_mod
+from production_stack_tpu.staticcheck.core import (
+    REGISTRY,
+    Project,
+    run_rules,
+)
+
+
+def _default_root() -> pathlib.Path:
+    # The repo root is two levels above this package.
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m production_stack_tpu.staticcheck",
+        description="AST analyzers enforcing the stack's structural "
+                    "invariants (docs/static_analysis.md)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="NAME",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite baseline.json from the current "
+                             "tree (then exit 0)")
+    args = parser.parse_args(argv)
+
+    # Side-effect import: registers every analyzer.
+    from production_stack_tpu.staticcheck import analyzers  # noqa: F401
+
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            print(f"{name}: {REGISTRY[name].description}")
+        return 0
+
+    root = pathlib.Path(args.root) if args.root else _default_root()
+    if not (root / "production_stack_tpu").is_dir():
+        print(f"error: {root} does not look like the repo root",
+              file=sys.stderr)
+        return 2
+
+    try:
+        project = Project.from_root(root)
+        findings = run_rules(project, rules=args.rule)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        path = baseline_mod.write(root, findings)
+        print(f"wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    fingerprints = baseline_mod.load_fingerprints(root)
+    new, baselined = baseline_mod.split_new(findings, fingerprints)
+
+    if args.json:
+        print(json.dumps({
+            "version": 1,
+            "root": str(root),
+            "rules": sorted(args.rule) if args.rule else sorted(REGISTRY),
+            "findings": [f.to_json() for f in new],
+            "baselined": len(baselined),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        print(f"{len(new)} new finding(s), {len(baselined)} "
+              "baselined")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

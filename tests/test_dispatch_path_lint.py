@@ -2,144 +2,61 @@
 
 The overlapped async pipeline (docs/async_pipeline.md) only hides
 host work if ``ModelRunner.dispatch_decode`` and everything it calls
-stays purely dispatching: building a payload, one fused host->device
-transfer, launching the jitted step. A single ``np.asarray(device
-array)``, ``jax.device_get`` or ``.block_until_ready()`` anywhere on
-that path silently re-serializes the pipeline — the step "works" but
-the overlap is gone, which no functional test notices. Flags, inside
-the DISPATCH_PATH functions of engine/model_runner.py:
+stays purely dispatching — a single ``np.asarray(device array)``,
+``jax.device_get`` or ``.block_until_ready()`` on that path silently
+re-serializes the pipeline.
 
-- ``np.asarray(...)`` / ``np.array(...)`` (device->host copy when fed
-  a device array),
-- ``jax.device_get(...)`` / ``device_get(...)``,
-- ``<anything>.block_until_ready(...)``,
-- ``<anything>.item(...)`` / ``float(...)`` / ``int(...)`` on a call's
-  result is not flagged — literal coercions of host scalars are fine —
-  but ``.item()`` on arrays is.
-
-A deliberate host read can carry a ``# lint: allow-host-read`` comment
-on the call line, which must be rare and justified in review.
+Since PR 5 this is a thin wrapper over the staticcheck ``host-read``
+rule (production_stack_tpu/staticcheck/analyzers/dispatch_path.py),
+which also owns the DISPATCH_PATH function list and the
+tracks-reality check. Test names are kept so history stays
+comparable. Waivers: ``# lint: allow-host-read`` on the call line.
 """
 
-import ast
 import pathlib
 
+from production_stack_tpu.staticcheck import Project, run_rules
+
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-RUNNER = ROOT / "production_stack_tpu" / "engine" / "model_runner.py"
-
-# Every function the async dispatch path runs through. run_decode /
-# result() are NOT here: they are the sync completion side and their
-# device_get is the one intended blocking read.
-DISPATCH_PATH = {
-    "dispatch_decode",
-    "_staging_set",
-    "_dispatch",
-    "execute_payload",
-    "_optional_device_inputs",
-    "_penalty_payload",
-    "_seed_payload",
-    "_bias_payload",
-    "_suppress_payload",
-    "_guided_payload",
-    "_next_rng",
-    "_as_device",
-}
-
-_WAIVER = "lint: allow-host-read"
 
 
-def _tail_name(node: ast.AST) -> str:
-    """Rightmost identifier of a Name/Attribute chain ('' otherwise)."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return ""
-
-
-def _recv_name(node: ast.AST) -> str:
-    """Identifier of an Attribute's receiver ('' otherwise)."""
-    if isinstance(node, ast.Attribute):
-        return _tail_name(node.value)
-    return ""
-
-
-def _is_blocking_call(call: ast.Call) -> bool:
-    func = call.func
-    name = _tail_name(func)
-    recv = _recv_name(func)
-    if recv == "np" and name in ("asarray", "array"):
-        return True
-    if name == "device_get":  # jax.device_get or bare import
-        return True
-    if isinstance(func, ast.Attribute) and name in (
-            "block_until_ready", "item"):
-        return True
-    return False
-
-
-def _dispatch_path_functions(tree: ast.Module):
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if node.name in DISPATCH_PATH:
-                yield node
+def _findings(project):
+    return [f for f in run_rules(project, rules=["host-read"])
+            if f.rule == "host-read"]
 
 
 def test_dispatch_path_has_no_blocking_host_reads():
-    source = RUNNER.read_text()
-    lines = source.splitlines()
-    tree = ast.parse(source, filename=str(RUNNER))
-    seen = set()
-    violations = []
-    for fn in _dispatch_path_functions(tree):
-        seen.add(fn.name)
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            if not _is_blocking_call(node):
-                continue
-            line = (lines[node.lineno - 1]
-                    if node.lineno <= len(lines) else "")
-            if _WAIVER in line:
-                continue
-            violations.append(
-                f"{RUNNER.relative_to(ROOT)}:{node.lineno} "
-                f"(in {fn.name}): blocking host read on the dispatch "
-                f"path: {line.strip()}"
-            )
-    assert not violations, (
+    # Covers both halves of the old test: no blocking reads inside
+    # the DISPATCH_PATH functions, and every DISPATCH_PATH name still
+    # existing in model_runner.py (the rule emits a finding when one
+    # falls out of the real call graph).
+    findings = _findings(Project.from_root(ROOT))
+    assert not findings, (
         "Blocking host reads inside the async dispatch path (these "
         "re-serialize the pipeline; move the read to result()/"
         "completion, or add a '# lint: allow-host-read' waiver with "
-        "justification):\n" + "\n".join(violations)
-    )
-    # The list must track reality: a renamed/deleted function here
-    # would silently stop being linted.
-    missing = DISPATCH_PATH - seen
-    assert not missing, (
-        f"DISPATCH_PATH names not found in model_runner.py: {missing}"
+        "justification):\n" + "\n".join(f.render() for f in findings)
     )
 
 
 def test_lint_catches_a_violation():
     """The checker itself must actually flag offending calls."""
-    snippet = (
-        "def dispatch_decode(self):\n"
-        "    x = np.asarray(self._next_rng())\n"
-        "    y = jax.device_get(x)\n"
-        "    z = sampled.block_until_ready()\n"
-        "    return int(x[0])\n"
-    )
-    tree = ast.parse(snippet)
-    fns = list(_dispatch_path_functions(tree))
-    assert [f.name for f in fns] == ["dispatch_decode"]
-    flagged = [n for n in ast.walk(fns[0])
-               if isinstance(n, ast.Call) and _is_blocking_call(n)]
+    findings = _findings(Project.from_sources({
+        "production_stack_tpu/engine/model_runner.py":
+            "def dispatch_decode(self):\n"
+            "    x = np.asarray(self._next_rng())\n"
+            "    y = jax.device_get(x)\n"
+            "    z = sampled.block_until_ready()\n"
+            "    return int(x[0])\n",
+    }))
+    blocking = [f for f in findings
+                if "blocking host read" in f.message]
     # np.asarray, device_get, block_until_ready — int() is not one.
-    assert len(flagged) == 3
-    clean = ast.parse(
-        "def dispatch_decode(self):\n"
-        "    return jax.device_put(tuple(x))\n"
-    )
-    assert not [n for n in ast.walk(clean)
-                if isinstance(n, ast.Call) and _is_blocking_call(n)]
+    assert len(blocking) == 3
+    # A clean dispatch body produces no blocking-read findings.
+    clean = _findings(Project.from_sources({
+        "production_stack_tpu/engine/model_runner.py":
+            "def dispatch_decode(self):\n"
+            "    return jax.device_put(tuple(x))\n",
+    }))
+    assert not [f for f in clean if "blocking host read" in f.message]

@@ -1,112 +1,68 @@
 """Static check: every attention impl has bf16-vs-int8 parity tests.
 
 The int8 KV cache (docs/kv_quantization.md) dequantizes inside each
-attention implementation — XLA reference and both Pallas kernels. A
-new impl that skips the QuantKV branch would pass every full-precision
-test and silently serve garbage under ``--kv-cache-dtype int8``, so
-this lint walks the registry ``ops.attention.ATTENTION_IMPLS`` and
-requires, for each registered function, at least one test function in
-``tests/`` that (a) carries ``int8`` in its name and (b) references
-the impl by name in its body. Registering the impl is what arms the
-check; the companion assertion keeps the registry honest against the
-modules it points at.
+attention implementation; a new impl that skips the QuantKV branch
+passes every full-precision test and silently serves garbage under
+``--kv-cache-dtype int8``.
+
+Since PR 5 the static half is a thin wrapper over the staticcheck
+``kv-parity`` rule (production_stack_tpu/staticcheck/analyzers/
+kv_parity.py): registry coverage AND the paged_*-module-must-register
+check both live there. The importlib half (registry entries resolve
+to real callables) stays here — staticcheck deliberately never
+imports the code it analyzes. Test names are kept so history stays
+comparable.
 """
 
-import ast
 import importlib
 import pathlib
 
 from production_stack_tpu.ops.attention import ATTENTION_IMPLS
+from production_stack_tpu.staticcheck import Project, run_rules
 
-TESTS = pathlib.Path(__file__).resolve().parent
-
-
-def _referenced_names(fn: ast.AST):
-    """Every identifier a test function touches: bare names, attribute
-    tails, and string constants (covers indirect references like
-    getattr-by-name or parametrize ids)."""
-    names = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Name):
-            names.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            names.add(node.attr)
-        elif isinstance(node, ast.Constant) and isinstance(
-                node.value, str):
-            names.add(node.value)
-    return names
+ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 
-def _int8_test_functions():
-    """(test_name, referenced_names) for every int8-named test under
-    tests/."""
-    out = []
-    for path in sorted(TESTS.glob("test_*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                continue
-            if not node.name.startswith("test_"):
-                continue
-            if "int8" not in node.name and "quant" not in node.name:
-                continue
-            out.append((f"{path.name}::{node.name}",
-                        _referenced_names(node)))
-    return out
+def _findings(project):
+    return [f for f in run_rules(project, rules=["kv-parity"])
+            if f.rule == "kv-parity"]
 
 
 def test_every_attention_impl_has_int8_parity_coverage():
-    tests = _int8_test_functions()
-    assert tests, "no int8/quant parity tests found under tests/"
-    violations = []
-    for key, (module, func_name) in sorted(ATTENTION_IMPLS.items()):
-        covered = [name for name, refs in tests if func_name in refs]
-        if not covered:
-            violations.append(
-                f"{key} ({module}.{func_name}): no test function "
-                "with 'int8'/'quant' in its name references "
-                f"{func_name}"
-            )
-    assert not violations, (
+    findings = _findings(Project.from_root(ROOT))
+    assert not findings, (
         "Attention impls without bf16-vs-int8 parity coverage (add a "
         "test named test_*int8* that exercises the impl on QuantKV "
-        "pages):\n" + "\n".join(violations)
+        "pages):\n" + "\n".join(f.render() for f in findings)
     )
 
 
 def test_registry_tracks_reality():
-    """Every registry entry must resolve to a real callable, and every
-    paged-attention entry point in ops/ must be registered — a new
-    kernel module cannot dodge the lint by not registering."""
+    """Every registry entry must resolve to a real callable (needs
+    imports, so it stays outside staticcheck); the companion check —
+    every paged_* module in ops/ is registered — is a kv-parity
+    finding covered by the wrapper above."""
     for key, (module, func_name) in ATTENTION_IMPLS.items():
         fn = getattr(importlib.import_module(module), func_name, None)
         assert callable(fn), f"{key}: {module}.{func_name} missing"
 
-    registered = {m.rsplit(".", 1)[-1]
-                  for m, _ in ATTENTION_IMPLS.values()}
-    ops_dir = (TESTS.parent / "production_stack_tpu" / "ops")
-    for path in ops_dir.glob("*attention*.py"):
-        # Only modules exposing a paged entry point read KV pages and
-        # therefore need a QuantKV branch (ring_attention consumes raw
-        # q/k/v and is gated off from int8 at config level).
-        tree = ast.parse(path.read_text(), filename=str(path))
-        paged = any(isinstance(n, ast.FunctionDef)
-                    and n.name.startswith("paged_")
-                    for n in tree.body)
-        if paged:
-            assert path.stem in registered, (
-                f"ops/{path.name} defines a paged_* entry point but "
-                "is not in ATTENTION_IMPLS — register it so the int8 "
-                "parity lint covers it"
-            )
-
 
 def test_lint_catches_a_missing_impl():
-    """The checker itself must flag an unreferenced impl."""
-    tests = _int8_test_functions()
-    phantom = "paged_attention_that_does_not_exist"
-    assert not [name for name, refs in tests if phantom in refs]
-    # And a real impl is found by the same mechanism.
-    assert [name for name, refs in tests
-            if "paged_decode_attention" in refs]
+    """The checker itself must flag an unreferenced impl and an
+    unregistered paged_* module."""
+    findings = _findings(Project.from_sources({
+        "production_stack_tpu/ops/attention.py":
+            'ATTENTION_IMPLS = {\n'
+            '    "phantom": ("production_stack_tpu.ops.gone",\n'
+            '                "paged_attention_that_does_not_exist"),\n'
+            '}\n',
+        "production_stack_tpu/ops/rogue_attention.py":
+            "def paged_rogue(q):\n"
+            "    return q\n",
+        "tests/test_int8_parity.py":
+            "def test_int8_other():\n"
+            "    assert paged_decode_attention\n",
+    }))
+    messages = "\n".join(f.message for f in findings)
+    assert "paged_attention_that_does_not_exist" in messages
+    assert "rogue_attention.py defines a paged_* entry point" in messages

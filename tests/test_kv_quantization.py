@@ -80,6 +80,17 @@ def test_kv_dtype_validation():
                                       max_model_len=256),
             parallel=ParallelConfig(pipeline_parallel_size=2),
         )
+    # Context parallelism moves plain cache arrays through the sp
+    # ring walk, so int8 QuantKV pages are rejected the same way.
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        EngineConfig(
+            model=tiny_model_config("llama"),
+            cache=CacheConfig(page_size=16, num_pages=64,
+                              kv_cache_dtype="int8"),
+            scheduler=SchedulerConfig(max_num_seqs=4,
+                                      max_model_len=256),
+            parallel=ParallelConfig(context_parallel_size=2),
+        )
 
 
 def test_page_budget_expansion_and_idempotency():

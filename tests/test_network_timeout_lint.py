@@ -2,92 +2,49 @@
 
 Every outbound network call in ``production_stack_tpu/router/`` must
 carry an explicit timeout — the resilience layer's bounded-wait
-guarantee (docs/resilience.md) regresses silently otherwise. Flags:
+guarantee (docs/resilience.md) regresses silently otherwise.
 
-- ``requests.<verb>(...)`` without a ``timeout=`` keyword,
-- ``aiohttp.ClientSession(...)`` / ``ClientSession(...)`` constructors
-  without a ``timeout=`` keyword (session default),
-- ``<anything named *session*>.<verb>(...)`` without ``timeout=``.
-
-A call that is intentionally unbounded can carry a
-``# lint: allow-no-timeout`` comment on the call line, which must be
-rare and justified in review.
+Since PR 5 this is a thin wrapper over the staticcheck ``no-timeout``
+rule (production_stack_tpu/staticcheck/analyzers/network_timeout.py);
+the AST walker that used to live here IS the rule now. Test names are
+kept so history stays comparable. Waivers: ``# lint: allow-no-timeout``
+on the call line, rare and justified in review.
 """
 
-import ast
 import pathlib
 
+from production_stack_tpu.staticcheck import Project, run_rules
+
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-ROUTER_DIR = ROOT / "production_stack_tpu" / "router"
-
-_HTTP_VERBS = {"get", "post", "put", "patch", "delete", "head", "request"}
-_WAIVER = "lint: allow-no-timeout"
 
 
-def _has_timeout_kw(call: ast.Call) -> bool:
-    return any(kw.arg == "timeout" for kw in call.keywords) or any(
-        kw.arg is None for kw in call.keywords  # **kwargs: trust it
-    )
-
-
-def _tail_name(node: ast.AST) -> str:
-    """Rightmost identifier of a Name/Attribute chain ('' otherwise)."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return ""
-
-
-def _is_network_call(call: ast.Call) -> bool:
-    func = call.func
-    if isinstance(func, ast.Name):
-        return func.id == "ClientSession"
-    if not isinstance(func, ast.Attribute):
-        return False
-    recv = _tail_name(func.value)
-    if recv == "requests" and func.attr in _HTTP_VERBS:
-        return True
-    if recv == "aiohttp" and func.attr == "ClientSession":
-        return True
-    if "session" in recv.lower() and func.attr in _HTTP_VERBS:
-        return True
-    return False
+def _findings(project):
+    return [f for f in run_rules(project, rules=["no-timeout"])
+            if f.rule == "no-timeout"]
 
 
 def test_router_network_calls_have_explicit_timeouts():
-    violations = []
-    for path in sorted(ROUTER_DIR.rglob("*.py")):
-        source = path.read_text()
-        lines = source.splitlines()
-        tree = ast.parse(source, filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or not _is_network_call(node):
-                continue
-            if _has_timeout_kw(node):
-                continue
-            line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-            if _WAIVER in line:
-                continue
-            violations.append(
-                f"{path.relative_to(ROOT)}:{node.lineno}: "
-                f"network call without explicit timeout: {line.strip()}"
-            )
-    assert not violations, (
+    findings = _findings(Project.from_root(ROOT))
+    assert not findings, (
         "Unbounded network calls under production_stack_tpu/router/ "
         "(add an explicit timeout=, or a '# lint: allow-no-timeout' "
-        "waiver with justification):\n" + "\n".join(violations)
+        "waiver with justification):\n"
+        + "\n".join(f.render() for f in findings)
     )
 
 
-def test_lint_catches_a_violation(tmp_path):
+def test_lint_catches_a_violation():
     """The checker itself must actually flag an offending call."""
-    snippet = "import requests\nrequests.get('http://x')\n"
-    tree = ast.parse(snippet)
-    calls = [n for n in ast.walk(tree) if isinstance(n, ast.Call)]
-    assert len(calls) == 1
-    assert _is_network_call(calls[0])
-    assert not _has_timeout_kw(calls[0])
-    ok = ast.parse("import requests\nrequests.get('http://x', timeout=5)\n")
-    call = next(n for n in ast.walk(ok) if isinstance(n, ast.Call))
-    assert _has_timeout_kw(call)
+    findings = _findings(Project.from_sources({
+        "production_stack_tpu/router/planted.py":
+            "import requests\n"
+            "requests.get('http://x')\n",
+    }))
+    assert len(findings) == 1
+    assert findings[0].line == 2
+    # And the bounded version passes.
+    assert not _findings(Project.from_sources({
+        "production_stack_tpu/router/planted.py":
+            "import requests\n"
+            "requests.get('http://x', timeout=5)\n",
+    }))

@@ -1,0 +1,442 @@
+"""Self-tests for the staticcheck analyzer suite (tier 1).
+
+Each analyzer gets a negative fixture: a synthetic tree (built with
+``Project.from_sources``, never touching disk) with a planted
+violation the rule must catch, plus the corresponding clean shape it
+must NOT flag. On top of that: waiver semantics (a valid waiver
+suppresses, a typoed waiver is itself a finding), fingerprint
+stability (baseline survives line drift), parse-error surfacing, the
+real tree staying clean modulo the checked-in baseline, and the CLI
+exit-code/JSON contract. Rule catalog: docs/static_analysis.md.
+"""
+
+import json
+import pathlib
+import textwrap
+
+from production_stack_tpu.staticcheck import (
+    Finding,
+    Project,
+    REGISTRY,
+    run_rules,
+)
+from production_stack_tpu.staticcheck import baseline as baseline_mod
+from production_stack_tpu.staticcheck.cli import main as cli_main
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run(sources, rule):
+    """Findings for ``rule`` on an in-memory tree (waiver/parse
+    findings from run_rules filtered out unless asked for)."""
+    project = Project.from_sources(
+        {path: textwrap.dedent(text) for path, text in sources.items()})
+    return [f for f in run_rules(project, rules=[rule])
+            if f.rule == rule]
+
+
+# ---- registry sanity ---------------------------------------------------
+
+
+def test_all_advertised_rules_are_registered():
+    import production_stack_tpu.staticcheck.analyzers  # noqa: F401
+    expected = {"tracer-hygiene", "async-blocking", "metrics-contract",
+                "config-contract", "no-timeout", "host-read",
+                "kv-parity"}
+    assert expected <= set(REGISTRY)
+
+
+# ---- tracer-hygiene ----------------------------------------------------
+
+
+def test_tracer_hygiene_catches_planted_hazards():
+    findings = _run({
+        "production_stack_tpu/ops/bad_kernel.py": """\
+            import jax
+            import jax.numpy as jnp
+
+            EAGER = jnp.zeros((4,))
+
+            @jax.jit
+            def step(x):
+                if float(x[0]) > 0:
+                    x = x + 1
+                while x[0] > 0:
+                    x = x - 1
+                if x.shape[0] == 1:
+                    x = x * 2
+                return x.sum().item()
+            """,
+    }, "tracer-hygiene")
+    messages = "\n".join(f.message for f in findings)
+    assert "eager jnp.zeros" in messages
+    assert "float()-driven branch" in messages
+    assert "Python while-loop" in messages
+    assert "shape-dependent branch" in messages
+    assert ".item() in traced function step" in messages
+
+
+def test_tracer_hygiene_finds_jit_by_call_and_pallas_kernels():
+    # Traced-ness must follow jax.jit(fn) references and kernels
+    # handed to pl.pallas_call, not just decorators.
+    findings = _run({
+        "production_stack_tpu/ops/indirect.py": """\
+            import jax
+            from jax.experimental import pallas as pl
+
+            def _impl(x):
+                return x.sum().item()
+
+            run = jax.jit(_impl)
+
+            def _kernel(ref, out):
+                if bool(ref[0]):
+                    out[0] = ref[0]
+
+            def launch(x):
+                return pl.pallas_call(_kernel, out_shape=None)(x)
+            """,
+    }, "tracer-hygiene")
+    messages = "\n".join(f.message for f in findings)
+    assert ".item() in traced function _impl" in messages
+    assert "bool()-driven branch in traced function _kernel" in messages
+
+
+def test_tracer_hygiene_ignores_clean_and_untraced_code():
+    findings = _run({
+        "production_stack_tpu/ops/clean_kernel.py": """\
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            @jax.jit
+            def step(x):
+                return lax.cond(x[0] > 0, lambda v: v + 1,
+                                lambda v: v - 1, x)
+
+            def host_helper(arr):
+                # Not traced: host-side coercion is fine here.
+                if float(arr[0]) > 0:
+                    return int(arr.sum())
+                return 0
+            """,
+    }, "tracer-hygiene")
+    assert findings == []
+
+
+# ---- async-blocking ----------------------------------------------------
+
+
+def test_async_blocking_catches_planted_calls():
+    findings = _run({
+        "production_stack_tpu/router/bad_async.py": """\
+            import time
+            import requests
+
+            async def handler():
+                time.sleep(1)
+                requests.get("http://x", timeout=5)
+                with open("/tmp/f") as f:
+                    return f.read()
+            """,
+    }, "async-blocking")
+    messages = "\n".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "time.sleep blocks the event loop" in messages
+    assert "synchronous requests." in messages
+    assert "synchronous open() on the event loop" in messages
+    assert all("in async def handler" in f.message for f in findings)
+
+
+def test_async_blocking_skips_nested_sync_defs():
+    # The file_storage.py pattern: blocking IO wrapped in a sync def
+    # handed to asyncio.to_thread runs off-loop and must not flag.
+    findings = _run({
+        "production_stack_tpu/router/offloop.py": """\
+            import asyncio
+            import time
+
+            async def handler():
+                def _work():
+                    time.sleep(1)
+                    with open("/tmp/f") as f:
+                        return f.read()
+                return await asyncio.to_thread(_work)
+
+            def sync_helper():
+                time.sleep(1)  # not a coroutine: out of scope
+            """,
+    }, "async-blocking")
+    assert findings == []
+
+
+# ---- no-timeout (migrated PR1 lint) ------------------------------------
+
+
+def test_no_timeout_flags_only_unbounded_calls():
+    findings = _run({
+        "production_stack_tpu/router/client.py": """\
+            import requests
+
+            def bad():
+                return requests.get("http://x")
+
+            def good():
+                return requests.get("http://x", timeout=5)
+            """,
+    }, "no-timeout")
+    assert len(findings) == 1
+    assert findings[0].line == 4
+
+
+# ---- host-read (migrated PR3 lint) -------------------------------------
+
+
+def test_host_read_catches_planted_dispatch_read():
+    findings = _run({
+        "production_stack_tpu/engine/model_runner.py": """\
+            import numpy as np
+
+            def dispatch_decode(self, batch):
+                tokens = np.asarray(batch.tokens)
+                probed = batch.logits.item()
+                batch.state.block_until_ready()
+                return tokens, probed
+            """,
+    }, "host-read")
+    blocking = [f for f in findings
+                if "blocking host read in dispatch_decode" in f.message]
+    assert len(blocking) == 3
+    # The synthetic runner defines only one DISPATCH_PATH function;
+    # the tracks-reality check reports the rest as out of coverage.
+    assert any("DISPATCH_PATH names not found" in f.message
+               for f in findings)
+
+
+# ---- metrics-contract --------------------------------------------------
+
+_METRICS_FIXTURE = {
+    "production_stack_tpu/engine/metrics.py": """\
+        def render():
+            return [
+                "vllm:num_requests_running 1",
+                "vllm:ghost_total 2",
+            ]
+        """,
+    "production_stack_tpu/engine/server.py": """\
+        PORT = 8000
+        """,
+    "production_stack_tpu/router/stats/engine_stats.py": """\
+        _METRIC_MAP = {
+            "vllm:num_requests_running": "num_running_requests",
+            "vllm:stale_metric": "missing_attr",
+        }
+        _ROUTER_UNSCRAPED = frozenset()
+
+        class EngineStats:
+            num_running_requests: int = 0
+            orphan_field: int = 0
+        """,
+    "production_stack_tpu/router/services/metrics_service.py": """\
+        def refresh_gauges(es):
+            return es.num_running_requests
+        """,
+}
+
+
+def test_metrics_contract_catches_planted_drift():
+    findings = _run(_METRICS_FIXTURE, "metrics-contract")
+    messages = "\n".join(f.message for f in findings)
+    # Engine emits a name the scraper never reads.
+    assert "engine emits vllm:ghost_total" in messages
+    # Scraper maps a name no engine file emits.
+    assert "references vllm:stale_metric" in messages
+    # Map target is not a declared EngineStats field.
+    assert "not a declared field" in messages
+    # Scraped field never re-exported by the metrics service.
+    assert "EngineStats.orphan_field is scraped but never" in messages
+
+
+def test_metrics_contract_accepts_explicit_drop_marker():
+    fixture = dict(_METRICS_FIXTURE)
+    fixture["production_stack_tpu/router/stats/engine_stats.py"] = """\
+        _METRIC_MAP = {
+            "vllm:num_requests_running": "num_running_requests",
+        }
+        _ROUTER_UNSCRAPED = frozenset({
+            "vllm:ghost_total",
+        })
+
+        class EngineStats:
+            num_running_requests: int = 0
+        """
+    assert _run(fixture, "metrics-contract") == []
+
+
+# ---- config-contract ---------------------------------------------------
+
+_CONFIG_FIXTURE = {
+    "production_stack_tpu/engine/config.py": """\
+        class CacheConfig:
+            page_size: int = 16
+            secret_knob: int = 0
+
+        class EngineConfig:
+            cache: CacheConfig = None
+
+            def validate(self):
+                if self.cache.page_size and self.cache.secret_knob:
+                    raise ValueError(
+                        "page_size conflicts with secret_knob")
+
+        EXCLUSIVITY_RULES = (
+            ("cache.page_size", "cache.secret_knob",
+             "conflicts with secret_knob"),
+        )
+        """,
+    "production_stack_tpu/engine/server.py": """\
+        def parse_args(parser):
+            parser.add_argument("--page-size", type=int)
+        """,
+}
+
+
+def test_config_contract_catches_planted_drift():
+    findings = _run(_CONFIG_FIXTURE, "config-contract")
+    messages = "\n".join(f.message for f in findings)
+    # Field with no flag, alias or internal marker.
+    assert "config field cache.secret_knob has no CLI flag" in messages
+    # Exclusivity pair with a raise but no pytest.raises test.
+    assert "rejection is untested" in messages
+    # Flag missing from every markdown doc.
+    assert "--page-size appears in no markdown doc" in messages
+
+
+def test_config_contract_accepts_markers_docs_and_tests():
+    fixture = dict(_CONFIG_FIXTURE)
+    fixture["production_stack_tpu/engine/config.py"] += (
+        'INTERNAL_FIELDS = {"cache.secret_knob"}\n')
+    fixture["docs/engine_flags.md"] = (
+        "| `--page-size` | 16 | Tokens per KV page |\n")
+    fixture["tests/test_exclusivity.py"] = textwrap.dedent("""\
+        import pytest
+
+        def test_page_size_conflict(make_config):
+            with pytest.raises(ValueError,
+                               match="conflicts with secret_knob"):
+                make_config(secret_knob=1)
+        """)
+    assert _run(fixture, "config-contract") == []
+
+
+# ---- kv-parity ---------------------------------------------------------
+
+
+def test_kv_parity_catches_uncovered_and_unregistered_impls():
+    findings = _run({
+        "production_stack_tpu/ops/attention.py": """\
+            ATTENTION_IMPLS = {
+                "xla": ("production_stack_tpu.ops.attention",
+                        "paged_real"),
+                "phantom": ("production_stack_tpu.ops.gone",
+                            "paged_phantom"),
+            }
+
+            def paged_real(q):
+                return q
+            """,
+        "production_stack_tpu/ops/new_attention.py": """\
+            def paged_new(q):
+                return q
+            """,
+        "tests/test_int8_parity.py": """\
+            def test_int8_real_impl():
+                assert paged_real
+            """,
+    }, "kv-parity")
+    messages = "\n".join(f.message for f in findings)
+    # Registered impl with no int8-named test referencing it.
+    assert "paged_phantom" in messages
+    # paged_* module that never registered itself.
+    assert ("ops/new_attention.py defines a paged_* entry point"
+            in messages)
+    # The covered impl is NOT among the findings.
+    assert "references paged_real" not in messages
+
+
+# ---- waivers -----------------------------------------------------------
+
+
+def test_valid_waiver_suppresses_the_finding():
+    project = Project.from_sources({
+        "production_stack_tpu/router/w.py":
+            "import requests\n"
+            "requests.get('http://x')  # lint: allow-no-timeout\n",
+    })
+    assert run_rules(project, rules=["no-timeout"]) == []
+
+
+def test_typoed_waiver_fails_loudly():
+    # allow-no-timeoutS: must NOT suppress, and must surface as its
+    # own unknown-waiver finding naming the bad token.
+    project = Project.from_sources({
+        "production_stack_tpu/router/w.py":
+            "import requests\n"
+            "requests.get('http://x')  # lint: allow-no-timeouts\n",
+    })
+    findings = run_rules(project, rules=["no-timeout"])
+    by_rule = {f.rule for f in findings}
+    assert "no-timeout" in by_rule
+    assert "unknown-waiver" in by_rule
+    unknown = [f for f in findings if f.rule == "unknown-waiver"]
+    assert "no-timeouts" in unknown[0].message
+
+
+# ---- framework mechanics -----------------------------------------------
+
+
+def test_parse_error_is_a_finding_not_a_pass():
+    project = Project.from_sources({
+        "production_stack_tpu/router/broken.py": "def oops(:\n",
+    })
+    findings = run_rules(project, rules=["no-timeout"])
+    assert any(f.rule == "parse-error" for f in findings)
+
+
+def test_fingerprint_ignores_line_number_but_not_content():
+    a = Finding(rule="r", path="p.py", line=10, message="m",
+                snippet="requests.get('http://x')")
+    b = Finding(rule="r", path="p.py", line=99, message="m",
+                snippet="  requests.get('http://x')  ")
+    c = Finding(rule="r", path="p.py", line=10, message="m",
+                snippet="requests.post('http://x')")
+    assert a.fingerprint() == b.fingerprint()
+    assert a.fingerprint() != c.fingerprint()
+
+
+# ---- the real tree -----------------------------------------------------
+
+
+def test_repo_tree_is_clean_modulo_baseline():
+    project = Project.from_root(ROOT)
+    findings = run_rules(project)
+    fingerprints = baseline_mod.load_fingerprints(ROOT)
+    new, _ = baseline_mod.split_new(findings, fingerprints)
+    assert not new, (
+        "new staticcheck findings (fix, waive with a justified "
+        "# lint: allow-<rule>, or --update-baseline and review the "
+        "diff):\n" + "\n".join(f.render() for f in new))
+
+
+def test_cli_json_contract(capsys):
+    code = cli_main(["--json", "--root", str(ROOT)])
+    payload = json.loads(capsys.readouterr().out)
+    assert code == 0
+    assert payload["findings"] == []
+    assert set(payload["rules"]) == set(REGISTRY)
+
+
+def test_cli_rejects_unknown_rule(capsys):
+    code = cli_main(["--rule", "not-a-rule", "--root", str(ROOT)])
+    assert code == 2
+    assert "unknown rule" in capsys.readouterr().err
