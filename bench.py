@@ -567,6 +567,241 @@ def run_worker(impl: str, tpu: bool) -> None:
     }))
 
 
+def run_disagg_worker(mode: str) -> None:
+    """Disaggregation A/B worker (docs/disaggregation.md): bursty
+    long-prompt arrivals landing on the same engine that serves steady
+    interactive decode streams (``mode=mono``) vs on a separate
+    prefill-role engine that hands the KV off through a live cache
+    server (``mode=disagg``). Reports the interactive streams' ITL
+    and the long prompts' TTFT — the pair of numbers disaggregation
+    exists to trade between.
+
+    Always runs the tiny-llama CPU config: the phase measures the
+    scheduling interference structure (prefill chunks stalling decode
+    steps), which needs two engines side by side — not a chip number.
+    """
+    import queue as queue_mod
+    import threading
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import numpy as np
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        OffloadConfig,
+        SchedulerConfig,
+        tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/jax-comp-cache")
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    def make_engine(role="both", remote_url=None):
+        return LLMEngine(EngineConfig(
+            model=tiny_model_config("llama"),
+            cache=CacheConfig(page_size=16, num_pages=256),
+            scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=512,
+                                      prefill_chunk_size=64),
+            offload=OffloadConfig(enable=remote_url is not None,
+                                  remote_url=remote_url,
+                                  host_pool_bytes=0),
+            engine_role=role,
+        ))
+
+    cache_stop = None
+    cache_url = None
+    if mode == "disagg":
+        # Live cache server: the KV handoff crosses a real HTTP wire.
+        import asyncio
+
+        from aiohttp import web
+
+        from production_stack_tpu.engine.cache_server import (
+            build_cache_server,
+        )
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+        port_box = {}
+
+        def serve_cache():
+            asyncio.set_event_loop(loop)
+            runner = web.AppRunner(build_cache_server(256 * 1024 ** 2))
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            loop.run_until_complete(site.start())
+            port_box["port"] = site._server.sockets[0].getsockname()[1]
+            started.set()
+            loop.run_forever()
+
+        cache_thread = threading.Thread(target=serve_cache, daemon=True)
+        cache_thread.start()
+        started.wait(10)
+        cache_url = f"http://127.0.0.1:{port_box['port']}"
+        cache_stop = lambda: loop.call_soon_threadsafe(loop.stop)  # noqa: E731
+
+    rng = np.random.RandomState(0)
+    long_prompt_len = 256  # 4 chunked-prefill steps each
+    short_prompt_len = 32
+    duration = float(os.environ.get("BENCH_DISAGG_DURATION_S", "10"))
+    burst_every = 1.5
+    burst_size = 2
+    n_interactive = 3  # steady decode streams (batch leaves 1 slot free)
+
+    inter_samp = lambda: SamplingParams(  # noqa: E731
+        max_tokens=48, temperature=0.0, ignore_eos=True)
+    long_samp = lambda: SamplingParams(  # noqa: E731
+        max_tokens=4, temperature=0.0, ignore_eos=True)
+
+    def prompt(n):
+        return [int(x) for x in rng.randint(1, 30000, size=n)]
+
+    decode_eng = make_engine(
+        role="decode" if mode == "disagg" else "both",
+        remote_url=cache_url)
+    prefill_eng = None
+    work_q: queue_mod.Queue = queue_mod.Queue()
+    done_q: queue_mod.Queue = queue_mod.Queue()
+    stop_flag = threading.Event()
+
+    if mode == "disagg":
+        prefill_eng = make_engine(role="prefill", remote_url=cache_url)
+        # Warm the prefill program shapes outside the measured window.
+        prefill_eng.add_request(prompt(long_prompt_len), long_samp(),
+                                handoff_prefill=True)
+        while prefill_eng.has_work():
+            prefill_eng.step()
+
+        def prefill_loop():
+            pending = {}
+            while not stop_flag.is_set():
+                try:
+                    while True:
+                        p, t0 = work_q.get_nowait()
+                        sid = prefill_eng.add_request(
+                            list(p), long_samp(), handoff_prefill=True)
+                        pending[sid] = (p, t0)
+                except queue_mod.Empty:
+                    pass
+                if not prefill_eng.has_work():
+                    time.sleep(0.002)
+                    continue
+                for out in prefill_eng.step():
+                    if out.finished and out.seq_id in pending:
+                        p, t0 = pending.pop(out.seq_id)
+                        # The first token reaches the client here.
+                        done_q.put((p, out.new_token, t0, time.time()))
+
+        prefill_thread = threading.Thread(target=prefill_loop,
+                                          daemon=True)
+
+    # Warm the decode-side shapes too.
+    decode_eng.generate(prompt(short_prompt_len),
+                        SamplingParams(max_tokens=4, temperature=0.0,
+                                       ignore_eos=True))
+
+    itl = []          # interactive inter-token gaps (s)
+    ttft = []         # long-prompt submit -> first token (s)
+    interactive = {}  # seq_id -> last token wall time (None = none yet)
+    long_pending = {}  # seq_id -> submit time (mono mode)
+    long_done = 0
+    interactive_tokens = 0
+
+    def submit_interactive():
+        sid = decode_eng.add_request(
+            prompt(short_prompt_len), inter_samp())
+        interactive[sid] = None
+
+    for _ in range(n_interactive):
+        submit_interactive()
+    if mode == "disagg":
+        prefill_thread.start()
+
+    start = time.time()
+    next_burst = start + 0.5
+    deadline = start + duration
+    while time.time() < deadline:
+        now = time.time()
+        if now >= next_burst:
+            for _ in range(burst_size):
+                if mode == "disagg":
+                    work_q.put((prompt(long_prompt_len), now))
+                else:
+                    sid = decode_eng.add_request(
+                        prompt(long_prompt_len), long_samp())
+                    long_pending[sid] = now
+            next_burst += burst_every
+        if mode == "disagg":
+            try:
+                while True:
+                    p, first_token, t0, t_first = done_q.get_nowait()
+                    ttft.append(t_first - t0)
+                    decode_eng.add_handoff(list(p), int(first_token),
+                                           long_samp())
+                    long_done += 1
+            except queue_mod.Empty:
+                pass
+        if not decode_eng.has_work():
+            time.sleep(0.001)
+            continue
+        outs = decode_eng.step()
+        now = time.time()
+        for out in outs:
+            if out.seq_id in interactive:
+                if out.new_token is not None:
+                    last = interactive[out.seq_id]
+                    if last is not None:
+                        itl.append(now - last)
+                    interactive[out.seq_id] = now
+                    interactive_tokens += 1
+                if out.finished:
+                    del interactive[out.seq_id]
+                    submit_interactive()
+            elif out.seq_id in long_pending and out.new_token is not None:
+                ttft.append(now - long_pending.pop(out.seq_id))
+                long_done += 1
+
+    stop_flag.set()
+    if mode == "disagg":
+        prefill_thread.join(timeout=5)
+    if cache_stop is not None:
+        cache_stop()
+
+    def pctl(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    itl_p99 = pctl(itl, 0.99) or 0.0
+    print(json.dumps({
+        "metric": f"disagg bench ({mode}): interactive ITL p99 under "
+                  "bursty long-prompt arrivals",
+        "value": round(itl_p99, 4),
+        "unit": "s",
+        "vs_baseline": 0.0,
+        "extra": {
+            "mode": mode,
+            "itl_p50_s": round(pctl(itl, 0.5) or 0.0, 4),
+            "itl_p99_s": round(itl_p99, 4),
+            "ttft_p50_s": round(pctl(ttft, 0.5) or 0.0, 4),
+            "ttft_p99_s": round(pctl(ttft, 0.99) or 0.0, 4),
+            "interactive_tokens": interactive_tokens,
+            "long_requests_finished": long_done,
+        },
+    }))
+
+
 def _spawn_worker(impl: str, tpu: bool, timeout: int, extra_env=None):
     """Run one benchmark worker; returns (result_dict | None, error)."""
     cmd = [sys.executable, os.path.abspath(__file__),
@@ -605,7 +840,10 @@ def _load_baseline() -> float:
 def main() -> None:
     if "--worker" in sys.argv:
         impl = sys.argv[sys.argv.index("--worker") + 1]
-        run_worker(impl, tpu="--tpu" in sys.argv)
+        if impl == "disagg":
+            run_disagg_worker(os.environ.get("BENCH_DISAGG_MODE", "mono"))
+        else:
+            run_worker(impl, tpu="--tpu" in sys.argv)
         return
 
     tpu = _tpu_available()
@@ -717,6 +955,32 @@ def main() -> None:
                         "kv_bytes_per_decode_step",
                         "kv_max_decode_batch"):
                 result["extra"][f"{tag}_{key}"] = ke.get(key)
+
+        # Disaggregated prefill/decode A/B (docs/disaggregation.md):
+        # bursty long-prompt arrivals on the engine serving steady
+        # interactive decode streams, vs handed off to a separate
+        # prefill engine through a live cache server. Always the
+        # tiny CPU config (the phase measures scheduling interference
+        # structure, not a chip number — and two engines on one chip
+        # would fight over HBM). Interactive ITL p99 and long-prompt
+        # TTFT ride in extra under disagg_mono_* / disagg_split_*.
+        for tag, mode in (("disagg_mono", "mono"),
+                          ("disagg_split", "disagg")):
+            sys.stderr.write(f"[bench] running {tag} worker "
+                             f"(timeout {timeout}s)...\n")
+            dg_result, dg_err = _spawn_worker(
+                "disagg", False, timeout,
+                extra_env={"BENCH_DISAGG_MODE": mode,
+                           "JAX_PLATFORMS": "cpu"})
+            if dg_result is None:
+                errors[f"{tag}_error"] = dg_err
+                sys.stderr.write(f"[bench] WARNING: {dg_err}\n")
+                continue
+            de = dg_result.get("extra", {})
+            for key in ("itl_p50_s", "itl_p99_s", "ttft_p50_s",
+                        "ttft_p99_s", "interactive_tokens",
+                        "long_requests_finished"):
+                result["extra"][f"{tag}_{key}"] = de.get(key)
 
     if result is None:
         # Never hang the driver: report the failure as the metric line.

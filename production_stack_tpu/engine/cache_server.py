@@ -109,6 +109,12 @@ def _validate_payload(blob: bytes):
     return None
 
 
+# Upper bound on keys per batched GET: bounds the response to
+# ~max page size x this many blobs and keeps one request from
+# monopolising the store lock.
+BATCH_GET_MAX_KEYS = 1024
+
+
 def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
     store = BlobStore(max_bytes)
 
@@ -134,6 +140,36 @@ def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
             return web.Response(status=200)
         return web.Response(status=404)
 
+    async def batch_get_kv(request: web.Request) -> web.Response:
+        """Many-page GET in one round trip (disagg decode restores:
+        docs/disaggregation.md). Request: msgpack {"keys": [str,...]};
+        response: msgpack {"blobs": [bytes|nil,...]} aligned to the
+        request order, each blob the exact frame stored at PUT (so it
+        was already validated by _validate_payload)."""
+        import msgpack
+        body = await request.read()
+        try:
+            obj = msgpack.unpackb(body)
+        except Exception:
+            return web.json_response(
+                {"error": {"message": "body is not valid msgpack"}},
+                status=400)
+        keys = obj.get("keys") if isinstance(obj, dict) else None
+        if (not isinstance(keys, list)
+                or not all(isinstance(k, str) for k in keys)):
+            return web.json_response(
+                {"error": {"message": "body missing 'keys' list"}},
+                status=400)
+        if len(keys) > BATCH_GET_MAX_KEYS:
+            return web.json_response(
+                {"error": {"message":
+                           f"too many keys (max {BATCH_GET_MAX_KEYS})"}},
+                status=400)
+        blobs = [store.get(k) for k in keys]
+        return web.Response(
+            body=msgpack.packb({"blobs": blobs}),
+            content_type="application/octet-stream")
+
     async def health(request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
@@ -157,6 +193,9 @@ def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
 
     app = web.Application(client_max_size=256 * 1024 ** 2)
     app["store"] = store
+    # Exact route first: /kv/batch_get must never resolve as a page
+    # key (sha256 hex keys cannot collide with it anyway).
+    app.router.add_post("/kv/batch_get", batch_get_kv)
     app.router.add_put("/kv/{key}", put_kv)
     app.router.add_head("/kv/{key}", head_kv)
     app.router.add_get("/kv/{key}", get_kv, allow_head=False)

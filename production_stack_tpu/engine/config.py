@@ -315,8 +315,46 @@ class EngineConfig:
         default_factory=OffloadConfig)
     lora: LoRAConfig = dataclasses.field(default_factory=LoRAConfig)
     seed: int = 0
+    # Disaggregated serving role (docs/disaggregation.md):
+    #   both    -> monolithic engine (default; fully backward
+    #              compatible): serves prefill + decode.
+    #   prefill -> computes prompt KV, ships committed pages over the
+    #              offload wire and answers with a handoff descriptor
+    #              instead of a token stream (POST /v1/disagg/prefill).
+    #   decode  -> accepts handoff submissions (POST
+    #              /v1/disagg/handoff), restores the shipped pages and
+    #              streams decode from the first sampled token.
+    engine_role: str = "both"
+    # Seconds a decode-role engine holds a handoff in AWAITING_KV while
+    # its pages are unreachable (remote tier down) before degrading to
+    # a full prompt recompute. 0 = recompute immediately on a miss.
+    handoff_timeout_s: float = 30.0
 
     def __post_init__(self):
+        if self.engine_role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                "engine_role must be 'prefill', 'decode' or 'both' "
+                f"(got {self.engine_role!r})")
+        if self.handoff_timeout_s < 0:
+            raise ValueError("handoff_timeout_s must be >= 0")
+        if self.engine_role == "prefill":
+            # A prefill-role engine never decodes past the first
+            # sampled token, so decode-side machinery is dead weight
+            # at best and a config lie at worst — reject it loudly.
+            if self.scheduler.speculative_k > 0:
+                raise ValueError(
+                    "engine_role='prefill' is incompatible with "
+                    "speculative_k > 0 (speculation accelerates "
+                    "decode; a prefill-role engine hands off after "
+                    "the first token; docs/disaggregation.md "
+                    "§interactions)")
+            if self.scheduler.async_scheduling:
+                raise ValueError(
+                    "engine_role='prefill' is incompatible with "
+                    "async_scheduling (the overlapped pipeline keeps "
+                    "a decode step in flight; a prefill-role engine "
+                    "has no decode steps; docs/disaggregation.md "
+                    "§interactions)")
         if self.cache.kv_cache_dtype not in ("auto", "bf16", "int8"):
             raise ValueError(
                 "cache.kv_cache_dtype must be 'auto', 'bf16' or "
@@ -461,6 +499,8 @@ EXCLUSIVITY_RULES = (
      "decode_steps"),
     ("scheduler.async_scheduling", "scheduler.speculative_k",
      "speculative_k"),
+    ("engine_role", "scheduler.speculative_k", "engine_role"),
+    ("engine_role", "scheduler.async_scheduling", "engine_role"),
 )
 
 

@@ -88,6 +88,13 @@ class LLMEngine:
         self.offload = None
         if config.offload.enable:
             self._init_offload()
+        # Disaggregated serving (docs/disaggregation.md): descriptor
+        # payloads for completed prefill handoffs (drained by the
+        # server via take_handoff_info) and cumulative role counters.
+        self._handoff_info: Dict[str, dict] = {}
+        self.disagg_prefill_requests = 0
+        self.disagg_decode_requests = 0
+        self.disagg_kv_bytes_shipped = 0
 
     def _init_offload(self) -> None:
         import numpy as np
@@ -143,8 +150,11 @@ class LLMEngine:
         except OutOfPagesError:
             return []
         restored = []
-        for page_id, page_hash in zip(pages, remaining[:n]):
-            payload = self.offload.fetch(page_hash)
+        # One batched round trip for every remote miss in the chain
+        # (POST /kv/batch_get) instead of N sequential GETs.
+        payloads = self.offload.fetch_many(remaining[:n])
+        for page_id, page_hash, payload in zip(
+                pages, remaining[:n], payloads):
             expected_arity = 4 if self.runner.kv_quantized else 2
             if payload is None or len(payload) != expected_arity:
                 # Tier raced an eviction, or a payload with the wrong
@@ -173,7 +183,8 @@ class LLMEngine:
                     sampling: Optional[SamplingParams] = None,
                     seq_id: Optional[str] = None,
                     output_sink=None,
-                    lora_name: Optional[str] = None) -> str:
+                    lora_name: Optional[str] = None,
+                    handoff_prefill: bool = False) -> str:
         sampling = sampling or SamplingParams()
         stop_ids = list(sampling.stop_token_ids)
         if (not sampling.ignore_eos
@@ -208,6 +219,7 @@ class LLMEngine:
             cache_salt=(self.runner.lora_registry.cache_root(lora_id)
                         if lora_id else 0),
             fsm_state=fsm_state,
+            handoff_prefill=handoff_prefill,
         )
         with self._lock:
             self.sequences[seq.seq_id] = seq
@@ -217,6 +229,139 @@ class LLMEngine:
                 self.sequences.pop(seq.seq_id, None)
                 raise
         return seq.seq_id
+
+    def add_handoff(self, prompt_token_ids: List[int],
+                    first_token: int,
+                    sampling: Optional[SamplingParams] = None,
+                    seq_id: Optional[str] = None,
+                    output_sink=None) -> str:
+        """Accept a disaggregated prefill->decode handoff
+        (docs/disaggregation.md): park the sequence in AWAITING_KV
+        until its shipped pages are reachable in an offload tier
+        (or the handoff timeout degrades it to recompute).
+
+        The prefill engine's first sampled token is folded into the
+        prompt exactly like scheduler._preempt folds generated tokens,
+        with ``num_prior_output_tokens = 1`` keeping every budget
+        honest; the caller (server handler) emits that first token to
+        the client itself — this engine streams from token two.
+        """
+        sampling = sampling or SamplingParams()
+        stop_ids = list(sampling.stop_token_ids)
+        if (not sampling.ignore_eos
+                and self.tokenizer.eos_token_id is not None
+                and self.tokenizer.eos_token_id not in stop_ids):
+            stop_ids.append(self.tokenizer.eos_token_id)
+        sampling.stop_token_ids = stop_ids
+        if sampling.guided is not None:
+            raise ValueError(
+                "guided decoding is not supported across a disagg "
+                "handoff (automaton state does not transfer)")
+        orig_max_tokens = sampling.max_tokens
+        seq = Sequence(
+            seq_id=seq_id or f"seq-{uuid.uuid4().hex[:16]}",
+            prompt_token_ids=(list(prompt_token_ids)
+                              + [int(first_token)]),
+            sampling=sampling,
+            output_sink=output_sink,
+            state=SequenceState.AWAITING_KV,
+            num_prior_output_tokens=1,
+            handoff_arrival_time=time.time(),
+        )
+        with self._lock:
+            self.sequences[seq.seq_id] = seq
+            try:
+                self.scheduler.add_sequence(seq)
+            except Exception:
+                self.sequences.pop(seq.seq_id, None)
+                raise
+            # Undo the admission clamp: it counts the folded first
+            # token as prompt, which would end generation one token
+            # earlier than the monolithic path. num_prior_output_tokens
+            # plus the max_model_len finish check already bound this
+            # sequence exactly as a monolithic engine would.
+            sampling.max_tokens = orig_max_tokens
+            self.disagg_decode_requests += 1
+            if self.offload is None:
+                # No tier to restore from: degrade to recompute now.
+                seq.state = SequenceState.WAITING
+                self.metrics.on_handoff_admitted(0.0)
+        return seq.seq_id
+
+    def take_handoff_info(self, seq_id: str) -> Optional[dict]:
+        """Drain the descriptor payload recorded when ``seq_id``
+        finished its prefill handoff (None if it never shipped)."""
+        with self._lock:
+            return self._handoff_info.pop(seq_id, None)
+
+    def _ship_handoff(self, seq: Sequence) -> None:
+        """Prefill-role completion: push the sequence's committed
+        full-page KV to the offload tiers (push-on-prefill-done),
+        record the descriptor payload for the server, and retire the
+        sequence so its pages free for the next prefill burst. Caller
+        holds self._lock."""
+        from production_stack_tpu.engine.kv_cache import (
+            PagedCacheManager,
+        )
+        info = {"num_pages": 0, "kv_bytes": 0, "page_keys": []}
+        if self.offload is not None:
+            hashes = PagedCacheManager.chain_hashes(
+                seq.prompt_token_ids, self.cache_manager.page_size,
+                seq.cache_salt)
+            for page_id, page_hash in zip(seq.pages, hashes):
+                payload = self.runner.read_page(page_id)
+                self.offload.offload_page(page_hash, *payload)
+                info["kv_bytes"] += sum(
+                    int(a.nbytes) for a in payload)
+                info["page_keys"].append(
+                    self.offload.key_for(page_hash))
+            info["num_pages"] = len(info["page_keys"])
+        self._handoff_info[seq.seq_id] = info
+        self.disagg_prefill_requests += 1
+        self.disagg_kv_bytes_shipped += info["kv_bytes"]
+        self.scheduler.finish_handoff(seq)
+
+    def _handoff_kv_ready(self, seq: Sequence) -> Optional[bool]:
+        """Availability of a parked handoff's KV. Pages ship in chain
+        order, so probing the LAST shipped page (one HEAD at most)
+        answers for the whole chain. True/False is definitive; None =
+        tier unreachable (keep waiting until the handoff timeout)."""
+        from production_stack_tpu.engine.kv_cache import (
+            PagedCacheManager,
+        )
+        usable = len(seq.prompt_token_ids) - 1
+        hashes = PagedCacheManager.chain_hashes(
+            seq.prompt_token_ids[:usable],
+            self.cache_manager.page_size, seq.cache_salt)
+        if not hashes:
+            return True  # prompt shorter than a page: pure recompute
+        return self.offload.handoff_ready(hashes[-1])
+
+    def _admit_handoffs(self) -> None:
+        """Flip AWAITING_KV sequences to WAITING once their pages are
+        reachable (the normal first-touch restore path then pulls
+        them), or degrade to recompute on definitive loss / timeout.
+        Either way the request completes — never dropped."""
+        now = time.time()
+        with self._lock:
+            for seq in list(self.scheduler.waiting):
+                if seq.state != SequenceState.AWAITING_KV:
+                    continue
+                ready = self._handoff_kv_ready(seq)
+                if ready is None:
+                    if (now - seq.handoff_arrival_time
+                            < self.config.handoff_timeout_s):
+                        continue
+                    logger.warning(
+                        "Handoff %s timed out waiting for KV; "
+                        "degrading to recompute", seq.seq_id)
+                elif ready is False:
+                    logger.warning(
+                        "Handoff %s KV not in any offload tier; "
+                        "degrading to recompute", seq.seq_id)
+                seq.state = SequenceState.WAITING
+                self.metrics.on_handoff_admitted(
+                    now - seq.handoff_arrival_time)
 
     def register_lora(self, name_or_path: str,
                       name: Optional[str] = None) -> int:
@@ -260,6 +405,8 @@ class LLMEngine:
         host work behind the device step. Single-host only — the
         multihost step bridge broadcasts host-resident numpy payloads.
         """
+        if self.scheduler.num_awaiting_kv:
+            self._admit_handoffs()
         if (self.config.scheduler.async_scheduling
                 and self.runner.bridge is None):
             return self._step_async()
@@ -302,6 +449,14 @@ class LLMEngine:
                     zip(plan.prefill.chunks, sampled)):
                 self.scheduler.on_prefill_executed(chunk, token)
                 if chunk.is_last_chunk:
+                    if (chunk.seq.handoff_prefill
+                            and chunk.seq.state
+                            == SequenceState.RUNNING):
+                        # Disagg prefill role: ship KV + retire
+                        # (unless the first token already finished
+                        # the request — then there is nothing to
+                        # decode and nothing worth shipping).
+                        self._ship_handoff(chunk.seq)
                     outputs.append(self._delta(
                         chunk.seq, token,
                         lp_rows[i] if lp_rows else None))
@@ -509,6 +664,15 @@ class LLMEngine:
                 self.config.scheduler.max_num_seqs
                 * self.config.cache.kv_bytes_per_token(
                     self.config.model),
+            # Disaggregated serving (docs/disaggregation.md).
+            "disagg_prefill_requests_total":
+                self.disagg_prefill_requests,
+            "disagg_decode_requests_total":
+                self.disagg_decode_requests,
+            "disagg_kv_bytes_shipped_total":
+                self.disagg_kv_bytes_shipped,
+            "disagg_awaiting_kv_requests":
+                self.scheduler.num_awaiting_kv,
         }
         if self.offload is not None:
             out.update({

@@ -89,6 +89,12 @@ class EngineMetrics:
         self.pipeline_steps_total = 0
         self.pipeline_ahead_steps_total = 0
         self.async_inflight_depth = 0
+        # Disaggregated serving (docs/disaggregation.md): latency from
+        # a handoff submission arriving at a decode-role engine to the
+        # sequence leaving AWAITING_KV (its pages became reachable or
+        # it degraded to recompute). Always rendered (empty when the
+        # engine never receives handoffs) for a stable scrape surface.
+        self.handoff_latency = Histogram(_TTFT_BUCKETS)
 
     def on_spec_step(self, drafted: int, accepted: int) -> None:
         """One speculative verify step's draft/accept counts."""
@@ -117,6 +123,11 @@ class EngineMetrics:
     def set_inflight_depth(self, depth: int) -> None:
         with self._lock:
             self.async_inflight_depth = depth
+
+    def on_handoff_admitted(self, latency_s: float) -> None:
+        """One disagg handoff left AWAITING_KV after ``latency_s``."""
+        with self._lock:
+            self.handoff_latency.observe(max(0.0, latency_s))
 
     def on_decode_tokens(self, seq, n_tokens: int,
                          now: float) -> None:
@@ -174,6 +185,8 @@ class EngineMetrics:
                 "vllm:request_queue_time_seconds")
             lines += self.prefill_time.render(
                 "vllm:request_prefill_time_seconds")
+            lines += self.handoff_latency.render(
+                "vllm:disagg_handoff_latency_seconds")
             lines += [
                 "# TYPE vllm:prompt_tokens_total counter",
                 f"vllm:prompt_tokens_total {self.prompt_tokens_total}",

@@ -182,13 +182,70 @@ class RemoteKVClient:
             return None
 
     def contains(self, key: str) -> bool:
+        return self.probe(key) is True
+
+    def probe(self, key: str) -> Optional[bool]:
+        """``contains`` with errors distinguished: True/False is a
+        definitive server answer, None a transport failure (tier
+        unreachable right now). Disagg handoff admission
+        (engine._admit_handoffs) degrades to recompute immediately on
+        False but keeps waiting (until the handoff timeout) on None."""
         try:
             resp = self._session.head(
                 f"{self.base_url}/kv/{key}", timeout=self.timeout_s
             )
             return resp.status_code == 200
         except Exception:
-            return False
+            return None
+
+    def batch_get(self, keys: List[str]) -> Dict[str, PagePayload]:
+        """Fetch many pages in one round trip (POST /kv/batch_get).
+
+        Returns only the keys the server holds; falls back to
+        sequential GETs against an older server that lacks the
+        endpoint. The response carries the exact blobs stored at PUT
+        (already validated server-side); the dtype allowlist is
+        re-checked here before any buffer is interpreted.
+        """
+        import msgpack
+        if not keys:
+            return {}
+        try:
+            resp = self._session.post(
+                f"{self.base_url}/kv/batch_get",
+                data=msgpack.packb({"keys": list(keys)}),
+                timeout=self.timeout_s,
+            )
+            if resp.status_code in (404, 405):
+                out = {}
+                for key in keys:
+                    payload = self.get(key)
+                    if payload is not None:
+                        out[key] = payload
+                return out
+            if resp.status_code != 200:
+                return {}
+            obj = msgpack.unpackb(resp.content)
+            blobs = obj.get("blobs")
+            if not isinstance(blobs, list) or len(blobs) != len(keys):
+                return {}
+            out = {}
+            for key, blob in zip(keys, blobs):
+                if blob is None:
+                    continue
+                arrays = msgpack.unpackb(blob)["arrays"]
+                if any(a["dtype"] not in ALLOWED_WIRE_DTYPES
+                       for a in arrays):
+                    continue
+                out[key] = tuple(
+                    np.frombuffer(a["data"], _np_dtype(a["dtype"]))
+                    .reshape(tuple(a["shape"]))
+                    for a in arrays
+                )
+            return out
+        except Exception as e:
+            logger.warning("Remote KV batch_get failed: %s", e)
+            return {}
 
 
 class KVOffloadManager:
@@ -220,6 +277,22 @@ class KVOffloadManager:
 
     def _key(self, page_hash: PageHash) -> str:
         return _stable_key(page_hash, self.kv_dtype)
+
+    def key_for(self, page_hash: PageHash) -> str:
+        """Public tier key for a chain hash (handoff descriptors name
+        shipped pages by these keys)."""
+        return self._key(page_hash)
+
+    def handoff_ready(self, page_hash: PageHash) -> Optional[bool]:
+        """Is a shipped page reachable in some tier? True/False is
+        definitive; None means the remote tier could not be probed
+        (transient) — see RemoteKVClient.probe."""
+        key = self._key(page_hash)
+        if self.host.contains(key):
+            return True
+        if self.remote is None:
+            return False
+        return self.remote.probe(key)
 
     def offload_page(self, page_hash: PageHash,
                      *payload: np.ndarray) -> None:
@@ -255,6 +328,24 @@ class KVOffloadManager:
                 self.host.put(key, payload)
                 return payload
         return None
+
+    def fetch_many(self, hashes: List[PageHash]) -> List[
+            Optional[PagePayload]]:
+        """Payloads for ``hashes``, order-aligned (None = miss). Host
+        hits serve locally; ALL remote misses go out as one batch_get
+        round trip, and fetched pages promote into the host tier."""
+        keys = [self._key(h) for h in hashes]
+        out: List[Optional[PagePayload]] = [
+            self.host.get(k) for k in keys
+        ]
+        missing = [k for k, p in zip(keys, out) if p is None]
+        if missing and self.remote is not None:
+            fetched = self.remote.batch_get(missing)
+            for i, key in enumerate(keys):
+                if out[i] is None and key in fetched:
+                    out[i] = fetched[key]
+                    self.host.put(key, fetched[key])
+        return out
 
     def stats(self) -> Dict[str, float]:
         total = self.host.hits + self.host.misses

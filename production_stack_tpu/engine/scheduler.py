@@ -167,7 +167,21 @@ class Scheduler:
 
     @property
     def num_waiting(self) -> int:
+        # Includes AWAITING_KV handoffs: they occupy a queue slot and
+        # belong in num_requests_waiting (docs/disaggregation.md).
         return len(self.waiting)
+
+    @property
+    def num_awaiting_kv(self) -> int:
+        return sum(1 for s in self.waiting
+                   if s.state == SequenceState.AWAITING_KV)
+
+    def _has_plannable_waiting(self) -> bool:
+        """Waiting work prefill could actually plan now — AWAITING_KV
+        handoffs are parked until the engine admits them, so they must
+        not trigger prefill planning or break the async pipeline."""
+        return any(s.state != SequenceState.AWAITING_KV
+                   for s in self.waiting)
 
     @property
     def num_running(self) -> int:
@@ -180,7 +194,8 @@ class Scheduler:
 
     def plan_step(self) -> StepPlan:
         want_prefill = bool(
-            self.waiting and len(self.running) < self.config.max_num_seqs
+            self._has_plannable_waiting()
+            and len(self.running) < self.config.max_num_seqs
         )
         want_decode = bool(self.running)
         if want_prefill and want_decode:
@@ -288,7 +303,8 @@ class Scheduler:
           step in flight: the victim's pages are inputs of the
           running program).
         """
-        if self.waiting and len(self.running) < self.config.max_num_seqs:
+        if (self._has_plannable_waiting()
+                and len(self.running) < self.config.max_num_seqs):
             return None
         rows: List[Optional[Sequence]] = []
         any_live = False
@@ -354,6 +370,11 @@ class Scheduler:
             seq = self.waiting[idx]
             if seq.state == SequenceState.ABORTED:
                 del self.waiting[idx]
+                continue
+            if seq.state == SequenceState.AWAITING_KV:
+                # Parked handoff: its KV pages are not reachable yet
+                # (engine._admit_handoffs flips it to WAITING).
+                idx += 1
                 continue
             if (len(self.running) + admitting
                     >= self.config.max_num_seqs):
@@ -537,6 +558,14 @@ class Scheduler:
             seq.first_token_time = time.time()
             self.running.append(seq)
             self._append_token(seq, sampled_token)
+
+    def finish_handoff(self, seq: Sequence) -> None:
+        """Disagg prefill handoff complete (the engine already shipped
+        the committed KV to the offload tier): retire the sequence so
+        its pages free immediately for the next prefill burst."""
+        if seq in self.running:
+            self.running.remove(seq)
+        self._finish(seq, FinishReason.HANDOFF)
 
     def on_spec_executed(self, seq: Sequence) -> None:
         """Post-verify accounting rollback (docs/speculative.md).

@@ -65,6 +65,12 @@ class SamplingParams:
 
 class SequenceState(enum.Enum):
     WAITING = "waiting"  # queued, prompt not (fully) prefilled
+    # Disaggregated handoff admission (docs/disaggregation.md): the
+    # sequence arrived via a prefill->decode handoff and is parked
+    # until its KV pages are reachable in an offload tier (or the
+    # handoff timeout elapses and it degrades to recompute). Counted
+    # in num_requests_waiting; skipped by prefill planning.
+    AWAITING_KV = "awaiting_kv"
     RUNNING = "running"  # decoding
     FINISHED = "finished"
     ABORTED = "aborted"
@@ -74,6 +80,11 @@ class FinishReason(str, enum.Enum):
     STOP = "stop"
     LENGTH = "length"
     ABORT = "abort"
+    # Disaggregated prefill role: the engine computed the prompt KV,
+    # shipped it to the offload tier and retired the sequence after
+    # the first sampled token; decoding continues on a decode-role
+    # engine (docs/disaggregation.md).
+    HANDOFF = "handoff"
 
 
 @dataclass
@@ -117,6 +128,14 @@ class Sequence:
     # (max_tokens, min_tokens, seeded-sampling emitted index) must
     # count these or a preempted sequence restarts its windows.
     num_prior_output_tokens: int = 0
+    # Disaggregated serving (docs/disaggregation.md): a prefill-role
+    # request finishes after the first sampled token — the engine
+    # ships the committed KV pages to the offload tier and returns a
+    # handoff descriptor instead of decoding.
+    handoff_prefill: bool = False
+    # Decode-side handoff bookkeeping: when the sequence was parked in
+    # AWAITING_KV (admission latency = admit time - this).
+    handoff_arrival_time: Optional[float] = None
 
     @property
     def num_generated(self) -> int:

@@ -88,12 +88,23 @@ class AsyncEngine:
             except queue.Empty:
                 item = None
             if item is not None:
-                prompt, sampling, seq_id, lora_name = item
+                seq_id = item["seq_id"]
                 try:
-                    self.engine.add_request(
-                        prompt, sampling, seq_id=seq_id,
-                        lora_name=lora_name,
-                    )
+                    if item.get("kind") == "handoff":
+                        # Disagg decode role: park until the shipped
+                        # KV is reachable (engine.add_handoff).
+                        self.engine.add_handoff(
+                            item["prompt"], item["first_token"],
+                            item["sampling"], seq_id=seq_id,
+                        )
+                    else:
+                        self.engine.add_request(
+                            item["prompt"], item["sampling"],
+                            seq_id=seq_id,
+                            lora_name=item.get("lora_name"),
+                            handoff_prefill=item.get(
+                                "handoff_prefill", False),
+                        )
                 except Exception as e:
                     # Queue full / invalid request: fail THIS request,
                     # never the engine loop.
@@ -133,11 +144,33 @@ class AsyncEngine:
 
     async def submit(self, prompt: List[int], sampling: SamplingParams,
                      lora_name: Optional[str] = None,
+                     handoff_prefill: bool = False,
                      ) -> tuple[str, asyncio.Queue]:
         seq_id = f"seq-{uuid.uuid4().hex[:16]}"
         stream: asyncio.Queue = asyncio.Queue()
         self._streams[seq_id] = stream
-        self._submit_q.put((prompt, sampling, seq_id, lora_name))
+        self._submit_q.put({
+            "kind": "request", "prompt": prompt, "sampling": sampling,
+            "seq_id": seq_id, "lora_name": lora_name,
+            "handoff_prefill": handoff_prefill,
+        })
+        self._wakeup.set()
+        return seq_id, stream
+
+    async def submit_handoff(self, prompt: List[int], first_token: int,
+                             sampling: SamplingParams,
+                             ) -> tuple[str, asyncio.Queue]:
+        """Submit a disagg handoff descriptor's sequence
+        (docs/disaggregation.md); the stream carries tokens FROM THE
+        SECOND onward — the caller already has the first."""
+        seq_id = f"seq-{uuid.uuid4().hex[:16]}"
+        stream: asyncio.Queue = asyncio.Queue()
+        self._streams[seq_id] = stream
+        self._submit_q.put({
+            "kind": "handoff", "prompt": prompt,
+            "first_token": first_token, "sampling": sampling,
+            "seq_id": seq_id,
+        })
         self._wakeup.set()
         return seq_id, stream
 
@@ -295,6 +328,29 @@ def _validate_sampling(p: SamplingParams) -> None:
         raise ValueError(
             f"min_tokens must be in [0, max_tokens], got "
             f"{p.min_tokens} with max_tokens {p.max_tokens}")
+
+
+def _sampling_to_wire(p: SamplingParams) -> dict:
+    """SamplingParams -> JSON-safe dict for a handoff descriptor."""
+    d = dict(vars(p))
+    if d.get("logit_bias"):
+        # JSON object keys are strings; _sampling_from_wire restores
+        # the int token ids.
+        d["logit_bias"] = {str(k): v
+                           for k, v in d["logit_bias"].items()}
+    return d
+
+
+def _sampling_from_wire(d: dict) -> SamplingParams:
+    """Inverse of _sampling_to_wire; unknown keys are dropped so a
+    newer prefill engine can hand off to an older decode engine."""
+    d = dict(d)
+    lb = d.get("logit_bias")
+    if lb:
+        d["logit_bias"] = {int(k): float(v) for k, v in lb.items()}
+    allowed = {f.name for f in dataclasses.fields(SamplingParams)}
+    return SamplingParams(**{k: v for k, v in d.items()
+                             if k in allowed})
 
 
 class _StopStringScanner:
@@ -860,6 +916,281 @@ class EngineServer:
             raise
         return resp
 
+    # -- disaggregated serving (docs/disaggregation.md) ---------------------
+
+    async def disagg_prefill(self, request: web.Request):
+        """POST /v1/disagg/prefill: run the prompt through the normal
+        chunked-prefill path, ship the committed KV pages to the
+        offload tiers (push-on-prefill-done) and return the handoff
+        descriptor a decode-role engine resumes from. The first
+        sampled token rides the descriptor — it is never recomputed.
+
+        Any engine can serve this (the role gates routing, not
+        capability); without an offload tier the descriptor ships zero
+        pages and the decode side recomputes (degraded, still exact).
+        """
+        body = await self._json_body(request)
+        messages = body.get("messages")
+        chat = isinstance(messages, list)
+        if chat:
+            prompt = render_chat_prompt(
+                self.tokenizer, messages,
+                chat_template=self.chat_template)
+        else:
+            prompt_in = body.get("prompt", "")
+            if (isinstance(prompt_in, list) and prompt_in
+                    and isinstance(prompt_in[0], int)):
+                prompt = list(prompt_in)
+            elif isinstance(prompt_in, list):
+                prompt = self.tokenizer.encode("".join(prompt_in))
+            else:
+                prompt = self.tokenizer.encode(str(prompt_in))
+        try:
+            sampling = _sampling_from_body(
+                body, self.engine.config.scheduler.max_model_len,
+                vocab_size=self.engine.config.model.vocab_size,
+            )
+        except (ValueError, TypeError) as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        if (sampling.guided is not None or sampling.logprobs
+                or body.get("model") in self.engine.lora_names()):
+            # Monolithic-only features: guided automaton state and
+            # first-token logprobs do not transfer across a handoff,
+            # and adapter cache salts are process-local. The router
+            # never disagg-routes these; a direct caller gets 400.
+            return web.json_response(
+                {"error": {"message": (
+                    "request cannot be disaggregated (guided "
+                    "decoding, logprobs and LoRA adapters are "
+                    "monolithic-only)"),
+                    "type": "invalid_request_error"}},
+                status=400,
+            )
+        max_prompt = self.engine.config.scheduler.max_model_len - 1
+        if len(prompt) > max_prompt:
+            return web.json_response(
+                {"error": {"message": (
+                    f"Prompt is {len(prompt)} tokens; maximum is "
+                    f"{max_prompt}"),
+                    "type": "invalid_request_error"}},
+                status=400,
+            )
+        seq_id, stream = await self.async_engine.submit(
+            prompt, sampling, handoff_prefill=True)
+        try:
+            out = await stream.get()
+        finally:
+            self.async_engine.finish_stream(seq_id)
+        if out.new_token is None and out.finish_reason == "abort":
+            return web.json_response(
+                {"error": {"message":
+                           "prefill engine rejected the request"}},
+                status=503, headers={"Retry-After": "1"},
+            )
+        info = (self.engine.take_handoff_info(seq_id)
+                or {"num_pages": 0, "kv_bytes": 0, "page_keys": []})
+        descriptor = {
+            "version": 1,
+            "request_id": seq_id,
+            "chat": chat,
+            "model": self.model_name,
+            "token_ids": list(prompt),
+            "first_token": out.new_token,
+            # Non-None when the first token already finished the
+            # request (stop/length): the decode side then emits that
+            # single token and never touches its engine.
+            "finish_reason": (out.finish_reason
+                              if out.finish_reason != "handoff"
+                              else None),
+            "kv_dtype": self.engine.config.cache.resolved_kv_dtype(),
+            "page_keys": info["page_keys"],
+            "num_pages": info["num_pages"],
+            "kv_bytes": info["kv_bytes"],
+            "sampling": _sampling_to_wire(sampling),
+        }
+        return web.json_response({"descriptor": descriptor})
+
+    async def disagg_handoff(self, request: web.Request):
+        """POST /v1/disagg/handoff: resume decoding from a prefill
+        engine's descriptor. Emits OpenAI chunks (or one JSON
+        completion), starting with the descriptor's first sampled
+        token; the engine restores the shipped KV pages (AWAITING_KV)
+        or degrades to recompute — the request always completes."""
+        body = await self._json_body(request)
+        desc = body.get("descriptor")
+        if not isinstance(desc, dict):
+            return web.json_response(
+                {"error": {"message": "'descriptor' object is "
+                                      "required"}}, status=400)
+        token_ids = desc.get("token_ids")
+        first_token = desc.get("first_token")
+        if (not isinstance(token_ids, list)
+                or not all(isinstance(t, int) for t in token_ids)
+                or not isinstance(first_token, int)):
+            return web.json_response(
+                {"error": {"message": "descriptor missing "
+                                      "token_ids/first_token"}},
+                status=400)
+        my_dtype = self.engine.config.cache.resolved_kv_dtype()
+        desc_dtype = desc.get("kv_dtype") or my_dtype
+        if desc_dtype != my_dtype:
+            # 409: this pod can NEVER restore those pages (tier keys
+            # are dtype-namespaced) — the router stops retrying the
+            # decode pool and falls back to a monolithic recompute.
+            return web.json_response(
+                {"error": {"message": (
+                    f"handoff KV not restorable here (descriptor "
+                    f"kv_dtype {desc_dtype!r}, engine "
+                    f"{my_dtype!r})")}},
+                status=409)
+        try:
+            sampling = _sampling_from_wire(desc.get("sampling") or {})
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message":
+                           f"bad descriptor sampling: {e}"}},
+                status=400)
+        chat = bool(desc.get("chat", True))
+        stream_mode = bool(body.get("stream", False))
+        created = int(time.time())
+        rid = (("chatcmpl-" if chat else "cmpl-")
+               + uuid.uuid4().hex[:16])
+        finish_hint = desc.get("finish_reason")
+        seq_id: Optional[str] = None
+        stream: Optional[asyncio.Queue] = None
+        if not finish_hint and sampling.max_tokens > 1:
+            seq_id, stream = await self.async_engine.submit_handoff(
+                token_ids, first_token, sampling)
+        # Peek the first engine event so a rejected submission (queue
+        # full) surfaces as a retryable 503, not a stream that aborts
+        # after the headers already went out.
+        first_out = None
+        if stream is not None:
+            first_out = await stream.get()
+            if (first_out.finished and first_out.new_token is None
+                    and first_out.finish_reason == "abort"):
+                self.async_engine.finish_stream(seq_id)
+                return web.json_response(
+                    {"error": {"message":
+                               "decode engine rejected the handoff"}},
+                    status=503, headers={"Retry-After": "1"},
+                )
+
+        async def produce(on_text):
+            """Decode + stop-scan the token stream (first token from
+            the descriptor, rest from the engine); returns
+            (completion_tokens, finish_reason)."""
+            decoder = self._delta_decoder()
+            scanner = _StopStringScanner(sampling.stop_strings)
+            n_tokens = 1
+            try:
+                await on_text(scanner.feed(decoder(first_token)))
+                if scanner.stopped:
+                    if seq_id is not None:
+                        self.async_engine.abort(seq_id)
+                    return n_tokens, "stop"
+                if stream is None:
+                    tail = scanner.feed(decoder(None, flush=True))
+                    await on_text(tail + scanner.flush())
+                    return n_tokens, finish_hint or "length"
+                out = first_out
+                while True:
+                    if out.new_token is not None:
+                        n_tokens += 1
+                        await on_text(
+                            scanner.feed(decoder(out.new_token)))
+                        if scanner.stopped:
+                            self.async_engine.abort(seq_id)
+                            return n_tokens, "stop"
+                    if out.finished:
+                        finish = out.finish_reason or "stop"
+                        tail = scanner.feed(decoder(None, flush=True))
+                        await on_text(tail + scanner.flush())
+                        return (n_tokens,
+                                "stop" if scanner.stopped else finish)
+                    out = await stream.get()
+            finally:
+                if seq_id is not None:
+                    self.async_engine.finish_stream(seq_id)
+
+        if not stream_mode:
+            pieces: List[str] = []
+
+            async def collect(t):
+                if t:
+                    pieces.append(t)
+
+            try:
+                n_tokens, finish = await produce(collect)
+            except BaseException:
+                if seq_id is not None:
+                    self.async_engine.abort(seq_id)
+                raise
+            text = "".join(pieces)
+            if chat:
+                choice = {"index": 0,
+                          "message": {"role": "assistant",
+                                      "content": text},
+                          "finish_reason": finish}
+                obj = "chat.completion"
+            else:
+                choice = {"index": 0, "text": text,
+                          "finish_reason": finish}
+                obj = "text_completion"
+            return web.json_response({
+                "id": rid, "object": obj, "created": created,
+                "model": self.model_name, "choices": [choice],
+                "usage": _usage(len(token_ids), n_tokens),
+            })
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+
+        def sse(payload: dict) -> bytes:
+            return f"data: {json.dumps(payload)}\n\n".encode()
+
+        def chunk(delta: Optional[str], finish: Optional[str],
+                  first: bool = False) -> dict:
+            if chat:
+                d: Dict[str, Any] = {}
+                if first:
+                    d["role"] = "assistant"
+                if delta:
+                    d["content"] = delta
+                choice = {"index": 0, "delta": d,
+                          "finish_reason": finish}
+                obj = "chat.completion.chunk"
+            else:
+                choice = {"index": 0, "text": delta or "",
+                          "finish_reason": finish}
+                obj = "text_completion"
+            return {"id": rid, "object": obj, "created": created,
+                    "model": self.model_name, "choices": [choice]}
+
+        async def emit(t):
+            if t:
+                await resp.write(sse(chunk(t, None)))
+
+        try:
+            if chat:
+                await resp.write(sse(chunk(None, None, first=True)))
+            _, finish = await produce(emit)
+            await resp.write(sse(chunk(None, finish)))
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except BaseException:
+            if seq_id is not None:
+                self.async_engine.abort(seq_id)
+            raise
+        return resp
+
     async def embeddings(self, request: web.Request):
         """OpenAI /v1/embeddings over the served model's hidden states."""
         from production_stack_tpu.engine.embeddings import (
@@ -1034,7 +1365,13 @@ class EngineServer:
         return web.json_response({"object": "list", "data": data})
 
     async def health(self, request: web.Request):
-        return web.json_response({"status": "ok"})
+        # ``role`` feeds the router's role-aware discovery
+        # (router/service_discovery.py probes it; absent on older
+        # engines -> treated as "both").
+        return web.json_response({
+            "status": "ok",
+            "role": self.engine.config.engine_role,
+        })
 
     async def profiler_start(self, request: web.Request):
         """Start a JAX profiler trace (view in TensorBoard/XProf).
@@ -1102,6 +1439,24 @@ class EngineServer:
         lines.append("# TYPE vllm:engine_kv_cache_dtype gauge")
         lines.append("vllm:engine_kv_cache_dtype{kv_dtype=\""
                      f"{kv_dtype}\"}} 1.0")
+        # Disaggregated serving (docs/disaggregation.md): per-role
+        # request counters, KV bytes shipped on handoffs, and the
+        # AWAITING_KV admission depth.
+        lines.append("# TYPE vllm:disagg_prefill_requests_total "
+                     "counter")
+        lines.append("vllm:disagg_prefill_requests_total "
+                     f"{float(stats['disagg_prefill_requests_total'])}")
+        lines.append("# TYPE vllm:disagg_decode_requests_total "
+                     "counter")
+        lines.append("vllm:disagg_decode_requests_total "
+                     f"{float(stats['disagg_decode_requests_total'])}")
+        lines.append("# TYPE vllm:disagg_kv_bytes_shipped_total "
+                     "counter")
+        lines.append("vllm:disagg_kv_bytes_shipped_total "
+                     f"{float(stats['disagg_kv_bytes_shipped_total'])}")
+        lines.append("# TYPE vllm:disagg_awaiting_kv_requests gauge")
+        lines.append("vllm:disagg_awaiting_kv_requests "
+                     f"{float(stats['disagg_awaiting_kv_requests'])}")
         # vLLM-parity request-latency histograms + token counters.
         lines.extend(self.engine.metrics.render())
         lines.append("")
@@ -1112,6 +1467,8 @@ class EngineServer:
         app = web.Application(client_max_size=1024 ** 3)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/disagg/prefill", self.disagg_prefill)
+        app.router.add_post("/v1/disagg/handoff", self.disagg_handoff)
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/score", self.score)
         app.router.add_post("/score", self.score)
@@ -1163,10 +1520,15 @@ def _resolve_async_scheduling(args) -> bool:
     stays off where the pipeline cannot run: multi-step bursts and
     speculative decoding already amortize the host round trip on
     device (config validation rejects an explicit 'on' there), and
-    the multihost step bridge broadcasts host-resident payloads."""
+    the multihost step bridge broadcasts host-resident payloads.
+    A prefill-role engine (docs/disaggregation.md) has no decode
+    steps to overlap, so 'auto' resolves off there — only an
+    explicit 'on' is a config error."""
     if args.async_scheduling == "on":
         return True
     if args.async_scheduling == "off":
+        return False
+    if getattr(args, "engine_role", "both") == "prefill":
         return False
     from production_stack_tpu.engine.model_runner import (
         async_scheduling_eligible,
@@ -1266,6 +1628,8 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             max_lora_rank=args.max_lora_rank,
         ),
         seed=args.seed,
+        engine_role=args.engine_role,
+        handoff_timeout_s=args.handoff_timeout_s,
     )
     engine = LLMEngine(config, mesh=mesh, params=params,
                        tokenizer=tokenizer)
@@ -1405,6 +1769,20 @@ def parse_args(argv=None):
     parser.add_argument("--seed", type=int, default=0,
                         help="Base RNG seed for sampled requests "
                              "without a per-request seed")
+    parser.add_argument("--engine-role", default="both",
+                        choices=["prefill", "decode", "both"],
+                        help="Disaggregated serving role "
+                             "(docs/disaggregation.md): 'prefill' "
+                             "computes prompt KV and hands off via "
+                             "the offload wire, 'decode' resumes "
+                             "handoffs, 'both' (default) serves "
+                             "monolithically. Advertised via /health "
+                             "for role-aware routing")
+    parser.add_argument("--handoff-timeout-s", type=float, default=30.0,
+                        help="How long a decode-role engine holds a "
+                             "handoff in AWAITING_KV waiting for an "
+                             "unreachable offload tier before "
+                             "degrading to full recompute")
     return parser.parse_args(argv)
 
 

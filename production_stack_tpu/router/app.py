@@ -314,6 +314,8 @@ def initialize_all(app: web.Application, args) -> None:
             "static",
             urls=parse_comma_separated_urls(args.static_backends),
             models=parse_comma_separated_values(args.static_models) or None,
+            roles=parse_comma_separated_values(
+                getattr(args, "static_roles", None)) or None,
         )
     else:
         initialize_service_discovery(

@@ -28,6 +28,13 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--static-models", default=None,
         help="Comma-separated model names, aligned with --static-backends",
     )
+    parser.add_argument(
+        "--static-roles", default=None,
+        help="Comma-separated engine roles (prefill|decode|both), aligned "
+             "with --static-backends; enables two-hop disaggregated "
+             "dispatch when both a prefill and a decode backend exist "
+             "(docs/disaggregation.md)",
+    )
     parser.add_argument("--k8s-namespace", default="default")
     parser.add_argument("--k8s-port", type=int, default=8000)
     parser.add_argument("--k8s-label-selector", default="")
@@ -151,6 +158,16 @@ def validate_args(args: argparse.Namespace) -> None:
             raise ValueError(
                 "--static-models must align with --static-backends"
             )
+        roles = parse_comma_separated_values(args.static_roles)
+        if roles and len(roles) != len(urls):
+            raise ValueError(
+                "--static-roles must align with --static-backends"
+            )
+        for role in roles or []:
+            if role not in ("prefill", "decode", "both"):
+                raise ValueError(
+                    "--static-roles values must be prefill, decode or both"
+                )
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("--session-key is required with session routing")
     if args.max_retries < 0:

@@ -54,6 +54,17 @@ def usable_endpoints(endpoints: List[EndpointInfo],
     return [ep for ep in pool if mgr.endpoint_available(ep.url)]
 
 
+def filter_by_role(endpoints: List[EndpointInfo],
+                   role: str) -> List[EndpointInfo]:
+    """Endpoints deployed as exactly *role*. Disagg dispatch
+    (request_service._route_disagg) engages only when both a strict
+    'prefill' and a strict 'decode' pool are non-empty; 'both'
+    (monolithic) endpoints never join either hop — they serve the
+    fallback path instead."""
+    return [ep for ep in endpoints
+            if getattr(ep, "role", "both") == role]
+
+
 class RoutingLogic(str, enum.Enum):
     ROUND_ROBIN = "roundrobin"
     SESSION_BASED = "session"

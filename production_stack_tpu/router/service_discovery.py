@@ -34,6 +34,11 @@ class EndpointInfo:
     # probed endpoints set wildcard=False so a model list that is
     # *authoritatively* empty serves nothing instead of everything.
     wildcard: bool = True
+    # Disaggregated-serving deployment role ("prefill" | "decode" |
+    # "both", docs/disaggregation.md). Any role can serve any request —
+    # the role only steers the router's two-hop disagg dispatch — so
+    # engines that predate role reporting default to "both".
+    role: str = "both"
 
     def serves_model(self, model: str) -> bool:
         if model in self.model_names:
@@ -83,17 +88,30 @@ class StaticServiceDiscovery(ServiceDiscovery):
     """Fixed backend list from --static-backends / --static-models flags."""
 
     def __init__(self, urls: List[str],
-                 models: Optional[List[str]] = None):
+                 models: Optional[List[str]] = None,
+                 roles: Optional[List[str]] = None):
         if models and len(models) != len(urls):
             raise ValueError(
                 "static models list must match static backends list"
             )
+        if roles and len(roles) != len(urls):
+            raise ValueError(
+                "static roles list must match static backends list"
+            )
+        if roles:
+            for role in roles:
+                if role not in ("prefill", "decode", "both"):
+                    raise ValueError(
+                        f"static role must be 'prefill', 'decode' or "
+                        f"'both' (got {role!r})"
+                    )
         now = time.time()
         self._endpoints = [
             EndpointInfo(
                 url=url,
                 model_names=[models[i]] if models else [],
                 added_timestamp=now,
+                role=roles[i] if roles else "both",
             )
             for i, url in enumerate(urls)
         ]
@@ -178,6 +196,22 @@ class K8sServiceDiscovery(ServiceDiscovery):
             logger.warning("Model probe failed for %s: %s", url, e)
             return None
 
+    @classmethod
+    def _probe_role(cls, url: str) -> str:
+        """Engine role reported by ``GET /health`` ("prefill" |
+        "decode" | "both"). Engines that predate disaggregation (or a
+        failed probe) default to "both": any engine can serve any
+        request, the role only enables two-hop disagg dispatch."""
+        try:
+            resp = requests.get(
+                f"{url}/health", timeout=cls._MODEL_PROBE_TIMEOUT_S
+            )
+            resp.raise_for_status()
+            role = resp.json().get("role")
+        except Exception:
+            return "both"
+        return role if role in ("prefill", "decode", "both") else "both"
+
     def _reprobe_loop(self) -> None:
         while self._running:
             time.sleep(self._REPROBE_TICK_S)
@@ -199,6 +233,7 @@ class K8sServiceDiscovery(ServiceDiscovery):
             ]
         for name, url, attempts, gen in due:
             models = self._probe_models(url)
+            role = self._probe_role(url) if models is not None else "both"
             with self._lock:
                 current = self._pending_probe.get(name)
                 if current is None or current[3] != gen:
@@ -207,7 +242,7 @@ class K8sServiceDiscovery(ServiceDiscovery):
                     del self._pending_probe[name]
                     self._endpoints[name] = EndpointInfo(
                         url=url, model_names=models, pod_name=name,
-                        wildcard=False,
+                        wildcard=False, role=role,
                     )
                     logger.info("Engine pod up after re-probe: "
                                 "%s -> %s (%s)", name, url, models)
@@ -255,6 +290,8 @@ class K8sServiceDiscovery(ServiceDiscovery):
                 known = self._endpoints.get(name)
             if known is None or known.url != url:
                 models = self._probe_models(url)
+                role = (self._probe_role(url) if models is not None
+                        else "both")
                 with self._lock:
                     if models is None:
                         # Keep the pod out of rotation until a probe
@@ -268,7 +305,7 @@ class K8sServiceDiscovery(ServiceDiscovery):
                         self._pending_probe.pop(name, None)
                         self._endpoints[name] = EndpointInfo(
                             url=url, model_names=models, pod_name=name,
-                            wildcard=False,
+                            wildcard=False, role=role,
                         )
                 if models is not None:
                     logger.info("Engine pod up: %s -> %s (%s)",
@@ -305,7 +342,8 @@ def initialize_service_discovery(discovery_type: str,
     dtype = ServiceDiscoveryType(discovery_type)
     if dtype == ServiceDiscoveryType.STATIC:
         holder.instance = StaticServiceDiscovery(
-            urls=kwargs["urls"], models=kwargs.get("models")
+            urls=kwargs["urls"], models=kwargs.get("models"),
+            roles=kwargs.get("roles"),
         )
     else:
         holder.instance = K8sServiceDiscovery(

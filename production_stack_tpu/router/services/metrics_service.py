@@ -96,6 +96,25 @@ engine_kv_cache_dtype = Gauge(
     "vllm:engine_kv_cache_dtype",
     "Engine-reported KV page storage dtype as a one-hot labeled "
     "gauge (scraped)", ["server", "kv_dtype"])
+engine_disagg_prefill_requests = Gauge(
+    "vllm:engine_disagg_prefill_requests",
+    "Engine-reported disagg prefill handoffs served (scraped)", _LBL)
+engine_disagg_decode_requests = Gauge(
+    "vllm:engine_disagg_decode_requests",
+    "Engine-reported disagg handoffs accepted for decode (scraped)",
+    _LBL)
+engine_disagg_kv_bytes_shipped = Gauge(
+    "vllm:engine_disagg_kv_bytes_shipped",
+    "Engine-reported KV bytes shipped to the offload tier on handoff "
+    "(scraped)", _LBL)
+engine_disagg_awaiting_kv = Gauge(
+    "vllm:engine_disagg_awaiting_kv_requests",
+    "Engine-reported sequences parked awaiting handed-off KV "
+    "(scraped)", _LBL)
+engine_disagg_handoff_latency_mean = Gauge(
+    "vllm:engine_disagg_handoff_latency_mean_seconds",
+    "Mean handoff-admission latency from the engine's histogram "
+    "sum/count (scraped)", _LBL)
 
 # -- resilience layer (router/resilience.py) --------------------------------
 circuit_breaker_state = Gauge(
@@ -123,6 +142,16 @@ requests_shed = Gauge(
     "vllm:requests_shed_total",
     "Requests answered 503 because no endpoint was admittable "
     "(router-wide)", [])
+
+# -- disaggregated dispatch (services/request_service.py) -------------------
+router_disagg_handoffs = Gauge(
+    "vllm:router_disagg_handoffs_total",
+    "Requests served via the two-hop prefill->decode disagg path "
+    "(router-wide)", [])
+router_disagg_fallbacks = Gauge(
+    "vllm:router_disagg_fallbacks_total",
+    "Requests that attempted the disagg path but were served "
+    "monolithically instead (router-wide)", [])
 
 
 def refresh_gauges() -> None:
@@ -201,6 +230,21 @@ def refresh_gauges() -> None:
             engine_kv_cache_dtype.labels(
                 server=server,
                 kv_dtype=es.engine_kv_cache_dtype).set(1)
+        engine_disagg_prefill_requests.labels(server=server).set(
+            es.disagg_prefill_requests)
+        engine_disagg_decode_requests.labels(server=server).set(
+            es.disagg_decode_requests)
+        engine_disagg_kv_bytes_shipped.labels(server=server).set(
+            es.disagg_kv_bytes_shipped)
+        engine_disagg_awaiting_kv.labels(server=server).set(
+            es.disagg_awaiting_kv_requests)
+        if es.disagg_handoff_latency_count > 0:
+            engine_disagg_handoff_latency_mean.labels(server=server).set(
+                es.disagg_handoff_latency_sum
+                / es.disagg_handoff_latency_count)
+    from production_stack_tpu.router.services import request_service
+    router_disagg_handoffs.set(request_service.disagg_handoffs_total)
+    router_disagg_fallbacks.set(request_service.disagg_fallbacks_total)
     from production_stack_tpu.router.resilience import get_resilience
     mgr = get_resilience()
     try:

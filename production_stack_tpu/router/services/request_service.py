@@ -72,6 +72,14 @@ _NETWORK_ERRORS = (
     ConnectionError, OSError,
 )
 
+# Disaggregated two-hop outcomes (docs/disaggregation.md), re-exported
+# at the router's /metrics by services/metrics_service.py. "handoffs"
+# counts requests served prefill-engine -> decode-engine; "fallbacks"
+# counts requests that attempted the disagg path but ended on the
+# monolithic one (still served — never dropped).
+disagg_handoffs_total = 0
+disagg_fallbacks_total = 0
+
 
 class RetryableUpstreamError(Exception):
     """Backend failed before the first byte reached the client: connect
@@ -166,13 +174,32 @@ def _finish_span(span, status: str) -> None:
         sink.emit(span)
 
 
+def _disagg_eligible(payload: dict) -> bool:
+    """Conservative gate for the two-hop disagg path: only plain
+    single-choice generate requests. Anything exotic (multi-choice,
+    logprobs, structured output, completion echo/suffix) stays on the
+    monolithic path; the prefill engine applies its own finer checks
+    (guided decoding, LoRA) and answers 400, which also falls back."""
+    if (payload.get("n") or 1) != 1:
+        return False
+    if payload.get("best_of") not in (None, 1):
+        return False
+    for key in ("echo", "suffix", "logprobs", "top_logprobs",
+                "response_format"):
+        if payload.get(key):
+            return False
+    return True
+
+
 async def route_general_request(request: web.Request,
                                 endpoint_path: str) -> web.StreamResponse:
     """Proxy one OpenAI-API request to a chosen engine, streaming back."""
     from production_stack_tpu.router.routing.logic import (
+        filter_by_role,
         get_routing_logic,
         usable_endpoints,
     )
+    global disagg_fallbacks_total
 
     in_router_time = time.time()
     request_id = request.headers.get("x-request-id") or str(uuid.uuid4())
@@ -219,6 +246,27 @@ async def route_general_request(request: web.Request,
     prompt_text = (_routable_prompt_text(payload)
                    if policy.uses_prompt_text else None)
     store_callback = _semantic_cache_store_callback(endpoint_path, payload)
+
+    # Disaggregated dispatch: with both a prefill-role and a decode-role
+    # pool discovered, eligible generate requests take the two-hop path
+    # (prefill engine computes KV + first token, decode engine streams
+    # the rest). Any failure there falls through to the monolithic loop
+    # below — degraded to a recompute, never dropped.
+    if endpoint_path in ("/v1/chat/completions", "/v1/completions"):
+        prefill_pool = filter_by_role(healthy, "prefill")
+        decode_pool = filter_by_role(healthy, "decode")
+        if prefill_pool and decode_pool and _disagg_eligible(payload):
+            response = await _route_disagg(
+                request, body, payload, request_id,
+                prefill_pool, decode_pool, num_prefill_tokens,
+                span=span, mgr=mgr,
+            )
+            if response is not None:
+                return response
+            disagg_fallbacks_total += 1
+            logger.warning(
+                "Disagg dispatch for %s fell back to the monolithic "
+                "path", request_id)
 
     max_attempts = 1 + (mgr.config.max_retries if mgr is not None else 0)
     tried: set = set()
@@ -321,6 +369,156 @@ async def route_general_request(request: web.Request,
     )
 
 
+async def _route_disagg(request: web.Request, body: bytes, payload: dict,
+                        request_id: str, prefill_pool, decode_pool,
+                        num_prefill_tokens: int, span=None,
+                        mgr=None) -> Optional[web.StreamResponse]:
+    """Two-hop disaggregated dispatch (docs/disaggregation.md).
+
+    Hop 1 POSTs the original body to a prefill-role engine's
+    ``/v1/disagg/prefill`` and collects the handoff descriptor (KV
+    already shipped to the offload tier, first token sampled). Hop 2
+    submits the descriptor to a decode-role engine's
+    ``/v1/disagg/handoff`` and streams its response to the client.
+
+    Resilience mirrors the monolithic loop: each hop retries across
+    its pool within the retry budget, breaker admissions are balanced,
+    and any unrecoverable outcome — empty pool, exhausted budget, a
+    409 (descriptor KV not restorable on this decode pool: kv_dtype
+    mismatch, retrying elsewhere in the pool is pointless) — returns
+    None so the caller serves the request monolithically instead."""
+    from production_stack_tpu.router.routing.logic import (
+        get_routing_logic,
+        usable_endpoints,
+    )
+    global disagg_handoffs_total
+    policy = get_routing_logic()
+    monitor = get_request_stats_monitor()
+    session = _client_session(request.app)
+    max_attempts = 1 + (mgr.config.max_retries if mgr is not None else 0)
+
+    def least_loaded(candidates) -> str:
+        stats = monitor.get_request_stats(time.time())
+
+        def load(url: str) -> int:
+            stat = stats.get(url)
+            if stat is None:
+                return 0
+            return stat.in_prefill_requests + stat.in_decoding_requests
+
+        return min(candidates, key=lambda ep: (load(ep.url), ep.url)).url
+
+    descriptor = None
+    tried: set = set()
+    attempts = 0
+    while attempts < max_attempts and descriptor is None:
+        candidates = usable_endpoints(prefill_pool, exclude=tried)
+        if not candidates:
+            break
+        url = least_loaded(candidates)
+        tried.add(url)
+        attempts += 1
+        if mgr is not None and not mgr.on_attempt(url):
+            continue
+        # True = backend's fault, False = clean answer, None = no
+        # verdict; balances the on_attempt admission exactly once.
+        blame = None
+        try:
+            async with session.post(
+                f"{url}/v1/disagg/prefill", data=body,
+                headers={"content-type": "application/json",
+                         "x-request-id": request_id},
+                timeout=_request_timeout(mgr),
+            ) as resp:
+                if resp.status == 200:
+                    blame = False
+                    desc = (await resp.json()).get("descriptor")
+                    if not isinstance(desc, dict):
+                        return None
+                    descriptor = desc
+                elif resp.status >= 500:
+                    blame = True  # includes 503 queue-full: next pod
+                else:
+                    # 4xx: the backend is healthy but this request (or
+                    # an engine without the endpoint, 404) cannot take
+                    # the disagg path — monolithic immediately.
+                    blame = False
+                    return None
+        except _NETWORK_ERRORS as e:
+            blame = True
+            logger.warning("Disagg prefill hop to %s failed for %s: %s",
+                           url, request_id, e)
+        finally:
+            if mgr is not None:
+                if blame is True:
+                    mgr.record_failure(url)
+                elif blame is False:
+                    mgr.record_success(url)
+                else:
+                    mgr.release_attempt(url)
+        if descriptor is None and mgr is not None:
+            mgr.retries_total += 1
+    if descriptor is None:
+        return None
+
+    handoff_body = json.dumps({
+        "descriptor": descriptor,
+        "stream": bool(payload.get("stream")),
+    }).encode()
+    tried = set()
+    attempts = 0
+    while attempts < max_attempts:
+        candidates = usable_endpoints(decode_pool, exclude=tried)
+        if not candidates:
+            break
+        server_url = least_loaded(candidates)
+        attempts += 1
+        monitor.on_request_routed(server_url, request_id,
+                                  num_prefill_tokens)
+        if mgr is not None and not mgr.on_attempt(server_url):
+            monitor.on_request_kill(server_url, request_id)
+            policy.on_request_complete(server_url)
+            tried.add(server_url)
+            continue
+        if span is not None:
+            span.on_routed(server_url)
+        try:
+            response = await _proxy_stream(
+                request, server_url, "/v1/disagg/handoff", handoff_body,
+                request_id, policy, span=span, mgr=mgr,
+                reject_statuses=(409,),
+            )
+        except RetryableUpstreamError as e:
+            tried.add(server_url)
+            if mgr is not None:
+                mgr.retries_total += 1
+            if e.status == 409:
+                logger.warning(
+                    "Decode pool cannot restore handoff KV for %s "
+                    "(%s); falling back to monolithic", request_id, e)
+                return None
+            logger.warning(
+                "Disagg handoff hop to %s failed for %s (%s); %s",
+                server_url, request_id, e,
+                "trying next decode backend" if attempts < max_attempts
+                else "decode retry budget exhausted")
+            continue
+        except _BackendStreamError as e:
+            # Bytes already reached the client: terminal, same as the
+            # monolithic path.
+            if request.transport is not None:
+                request.transport.close()
+            return e.response
+        except _ClientDisconnectedError as e:
+            if e.response is not None:
+                return e.response
+            return web.Response(status=499,
+                                reason="Client Closed Request")
+        disagg_handoffs_total += 1
+        return response
+    return None
+
+
 def _semantic_cache_store_callback(endpoint_path: str, payload: dict):
     """Build a response-store hook when the semantic cache should learn
     from this request (non-streaming chat completions, gate enabled)."""
@@ -352,7 +550,8 @@ def _semantic_cache_store_callback(endpoint_path: str, payload: dict):
 async def _proxy_stream(request: web.Request, server_url: str,
                         endpoint_path: str, body: bytes, request_id: str,
                         policy, store_callback=None,
-                        span=None, mgr=None) -> web.StreamResponse:
+                        span=None, mgr=None,
+                        reject_statuses: tuple = ()) -> web.StreamResponse:
     """One proxy attempt. Raises ``RetryableUpstreamError`` when the
     backend failed before anything was streamed to the client; once the
     client response is prepared, failures are terminal.
@@ -387,6 +586,14 @@ async def _proxy_stream(request: web.Request, server_url: str,
             if backend.status >= 500:
                 raise RetryableUpstreamError(
                     f"upstream returned {backend.status}",
+                    status=backend.status,
+                )
+            if backend.status in reject_statuses:
+                # Caller-designated rejection statuses (disagg handoff
+                # 409) surface as pre-stream errors instead of being
+                # proxied: the caller decides retry vs fallback.
+                raise RetryableUpstreamError(
+                    f"upstream rejected with {backend.status}",
                     status=backend.status,
                 )
             response = web.StreamResponse(

@@ -55,7 +55,19 @@ _METRIC_MAP = {
         "engine_kv_cache_page_capacity",
     "vllm:engine_kv_bytes_per_decode_step":
         "engine_kv_bytes_per_decode_step",
+    # Disaggregated serving (docs/disaggregation.md): per-role request
+    # counters, KV bytes shipped over the handoff wire, and the
+    # AWAITING_KV queue depth on decode-role engines.
+    "vllm:disagg_prefill_requests_total": "disagg_prefill_requests",
+    "vllm:disagg_decode_requests_total": "disagg_decode_requests",
+    "vllm:disagg_kv_bytes_shipped_total": "disagg_kv_bytes_shipped",
+    "vllm:disagg_awaiting_kv_requests": "disagg_awaiting_kv_requests",
 }
+
+# Handoff-latency histogram (submission to leaving AWAITING_KV on the
+# decode engine): the scraper keeps the running sum/count so the
+# router can re-export a mean; buckets stay with cluster Prometheus.
+_HANDOFF_HIST = "vllm:disagg_handoff_latency_seconds"
 
 # Engine metrics the router deliberately does NOT scrape: request
 # latency histograms and lifecycle counters are read by cluster
@@ -104,12 +116,27 @@ class EngineStats:
     engine_kv_cache_page_capacity: float = 0.0
     engine_kv_bytes_per_decode_step: float = 0.0
     engine_kv_cache_dtype: str = ""
+    # Disaggregated serving (docs/disaggregation.md): role counters,
+    # shipped KV volume, AWAITING_KV depth, and the handoff-latency
+    # histogram's running sum/count (mean = sum / count when > 0).
+    disagg_prefill_requests: float = 0.0
+    disagg_decode_requests: float = 0.0
+    disagg_kv_bytes_shipped: float = 0.0
+    disagg_awaiting_kv_requests: float = 0.0
+    disagg_handoff_latency_sum: float = 0.0
+    disagg_handoff_latency_count: float = 0.0
 
     @classmethod
     def from_prometheus_text(cls, text: str) -> "EngineStats":
         stats = cls()
         for family in text_string_to_metric_families(text):
             for sample in family.samples:
+                if sample.name == _HANDOFF_HIST + "_sum":
+                    stats.disagg_handoff_latency_sum = sample.value
+                    continue
+                if sample.name == _HANDOFF_HIST + "_count":
+                    stats.disagg_handoff_latency_count = sample.value
+                    continue
                 if (sample.name == "vllm:engine_kv_cache_dtype"
                         and sample.value == 1.0):
                     # One-hot labeled gauge: the label carries the

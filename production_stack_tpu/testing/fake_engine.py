@@ -14,7 +14,16 @@ Fault injection (for resilience tests): ``--fault MODE`` at startup or
 - ``slow_first_token``  first token delayed by ``--fault-ttft`` seconds
 - ``abort_mid_stream``  stream a couple of chunks, then drop the socket
 - ``unhealthy``      API keeps working but /health answers 500
+- ``kv_missing``     disagg: a prefill-role fake emits descriptors whose
+                     pages are unavailable; a decode-role fake answers
+                     409 to every handoff (KV never restorable here)
 - ``null``/absent    healthy (clears a previously set fault)
+
+Disaggregation (docs/disaggregation.md): ``--role prefill|decode|both``
+is reported in ``/health`` for the router's role discovery, and the
+fakes serve ``/v1/disagg/prefill`` (returns a handoff descriptor) and
+``/v1/disagg/handoff`` (streams from a descriptor) with output
+byte-identical to the monolithic fake endpoints.
 
 Connection refusal needs no mode: point the router at an unbound port.
 
@@ -35,13 +44,17 @@ from aiohttp import web
 
 FAULT_MODES = (
     "error500", "hang", "slow_first_token", "abort_mid_stream", "unhealthy",
+    "kv_missing",
 )
+
+ENGINE_ROLES = ("prefill", "decode", "both")
 
 
 class FakeEngineState:
     def __init__(self, model: str, speed: float, ttft: float,
                  max_tokens_default: int = 32,
-                 fault: Optional[str] = None, fault_ttft: float = 5.0):
+                 fault: Optional[str] = None, fault_ttft: float = 5.0,
+                 role: str = "both"):
         self.model = model
         self.speed = speed  # tokens per second
         self.ttft = ttft  # seconds before first token
@@ -52,6 +65,9 @@ class FakeEngineState:
         self.fault = fault  # one of FAULT_MODES or None
         self.fault_ttft = fault_ttft  # slow_first_token delay
         self.requests_received = 0  # API hits incl. faulted ones
+        self.role = role  # reported in /health for role discovery
+        self.disagg_prefills = 0  # descriptors emitted
+        self.disagg_decodes = 0  # handoffs streamed
 
 
 async def _apply_api_fault(state: FakeEngineState,
@@ -188,6 +204,132 @@ async def completions(request: web.Request) -> web.Response:
         state.running -= 1
 
 
+async def disagg_prefill(request: web.Request) -> web.Response:
+    """Fake prefill hop: returns a handoff descriptor without doing any
+    work. Under the ``kv_missing`` fault the descriptor is poisoned
+    (``pages_available: false``) so a well-behaved decode fake 409s it."""
+    state: FakeEngineState = request.app["state"]
+    state.requests_received += 1
+    fault_resp = await _apply_api_fault(state, request)
+    if fault_resp is not None:
+        return fault_resp
+    body = await request.json()
+    n_tokens = int(
+        body.get("max_tokens")
+        or body.get("max_completion_tokens")
+        or state.max_tokens_default
+    )
+    chat = isinstance(body.get("messages"), list)
+    await asyncio.sleep(state.ttft)
+    state.disagg_prefills += 1
+    state.total_served += 1
+    available = state.fault != "kv_missing"
+    return web.json_response({"descriptor": {
+        "version": 1,
+        "request_id": f"disagg-{uuid.uuid4().hex[:16]}",
+        "chat": chat,
+        "model": body.get("model", state.model),
+        "token_ids": [0] * 8,
+        "first_token": 0,
+        "finish_reason": None,
+        "kv_dtype": "bf16",
+        "page_keys": ["fake-page-0"] if available else [],
+        "num_pages": 1 if available else 0,
+        "kv_bytes": 4096 if available else 0,
+        "pages_available": available,
+        "sampling": {"max_tokens": n_tokens},
+    }})
+
+
+async def disagg_handoff(request: web.Request) -> web.StreamResponse:
+    """Fake decode hop: streams the same token text the monolithic fake
+    endpoints produce, resuming from a prefill fake's descriptor.
+    Answers 409 for poisoned descriptors or under its own
+    ``kv_missing`` fault — the router must fall back monolithically."""
+    state: FakeEngineState = request.app["state"]
+    state.requests_received += 1
+    fault_resp = await _apply_api_fault(state, request)
+    if fault_resp is not None:
+        return fault_resp
+    body = await request.json()
+    desc = body.get("descriptor") or {}
+    if state.fault == "kv_missing" or not desc.get("pages_available", True):
+        return web.json_response(
+            {"error": {"message": "handoff KV not restorable here"}},
+            status=409,
+        )
+    n_tokens = int(
+        (desc.get("sampling") or {}).get("max_tokens")
+        or state.max_tokens_default
+    )
+    stream = bool(body.get("stream", False))
+    chat = bool(desc.get("chat", True))
+    model = desc.get("model", state.model)
+    request_id = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+    words = [f"tok{i} " for i in range(n_tokens)]
+
+    state.running += 1
+    state.disagg_decodes += 1
+    try:
+        if not stream:
+            await asyncio.sleep(n_tokens / state.speed)
+            state.total_served += 1
+            if chat:
+                return web.json_response({
+                    "id": request_id,
+                    "object": "chat.completion",
+                    "created": int(time.time()),
+                    "model": model,
+                    "choices": [{
+                        "index": 0,
+                        "message": {"role": "assistant",
+                                    "content": "".join(words)},
+                        "finish_reason": "stop",
+                    }],
+                    "usage": {
+                        "prompt_tokens": 0,
+                        "completion_tokens": n_tokens,
+                        "total_tokens": n_tokens,
+                    },
+                })
+            return web.json_response({
+                "id": f"cmpl-{uuid.uuid4().hex[:16]}",
+                "object": "text_completion",
+                "created": int(time.time()),
+                "model": model,
+                "choices": [{
+                    "index": 0,
+                    "text": " ".join(f"tok{i}" for i in range(n_tokens)),
+                    "finish_reason": "length",
+                }],
+                "usage": {"prompt_tokens": 0,
+                          "completion_tokens": n_tokens,
+                          "total_tokens": n_tokens},
+            })
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        await resp.write(_sse(_chunk(request_id, model, None,
+                                     role="assistant")))
+        for i, word in enumerate(words):
+            if state.fault == "abort_mid_stream" and i >= 2:
+                if request.transport is not None:
+                    request.transport.close()
+                return resp
+            await asyncio.sleep(1.0 / state.speed)
+            await resp.write(_sse(_chunk(request_id, model, word)))
+        await resp.write(_sse(_chunk(request_id, model, None,
+                                     finish="stop")))
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        state.total_served += 1
+        return resp
+    finally:
+        state.running -= 1
+
+
 async def models(request: web.Request) -> web.Response:
     state: FakeEngineState = request.app["state"]
     return web.json_response({
@@ -205,7 +347,7 @@ async def health(request: web.Request) -> web.Response:
         return web.json_response({"status": "injected fault"}, status=500)
     if state.fault == "hang":
         await asyncio.sleep(3600)
-    return web.json_response({"status": "ok"})
+    return web.json_response({"status": "ok", "role": state.role})
 
 
 async def set_fault(request: web.Request) -> web.Response:
@@ -245,12 +387,16 @@ async def metrics(request: web.Request) -> web.Response:
 
 def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
                       ttft: float = 0.02, fault: Optional[str] = None,
-                      fault_ttft: float = 5.0) -> web.Application:
+                      fault_ttft: float = 5.0,
+                      role: str = "both") -> web.Application:
     app = web.Application()
     app["state"] = FakeEngineState(model=model, speed=speed, ttft=ttft,
-                                   fault=fault, fault_ttft=fault_ttft)
+                                   fault=fault, fault_ttft=fault_ttft,
+                                   role=role)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/disagg/prefill", disagg_prefill)
+    app.router.add_post("/v1/disagg/handoff", disagg_handoff)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
@@ -271,9 +417,13 @@ def main(argv=None) -> None:
                         help="start with this fault mode active")
     parser.add_argument("--fault-ttft", type=float, default=5.0,
                         help="slow_first_token injected delay (seconds)")
+    parser.add_argument("--role", default="both", choices=ENGINE_ROLES,
+                        help="engine role reported in /health "
+                             "(disaggregated-serving discovery)")
     args = parser.parse_args(argv)
     app = build_fake_engine(args.model, args.speed, args.ttft,
-                            fault=args.fault, fault_ttft=args.fault_ttft)
+                            fault=args.fault, fault_ttft=args.fault_ttft,
+                            role=args.role)
     web.run_app(app, host=args.host, port=args.port, print=None)
 
 

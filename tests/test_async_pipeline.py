@@ -101,6 +101,13 @@ def test_server_auto_resolution():
     assert not _resolve_async_scheduling(parse_args(["--distributed"]))
     assert not _resolve_async_scheduling(
         parse_args(["--async-scheduling", "off"]))
+    # A prefill-role engine has no decode steps to overlap: 'auto'
+    # resolves off so the role x async exclusivity rule only fires
+    # on an explicit 'on'.
+    assert not _resolve_async_scheduling(
+        parse_args(["--engine-role", "prefill"]))
+    assert _resolve_async_scheduling(
+        parse_args(["--engine-role", "decode"]))
     # Explicit 'on' passes resolution; the config validates later.
     assert _resolve_async_scheduling(
         parse_args(["--async-scheduling", "on", "--decode-steps", "4"]))

@@ -1,0 +1,646 @@
+"""Disaggregated prefill/decode serving (docs/disaggregation.md).
+
+Covers the whole handoff path: config-time role rules, the cache
+server's batched GET, the engine-side prefill->ship->park->restore
+cycle (token-for-token parity with a monolithic engine, bf16 and
+int8), the degrade-to-recompute fallbacks, and the router's two-hop
+dispatch with per-hop retry and monolithic fallback driven through
+role-carrying fake engines — the acceptance invariant being that a
+request that entered the disagg path is never dropped.
+"""
+
+import asyncio
+import socket
+import threading
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.cache_server import (
+    BATCH_GET_MAX_KEYS,
+    build_cache_server,
+)
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    OffloadConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.offload import KV_WIRE_VERSION, RemoteKVClient
+from production_stack_tpu.engine.sequence import SamplingParams, SequenceState
+from production_stack_tpu.router.resilience import (
+    ResilienceConfig,
+    initialize_resilience,
+)
+from production_stack_tpu.router.service_discovery import (
+    EndpointInfo,
+    K8sServiceDiscovery,
+    StaticServiceDiscovery,
+    initialize_service_discovery,
+)
+from production_stack_tpu.router.services import request_service
+from production_stack_tpu.router.services.rewriter import (
+    initialize_request_rewriter,
+)
+from production_stack_tpu.router.stats.engine_stats import (
+    initialize_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.request_stats import (
+    initialize_request_stats_monitor,
+)
+from production_stack_tpu.testing.fake_engine import build_fake_engine
+
+
+# ---- config contract ------------------------------------------------------
+
+def test_engine_role_value_validated():
+    with pytest.raises(ValueError, match="engine_role"):
+        EngineConfig(engine_role="compute")
+
+
+def test_negative_handoff_timeout_rejected():
+    with pytest.raises(ValueError, match="handoff_timeout_s"):
+        EngineConfig(handoff_timeout_s=-1.0)
+
+
+def test_engine_role_prefill_rejects_speculative_k():
+    """A prefill-role engine never decodes past the first token, so
+    speculation is dead weight — config-time error, not a silent lie."""
+    with pytest.raises(ValueError, match="engine_role"):
+        EngineConfig(engine_role="prefill",
+                     scheduler=SchedulerConfig(speculative_k=2))
+    # The combination is legal for every other role.
+    EngineConfig(engine_role="decode",
+                 scheduler=SchedulerConfig(speculative_k=2))
+
+
+def test_engine_role_prefill_rejects_async_scheduling():
+    with pytest.raises(ValueError, match="engine_role"):
+        EngineConfig(engine_role="prefill",
+                     scheduler=SchedulerConfig(async_scheduling=True))
+    EngineConfig(engine_role="both",
+                 scheduler=SchedulerConfig(async_scheduling=True))
+
+
+# ---- shared fixtures ------------------------------------------------------
+
+def _serve_app_in_thread(app: web.Application):
+    """Run an aiohttp app on a real socket in a daemon thread (the
+    sync RemoteKVClient and engine offload tier need real HTTP).
+    Returns (base_url, stop_fn)."""
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_box = {}
+
+    def serve():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        port_box["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    assert started.wait(10)
+
+    def stop():
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+
+    return f"http://127.0.0.1:{port_box['port']}", stop
+
+
+@pytest.fixture(scope="module")
+def cache_server_url():
+    """One live cache server shared by the module: keys are
+    content-addressed and dtype-namespaced, so tests cannot collide."""
+    url, stop = _serve_app_in_thread(build_cache_server(256 * 1024 ** 2))
+    yield url
+    stop()
+
+
+def _free_port_url() -> str:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def _make_engine(remote_url, role="both", kv_dtype="auto", offload=True,
+                 handoff_timeout_s=30.0):
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=64,
+                          kv_cache_dtype=kv_dtype),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=256,
+                                  prefill_chunk_size=64),
+        # host_pool_bytes=0: remote-only tier, so every restore is a
+        # real cross-process fetch like a disaggregated deployment.
+        offload=OffloadConfig(enable=offload, remote_url=remote_url,
+                              host_pool_bytes=0),
+        engine_role=role,
+        handoff_timeout_s=handoff_timeout_s,
+    ))
+
+
+def _sampling():
+    return SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+
+
+def _run_prefill_handoff(engine, prompt, sampling):
+    """Drive a prefill-role engine to handoff; returns (first_token,
+    descriptor info dict)."""
+    sid = engine.add_request(list(prompt), sampling, handoff_prefill=True)
+    outs = []
+    while not outs or not outs[-1].finished:
+        outs.extend(engine.step())
+    assert outs[-1].finish_reason == "handoff"
+    return outs[-1].new_token, engine.take_handoff_info(sid)
+
+
+def _run_decode_handoff(engine, prompt, first_token, sampling):
+    """Drive a decode-role engine from a handoff to completion;
+    returns the full output token list (first token included)."""
+    did = engine.add_handoff(list(prompt), first_token, sampling)
+    seq = engine.sequences[did]
+    while seq.state not in (SequenceState.FINISHED,
+                            SequenceState.ABORTED):
+        engine.step()
+    assert seq.state == SequenceState.FINISHED
+    return [first_token] + seq.output_token_ids
+
+
+# ---- engine E2E: handoff parity -------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype", ["auto", "int8"])
+def test_disagg_parity_with_monolithic(cache_server_url, kv_dtype):
+    """The acceptance invariant: prefill on one engine + decode on
+    another (KV through the shared cache server) produces exactly the
+    monolithic engine's greedy tokens — for bf16 and int8 KV pages."""
+    prompt = list(range(1, 50))  # 3 full pages + a tail
+    ref = _make_engine(cache_server_url, offload=False,
+                       kv_dtype=kv_dtype).generate(
+        list(prompt), _sampling())
+
+    pre = _make_engine(cache_server_url, role="prefill",
+                       kv_dtype=kv_dtype)
+    first, info = _run_prefill_handoff(pre, prompt, _sampling())
+    assert info is not None
+    assert info["num_pages"] == 3  # 48 of 49 prompt tokens are paged
+    assert info["kv_bytes"] > 0 and len(info["page_keys"]) == 3
+    stats = pre.stats()
+    assert stats["disagg_prefill_requests_total"] == 1
+    assert stats["disagg_kv_bytes_shipped_total"] == info["kv_bytes"]
+    # The prefill engine retired the sequence: pages free, no work.
+    assert not pre.scheduler.has_work()
+
+    dec = _make_engine(cache_server_url, role="decode",
+                       kv_dtype=kv_dtype)
+    did = dec.add_handoff(list(prompt), first, _sampling())
+    seq = dec.sequences[did]
+    assert seq.state == SequenceState.AWAITING_KV
+    assert dec.stats()["disagg_awaiting_kv_requests"] == 1
+    assert dec.stats()["num_requests_waiting"] == 1
+    while seq.state not in (SequenceState.FINISHED,
+                            SequenceState.ABORTED):
+        dec.step()
+    got = [first] + seq.output_token_ids
+    assert got == ref.output_token_ids
+    # Decode restored the shipped pages instead of recomputing.
+    assert dec.offload.restored_pages > 0
+    assert dec.stats()["disagg_decode_requests_total"] == 1
+    assert dec.stats()["disagg_awaiting_kv_requests"] == 0
+
+
+def test_handoff_kv_miss_recomputes_exactly(cache_server_url):
+    """Pages never shipped (definitive tier miss): the decode engine
+    degrades to a local recompute immediately and still produces the
+    monolithic output — degraded, never dropped."""
+    prompt = list(range(101, 150))
+    ref = _make_engine(cache_server_url, offload=False).generate(
+        list(prompt), _sampling())
+    dec = _make_engine(cache_server_url, role="decode")
+    got = _run_decode_handoff(dec, prompt, ref.output_token_ids[0],
+                              _sampling())
+    assert got == ref.output_token_ids
+    assert dec.offload.restored_pages == 0
+
+
+def test_handoff_tier_unreachable_times_out_to_recompute():
+    """Remote tier down (probe returns no verdict): the sequence waits
+    in AWAITING_KV up to handoff_timeout_s, then recomputes. With a
+    zero timeout the first admission pass degrades immediately."""
+    prompt = list(range(11, 60))
+    ref = _make_engine(None, offload=False).generate(
+        list(prompt), _sampling())
+    dec = _make_engine(_free_port_url(), role="decode",
+                       handoff_timeout_s=0.0)
+    got = _run_decode_handoff(dec, prompt, ref.output_token_ids[0],
+                              _sampling())
+    assert got == ref.output_token_ids
+    assert dec.offload.restored_pages == 0
+
+
+def test_awaiting_kv_abort_releases_nothing_and_clears_depth(
+        cache_server_url):
+    """Regression: aborting a handoff parked in AWAITING_KV must drop
+    it from the waiting queue and the depth gauge without leaking KV
+    pages (a parked sequence holds none yet)."""
+    dec = _make_engine(cache_server_url, role="decode")
+    # Pin the sequence in AWAITING_KV: the tier never gives a verdict
+    # and the (default 30s) timeout never fires within the test.
+    dec.offload.handoff_ready = lambda page_hash: None
+    free_before = dec.cache_manager.num_free_pages
+    did = dec.add_handoff(list(range(1, 50)), 7, _sampling())
+    seq = dec.sequences[did]
+    for _ in range(3):
+        dec.step()
+    assert seq.state == SequenceState.AWAITING_KV
+    assert dec.stats()["disagg_awaiting_kv_requests"] == 1
+    assert dec.stats()["num_requests_waiting"] == 1
+    assert dec.cache_manager.num_free_pages == free_before
+
+    dec.abort_request(did)
+    assert did not in dec.sequences
+    assert dec.stats()["disagg_awaiting_kv_requests"] == 0
+    assert dec.stats()["num_requests_waiting"] == 0
+    assert dec.cache_manager.num_free_pages == free_before
+    assert not dec.scheduler.has_work()
+
+
+# ---- cache server: POST /kv/batch_get -------------------------------------
+
+def _wire_body(arrays):
+    import msgpack
+    return msgpack.packb({
+        "version": KV_WIRE_VERSION,
+        "arrays": [
+            {"data": a.tobytes(), "shape": list(a.shape),
+             "dtype": str(a.dtype)}
+            for a in arrays
+        ],
+    })
+
+
+async def test_batch_get_hits_misses_and_validation():
+    import msgpack
+    client = TestClient(TestServer(build_cache_server(1024 ** 2)))
+    await client.start_server()
+    try:
+        a = np.arange(32, dtype=np.float32).reshape(2, 16)
+        int8_page = (np.ones((2, 2), np.int8), np.ones((2, 2), np.int8),
+                     np.ones((2,), np.float32), np.ones((2,), np.float32))
+        assert (await client.put("/kv/pa",
+                                 data=_wire_body((a, a)))).status == 200
+        assert (await client.put("/kv/pb",
+                                 data=_wire_body(int8_page))).status == 200
+
+        resp = await client.post(
+            "/kv/batch_get",
+            data=msgpack.packb({"keys": ["pa", "missing", "pb"]}))
+        assert resp.status == 200
+        blobs = msgpack.unpackb(await resp.read())["blobs"]
+        assert len(blobs) == 3
+        assert blobs[1] is None  # order-aligned nil for the miss
+        got_a = msgpack.unpackb(blobs[0])["arrays"][0]
+        np.testing.assert_array_equal(
+            np.frombuffer(got_a["data"], np.float32).reshape(2, 16), a)
+        assert len(msgpack.unpackb(blobs[2])["arrays"]) == 4
+
+        # Malformed requests 400 instead of crashing or storing junk.
+        bad = [
+            b"\x00junk not msgpack",
+            msgpack.packb({"nope": 1}),
+            msgpack.packb({"keys": "pa"}),
+            msgpack.packb({"keys": [1, 2]}),
+            msgpack.packb({"keys": ["k"] * (BATCH_GET_MAX_KEYS + 1)}),
+        ]
+        for body in bad:
+            assert (await client.post("/kv/batch_get",
+                                      data=body)).status == 400
+    finally:
+        await client.close()
+
+
+def test_remote_client_batch_get_and_probe(cache_server_url):
+    client = RemoteKVClient(cache_server_url)
+    payloads = {
+        f"bg{i}": (np.full((2, 4), i, np.float32),
+                   np.full((2, 4), -i, np.float32))
+        for i in range(3)
+    }
+    for key, payload in payloads.items():
+        assert client.put(key, payload)
+    got = client.batch_get(list(payloads) + ["bg-missing"])
+    assert set(got) == set(payloads)
+    for key, payload in payloads.items():
+        for want, have in zip(payload, got[key]):
+            assert have.dtype == want.dtype
+            np.testing.assert_array_equal(want, have)
+    assert client.batch_get([]) == {}
+    # Probe tri-state: definitive hit / definitive miss / no verdict.
+    assert client.probe("bg0") is True
+    assert client.probe("bg-missing") is False
+    dead = RemoteKVClient(_free_port_url(), timeout_s=0.5)
+    assert dead.probe("bg0") is None
+    assert dead.batch_get(["bg0"]) == {}
+
+
+def test_batch_get_falls_back_to_sequential_on_old_server():
+    """A pre-batch_get cache server answers 404/405 on the endpoint;
+    RemoteKVClient must degrade to per-key GETs transparently."""
+    store = {}
+
+    async def put_kv(request):
+        store[request.match_info["key"]] = await request.read()
+        return web.Response(status=200)
+
+    async def get_kv(request):
+        blob = store.get(request.match_info["key"])
+        if blob is None:
+            return web.Response(status=404)
+        return web.Response(body=blob)
+
+    app = web.Application()
+    app.router.add_put("/kv/{key}", put_kv)
+    app.router.add_get("/kv/{key}", get_kv)
+    url, stop = _serve_app_in_thread(app)
+    try:
+        client = RemoteKVClient(url)
+        payload = (np.arange(8, dtype=np.float32),
+                   np.arange(8, dtype=np.float32) * 2)
+        assert client.put("old0", payload)
+        got = client.batch_get(["old0", "old-missing"])
+        assert set(got) == {"old0"}
+        np.testing.assert_array_equal(got["old0"][0], payload[0])
+    finally:
+        stop()
+
+
+# ---- role discovery -------------------------------------------------------
+
+def test_filter_by_role_and_static_roles():
+    from production_stack_tpu.router.routing.logic import filter_by_role
+    eps = [EndpointInfo(url="http://p", role="prefill"),
+           EndpointInfo(url="http://d", role="decode"),
+           EndpointInfo(url="http://b")]
+    assert [ep.url for ep in filter_by_role(eps, "prefill")] == ["http://p"]
+    assert [ep.url for ep in filter_by_role(eps, "decode")] == ["http://d"]
+
+    disc = StaticServiceDiscovery(
+        urls=["http://p", "http://d"], models=["m1", "m1"],
+        roles=["prefill", "decode"])
+    assert [ep.role for ep in disc.get_endpoint_info()] == [
+        "prefill", "decode"]
+    with pytest.raises(ValueError):
+        StaticServiceDiscovery(urls=["http://p"], models=["m1"],
+                               roles=["prefill", "decode"])
+    with pytest.raises(ValueError):
+        StaticServiceDiscovery(urls=["http://p"], models=["m1"],
+                               roles=["gpu"])
+
+
+def test_parser_validates_static_roles():
+    from production_stack_tpu.router.parser import parse_args
+    ok = parse_args([
+        "--service-discovery", "static",
+        "--static-backends", "http://a,http://b",
+        "--static-models", "m1,m1",
+        "--static-roles", "prefill,decode",
+    ])
+    assert ok.static_roles == "prefill,decode"
+    with pytest.raises(ValueError, match="static-roles"):
+        parse_args([
+            "--service-discovery", "static",
+            "--static-backends", "http://a,http://b",
+            "--static-models", "m1,m1",
+            "--static-roles", "prefill",
+        ])
+    with pytest.raises(ValueError, match="prefill, decode or both"):
+        parse_args([
+            "--service-discovery", "static",
+            "--static-backends", "http://a",
+            "--static-models", "m1",
+            "--static-roles", "gpu",
+        ])
+
+
+def test_k8s_role_probe_reads_health():
+    """K8s discovery learns the role from GET /health; anything that
+    fails or reports an unknown role is treated as 'both'."""
+    url, stop = _serve_app_in_thread(
+        build_fake_engine(model="m1", role="prefill"))
+    try:
+        assert K8sServiceDiscovery._probe_role(url) == "prefill"
+    finally:
+        stop()
+    assert K8sServiceDiscovery._probe_role(_free_port_url()) == "both"
+
+
+# ---- router two-hop dispatch (fake engines) -------------------------------
+
+async def _start_disagg_router(backends):
+    """backends: [(url, model, role)]. Initializes the router
+    singletons with role-aware static discovery and returns a started
+    TestClient."""
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.routing.logic import (
+        initialize_routing_logic,
+    )
+    request_service.disagg_handoffs_total = 0
+    request_service.disagg_fallbacks_total = 0
+    initialize_service_discovery(
+        "static",
+        urls=[b[0] for b in backends],
+        models=[b[1] for b in backends],
+        roles=[b[2] for b in backends],
+    )
+    initialize_request_stats_monitor(60.0)
+    initialize_engine_stats_scraper(3600.0)
+    initialize_routing_logic("roundrobin")
+    initialize_request_rewriter("noop")
+    initialize_resilience(ResilienceConfig(
+        max_retries=2, backend_connect_timeout=1.0, backend_timeout=10.0,
+        health_check_interval=0.0,
+    ))
+    client = TestClient(TestServer(build_app()))
+    await client.start_server()
+    return client
+
+
+def _chat_body(model, stream=False, max_tokens=3):
+    return {
+        "model": model,
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": max_tokens,
+        "stream": stream,
+    }
+
+
+async def _start_fakes(*roles, fault=None):
+    """One fake engine per role; returns the started TestServers."""
+    servers = [
+        TestServer(build_fake_engine(model="m1", speed=1000, ttft=0.0,
+                                     role=role,
+                                     fault=fault.get(i) if fault else None))
+        for i, role in enumerate(roles)
+    ]
+    for server in servers:
+        await server.start_server()
+    return servers
+
+
+def _url(server: TestServer) -> str:
+    return f"http://127.0.0.1:{server.port}"
+
+
+def _sse_contents(text: str):
+    """Delta contents of an SSE chat stream, in order."""
+    import json
+    contents = []
+    for line in text.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        delta = json.loads(line[len("data: "):])["choices"][0]["delta"]
+        if delta.get("content"):
+            contents.append(delta["content"])
+    return contents
+
+
+async def test_router_two_hop_matches_monolithic():
+    """Happy path: prefill fake emits the descriptor, decode fake
+    streams — the client sees exactly what a monolithic backend would
+    have produced, and both hops are accounted."""
+    pre, dec, mono = await _start_fakes("prefill", "decode", "both")
+    mono_client = TestClient(mono)
+    client = await _start_disagg_router([
+        (_url(pre), "m1", "prefill"),
+        (_url(dec), "m1", "decode"),
+    ])
+    try:
+        ref = await mono_client.post("/v1/chat/completions",
+                                     json=_chat_body("m1"))
+        ref_content = (await ref.json())[
+            "choices"][0]["message"]["content"]
+
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("m1"))
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["choices"][0]["message"]["content"] == ref_content
+        assert pre.app["state"].disagg_prefills == 1
+        assert dec.app["state"].disagg_decodes == 1
+        assert request_service.disagg_handoffs_total == 1
+        assert request_service.disagg_fallbacks_total == 0
+
+        # Streaming: same delta sequence as the monolithic stream.
+        ref_stream = await mono_client.post(
+            "/v1/chat/completions", json=_chat_body("m1", stream=True))
+        want = _sse_contents(await ref_stream.text())
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("m1", stream=True))
+        assert resp.status == 200
+        assert _sse_contents(await resp.text()) == want
+        assert dec.app["state"].disagg_decodes == 2
+
+        # Ineligible requests (n > 1) never engage the disagg path.
+        body = _chat_body("m1")
+        body["n"] = 2
+        resp = await client.post("/v1/chat/completions", json=body)
+        assert resp.status == 200
+        assert pre.app["state"].disagg_prefills == 2  # unchanged
+        assert dec.app["state"].disagg_decodes == 2  # unchanged
+    finally:
+        await client.close()
+        await mono_client.close()
+        for server in (pre, dec, mono):
+            await server.close()
+
+
+@pytest.mark.parametrize("failure", ["dead", "error500"])
+async def test_router_retries_decode_hop_on_backend_failure(failure):
+    """The acceptance kill test: the decode backend chosen for hop 2
+    is gone (connection refused) or broken (500) — the router retries
+    the other decode-role backend and the client still gets a 200,
+    never a 5xx."""
+    pre, d1, d2 = await _start_fakes("prefill", "decode", "decode")
+    # Hop 2 picks the least-loaded decode backend, tie-broken by URL:
+    # break exactly the one it will try first.
+    first, second = sorted((d1, d2), key=_url)
+    if failure == "dead":
+        await first.close()  # port now refuses connections
+    else:
+        first.app["state"].fault = "error500"
+    client = await _start_disagg_router([
+        (_url(pre), "m1", "prefill"),
+        (_url(first), "m1", "decode"),
+        (_url(second), "m1", "decode"),
+    ])
+    try:
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("m1"))
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["choices"][0]["message"]["content"]
+        assert second.app["state"].disagg_decodes == 1
+        assert request_service.disagg_handoffs_total == 1
+    finally:
+        await client.close()
+        for server in (pre, d1, d2):
+            await server.close()
+
+
+@pytest.mark.parametrize("poisoned", ["prefill", "decode"])
+async def test_router_kv_missing_falls_back_monolithic(poisoned):
+    """KV not restorable (poisoned descriptor from the prefill fake,
+    or the decode fake's own kv_missing fault): the decode hop answers
+    409, the router stops retrying the decode pool and completes the
+    request monolithically — degraded, never dropped, never a 5xx."""
+    fault = {0: "kv_missing"} if poisoned == "prefill" else {1: "kv_missing"}
+    pre, dec, mono = await _start_fakes("prefill", "decode", "both",
+                                        fault=fault)
+    client = await _start_disagg_router([
+        (_url(pre), "m1", "prefill"),
+        (_url(dec), "m1", "decode"),
+        (_url(mono), "m1", "both"),
+    ])
+    try:
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("m1"))
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["choices"][0]["message"]["content"]
+        assert dec.app["state"].disagg_decodes == 0  # 409ed, never streamed
+        assert request_service.disagg_handoffs_total == 0
+        assert request_service.disagg_fallbacks_total == 1
+    finally:
+        await client.close()
+        for server in (pre, dec, mono):
+            await server.close()
+
+
+async def test_router_empty_prefill_pool_serves_monolithic():
+    """Decode-only fleet (no prefill pool): the disagg path never
+    engages and requests serve monolithically off the decode pods."""
+    (dec,) = await _start_fakes("decode")
+    client = await _start_disagg_router([(_url(dec), "m1", "decode")])
+    try:
+        resp = await client.post("/v1/chat/completions",
+                                 json=_chat_body("m1"))
+        assert resp.status == 200
+        assert dec.app["state"].disagg_decodes == 0
+        assert request_service.disagg_handoffs_total == 0
+        # Never entered the two-hop path, so no fallback either.
+        assert request_service.disagg_fallbacks_total == 0
+    finally:
+        await client.close()
+        await dec.close()
