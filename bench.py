@@ -802,6 +802,183 @@ def run_disagg_worker(mode: str) -> None:
     }))
 
 
+def run_unified_worker(mode: str) -> None:
+    """Unified ragged-step A/B worker (docs/unified_step.md): steady
+    interactive decode streams sharing ONE engine with bursty
+    long-prompt arrivals, with the unified mixed step on
+    (``mode=on``: prefill chunks admitted into decode steps under a
+    token budget) vs off (``mode=off``: bimodal alternation).
+    Reports the interactive streams' decode rate and ITL and the
+    long prompts' TTFT — the three numbers the ragged step trades
+    between — plus the padded-row ratio of the mixed dispatches.
+
+    Always runs the tiny-llama CPU config: like the disagg phase,
+    this measures the scheduling interference structure (prefill
+    chunks stalling decode steps), not a chip number.
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import numpy as np
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        SchedulerConfig,
+        tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/jax-comp-cache")
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    engine = LLMEngine(EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=256),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_model_len=512,
+                                  prefill_chunk_size=64,
+                                  unified_step=(mode == "on")),
+    ))
+
+    rng = np.random.RandomState(0)
+    long_prompt_len = 256  # 4 chunked-prefill steps each
+    short_prompt_len = 32
+    duration = float(os.environ.get("BENCH_UNIFIED_DURATION_S", "10"))
+    burst_every = 1.5
+    burst_size = 2
+    n_interactive = 3  # steady decode streams (batch leaves 1 slot)
+
+    inter_samp = lambda: SamplingParams(  # noqa: E731
+        max_tokens=48, temperature=0.0, ignore_eos=True)
+    long_samp = lambda: SamplingParams(  # noqa: E731
+        max_tokens=4, temperature=0.0, ignore_eos=True)
+
+    def prompt(n):
+        return [int(x) for x in rng.randint(1, 30000, size=n)]
+
+    # Warm both program shapes outside the measured window.
+    engine.generate(prompt(short_prompt_len),
+                    SamplingParams(max_tokens=4, temperature=0.0,
+                                   ignore_eos=True))
+
+    itl = []          # interactive inter-token gaps (s)
+    ttft = []         # long-prompt submit -> first token (s)
+    interactive = {}  # seq_id -> last token wall time (None = none)
+    long_pending = {}  # seq_id -> submit time
+    long_done = 0
+    interactive_tokens = 0
+
+    def submit_interactive():
+        sid = engine.add_request(prompt(short_prompt_len),
+                                 inter_samp())
+        interactive[sid] = None
+
+    for _ in range(n_interactive):
+        submit_interactive()
+
+    def run_phase(phase_s):
+        nonlocal long_done, interactive_tokens
+        start = time.time()
+        next_burst = start + 0.5
+        deadline = start + phase_s
+        while time.time() < deadline:
+            now = time.time()
+            if now >= next_burst:
+                for _ in range(burst_size):
+                    sid = engine.add_request(prompt(long_prompt_len),
+                                             long_samp())
+                    long_pending[sid] = now
+                next_burst += burst_every
+            if not engine.has_work():
+                time.sleep(0.001)
+                continue
+            outs = engine.step()
+            now = time.time()
+            for out in outs:
+                if out.seq_id in interactive:
+                    if out.new_token is not None:
+                        last = interactive[out.seq_id]
+                        if last is not None:
+                            itl.append(now - last)
+                        interactive[out.seq_id] = now
+                        interactive_tokens += 1
+                    if out.finished:
+                        del interactive[out.seq_id]
+                        submit_interactive()
+                elif (out.seq_id in long_pending
+                        and out.new_token is not None):
+                    ttft.append(now - long_pending.pop(out.seq_id))
+                    long_done += 1
+        return time.time() - start
+
+    # Warmup phases: identical traffic, discarded samples — first-hit
+    # compilation of the ragged (row bucket, W bucket) lattice
+    # otherwise lands in a burst's TTFT and dominates p99. Traffic
+    # wanders through the lattice over time, so keep warming until
+    # the unified program's executable cache stops growing.
+    warmup = float(os.environ.get("BENCH_UNIFIED_WARMUP_S", "3.0"))
+    run_phase(warmup)
+    jit = getattr(engine.runner, "_unified_jit", None)
+    if jit is not None and hasattr(jit, "_cache_size"):
+        prev = jit._cache_size()
+        for _ in range(4):
+            run_phase(1.6)
+            size = jit._cache_size()
+            if size == prev:
+                break
+            prev = size
+    itl.clear()
+    ttft.clear()
+    long_pending.clear()
+    long_done = 0
+    interactive_tokens = 0
+    st0 = engine.stats()
+
+    wall = run_phase(duration)
+
+    def pctl(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    st = engine.stats()
+    ragged_steps = (st["engine_ragged_steps_total"]
+                    - st0["engine_ragged_steps_total"])
+    ragged_rows = (st["engine_ragged_rows_total"]
+                   - st0["engine_ragged_rows_total"])
+    ragged_pads = (st["engine_ragged_pad_rows_total"]
+                   - st0["engine_ragged_pad_rows_total"])
+    pad_ratio = ragged_pads / ragged_rows if ragged_rows else 0.0
+    itl_p99 = pctl(itl, 0.99) or 0.0
+    print(json.dumps({
+        "metric": f"unified-step bench ({mode}): interactive ITL p99 "
+                  "under bursty long-prompt arrivals",
+        "value": round(itl_p99, 4),
+        "unit": "s",
+        "vs_baseline": 0.0,
+        "extra": {
+            "mode": mode,
+            "decode_tok_s": round(interactive_tokens / wall, 1),
+            "itl_p50_s": round(pctl(itl, 0.5) or 0.0, 4),
+            "itl_p99_s": round(itl_p99, 4),
+            "ttft_p50_s": round(pctl(ttft, 0.5) or 0.0, 4),
+            "ttft_p99_s": round(pctl(ttft, 0.99) or 0.0, 4),
+            "ragged_steps": int(ragged_steps),
+            "ragged_pad_ratio": round(pad_ratio, 4),
+            "interactive_tokens": interactive_tokens,
+            "long_requests_finished": long_done,
+        },
+    }))
+
+
 def _spawn_worker(impl: str, tpu: bool, timeout: int, extra_env=None):
     """Run one benchmark worker; returns (result_dict | None, error)."""
     cmd = [sys.executable, os.path.abspath(__file__),
@@ -842,6 +1019,9 @@ def main() -> None:
         impl = sys.argv[sys.argv.index("--worker") + 1]
         if impl == "disagg":
             run_disagg_worker(os.environ.get("BENCH_DISAGG_MODE", "mono"))
+        elif impl == "unified":
+            run_unified_worker(
+                os.environ.get("BENCH_UNIFIED_MODE", "off"))
         else:
             run_worker(impl, tpu="--tpu" in sys.argv)
         return
@@ -981,6 +1161,32 @@ def main() -> None:
                         "ttft_p99_s", "interactive_tokens",
                         "long_requests_finished"):
                 result["extra"][f"{tag}_{key}"] = de.get(key)
+
+        # Unified ragged-step A/B (docs/unified_step.md): the same
+        # mixed workload as the disagg phase on ONE engine —
+        # bursty long prompts against steady interactive decode —
+        # with the unified mixed step as the only variable. Always
+        # the tiny CPU config (scheduling interference structure,
+        # not a chip number). Interactive decode rate/ITL, long-
+        # prompt TTFT and the mixed dispatches' pad ratio ride in
+        # extra under unified_off_* / unified_on_*.
+        for tag, mode in (("unified_off", "off"), ("unified_on", "on")):
+            sys.stderr.write(f"[bench] running {tag} worker "
+                             f"(timeout {timeout}s)...\n")
+            un_result, un_err = _spawn_worker(
+                "unified", False, timeout,
+                extra_env={"BENCH_UNIFIED_MODE": mode,
+                           "JAX_PLATFORMS": "cpu"})
+            if un_result is None:
+                errors[f"{tag}_error"] = un_err
+                sys.stderr.write(f"[bench] WARNING: {un_err}\n")
+                continue
+            ue = un_result.get("extra", {})
+            for key in ("decode_tok_s", "itl_p99_s", "ttft_p99_s",
+                        "ragged_pad_ratio", "ragged_steps",
+                        "interactive_tokens",
+                        "long_requests_finished"):
+                result["extra"][f"{tag}_{key}"] = ue.get(key)
 
     if result is None:
         # Never hang the driver: report the failure as the metric line.

@@ -249,12 +249,23 @@ class SchedulerConfig:
     # plan and dispatch decode step N+1 — feeding step N's sampled
     # tokens forward as a device array — before step N's results are
     # read back to the host, so completion work (detokenize, stop
-    # checks, stream fan-out) overlaps device execution. Pure-decode
-    # single-token steps only; requires decode_steps == 1 and
-    # speculative_k == 0 (both already amortize host round-trips on
-    # device — the pipeline would race their host-side state).
+    # checks, stream fan-out) overlaps device execution. Composes
+    # with speculative_k (the ahead plan assumes one committed token
+    # and reconciles extra accepted tokens through the stale-token
+    # drop path) and with decode_steps > 1 (burst windows execute
+    # synchronously between pipelined single-step stretches).
     # Greedy output is byte-identical to the synchronous loop.
     async_scheduling: bool = False
+    # Unified ragged step (docs/unified_step.md): plan prefill chunks
+    # INTO decode/spec steps under a token budget instead of
+    # alternating phases, executing genuinely mixed batches through
+    # one fixed-shape [rows, W] ragged program (span-gather +
+    # spec_verify emit 1..k+1 tokens per row through one shape).
+    # Pure-decode and pure-prefill steps keep the bimodal dispatch
+    # paths, so greedy streams stay byte-identical when no mixing
+    # happens. The server's --unified-step auto resolves this on for
+    # eligible single-runner configs (unified_step_eligible).
+    unified_step: bool = False
     max_queue_len: int = 1024
 
     def max_pages_per_seq(self, page_size: int) -> int:
@@ -348,13 +359,11 @@ class EngineConfig:
                     "decode; a prefill-role engine hands off after "
                     "the first token; docs/disaggregation.md "
                     "§interactions)")
-            if self.scheduler.async_scheduling:
-                raise ValueError(
-                    "engine_role='prefill' is incompatible with "
-                    "async_scheduling (the overlapped pipeline keeps "
-                    "a decode step in flight; a prefill-role engine "
-                    "has no decode steps; docs/disaggregation.md "
-                    "§interactions)")
+            # async_scheduling on a prefill-role engine is legal but
+            # inert: prefill dispatches run synchronously, so the
+            # pipeline simply never goes ahead. The server's
+            # --async-scheduling auto still resolves it off for the
+            # role (no decode steps to overlap).
         if self.cache.kv_cache_dtype not in ("auto", "bf16", "int8"):
             raise ValueError(
                 "cache.kv_cache_dtype must be 'auto', 'bf16' or "
@@ -392,28 +401,13 @@ class EngineConfig:
                     "docs/speculative.md §interactions)")
             if self.scheduler.speculative_min_match < 1:
                 raise ValueError("speculative_min_match must be >= 1")
-        if self.scheduler.async_scheduling:
-            # Mirror of the spec x deferred exclusion: the async
-            # pipeline's plan-ahead assumes exactly one committed
-            # token per running row per in-flight step; a multi-step
-            # burst or speculative verify commits a data-dependent
-            # count the ahead plan cannot predict. The server's
-            # --async-scheduling auto resolves these conflicts off
-            # (async_scheduling_eligible); an explicit 'on' fails
-            # loudly here.
-            if self.scheduler.decode_steps > 1:
-                raise ValueError(
-                    "async_scheduling is incompatible with "
-                    "decode_steps > 1 (the plan-ahead step assumes "
-                    "one committed token per row per dispatch; "
-                    "docs/async_pipeline.md §interactions)")
-            if self.scheduler.speculative_k > 0:
-                raise ValueError(
-                    "async_scheduling is incompatible with "
-                    "speculative_k > 0 (verify steps commit a "
-                    "data-dependent token count the ahead plan "
-                    "cannot predict; docs/async_pipeline.md "
-                    "§interactions)")
+        # async_scheduling now composes with decode_steps > 1 (burst
+        # windows run synchronously between pipelined single-step
+        # stretches) and speculative_k > 0 (the ahead plan assumes
+        # one committed token per row and reconciles multi-accept
+        # steps through the stale-token drop path) — the former
+        # exclusivity raises died with the unified ragged step
+        # (docs/unified_step.md §dissolved-rules).
         # Learned-position-embedding models (gpt2/opt) index a fixed
         # [max_positions, h] table; JAX clamps out-of-range gathers
         # silently, so positions past the table would all reuse the
@@ -495,13 +489,12 @@ EXCLUSIVITY_RULES = (
      "kv_cache_dtype"),
     ("scheduler.speculative_k", "scheduler.deferred_kv_writes",
      "deferred_kv"),
-    ("scheduler.async_scheduling", "scheduler.decode_steps",
-     "decode_steps"),
-    ("scheduler.async_scheduling", "scheduler.speculative_k",
-     "speculative_k"),
     ("engine_role", "scheduler.speculative_k", "engine_role"),
-    ("engine_role", "scheduler.async_scheduling", "engine_role"),
 )
+# Dissolved by the unified ragged step (docs/unified_step.md):
+#   async_scheduling x decode_steps, async_scheduling x
+#   speculative_k, engine_role x async_scheduling. Those combos are
+#   now legal compositions, not rejected pairs.
 
 
 def bench_1b_model_config() -> ModelConfig:

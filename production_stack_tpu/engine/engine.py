@@ -428,7 +428,11 @@ class LLMEngine:
             for out in outputs:
                 self.sequences.pop(out.seq_id, None)
             return outputs
-        if plan.prefill is not None:
+        if plan.prefill is not None and plan.decode is not None:
+            # Mixed plan (scheduler._plan_mixed): one unified ragged
+            # dispatch carries both sides (docs/unified_step.md).
+            wait_s = self._execute_unified(plan, outputs)
+        elif plan.prefill is not None:
             wait_s = self._execute_prefill(plan, outputs)
         else:
             wait_s = self._execute_decode_sync(plan, outputs)
@@ -497,6 +501,59 @@ class LLMEngine:
                 self.metrics.on_spec_step(drafted, accepted)
         return tr - td
 
+    def _execute_unified(self, plan, outputs) -> float:
+        """One unified ragged step (docs/unified_step.md): decode/
+        draft rows and prefill chunk rows commit out of a single
+        dispatch — decode rows through the spec-verify contract
+        (1..span tokens each), prefill chunks through the ordinary
+        chunked-prefill commit path, handoff shipping included."""
+        td = time.perf_counter()
+        self._note_dispatch(td)
+        (token_lists, lp_lists, prefill_toks,
+         prefill_lps) = self.runner.run_unified(plan)
+        tr = time.perf_counter()
+        self._idle_mark = tr
+        now = time.time()
+        seqs = plan.decode.seqs[: self.runner.decode_width]
+        chunks = plan.prefill.chunks[: self.runner.prefill_width]
+        spec_drafts = plan.decode.drafts
+        self.metrics.on_ragged_step(
+            prefill_rows=len(chunks), decode_rows=len(seqs),
+            pad_rows=(self.runner.last_unified_rows
+                      - len(chunks) - len(seqs)))
+        with self._lock:
+            drafted = accepted = 0
+            for i, (seq, toks) in enumerate(zip(seqs, token_lists)):
+                if spec_drafts is not None:
+                    drafted += len(spec_drafts[i])
+                    accepted += len(toks) - 1
+                emitted = 0
+                for k, tok in enumerate(toks):
+                    if seq.state != SequenceState.RUNNING:
+                        break  # stop hit mid-span: drop the tail
+                    self.scheduler.append_decode_token(seq, tok)
+                    emitted += 1
+                    outputs.append(self._delta(
+                        seq, tok,
+                        lp_lists[i][k] if lp_lists else None))
+                self.metrics.on_decode_tokens(seq, emitted, now)
+                if spec_drafts is not None:
+                    self.scheduler.on_spec_executed(seq)
+            if spec_drafts is not None:
+                self.metrics.on_spec_step(drafted, accepted)
+            for i, (chunk, token) in enumerate(
+                    zip(chunks, prefill_toks)):
+                self.scheduler.on_prefill_executed(chunk, token)
+                if chunk.is_last_chunk:
+                    if (chunk.seq.handoff_prefill
+                            and chunk.seq.state
+                            == SequenceState.RUNNING):
+                        self._ship_handoff(chunk.seq)
+                    outputs.append(self._delta(
+                        chunk.seq, token,
+                        prefill_lps[i] if prefill_lps else None))
+        return tr - td
+
     # ---- overlapped async pipeline (docs/async_pipeline.md) ---------------
 
     def _step_async(self) -> List[StepOutput]:
@@ -509,12 +566,32 @@ class LLMEngine:
         handle = self._in_flight
         if handle is not None:
             t0 = time.perf_counter()
-            with self._lock:
-                rows = self.scheduler.plan_ahead(handle.rows)
+            rows = None
+            if handle.expected_lens is None:
+                with self._lock:
+                    rows = self.scheduler.plan_ahead(handle.rows)
+            # else: this handle is the assume-1 successor of a spec
+            # verify step. Complete it now with the stale-drop filter
+            # (_complete) and re-plan from fresh host state — chaining
+            # another step off a possibly-stale token source can never
+            # recover, as every successor would sample from the same
+            # incomplete context (docs/unified_step.md
+            # §spec-under-async).
             if rows is not None:
-                self._in_flight = self.runner.dispatch_decode(
+                nxt = self.runner.dispatch_decode(
                     rows, token_source=handle.token_source,
                     ahead=True)
+                if handle.is_spec:
+                    # The successor assumed each row commits exactly
+                    # one token; record the total_len that assumption
+                    # predicts so _complete can drop rows where the
+                    # verify committed more (its KV write is identical
+                    # either way — token_source is always the first
+                    # committed token).
+                    nxt.expected_lens = [
+                        None if seq is None else seq.total_len + 1
+                        for seq in rows]
+                self._in_flight = nxt
                 outputs, wait_s = self._complete(handle)
                 # No _idle_mark here: step N+1 was queued before step
                 # N's results were read — the device never idled.
@@ -541,18 +618,45 @@ class LLMEngine:
                 self.sequences.pop(out.seq_id, None)
             return outputs
         if plan.prefill is not None:
-            # Prefill stays synchronous: each chunk's commit feeds
-            # the next chunk's plan.
-            wait_s = self._execute_prefill(plan, outputs)
+            # Prefill (and the mixed ragged step) stays synchronous:
+            # each chunk's commit feeds the next chunk's plan, so
+            # these run as deliberate pipeline breaks.
+            if plan.decode is not None:
+                wait_s = self._execute_unified(plan, outputs)
+            else:
+                wait_s = self._execute_prefill(plan, outputs)
             self.metrics.on_pipeline_step(
                 host_s=(time.perf_counter() - t0) - wait_s,
                 device_wait_s=wait_s, ahead=False)
             self._pop_finished(outputs)
             return outputs
-        # Pure-decode plan: dispatch and return without waiting.
-        # (async_scheduling forbids decode bursts and spec decode —
-        # config.__post_init__ — so the plan is always a single-step
-        # window with no drafts.)
+        if plan.decode.drafts is not None:
+            # Speculative verify step: dispatch it in flight like a
+            # decode step — its commit count is data-dependent, so
+            # the NEXT turn's ahead dispatch assumes one token and
+            # reconciles via the expected_lens stale-drop path
+            # (docs/unified_step.md §spec-under-async).
+            self._note_dispatch(time.perf_counter())
+            self._in_flight = self.runner.dispatch_spec(plan.decode)
+            self.metrics.set_inflight_depth(1)
+            self.metrics.on_pipeline_step(
+                host_s=time.perf_counter() - t0, device_wait_s=0.0,
+                ahead=False)
+            self._pop_finished(outputs)
+            return outputs
+        if plan.decode.window > 1:
+            # Multi-step burst: the burst program already hides host
+            # work for window-1 of its steps, so it runs synchronously
+            # rather than through the depth-1 pipeline (stacking both
+            # overlaps would speculate window tokens ahead).
+            wait_s = self._execute_decode_sync(plan, outputs)
+            self.metrics.on_pipeline_step(
+                host_s=(time.perf_counter() - t0) - wait_s,
+                device_wait_s=wait_s, ahead=False)
+            self._pop_finished(outputs)
+            return outputs
+        # Single-step pure-decode plan: dispatch and return without
+        # waiting; the next turn plans ahead against it.
         self._note_dispatch(time.perf_counter())
         self._in_flight = self.runner.dispatch_decode(
             plan.decode.seqs[: self.runner.decode_width])
@@ -564,22 +668,42 @@ class LLMEngine:
         return outputs
 
     def _complete(self, handle) -> tuple:
-        """Read back + reconcile one dispatched decode step: commit
-        tokens through the same scheduler path as the sync loop. Rows
-        that finished or were aborted mid-flight break out exactly as
-        there; plan-ahead boundary pages ride seq.pages and return
-        through the ordinary free_sequence path, so a mid-flight
-        abort leaks nothing."""
+        """Read back + reconcile one dispatched decode or verify
+        step: commit tokens through the same scheduler path as the
+        sync loop. Rows that finished or were aborted mid-flight
+        break out exactly as there; plan-ahead boundary pages ride
+        seq.pages and return through the ordinary free_sequence path,
+        so a mid-flight abort leaks nothing. Handles carrying
+        ``expected_lens`` (the assume-1 successor of a verify step)
+        drop rows whose committed length diverged from the
+        assumption — the stale-token path of
+        docs/unified_step.md §spec-under-async."""
         tw = time.perf_counter()
         token_lists, lp_lists = handle.result()
         wait_s = time.perf_counter() - tw
         now = time.time()
         outputs: List[StepOutput] = []
+        expected = handle.expected_lens
+        spec_drafts = handle.drafts if handle.is_spec else None
         with self._lock:
+            drafted = accepted = 0
             for i, (seq, toks) in enumerate(
                     zip(handle.rows, token_lists)):
                 if seq is None:  # plan-ahead masked slot
                     continue
+                if expected is not None and (
+                        expected[i] is None
+                        or seq.total_len != expected[i]):
+                    # Stale: the verify step this row was dispatched
+                    # behind committed more than the one token the
+                    # ahead plan assumed, so this sample came from
+                    # incomplete context. Its KV write was identical
+                    # either way (token_source is always the first
+                    # committed token) — only the sample is dropped.
+                    continue
+                if spec_drafts is not None:
+                    drafted += len(spec_drafts[i])
+                    accepted += len(toks) - 1
                 emitted = 0
                 for k, tok in enumerate(toks):
                     if seq.state != SequenceState.RUNNING:
@@ -590,6 +714,10 @@ class LLMEngine:
                         seq, tok,
                         lp_lists[i][k] if lp_lists else None))
                 self.metrics.on_decode_tokens(seq, emitted, now)
+                if spec_drafts is not None:
+                    self.scheduler.on_spec_executed(seq)
+            if spec_drafts is not None:
+                self.metrics.on_spec_step(drafted, accepted)
         self._pop_finished(outputs)
         return outputs, wait_s
 
@@ -655,6 +783,20 @@ class LLMEngine:
                 self.metrics.pipeline_ahead_steps_total,
             "engine_async_inflight_depth":
                 self.metrics.async_inflight_depth,
+            # Unified ragged step occupancy (docs/unified_step.md):
+            # last mixed dispatch's row split plus cumulative totals
+            # for pad-ratio accounting (benchmarks ragged_pad_ratio).
+            "engine_step_prefill_rows":
+                self.metrics.last_prefill_rows,
+            "engine_step_decode_rows":
+                self.metrics.last_decode_rows,
+            "engine_step_pad_rows": self.metrics.last_pad_rows,
+            "engine_ragged_steps_total":
+                self.metrics.ragged_steps_total,
+            "engine_ragged_rows_total":
+                self.metrics.ragged_rows_total,
+            "engine_ragged_pad_rows_total":
+                self.metrics.ragged_pad_rows_total,
             # KV quantization telemetry (docs/kv_quantization.md):
             # post-expansion page budget and worst-case KV bytes a
             # full decode batch writes per step.

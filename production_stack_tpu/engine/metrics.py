@@ -89,6 +89,18 @@ class EngineMetrics:
         self.pipeline_steps_total = 0
         self.pipeline_ahead_steps_total = 0
         self.async_inflight_depth = 0
+        # Unified ragged step (docs/unified_step.md): the last mixed
+        # dispatch's row occupancy split (gauges) plus cumulative row
+        # totals so scrapers can derive the pad ratio
+        # (pad_rows_total / rows_total) over any window. Always
+        # rendered (0 when the feature is off) for a stable scrape
+        # surface.
+        self.last_prefill_rows = 0
+        self.last_decode_rows = 0
+        self.last_pad_rows = 0
+        self.ragged_steps_total = 0
+        self.ragged_rows_total = 0
+        self.ragged_pad_rows_total = 0
         # Disaggregated serving (docs/disaggregation.md): latency from
         # a handoff submission arriving at a decode-role engine to the
         # sequence leaving AWAITING_KV (its pages became reachable or
@@ -101,6 +113,18 @@ class EngineMetrics:
         with self._lock:
             self.spec_draft_tokens_total += drafted
             self.spec_accepted_tokens_total += accepted
+
+    def on_ragged_step(self, prefill_rows: int, decode_rows: int,
+                       pad_rows: int) -> None:
+        """One unified ragged dispatch's row-occupancy split."""
+        with self._lock:
+            self.last_prefill_rows = prefill_rows
+            self.last_decode_rows = decode_rows
+            self.last_pad_rows = pad_rows
+            self.ragged_steps_total += 1
+            self.ragged_rows_total += (prefill_rows + decode_rows
+                                       + pad_rows)
+            self.ragged_pad_rows_total += pad_rows
 
     def on_pipeline_step(self, host_s: float, device_wait_s: float,
                          ahead: bool) -> None:
@@ -221,6 +245,24 @@ class EngineMetrics:
                 "# TYPE vllm:engine_async_inflight_depth gauge",
                 ("vllm:engine_async_inflight_depth "
                  f"{self.async_inflight_depth}"),
+                "# TYPE vllm:engine_step_prefill_rows gauge",
+                ("vllm:engine_step_prefill_rows "
+                 f"{self.last_prefill_rows}"),
+                "# TYPE vllm:engine_step_decode_rows gauge",
+                ("vllm:engine_step_decode_rows "
+                 f"{self.last_decode_rows}"),
+                "# TYPE vllm:engine_step_pad_rows gauge",
+                ("vllm:engine_step_pad_rows "
+                 f"{self.last_pad_rows}"),
+                "# TYPE vllm:engine_ragged_steps_total counter",
+                ("vllm:engine_ragged_steps_total "
+                 f"{self.ragged_steps_total}"),
+                "# TYPE vllm:engine_ragged_rows_total counter",
+                ("vllm:engine_ragged_rows_total "
+                 f"{self.ragged_rows_total}"),
+                "# TYPE vllm:engine_ragged_pad_rows_total counter",
+                ("vllm:engine_ragged_pad_rows_total "
+                 f"{self.ragged_pad_rows_total}"),
             ]
             # vLLM's success counter tracks completed requests only;
             # aborts go to a separate failure counter so reference

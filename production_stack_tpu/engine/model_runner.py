@@ -114,6 +114,24 @@ def async_scheduling_eligible(decode_steps: int, speculative_k: int,
     return (decode_steps == 1 and speculative_k == 0
             and not distributed)
 
+
+def unified_step_eligible(pipeline_parallel: int = 1,
+                          context_parallel: int = 1,
+                          distributed: bool = False,
+                          engine_role: str = "both") -> bool:
+    """The ONE eligibility predicate for the unified ragged step
+    (docs/unified_step.md).
+
+    Used by the server's '--unified-step auto' resolution and
+    bench.py's pass gating — one definition so the call sites cannot
+    drift (the deferred_kv_eligible pattern). The ragged program is a
+    single-runner path: the pp/sp runners use their own step bodies,
+    the multihost bridge broadcasts bimodal payload kinds, and a
+    disaggregated role engine by construction never holds prefill and
+    decode work at once — so none of them can mix rows."""
+    return (pipeline_parallel == 1 and context_parallel == 1
+            and not distributed and engine_role == "both")
+
 # PSTPU_TIMING=1: log every dispatch's wall time (dispatch ->
 # device_get of the sampled tokens, i.e. including device execution)
 # to stderr as "timing <kind> t=<window|bucket> <seconds>". The only
@@ -163,6 +181,8 @@ class DecodeStepHandle:
     synchronous path so sync and async consumers share one format.
     """
 
+    is_spec = False
+
     def __init__(self, runner: "ModelRunner", rows, sampled,
                  want_lp: bool):
         self.runner = runner
@@ -172,6 +192,13 @@ class DecodeStepHandle:
         self.rows = rows
         self.sampled = sampled
         self.want_lp = want_lp
+        # Set by the engine when this step was dispatched ahead of an
+        # unread speculative verify step: expected_lens[i] is the
+        # committed length row i must reach at completion for this
+        # step's assume-one-token planning to have been right; a
+        # mismatch (the verify accepted >= 1 draft) drops the row's
+        # token through the stale-token path (docs/unified_step.md).
+        self.expected_lens = None
 
     @property
     def token_source(self) -> jax.Array:
@@ -191,6 +218,66 @@ class DecodeStepHandle:
              if row is not None and row.sampling.logprobs else None]
             for i, row in enumerate(self.rows)
         ]
+        return token_lists, lp_lists
+
+
+class SpecStepHandle:
+    """One dispatched-but-unread speculative verify step.
+
+    The async pipeline treats a verify step as a decode step with a
+    data-dependent commit count (1..S tokens per row).
+    ``token_source`` exposes the [B] device array of FIRST emitted
+    tokens (e_0): whatever the acceptance turns out to be, e_0 is
+    committed, and the token the assume-one-token ahead dispatch
+    feeds at position L writes position L's CORRECT KV in both cases
+    — if the first draft was accepted the write is bit-identical to
+    the verify step's own, and if it was rejected the write repairs
+    the junk the rejected draft left there (docs/unified_step.md
+    §spec-under-async). ``result()`` performs the step's one blocking
+    device_get and parses exactly like the synchronous spec path.
+    """
+
+    is_spec = True
+    # Verify steps are never themselves dispatched ahead of an unread
+    # verify step (the engine breaks the pipeline instead), so the
+    # stale-drop marker is always unset here.
+    expected_lens = None
+
+    def __init__(self, runner: "ModelRunner", rows, drafts, sampled,
+                 want_lp: bool):
+        self.runner = runner
+        self.rows = rows  # List[Sequence], no None slots
+        self.drafts = drafts  # per-row draft lists (parallel to rows)
+        self.sampled = sampled
+        self.want_lp = want_lp
+
+    @property
+    def token_source(self) -> jax.Array:
+        """[B] device array of each row's first emitted token."""
+        out = self.sampled[0] if self.want_lp else self.sampled
+        return out[:, 0]
+
+    def result(self) -> Tuple[List[List[int]], Optional[list]]:
+        host = jax.device_get(self.sampled)
+        n = len(self.rows)
+        if not self.want_lp:
+            return [[int(t) for t in host[i] if t >= 0]
+                    for i in range(n)], None
+        toks, slp, tids, tlps = host
+        s = toks.shape[1]
+        token_lists, lp_lists = [], []
+        for i, seq in enumerate(self.rows):
+            row_t, row_l = [], []
+            for j in range(s):
+                if toks[i, j] < 0:
+                    break
+                row_t.append(int(toks[i, j]))
+                row_l.append(
+                    self.runner._lp_entry(seq, slp[i, j], tids[i, j],
+                                          tlps[i, j])
+                    if seq.sampling.logprobs else None)
+            token_lists.append(row_t)
+            lp_lists.append(row_l)
         return token_lists, lp_lists
 
 
@@ -559,6 +646,65 @@ class ModelRunner:
                 donate_argnums=(1, 2),  # k_cache, v_cache
             )
 
+        # Unified ragged step (docs/unified_step.md): ONE jitted
+        # program serves genuinely mixed batches — decode/draft rows
+        # and prefill chunk rows share a fixed [R, W] token block
+        # (R and W each snap to closed bucket sets: W from the
+        # prefill buckets, R from a doubling row lattice capped at
+        # decode_width + prefill_width), sampled through the verify
+        # rule so every row kind emits 1..span tokens through one
+        # shape. Row bucketing keeps a lightly mixed step (the common
+        # case: a few decode rows plus one chunk) from paying full-
+        # width compute for pad rows. Pure-decode and pure-prefill
+        # steps keep the bimodal dispatch paths, so greedy streams
+        # stay byte-identical when no mixing happens.
+        self.unified_span = max(self.spec_width, 1)
+        self.unified_rows = self.decode_width + self.prefill_width
+        buckets, b = [], 2
+        while b < self.unified_rows:
+            buckets.append(b)
+            if b + b // 2 < self.unified_rows:
+                buckets.append(b + b // 2)
+            b *= 2
+        buckets.append(self.unified_rows)
+        self.unified_row_buckets = buckets
+        # Last dispatched ragged shape, for occupancy metrics.
+        self.last_unified_rows = 0
+        self._unified = bool(config.scheduler.unified_step)
+        if self._unified:
+            if (config.parallel.pipeline_parallel_size > 1
+                    or self._sp_size > 1):
+                raise NotImplementedError(
+                    "unified_step with pipeline/context parallelism "
+                    "(the pp/sp runners use their own step bodies — "
+                    "unified_step_eligible)")
+            # Mixed batches run through the T>1 prefill attention
+            # path at [R, W] shapes the per-bucket probe never saw:
+            # probe them and degrade ONLY the ragged program to XLA
+            # if Mosaic rejects one — real prefill keeps its
+            # measured-winner kernel (the _spec_model pattern).
+            unified_model = getattr(self, "_spec_model", model_config)
+            prefill_impl = (unified_model.attention_impl_prefill
+                            or unified_model.attention_impl)
+            if (prefill_impl.startswith("pallas")
+                    and jax.default_backend() != "cpu"):
+                err = self._unified_lowering_error(
+                    unified_model, config)
+                if err is not None:
+                    logger.info(
+                        "Unified ragged step serves via XLA "
+                        "attention (Pallas prefill failed lowering "
+                        "at a ragged shape): %s", err)
+                    import copy
+                    unified_model = copy.copy(unified_model)
+                    unified_model.attention_impl_prefill = "xla"
+            self._unified_model = unified_model
+            self._unified_jit = jax.jit(
+                self._unified_impl,
+                static_argnames=("want_logprobs",),
+                donate_argnums=(1, 2),  # k_cache, v_cache
+            )
+
     def _spec_lowering_error(self, model_config,
                              config) -> Optional[str]:
         """Probe the Pallas prefill kernel at the verify shape."""
@@ -589,6 +735,47 @@ class ModelRunner:
             jax.ShapeDtypeStruct((b, max_pages), np.int32),
             jax.ShapeDtypeStruct((b, s), np.int32),
             jax.ShapeDtypeStruct((b,), np.int32), layer0)
+
+    def _unified_lowering_error(self, model_config,
+                                config) -> Optional[str]:
+        """Probe the Pallas prefill kernel at the ragged-step shapes
+        ([unified_rows, W] for every W the mixed planner can emit;
+        the smaller row buckets are strict sub-shapes and are taken
+        to lower whenever the widest one does)."""
+        from production_stack_tpu.ops.prefill_attention_pallas import (
+            paged_prefill_attention,
+        )
+        nh, nkv, d = (model_config.num_attention_heads,
+                      model_config.num_key_value_heads,
+                      model_config.head_dim)
+        dtype = model_config.jax_dtype
+        max_pages = config.scheduler.max_pages_per_seq(
+            config.cache.page_size)
+        if config.cache.cache_layout == "per_layer":
+            cache_shape = (nkv, config.cache.num_pages, d,
+                           config.cache.page_size)
+            layer0 = None
+        else:
+            cache_shape = (model_config.num_hidden_layers, nkv,
+                           config.cache.num_pages, d,
+                           config.cache.page_size)
+            layer0 = jax.ShapeDtypeStruct((), np.int32)
+        cache = (quant_cache_struct(cache_shape) if self.kv_quantized
+                 else jax.ShapeDtypeStruct(cache_shape, dtype))
+        r = self.unified_rows
+        widths = sorted({max(w, self.unified_span)
+                         for w in self._buckets})
+        for w in widths:
+            err = self._lowering_error(
+                paged_prefill_attention,
+                jax.ShapeDtypeStruct((r, w, nh, d), dtype), cache,
+                cache,
+                jax.ShapeDtypeStruct((r, max_pages), np.int32),
+                jax.ShapeDtypeStruct((r, w), np.int32),
+                jax.ShapeDtypeStruct((r,), np.int32), layer0)
+            if err is not None:
+                return err
+        return None
 
     @staticmethod
     def _lowering_error(fn, *args) -> Optional[str]:
@@ -1054,6 +1241,57 @@ class ModelRunner:
             return (out,) + lp, k_cache, v_cache
         return out, k_cache, v_cache
 
+    def _unified_impl(self, params, k_cache, v_cache, tokens,
+                      positions, page_table, kv_lens, valid,
+                      last_index, drafts, draft_lens, temperature,
+                      top_p, top_k, rng, lora, lora_ids,
+                      want_logprobs: bool = False):
+        """One fixed-shape ragged step (docs/unified_step.md).
+
+        ``tokens`` is the [R, W] ragged block: a decode/draft row
+        occupies its leading 1 + draft_len slots exactly like a
+        verify row ([last_committed, d_1..d_k] at positions
+        total_len-1 ..), a prefill chunk row occupies up to W slots
+        of prompt tokens, and pad slots are masked by ``valid`` (KV
+        lands in the trash page). The forward is the T>1
+        chunked-prefill attention path unchanged — its contract
+        (per-row contiguous positions, causal mask against the
+        row's cached context) already covers mixed query lengths
+        against the page table.
+
+        Sampling unifies through the verify rule: the span gather
+        ``span[i, j] = logits[i, last_index_i - draft_lens_i + j]``
+        collects each row's scoring span (a draft row's span starts
+        at its committed token; for draft-free rows the span IS the
+        last real position, draft_lens 0), and spec_verify emits
+        1..span tokens per row through ONE shape — a draft-free
+        greedy row degenerates to the plain argmax, bit-identical
+        to sample_tokens at temperature 0.
+        """
+        logits, k_cache, v_cache = self._forward(
+            params, self._unified_model, tokens, positions,
+            page_table, kv_lens, valid, k_cache, v_cache,
+            lora=lora, lora_ids=lora_ids,
+        )
+        s = drafts.shape[-1] + 1
+        start = jnp.clip(last_index - draft_lens, 0)
+        idx = jnp.clip(start[:, None] + jnp.arange(s)[None, :], 0,
+                       tokens.shape[1] - 1)
+        span = jnp.take_along_axis(logits, idx[:, :, None], axis=1)
+        out = spec_verify(span, drafts, draft_lens, temperature,
+                          top_p, top_k, rng)
+        if want_logprobs:
+            # Raw per-span-position distributions (the OpenAI
+            # contract); positions past a row's emitted count are
+            # discarded by the host parse.
+            b, _, v = span.shape
+            lp = token_logprobs(span.reshape(b * s, v),
+                                jnp.clip(out, 0).reshape(b * s),
+                                TOP_LOGPROBS_WIDTH)
+            lp = tuple(x.reshape((b, s) + x.shape[1:]) for x in lp)
+            return (out,) + lp, k_cache, v_cache
+        return out, k_cache, v_cache
+
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
@@ -1063,6 +1301,12 @@ class ModelRunner:
             if n <= b:
                 return b
         return self._buckets[-1]
+
+    def _row_bucket_for(self, n: int) -> int:
+        for b in self.unified_row_buckets:
+            if n <= b:
+                return b
+        return self.unified_row_buckets[-1]
 
     # ---- payload execution (shared by host 0 and multihost workers) -------
 
@@ -1080,6 +1324,7 @@ class ModelRunner:
         from production_stack_tpu.parallel.distributed import (
             KIND_EMBED,
             KIND_SPEC,
+            KIND_UNIFIED,
         )
         if kind == KIND_EMBED:
             return self.embedder.run_chunk(payload["tokens"],
@@ -1111,6 +1356,29 @@ class ModelRunner:
                 want_logprobs=want_lp,
             )
             return sampled  # [B, S] (+ logprob arrays when requested)
+        if kind == KIND_UNIFIED:
+            # Mixed ragged step: the scheduler only plans eligible
+            # rows (no penalties/seeds/bias/min_tokens/guided — the
+            # spec-row exclusion set), so the program compiles
+            # without those inputs.
+            sampled, self.k_cache, self.v_cache = self._unified_jit(
+                self.params, self.k_cache, self.v_cache,
+                _as_device(payload["tokens"]),
+                _as_device(payload["positions"]),
+                _as_device(payload["page_table"]),
+                _as_device(payload["kv_lens"]),
+                _as_device(payload["valid"]),
+                _as_device(payload["last_index"]),
+                _as_device(payload["drafts"]),
+                _as_device(payload["draft_lens"]),
+                _as_device(payload["temperature"]),
+                _as_device(payload["top_p"]),
+                _as_device(payload["top_k"]),
+                _as_device(payload["rng"]),
+                self._lora_stack, lora_ids,
+                want_logprobs=want_lp,
+            )
+            return sampled  # [R, span] (+ logprobs when requested)
         if kind == 2 and t > 1:
             sampled, self.k_cache, self.v_cache = \
                 self._decode_burst_jit(
@@ -1785,17 +2053,20 @@ class ModelRunner:
             lp_lists.append(row_l)
         return token_lists, lp_lists
 
-    def _run_spec_decode(self, plan: DecodePlan
-                         ) -> Tuple[List[List[int]], Optional[list]]:
-        """One speculative verify dispatch (docs/speculative.md).
+    def dispatch_spec(self, plan: DecodePlan) -> SpecStepHandle:
+        """Build and dispatch ONE speculative verify step with no
+        blocking host read on the path (docs/speculative.md).
 
         Every running row rides the same fixed [B, S] program: rows
         with a draft verify it, rows without (draft_len 0) decode
         exactly one token through the identical shape — occupancy and
-        acceptance counts never change the compiled program. Returns
-        each row's accepted prefix plus the bonus/resample token
-        (1..S tokens, order-correct). The scheduler guarantees row
-        eligibility and that pages cover total_len + draft_len.
+        acceptance counts never change the compiled program. The
+        handle's ``result()`` parses each row's accepted prefix plus
+        the bonus/resample token (1..S tokens, order-correct); its
+        ``token_source`` lets the async pipeline chain an
+        assume-one-token successor before the readback. The scheduler
+        guarantees row eligibility and that pages cover
+        total_len + draft_len.
         """
         from production_stack_tpu.parallel.distributed import KIND_SPEC
         seqs = plan.seqs[: self.decode_width]
@@ -1854,15 +2125,137 @@ class ModelRunner:
         if want_lp:
             payload["want_logprobs"] = True
 
-        t0 = time.perf_counter() if _TIMING else 0.0
         sampled = self._dispatch(KIND_SPEC, s, payload)
+        return SpecStepHandle(
+            self, list(seqs),
+            [list(plan.drafts[i]) for i in range(len(seqs))],
+            sampled, want_lp)
+
+    def _run_spec_decode(self, plan: DecodePlan
+                         ) -> Tuple[List[List[int]], Optional[list]]:
+        """Synchronous verify step: dispatch + immediate readback."""
+        t0 = time.perf_counter() if _TIMING else 0.0
+        out = self.dispatch_spec(plan).result()
+        if _TIMING:
+            _timing_log("spec", self.spec_width,
+                        time.perf_counter() - t0)
+        return out
+
+    # ---- unified ragged step (docs/unified_step.md) -----------------------
+
+    def run_unified(self, plan):
+        """Execute one genuinely mixed step: decode/draft rows and
+        prefill chunk rows in ONE fixed-shape [R, W] ragged program.
+
+        Row layout (the per-row descriptor is the
+        kv_lens/last_index/draft_lens triple — docs/unified_step.md):
+        compact — decode rows at 0..len(seqs)-1 (aligned with
+        plan.decode.seqs), prefill chunk rows immediately after
+        (aligned with plan.prefill.chunks), pads only at the tail.
+        R snaps to the closed ``unified_row_buckets`` lattice so the
+        compiled shape depends on occupancy only through the (row
+        bucket, W bucket) pair, never on batch composition. Returns
+        (decode_token_lists, decode_lp_lists, prefill_tokens,
+        prefill_lp_rows): decode rows commit 1..span tokens (the
+        verify contract), prefill rows one sampled token for last
+        chunks (None mid-prompt).
+        """
+        from production_stack_tpu.parallel.distributed import (
+            KIND_UNIFIED,
+        )
+        seqs = plan.decode.seqs[: self.decode_width]
+        chunks = plan.prefill.chunks[: self.prefill_width]
+        spec_drafts = plan.decode.drafts
+        off = len(seqs)
+        r = self._row_bucket_for(off + len(chunks))
+        self.last_unified_rows = r
+        s = self.unified_span
+        w = max(self._bucket_for(
+            max(len(c.chunk_tokens) for c in chunks)), s)
+
+        tokens = np.zeros((r, w), np.int32)
+        positions = np.zeros((r, w), np.int32)
+        valid = np.zeros((r, w), bool)
+        kv_lens = np.zeros((r,), np.int32)
+        last_index = np.zeros((r,), np.int32)
+        drafts = np.full((r, s - 1), -1, np.int32)
+        draft_lens = np.zeros((r,), np.int32)
+        # Pad rows stay temperature 0 so an all-greedy batch keeps
+        # the verify rule's argmax-only fast path (ops/sampling.py).
+        temperature = np.zeros((r,), np.float32)
+        top_p = np.ones((r,), np.float32)
+        top_k = np.zeros((r,), np.int32)
+        page_table = np.zeros((r, self.max_pages_per_seq), np.int32)
+        lora_ids = (np.zeros((r,), np.int32)
+                    if self.lora_registry is not None else None)
+
+        def _row_static(i, seq):
+            temperature[i] = seq.sampling.temperature
+            top_p[i] = seq.sampling.top_p
+            top_k[i] = seq.sampling.top_k
+            n = min(len(seq.pages), self.max_pages_per_seq)
+            page_table[i, :n] = seq.pages[:n]
+            if lora_ids is not None:
+                lora_ids[i] = seq.lora_id
+
+        for i, seq in enumerate(seqs):
+            d = (spec_drafts[i] if spec_drafts is not None else ())
+            n = 1 + len(d)
+            tokens[i, 0] = (seq.output_token_ids[-1]
+                            if seq.output_token_ids
+                            else seq.prompt_token_ids[-1])
+            tokens[i, 1:n] = d
+            positions[i, :n] = np.arange(seq.total_len - 1,
+                                         seq.total_len - 1 + n)
+            valid[i, :n] = True
+            kv_lens[i] = seq.total_len + len(d)
+            last_index[i] = n - 1
+            drafts[i, :len(d)] = d
+            draft_lens[i] = len(d)
+            _row_static(i, seq)
+
+        for j, chunk in enumerate(chunks):
+            i = off + j
+            n = len(chunk.chunk_tokens)
+            tokens[i, :n] = chunk.chunk_tokens
+            positions[i, :n] = np.arange(chunk.chunk_start,
+                                         chunk.chunk_start + n)
+            valid[i, :n] = True
+            kv_lens[i] = chunk.chunk_start + n
+            last_index[i] = n - 1
+            _row_static(i, chunk.seq)
+
+        payload = {
+            "tokens": tokens,
+            "positions": positions,
+            "valid": valid,
+            "page_table": page_table,
+            "kv_lens": kv_lens,
+            "last_index": last_index,
+            "drafts": drafts,
+            "draft_lens": draft_lens,
+            "temperature": temperature,
+            "top_p": top_p,
+            "top_k": top_k,
+            "rng": np.asarray(self._next_rng()),
+        }
+        if lora_ids is not None:
+            payload["lora_ids"] = lora_ids
+        sampling_rows = (list(seqs)
+                         + [c.seq for c in chunks if c.is_last_chunk])
+        want_lp = any(q.sampling.logprobs for q in sampling_rows)
+        if want_lp:
+            payload["want_logprobs"] = True
+
+        t0 = time.perf_counter() if _TIMING else 0.0
+        sampled = self._dispatch(KIND_UNIFIED, w, payload)
         host = jax.device_get(sampled)
         if _TIMING:
-            _timing_log("spec", s, time.perf_counter() - t0)
-        if not want_lp:
-            return [[int(t) for t in host[i] if t >= 0]
-                    for i in range(len(seqs))], None
-        toks, slp, tids, tlps = host
+            _timing_log("unified", w, time.perf_counter() - t0)
+        if want_lp:
+            toks, slp, tids, tlps = host
+        else:
+            toks = host
         token_lists, lp_lists = [], []
         for i, seq in enumerate(seqs):
             row_t, row_l = [], []
@@ -1870,13 +2263,27 @@ class ModelRunner:
                 if toks[i, j] < 0:
                     break
                 row_t.append(int(toks[i, j]))
-                row_l.append(
-                    self._lp_entry(seq, slp[i, j], tids[i, j],
-                                   tlps[i, j])
-                    if seq.sampling.logprobs else None)
+                if want_lp:
+                    row_l.append(
+                        self._lp_entry(seq, slp[i, j], tids[i, j],
+                                       tlps[i, j])
+                        if seq.sampling.logprobs else None)
             token_lists.append(row_t)
             lp_lists.append(row_l)
-        return token_lists, lp_lists
+        prefill_out, prefill_lps = [], []
+        for j, chunk in enumerate(chunks):
+            i = off + j
+            if not chunk.is_last_chunk:
+                prefill_out.append(None)
+                prefill_lps.append(None)
+                continue
+            prefill_out.append(int(toks[i, 0]))
+            prefill_lps.append(
+                self._lp_entry(chunk.seq, slp[i, 0], tids[i, 0],
+                               tlps[i, 0])
+                if want_lp and chunk.seq.sampling.logprobs else None)
+        return (token_lists, lp_lists if want_lp else None,
+                prefill_out, prefill_lps if want_lp else None)
 
     # ---- page-granular IO (offload tiers) ---------------------------------
 

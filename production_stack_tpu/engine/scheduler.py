@@ -198,6 +198,16 @@ class Scheduler:
             and len(self.running) < self.config.max_num_seqs
         )
         want_decode = bool(self.running)
+        if self.config.unified_step and want_prefill and want_decode:
+            # Unified ragged step (docs/unified_step.md): admit
+            # prefill chunks INTO the decode step under a token
+            # budget instead of alternating whole steps, so long
+            # prompts never stall decode ITL. Falls through to the
+            # bimodal alternation when a row needs per-token host
+            # state the ragged program doesn't compile.
+            plan = self._plan_mixed()
+            if plan is not None and not plan.empty:
+                return plan
         if want_prefill and want_decode:
             # Alternate so neither side starves.
             do_prefill = not self._last_was_prefill
@@ -280,6 +290,64 @@ class Scheduler:
         return DecodePlan(seqs=list(self.running), window=1,
                           drafts=plan_drafts)
 
+    def _plan_mixed(self) -> Optional[StepPlan]:
+        """Plan one unified ragged step: every running sequence as a
+        decode row (with prompt-lookup drafts when the proposer has
+        them — spec rows ride the same span the program already
+        compiles) plus waiting prefill chunks admitted under a token
+        budget matching a dedicated prefill step's full bandwidth
+        (``prefill_chunk_size * prefill_batch_size``) — so admission
+        under mixing proceeds exactly as fast as alternation would,
+        while decode rows keep emitting instead of stalling. Returns
+        None to fall back to bimodal alternation when any running
+        row needs per-row device inputs the ragged program doesn't
+        compile (same exclusion set as _plan_spec / plan_ahead)."""
+        for seq in self.running:
+            sp = seq.sampling
+            if (sp.needs_penalties or sp.seed is not None
+                    or sp.logit_bias
+                    or sp.min_tokens > seq.num_generated
+                    or seq.fsm_state is not None):
+                return None
+        drafts: Dict[str, List[int]] = {}
+        if self.proposer is not None:
+            for seq in self.running:
+                d = self.proposer.propose(seq,
+                                          self._seq_budget(seq) - 1)
+                if d:
+                    drafts[seq.seq_id] = d
+        # Reserve decode-side pages first (1 + draft_len per row);
+        # preemption here shrinks `running` before prefill admission
+        # competes for the same pages.
+        self._ensure_decode_capacity(per_seq={
+            s.seq_id: 1 + len(drafts.get(s.seq_id, ()))
+            for s in self.running})
+        if not self.running:
+            return None
+        prefill = self._plan_prefill(
+            max_tokens=(self.config.prefill_chunk_size
+                        * self.config.prefill_batch_size))
+        if prefill is not None and prefill.sp:
+            # Context-parallel whole-prompt plans run alone (their
+            # dispatch shards the sequence over the mesh); the
+            # decode rows keep their reserved pages for next step.
+            self._last_was_prefill = True
+            return StepPlan(prefill=prefill)
+        plan_drafts = None
+        if drafts:
+            rows = [drafts.get(s.seq_id, []) for s in self.running]
+            if any(rows):
+                plan_drafts = rows
+        if prefill is None and plan_drafts is None:
+            # Nothing ragged about this step (prefill couldn't admit,
+            # no drafts): let the bimodal path plan it — it knows how
+            # to take a decode_steps burst.
+            return None
+        decode = DecodePlan(seqs=list(self.running), window=1,
+                            drafts=plan_drafts)
+        self._last_was_prefill = prefill is not None
+        return StepPlan(prefill=prefill, decode=decode)
+
     def plan_ahead(self, inflight_rows) -> Optional[List[
             Optional[Sequence]]]:
         """Plan decode step N+1 while step N is still in flight
@@ -361,8 +429,14 @@ class Scheduler:
     def _seq_budget(self, seq: Sequence) -> int:
         return decode_budget(seq, self.config.max_model_len)
 
-    def _plan_prefill(self) -> Optional[PrefillPlan]:
+    def _plan_prefill(self, max_tokens: Optional[int] = None
+                      ) -> Optional[PrefillPlan]:
+        # ``max_tokens`` caps the total prompt tokens admitted this
+        # step (unified ragged steps budget prefill work so decode
+        # rows sharing the batch keep their ITL — _plan_mixed); the
+        # final chunk is truncated to fit, resuming next step.
         chunks: List[PrefillChunk] = []
+        tokens_planned = 0
         admitting = 0  # rows that will join `running` this step
         idx = 0
         while (idx < len(self.waiting)
@@ -378,6 +452,9 @@ class Scheduler:
                 continue
             if (len(self.running) + admitting
                     >= self.config.max_num_seqs):
+                break
+            if (max_tokens is not None
+                    and tokens_planned >= max_tokens):
                 break
             if seq.num_computed_tokens == 0 and not seq.pages:
                 # First touch: reuse cached prefix pages, then allocate
@@ -454,6 +531,8 @@ class Scheduler:
             start = seq.num_computed_tokens
             end = min(start + self.config.prefill_chunk_size,
                       seq.num_prompt_tokens)
+            if max_tokens is not None:
+                end = min(end, start + (max_tokens - tokens_planned))
             is_last = end == seq.num_prompt_tokens
             if seq.first_scheduled_time is None:
                 seq.first_scheduled_time = time.time()
@@ -463,6 +542,7 @@ class Scheduler:
                 chunk_tokens=seq.prompt_token_ids[start:end],
                 is_last_chunk=is_last,
             ))
+            tokens_planned += end - start
             if is_last:
                 admitting += 1
             idx += 1

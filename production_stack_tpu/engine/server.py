@@ -1516,14 +1516,17 @@ def _resolve_async_scheduling(args) -> bool:
     """--async-scheduling auto|on|off -> bool.
 
     'auto' enables the overlapped plan/dispatch/complete pipeline
-    (docs/async_pipeline.md) for pure single-step decode serving and
-    stays off where the pipeline cannot run: multi-step bursts and
-    speculative decoding already amortize the host round trip on
-    device (config validation rejects an explicit 'on' there), and
-    the multihost step bridge broadcasts host-resident payloads.
-    A prefill-role engine (docs/disaggregation.md) has no decode
-    steps to overlap, so 'auto' resolves off there — only an
-    explicit 'on' is a config error."""
+    (docs/async_pipeline.md) for pure single-host single-step decode
+    serving: multi-step bursts and speculative decoding already
+    amortize the host round trip on device, so 'auto' keeps the
+    pipeline off there, and the multihost step bridge broadcasts
+    host-resident payloads. An explicit 'on' is legal alongside
+    bursts and --speculative-k (docs/unified_step.md
+    §dissolved-rules): bursts run as synchronous pipeline breaks and
+    verify steps reconcile through the assume-1 stale-drop path. A
+    prefill-role engine (docs/disaggregation.md) has no decode steps
+    to overlap, so 'auto' resolves off and an explicit 'on' is
+    legal but inert."""
     if args.async_scheduling == "on":
         return True
     if args.async_scheduling == "off":
@@ -1536,6 +1539,28 @@ def _resolve_async_scheduling(args) -> bool:
     return async_scheduling_eligible(
         args.decode_steps, args.speculative_k,
         distributed=args.distributed)
+
+
+def _resolve_unified_step(args) -> bool:
+    """--unified-step auto|on|off -> bool.
+
+    'auto' enables the unified ragged step (docs/unified_step.md) —
+    prefill chunks admitted into decode steps as one fixed-shape
+    mixed batch — wherever it can run: single-host, no pp/sp
+    sharding, a monolithic engine role. An explicit 'on' outside
+    that envelope fails loudly at runner init
+    (model_runner.unified_step_eligible)."""
+    if args.unified_step == "on":
+        return True
+    if args.unified_step == "off":
+        return False
+    from production_stack_tpu.engine.model_runner import (
+        unified_step_eligible,
+    )
+    return unified_step_eligible(
+        args.pipeline_parallel_size, args.context_parallel_size,
+        distributed=args.distributed,
+        engine_role=getattr(args, "engine_role", "both"))
 
 
 def build_engine_from_args(args) -> tuple[LLMEngine, str]:
@@ -1609,6 +1634,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             speculative_k=args.speculative_k,
             speculative_min_match=args.speculative_min_match,
             async_scheduling=_resolve_async_scheduling(args),
+            unified_step=_resolve_unified_step(args),
             max_queue_len=args.max_queue_len,
         ),
         parallel=ParallelConfig(
@@ -1708,6 +1734,16 @@ def parse_args(argv=None):
                              "enables it for single-host single-step "
                              "decode (off under --decode-steps > 1, "
                              "--speculative-k > 0, --distributed)")
+    parser.add_argument("--unified-step", default="auto",
+                        choices=["auto", "on", "off"],
+                        help="Unified ragged step: admit prefill "
+                             "chunks into decode steps as one fixed-"
+                             "shape mixed batch instead of "
+                             "alternating whole steps "
+                             "(docs/unified_step.md). 'auto' enables "
+                             "it for single-host monolithic serving "
+                             "(off under pp/sp sharding, "
+                             "--distributed, a disagg --engine-role)")
     parser.add_argument("--deferred-kv-writes", default="auto",
                         choices=["auto", "on", "off"],
                         help="Defer decode KV writes to one batched "

@@ -24,15 +24,17 @@ keeps the whole page walk *inside* one kernel instance:
 - the page loop is a STATIC unroll over the page-table width with
   ``pl.when`` guards on the row's real chunk count — skipped chunks
   issue no DMAs and run no compute, so work still scales with the
-  context actually cached. (A dynamic ``fori_loop`` bound would be
-  tighter code, but dynamic trip counts + DMA semaphores push Mosaic
-  down a rarely-exercised compilation path — observed hanging the
-  AOT compiler on v5e — while the static unroll is the standard
-  public-Pallas shape.)
+  context actually cached,
 - flash-style online softmax accumulated in VMEM scratch,
 - matmuls are 2D ``[G, D] x [D, C*P]`` / ``[G, C*P] x [D, C*P]^T``
   contractions (the MXU forms Mosaic supports), with the query-head
   group padded to >=8 sublanes.
+
+The DMA/page-walk machinery is the SHARED layer in
+ops/paged_kv_common.py — one definition serves this kernel, the
+chunked-prefill kernel and the unified ragged step; only the query
+block layout (single token, group padded to a sublane tile) and the
+score mask (pure ``pos < kv_len``) live here.
 
 Pages past the sequence length DMA the trash page 0 (the allocator
 never hands it out) and are masked; the page-table width is padded to
@@ -57,14 +59,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from production_stack_tpu.ops.quant_kv import QuantKV
-
-try:  # jax >= 0.5 spelling
-    _HBM = pltpu.MemorySpace.HBM
-except AttributeError:  # jax 0.4.x: ANY keeps the operand un-blocked in HBM
-    _HBM = pltpu.TPUMemorySpace.ANY
-
-NEG_INF = -1e30
+from production_stack_tpu.ops.paged_kv_common import (
+    NEG_INF,
+    cache_alias_map,
+    dma_semaphore_shapes,
+    hbm_block_spec,
+    kv_scratch_shapes,
+    make_page_dma,
+    pad_page_table,
+    passthrough_out_shapes,
+    rewrap_cache_outputs,
+    run_page_walk,
+    unwrap_cache,
+    validate_layer_arg,
+)
 
 # Minimum sublane count for the query-group axis: fp32 tiles are
 # (8, 128), so G < 8 would force degenerate layouts.
@@ -88,6 +96,7 @@ def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
     # [.., pages, 1, page_size] so each page's scale row DMAs as the
     # same 2-D (sublane, lane) tile shape as the data pages; they (and
     # their scratch/semaphore) are None for a full-precision cache.
+    del group_pad  # sized into the scratch blocks by the wrapper
     b = pl.program_id(0)
     h = pl.program_id(1)
     c = pages_per_chunk
@@ -97,64 +106,14 @@ def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
     kv_len = kv_lens_ref[b]
     num_chunks = (kv_len + chunk_tokens - 1) // chunk_tokens
 
-    def dma(slot, chunk_idx, j):
-        """DMA page j of chunk chunk_idx into buffer ``slot``.
-
-        Scratch is laid out [slot, d, c*P]: each page lands in its own
-        128-aligned lane window, so after ``c`` copies the buffer IS
-        the [D, chunk_tokens] K/V tile — no in-VMEM reshuffle.
-        """
-        pid = page_table_ref[b, chunk_idx * c + j]
-        if has_layer:
-            # Stacked [L, kv, pages, d, p] cache: the layer index
-            # arrives as a prefetched scalar, so ONE compiled kernel
-            # serves every layer and the caller never slices (an HLO
-            # slice feeding a pallas custom-call materializes the
-            # whole 10s-of-MB layer as a copy).
-            k_src = k_hbm.at[layer_ref[0], h, pid]
-            v_src = v_hbm.at[layer_ref[0], h, pid]
-        else:
-            k_src = k_hbm.at[h, pid]
-            v_src = v_hbm.at[h, pid]
-        copies = [
-            pltpu.make_async_copy(
-                k_src,
-                k_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
-                sem.at[0, slot, j],
-            ),
-            pltpu.make_async_copy(
-                v_src,
-                v_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
-                sem.at[1, slot, j],
-            ),
-        ]
-        if quantized:
-            if has_layer:
-                ks_src = ks_hbm.at[layer_ref[0], h, pid]
-                vs_src = vs_hbm.at[layer_ref[0], h, pid]
-            else:
-                ks_src = ks_hbm.at[h, pid]
-                vs_src = vs_hbm.at[h, pid]
-            copies += [
-                pltpu.make_async_copy(
-                    ks_src,
-                    ks_scratch.at[
-                        slot, :, pl.ds(j * page_size, page_size)],
-                    ssem.at[0, slot, j],
-                ),
-                pltpu.make_async_copy(
-                    vs_src,
-                    vs_scratch.at[
-                        slot, :, pl.ds(j * page_size, page_size)],
-                    ssem.at[1, slot, j],
-                ),
-            ]
-        return copies
-
-    def issue(slot, chunk_idx):
-        for j in range(c):
-            for cp in dma(slot, chunk_idx, j):
-                cp.start()
+    issue, wait = make_page_dma(
+        b=b, h=h, page_table_ref=page_table_ref, layer_ref=layer_ref,
+        k_hbm=k_hbm, v_hbm=v_hbm, ks_hbm=ks_hbm, vs_hbm=vs_hbm,
+        k_scratch=k_scratch, v_scratch=v_scratch,
+        ks_scratch=ks_scratch, vs_scratch=vs_scratch,
+        sem=sem, ssem=ssem, pages_per_chunk=c, page_size=page_size,
+        has_layer=has_layer, quantized=quantized,
+    )
 
     # Padded batch rows have kv_len == 0 -> num_chunks == 0: nothing
     # may be issued for them — an unwaited DMA leaks its semaphore
@@ -168,60 +127,17 @@ def _decode_kernel(page_table_ref, kv_lens_ref, layer_ref, q_ref,
     acc_ref[...] = jnp.zeros_like(acc_ref)
 
     q = q_ref[0, 0].astype(jnp.float32)  # [G_pad, D]
-    scale = 1.0 / (head_dim ** 0.5)
 
-    for chunk_idx in range(max_chunks):
-        @pl.when(chunk_idx < num_chunks)
-        def _chunk(chunk_idx=chunk_idx):
-            slot = chunk_idx % 2
-
-            @pl.when(chunk_idx + 1 < num_chunks)
-            def _prefetch():
-                issue(1 - slot, chunk_idx + 1)
-
-            for j in range(c):
-                for cp in dma(slot, chunk_idx, j):
-                    cp.wait()
-
-            k = k_scratch[slot].astype(jnp.float32)  # [D, C*P]
-            v = v_scratch[slot].astype(jnp.float32)  # [D, C*P]
-            scores = jax.lax.dot_general(
-                q, k,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale  # [G_pad, C*P]
-            if quantized:
-                # Fold the k dequant scales into the logits: exact,
-                # since each scale is constant along the contracted
-                # head_dim axis. [1, C*P] broadcasts over the group.
-                scores = scores * ks_scratch[slot]
-
-            token_pos = (chunk_idx * chunk_tokens
-                         + jax.lax.broadcasted_iota(
-                             jnp.int32, scores.shape, 1))
-            scores = jnp.where(token_pos < kv_len, scores, NEG_INF)
-
-            m_prev = m_ref[...]
-            m_new = jnp.maximum(
-                m_prev, jnp.max(scores, axis=-1, keepdims=True)
-            )
-            alpha = jnp.exp(m_prev - m_new)
-            probs = jnp.exp(scores - m_new)
-            l_ref[...] = l_ref[...] * alpha + jnp.sum(
-                probs, axis=-1, keepdims=True
-            )
-            if quantized:
-                # v dequant folds into the probabilities before the
-                # pv contraction (per-token scales, constant along d).
-                probs = probs * vs_scratch[slot]
-            # pv: [G_pad, D] — contract the token axis of both sides.
-            pv = jax.lax.dot_general(
-                probs, v,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            acc_ref[...] = acc_ref[...] * alpha + pv
-            m_ref[...] = m_new
+    run_page_walk(
+        q=q, kv_len=kv_len, num_chunks=num_chunks,
+        max_chunks=max_chunks, chunk_tokens=chunk_tokens,
+        head_dim=head_dim, issue=issue, wait=wait,
+        k_scratch=k_scratch, v_scratch=v_scratch,
+        ks_scratch=ks_scratch, vs_scratch=vs_scratch,
+        m_ref=m_ref, l_ref=l_ref, acc_ref=acc_ref,
+        mask_fn=lambda token_pos: token_pos < kv_len,
+        quantized=quantized,
+    )
 
     o_ref[0, 0] = (acc_ref[...]
                    / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
@@ -253,26 +169,10 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     (models/llama.py layer loop); this keeps the cache buffer chain
     linear so XLA's copy-insertion never duplicates it.
     """
-    has_layer = k_cache_layer.ndim == 5
-    if has_layer != (layer is not None):
-        raise ValueError(
-            "layer index and cache rank must agree: pass a stacked "
-            "[L, ...] cache WITH layer, or a per-layer [kv, ...] "
-            f"cache WITHOUT (got ndim={k_cache_layer.ndim}, "
-            f"layer={layer!r})")
-    quantized = isinstance(k_cache_layer, QuantKV)
-    if quantized:
-        k_data, v_data = k_cache_layer.data, v_cache_layer.data
-        scale_shape = k_cache_layer.scale.shape
-        # [.., pages, ps] -> [.., pages, 1, ps]: scale DMAs then move
-        # 2-D (1, page_size) tiles, the same (sublane, lane) slicing
-        # discipline as the data pages. Pure bitcast — last axis is
-        # contiguous either way.
-        sshape = scale_shape[:-1] + (1, scale_shape[-1])
-        k_scale = k_cache_layer.scale.reshape(sshape)
-        v_scale = v_cache_layer.scale.reshape(sshape)
-    else:
-        k_data, v_data = k_cache_layer, v_cache_layer
+    has_layer = validate_layer_arg(k_cache_layer, layer)
+    (quantized, k_data, v_data,
+     k_scale, v_scale, scale_shape) = unwrap_cache(
+        k_cache_layer, v_cache_layer)
     layer_arr = jnp.asarray(
         [0 if layer is None else layer], jnp.int32)
     b, num_q_heads, head_dim = q.shape
@@ -281,16 +181,7 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     group_pad = max(group, _MIN_GROUP)
     c = _PAGES_PER_CHUNK
 
-    # Pad the page-table width to a chunk multiple so the DMA loop's
-    # page indices stay in range: the static unroll bound is
-    # max_pages // c, so every index lands inside the padded table
-    # (padded entries point at the trash page and are masked).
-    max_pages = page_table.shape[1]
-    if max_pages % c:
-        page_table = jnp.pad(
-            page_table, ((0, 0), (0, c - max_pages % c))
-        )
-        max_pages = page_table.shape[1]
+    page_table, max_pages = pad_page_table(page_table, c)
 
     # [B, KV, G, D] with the group axis padded up to a full sublane
     # tile; padded rows attend to real keys and are sliced off below.
@@ -331,24 +222,15 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
         base_kernel(pt, kl, la, q_ref, k, v, ks, vs, o_ref,
                     k_s, v_s, ks_s, vs_s, m, l, acc, sem, ssem)
 
-    hbm = pl.BlockSpec(memory_space=_HBM)
-    scratch_shapes = [
-        pltpu.VMEM((2, head_dim, c * page_size), k_data.dtype),
-        pltpu.VMEM((2, head_dim, c * page_size), v_data.dtype),
-    ]
-    if quantized:
-        scratch_shapes += [
-            pltpu.VMEM((2, 1, c * page_size), jnp.float32),  # k scale
-            pltpu.VMEM((2, 1, c * page_size), jnp.float32),  # v scale
-        ]
+    hbm = hbm_block_spec()
+    scratch_shapes = kv_scratch_shapes(
+        head_dim, c, page_size, k_data.dtype, v_data.dtype, quantized)
     scratch_shapes += [
         pltpu.VMEM((group_pad, 1), jnp.float32),  # m
         pltpu.VMEM((group_pad, 1), jnp.float32),  # l
         pltpu.VMEM((group_pad, head_dim), jnp.float32),  # acc
-        pltpu.SemaphoreType.DMA((2, 2, c)),
     ]
-    if quantized:
-        scratch_shapes += [pltpu.SemaphoreType.DMA((2, 2, c))]
+    scratch_shapes += dma_semaphore_shapes(c, quantized)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # page_table, kv_lens, layer
@@ -377,22 +259,9 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     if quantized:
         operands += [k_scale, v_scale]
     if has_layer:
-        out_shape += [
-            jax.ShapeDtypeStruct(k_data.shape, k_data.dtype),
-            jax.ShapeDtypeStruct(v_data.shape, v_data.dtype),
-        ]
-        if quantized:
-            out_shape += [
-                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
-                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
-            ]
-    # Inputs count scalar-prefetch operands: (page_table, kv_lens,
-    # layer, q, k, v[, ks, vs]) -> cache operands starting at 4 alias
-    # outputs starting at 1. Only the stacked (engine) form aliases:
-    # 4D callers keep using their caches afterwards, and aliasing a
-    # still-live value would force the copy it exists to avoid.
-    aliases = ({4 + i: 1 + i for i in range(n_cache_in)}
-               if has_layer else {})
+        out_shape += passthrough_out_shapes(
+            k_data, v_data, k_scale, v_scale, quantized)
+    aliases = cache_alias_map(3, n_cache_in, has_layer)
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
@@ -402,9 +271,6 @@ def paged_decode_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     )(*operands)
     out = res[0][:, :, :group].reshape(b, num_q_heads, head_dim)
     if has_layer:
-        if quantized:
-            return (out,
-                    QuantKV(res[1], res[3].reshape(scale_shape)),
-                    QuantKV(res[2], res[4].reshape(scale_shape)))
-        return out, res[1], res[2]
+        kc, vc = rewrap_cache_outputs(res, scale_shape, quantized)
+        return out, kc, vc
     return out

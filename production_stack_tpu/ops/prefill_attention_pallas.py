@@ -42,14 +42,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from production_stack_tpu.ops.quant_kv import QuantKV
-
-try:  # jax >= 0.5 spelling
-    _HBM = pltpu.MemorySpace.HBM
-except AttributeError:  # jax 0.4.x: ANY keeps the operand un-blocked in HBM
-    _HBM = pltpu.TPUMemorySpace.ANY
-
-NEG_INF = -1e30
+from production_stack_tpu.ops.paged_kv_common import (
+    NEG_INF,
+    cache_alias_map,
+    dma_semaphore_shapes,
+    hbm_block_spec,
+    kv_scratch_shapes,
+    make_page_dma,
+    pad_page_table,
+    passthrough_out_shapes,
+    rewrap_cache_outputs,
+    run_page_walk,
+    unwrap_cache,
+    validate_layer_arg,
+)
 
 # Pages per DMA burst (2 x 128-token pages = a 256-token KV tile per
 # compute step — prefill scores are [G*T, tile], so a fatter tile
@@ -80,56 +86,14 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
     q_start = q_start_ref[b]
     num_chunks = (kv_len + chunk_tokens - 1) // chunk_tokens
 
-    def dma(slot, chunk_idx, j):
-        pid = page_table_ref[b, chunk_idx * c + j]
-        if has_layer:
-            # Stacked cache + prefetched layer scalar: one compiled
-            # kernel for all layers, no materialized layer slice (see
-            # _decode_kernel).
-            k_src = k_hbm.at[layer_ref[0], h, pid]
-            v_src = v_hbm.at[layer_ref[0], h, pid]
-        else:
-            k_src = k_hbm.at[h, pid]
-            v_src = v_hbm.at[h, pid]
-        copies = [
-            pltpu.make_async_copy(
-                k_src,
-                k_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
-                sem.at[0, slot, j],
-            ),
-            pltpu.make_async_copy(
-                v_src,
-                v_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
-                sem.at[1, slot, j],
-            ),
-        ]
-        if quantized:
-            if has_layer:
-                ks_src = ks_hbm.at[layer_ref[0], h, pid]
-                vs_src = vs_hbm.at[layer_ref[0], h, pid]
-            else:
-                ks_src = ks_hbm.at[h, pid]
-                vs_src = vs_hbm.at[h, pid]
-            copies += [
-                pltpu.make_async_copy(
-                    ks_src,
-                    ks_scratch.at[
-                        slot, :, pl.ds(j * page_size, page_size)],
-                    ssem.at[0, slot, j],
-                ),
-                pltpu.make_async_copy(
-                    vs_src,
-                    vs_scratch.at[
-                        slot, :, pl.ds(j * page_size, page_size)],
-                    ssem.at[1, slot, j],
-                ),
-            ]
-        return copies
-
-    def issue(slot, chunk_idx):
-        for j in range(c):
-            for cp in dma(slot, chunk_idx, j):
-                cp.start()
+    issue, wait = make_page_dma(
+        b=b, h=h, page_table_ref=page_table_ref, layer_ref=layer_ref,
+        k_hbm=k_hbm, v_hbm=v_hbm, ks_hbm=ks_hbm, vs_hbm=vs_hbm,
+        k_scratch=k_scratch, v_scratch=v_scratch,
+        ks_scratch=ks_scratch, vs_scratch=vs_scratch,
+        sem=sem, ssem=ssem, pages_per_chunk=c, page_size=page_size,
+        has_layer=has_layer, quantized=quantized,
+    )
 
     # Padded rows (kv_len == 0 -> num_chunks == 0) must not issue the
     # warmup DMAs: the loop never waits them, and an unwaited DMA
@@ -143,7 +107,6 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
     acc_ref[...] = jnp.zeros_like(acc_ref)
 
     q = q_ref[0, 0].astype(jnp.float32)  # [G*T, D]
-    scale = 1.0 / (head_dim ** 0.5)
 
     # Row r of the flattened queries is (g, t) = (r // T, r % T) whose
     # absolute position is q_start + t (chunk positions contiguous).
@@ -151,56 +114,20 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
         jnp.int32, (rows, chunk_tokens), 0
     ) % chunk  # [G*T, C*P]
 
-    for chunk_idx in range(max_chunks):
-        @pl.when(chunk_idx < num_chunks)
-        def _chunk(chunk_idx=chunk_idx):
-            slot = chunk_idx % 2
-
-            @pl.when(chunk_idx + 1 < num_chunks)
-            def _prefetch():
-                issue(1 - slot, chunk_idx + 1)
-
-            for j in range(c):
-                for cp in dma(slot, chunk_idx, j):
-                    cp.wait()
-
-            k = k_scratch[slot].astype(jnp.float32)  # [D, C*P]
-            v = v_scratch[slot].astype(jnp.float32)
-            scores = jax.lax.dot_general(
-                q, k,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale  # [G*T, C*P]
-            if quantized:
-                # k dequant folds into the logits ([1, C*P] broadcast
-                # over the G*T rows); exact — the scale is constant
-                # along the contracted head_dim axis.
-                scores = scores * ks_scratch[slot]
-
-            token_pos = (chunk_idx * chunk_tokens
-                         + jax.lax.broadcasted_iota(
-                             jnp.int32, scores.shape, 1))
-            mask = (token_pos <= q_pos) & (token_pos < kv_len)
-            scores = jnp.where(mask, scores, NEG_INF)
-
-            m_prev = m_ref[...]
-            m_new = jnp.maximum(
-                m_prev, jnp.max(scores, axis=-1, keepdims=True)
-            )
-            alpha = jnp.exp(m_prev - m_new)
-            probs = jnp.exp(scores - m_new)
-            l_ref[...] = l_ref[...] * alpha + jnp.sum(
-                probs, axis=-1, keepdims=True
-            )
-            if quantized:
-                probs = probs * vs_scratch[slot]  # fold v dequant
-            pv = jax.lax.dot_general(
-                probs, v,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [G*T, D]
-            acc_ref[...] = acc_ref[...] * alpha + pv
-            m_ref[...] = m_new
+    run_page_walk(
+        q=q, kv_len=kv_len, num_chunks=num_chunks,
+        max_chunks=max_chunks, chunk_tokens=chunk_tokens,
+        head_dim=head_dim, issue=issue, wait=wait,
+        k_scratch=k_scratch, v_scratch=v_scratch,
+        ks_scratch=ks_scratch, vs_scratch=vs_scratch,
+        m_ref=m_ref, l_ref=l_ref, acc_ref=acc_ref,
+        # Causal over the chunk's own tokens plus everything cached
+        # before it — exactly the ragged mixed-length contract: each
+        # row masks independently off its scalar-prefetched start.
+        mask_fn=lambda token_pos: ((token_pos <= q_pos)
+                                   & (token_pos < kv_len)),
+        quantized=quantized,
+    )
 
     denom = jnp.maximum(l_ref[...], 1e-30)
     o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
@@ -234,24 +161,10 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     form; ``(out, k_cache, v_cache)`` for the stacked 5D form (caches
     pass through the kernel aliased — see paged_decode_attention).
     """
-    has_layer = k_cache_layer.ndim == 5
-    if has_layer != (layer is not None):
-        raise ValueError(
-            "layer index and cache rank must agree: pass a stacked "
-            "[L, ...] cache WITH layer, or a per-layer [kv, ...] "
-            f"cache WITHOUT (got ndim={k_cache_layer.ndim}, "
-            f"layer={layer!r})")
-    quantized = isinstance(k_cache_layer, QuantKV)
-    if quantized:
-        k_data, v_data = k_cache_layer.data, v_cache_layer.data
-        scale_shape = k_cache_layer.scale.shape
-        # [.., pages, ps] -> [.., pages, 1, ps]: scale DMAs then move
-        # 2-D (1, page_size) tiles like the data pages (free bitcast).
-        sshape = scale_shape[:-1] + (1, scale_shape[-1])
-        k_scale = k_cache_layer.scale.reshape(sshape)
-        v_scale = v_cache_layer.scale.reshape(sshape)
-    else:
-        k_data, v_data = k_cache_layer, v_cache_layer
+    has_layer = validate_layer_arg(k_cache_layer, layer)
+    (quantized, k_data, v_data,
+     k_scale, v_scale, scale_shape) = unwrap_cache(
+        k_cache_layer, v_cache_layer)
     layer_arr = jnp.asarray(
         [0 if layer is None else layer], jnp.int32)
     b, t, num_q_heads, head_dim = q.shape
@@ -259,12 +172,7 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     group = num_q_heads // num_kv_heads
     c = _PAGES_PER_CHUNK
 
-    max_pages = page_table.shape[1]
-    if max_pages % c:
-        page_table = jnp.pad(
-            page_table, ((0, 0), (0, c - max_pages % c))
-        )
-        max_pages = page_table.shape[1]
+    page_table, max_pages = pad_page_table(page_table, c)
 
     # [B, T, KV, G, D] -> [B, KV, G*T, D]: rows of one kv head's
     # queries, flattened so kernel matmuls are 2D.
@@ -302,22 +210,15 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
         base_kernel(pt, kl, qs, la, q_ref, k, v, ks, vs, o_ref,
                     m, l, acc, k_s, v_s, ks_s, vs_s, sem, ssem)
 
-    hbm = pl.BlockSpec(memory_space=_HBM)
+    hbm = hbm_block_spec()
     scratch_shapes = [
         pltpu.VMEM((group * t, 1), jnp.float32),  # m
         pltpu.VMEM((group * t, 1), jnp.float32),  # l
         pltpu.VMEM((group * t, head_dim), jnp.float32),  # acc
-        pltpu.VMEM((2, head_dim, c * page_size), k_data.dtype),
-        pltpu.VMEM((2, head_dim, c * page_size), v_data.dtype),
     ]
-    if quantized:
-        scratch_shapes += [
-            pltpu.VMEM((2, 1, c * page_size), jnp.float32),  # k scale
-            pltpu.VMEM((2, 1, c * page_size), jnp.float32),  # v scale
-        ]
-    scratch_shapes += [pltpu.SemaphoreType.DMA((2, 2, c))]
-    if quantized:
-        scratch_shapes += [pltpu.SemaphoreType.DMA((2, 2, c))]
+    scratch_shapes += kv_scratch_shapes(
+        head_dim, c, page_size, k_data.dtype, v_data.dtype, quantized)
+    scratch_shapes += dma_semaphore_shapes(c, quantized)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,  # page_table, kv_lens, q_start, layer
@@ -344,21 +245,9 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     if quantized:
         operands += [k_scale, v_scale]
     if has_layer:
-        out_shape += [
-            jax.ShapeDtypeStruct(k_data.shape, k_data.dtype),
-            jax.ShapeDtypeStruct(v_data.shape, v_data.dtype),
-        ]
-        if quantized:
-            out_shape += [
-                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
-                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
-            ]
-    # Inputs count scalar-prefetch operands: (page_table, kv_lens,
-    # q_start, layer, q, k, v[, ks, vs]) -> cache operands starting at
-    # 5 alias outputs starting at 1. Only the stacked (engine) form
-    # aliases — see paged_decode_attention.
-    aliases = ({5 + i: 1 + i for i in range(n_cache_in)}
-               if has_layer else {})
+        out_shape += passthrough_out_shapes(
+            k_data, v_data, k_scale, v_scale, quantized)
+    aliases = cache_alias_map(4, n_cache_in, has_layer)
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
@@ -370,9 +259,6 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
            .transpose(0, 3, 1, 2, 4)
            .reshape(b, t, num_q_heads, head_dim))
     if has_layer:
-        if quantized:
-            return (out,
-                    QuantKV(res[1], res[3].reshape(scale_shape)),
-                    QuantKV(res[2], res[4].reshape(scale_shape)))
-        return out, res[1], res[2]
+        kc, vc = rewrap_cache_outputs(res, scale_shape, quantized)
+        return out, kc, vc
     return out

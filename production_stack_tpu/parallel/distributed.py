@@ -34,6 +34,7 @@ KIND_PREFILL = 1
 KIND_DECODE = 2
 KIND_EMBED = 3  # /v1/embeddings|score|rerank batches (engine/embeddings.py)
 KIND_SPEC = 4  # speculative verify step (docs/speculative.md)
+KIND_UNIFIED = 5  # mixed ragged step (docs/unified_step.md)
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -113,6 +114,11 @@ class MultihostStepBridge:
             # decode slot; t is static per engine config so the shape
             # is derivable from the header.
             b, tt = r.decode_width, t
+        elif kind == KIND_UNIFIED:
+            # Mixed ragged step (docs/unified_step.md): decode and
+            # prefill rows share one [R, W] block; W rides the header
+            # and the row count / draft span are config-static.
+            b, tt = r.unified_rows, t
         else:
             b, tt = r.decode_width, 1
         template = {
@@ -131,6 +137,12 @@ class MultihostStepBridge:
             # Draft tokens per row (-1 padded) + true draft lengths;
             # the acceptance rule runs in-graph (ops/sampling.py).
             template["drafts"] = np.zeros((b, t - 1), np.int32)
+            template["draft_lens"] = np.zeros((b,), np.int32)
+        if kind == KIND_UNIFIED:
+            # Every unified row carries the draft span (zero-length
+            # for prefill/plain-decode rows); width is config-static.
+            template["drafts"] = np.zeros(
+                (b, r.unified_span - 1), np.int32)
             template["draft_lens"] = np.zeros((b,), np.int32)
         if kind == KIND_DECODE and t > 1:
             # Decode bursts carry per-row lifecycle state

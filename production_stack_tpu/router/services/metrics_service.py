@@ -84,6 +84,29 @@ engine_async_inflight_depth = Gauge(
     "vllm:engine_async_inflight_depth",
     "Engine-reported dispatched-but-unread decode steps (scraped)",
     _LBL)
+engine_step_prefill_rows = Gauge(
+    "vllm:engine_step_prefill_rows",
+    "Engine-reported prefill rows in the last unified ragged step "
+    "(scraped)", _LBL)
+engine_step_decode_rows = Gauge(
+    "vllm:engine_step_decode_rows",
+    "Engine-reported decode rows in the last unified ragged step "
+    "(scraped)", _LBL)
+engine_step_pad_rows = Gauge(
+    "vllm:engine_step_pad_rows",
+    "Engine-reported pad rows in the last unified ragged step "
+    "(scraped)", _LBL)
+engine_ragged_steps = Gauge(
+    "vllm:engine_ragged_steps",
+    "Engine-reported unified ragged steps executed (scraped)", _LBL)
+engine_ragged_rows = Gauge(
+    "vllm:engine_ragged_rows",
+    "Engine-reported cumulative unified-step row slots (scraped)",
+    _LBL)
+engine_ragged_pad_rows = Gauge(
+    "vllm:engine_ragged_pad_rows",
+    "Engine-reported cumulative unified-step pad rows (scraped)",
+    _LBL)
 engine_kv_cache_page_capacity = Gauge(
     "vllm:engine_kv_cache_page_capacity",
     "Engine-reported KV page budget after any int8 expansion "
@@ -222,6 +245,18 @@ def refresh_gauges() -> None:
             es.engine_pipeline_ahead_steps)
         engine_async_inflight_depth.labels(server=server).set(
             es.engine_async_inflight_depth)
+        engine_step_prefill_rows.labels(server=server).set(
+            es.engine_step_prefill_rows)
+        engine_step_decode_rows.labels(server=server).set(
+            es.engine_step_decode_rows)
+        engine_step_pad_rows.labels(server=server).set(
+            es.engine_step_pad_rows)
+        engine_ragged_steps.labels(server=server).set(
+            es.engine_ragged_steps)
+        engine_ragged_rows.labels(server=server).set(
+            es.engine_ragged_rows)
+        engine_ragged_pad_rows.labels(server=server).set(
+            es.engine_ragged_pad_rows)
         engine_kv_cache_page_capacity.labels(server=server).set(
             es.engine_kv_cache_page_capacity)
         engine_kv_bytes_per_decode_step.labels(server=server).set(

@@ -47,6 +47,15 @@ _METRIC_MAP = {
     "vllm:engine_pipeline_ahead_steps_total":
         "engine_pipeline_ahead_steps",
     "vllm:engine_async_inflight_depth": "engine_async_inflight_depth",
+    # Unified ragged step occupancy (engine docs/unified_step.md):
+    # per-step row split gauges plus cumulative row totals; pad ratio
+    # = pad_rows / rows when rows > 0.
+    "vllm:engine_step_prefill_rows": "engine_step_prefill_rows",
+    "vllm:engine_step_decode_rows": "engine_step_decode_rows",
+    "vllm:engine_step_pad_rows": "engine_step_pad_rows",
+    "vllm:engine_ragged_steps_total": "engine_ragged_steps",
+    "vllm:engine_ragged_rows_total": "engine_ragged_rows",
+    "vllm:engine_ragged_pad_rows_total": "engine_ragged_pad_rows",
     # KV quantization telemetry (engine docs/kv_quantization.md):
     # post-expansion page budget and worst-case bytes written per
     # decode step. The storage dtype itself travels as a label on
@@ -110,6 +119,15 @@ class EngineStats:
     engine_pipeline_steps: float = 0.0
     engine_pipeline_ahead_steps: float = 0.0
     engine_async_inflight_depth: float = 0.0
+    # Unified ragged step occupancy (engine docs/unified_step.md):
+    # last mixed dispatch's prefill/decode/pad row split and the
+    # cumulative row totals behind the pad ratio.
+    engine_step_prefill_rows: float = 0.0
+    engine_step_decode_rows: float = 0.0
+    engine_step_pad_rows: float = 0.0
+    engine_ragged_steps: float = 0.0
+    engine_ragged_rows: float = 0.0
+    engine_ragged_pad_rows: float = 0.0
     # KV page storage (engine docs/kv_quantization.md): page budget
     # after any int8 expansion, worst-case KV write bytes per decode
     # step, and the storage dtype ("bf16"/"int8"; "" until scraped).

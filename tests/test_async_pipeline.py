@@ -75,10 +75,12 @@ def _run_mixed(engine):
 
 
 def test_config_gating():
-    with pytest.raises(ValueError, match="decode_steps"):
-        _engine(async_on=True, decode_steps=4)
-    with pytest.raises(ValueError, match="speculative_k"):
-        _engine(async_on=True, speculative_k=4)
+    # async x decode_steps and async x speculative_k are dissolved
+    # exclusivity rules (docs/unified_step.md): bursts run as
+    # synchronous pipeline breaks, verify steps reconcile through the
+    # assume-1 stale-drop path. Both now construct.
+    _engine(async_on=True, decode_steps=4)
+    _engine(async_on=True, speculative_k=4)
     from production_stack_tpu.engine.model_runner import (
         async_scheduling_eligible,
     )
@@ -108,7 +110,8 @@ def test_server_auto_resolution():
         parse_args(["--engine-role", "prefill"]))
     assert _resolve_async_scheduling(
         parse_args(["--engine-role", "decode"]))
-    # Explicit 'on' passes resolution; the config validates later.
+    # Explicit 'on' alongside bursts is legal (docs/unified_step.md):
+    # burst plans simply run as synchronous pipeline breaks.
     assert _resolve_async_scheduling(
         parse_args(["--async-scheduling", "on", "--decode-steps", "4"]))
 

@@ -78,10 +78,14 @@ def test_engine_role_prefill_rejects_speculative_k():
                  scheduler=SchedulerConfig(speculative_k=2))
 
 
-def test_engine_role_prefill_rejects_async_scheduling():
-    with pytest.raises(ValueError, match="engine_role"):
-        EngineConfig(engine_role="prefill",
-                     scheduler=SchedulerConfig(async_scheduling=True))
+def test_engine_role_prefill_accepts_async_scheduling():
+    """role x async is a dissolved exclusivity rule
+    (docs/unified_step.md): async on a prefill-role engine is legal
+    but inert — there are no decode steps to overlap, so the loop
+    never dispatches ahead. The server's 'auto' still resolves it
+    off (test_async_pipeline.test_server_auto_resolution)."""
+    EngineConfig(engine_role="prefill",
+                 scheduler=SchedulerConfig(async_scheduling=True))
     EngineConfig(engine_role="both",
                  scheduler=SchedulerConfig(async_scheduling=True))
 
