@@ -979,6 +979,277 @@ def run_unified_worker(mode: str) -> None:
     }))
 
 
+def run_autoscale_worker() -> None:
+    """Fleet autoscale bench (docs/fleet.md): router + fleet manager +
+    a pool of fake-engine subprocesses driven through a load step up
+    (SLO breach -> 1 -> 2 replicas) and back down (recovery -> 2 -> 1
+    with a zero-loss drain). Reports the replica trajectory, the
+    goodput against a TTFT+ITL SLO, and a hard zero count of dropped
+    or 5xx'd requests across both transitions — the invariant the
+    drain sequence exists to hold.
+
+    Fake engines only (CPU, no JAX): the phase measures the control
+    loop and the drain protocol, not model throughput.
+    """
+    import asyncio
+    import socket
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import aiohttp
+    from aiohttp import web
+
+    from production_stack_tpu.fleet.manager import LIVE, FleetManager
+    from production_stack_tpu.fleet.spec import (
+        AutoscalerSpec,
+        FleetSpec,
+        PoolSpec,
+    )
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.dynamic_config import (
+        initialize_dynamic_config_watcher,
+    )
+    from production_stack_tpu.router.resilience import (
+        ResilienceConfig,
+        initialize_resilience,
+    )
+    from production_stack_tpu.router.routing.logic import (
+        initialize_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        initialize_service_discovery,
+    )
+    from production_stack_tpu.router.services.rewriter import (
+        initialize_request_rewriter,
+    )
+    from production_stack_tpu.router.stats.engine_stats import (
+        get_engine_stats_scraper,
+        initialize_engine_stats_scraper,
+    )
+    from production_stack_tpu.router.stats.request_stats import (
+        initialize_request_stats_monitor,
+    )
+
+    speed = float(os.environ.get("BENCH_AUTOSCALE_SPEED", "200"))
+    out_len = int(os.environ.get("BENCH_AUTOSCALE_OUT_LEN", "40"))
+    slo_ttft = float(os.environ.get("BENCH_AUTOSCALE_SLO_TTFT_S", "0.5"))
+    slo_itl = float(os.environ.get("BENCH_AUTOSCALE_SLO_ITL_S", "0.1"))
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    async def run():
+        t_start = time.time()
+        initialize_service_discovery("static", urls=[], models=[],
+                                     roles=[])
+        initialize_request_stats_monitor(60.0)
+        initialize_engine_stats_scraper(3600.0)
+        initialize_routing_logic("roundrobin")
+        initialize_request_rewriter("noop")
+        initialize_resilience(ResilienceConfig(
+            max_retries=2, backend_connect_timeout=2.0,
+            backend_timeout=30.0, health_check_interval=0.0))
+        runner = web.AppRunner(build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        router_url = ("http://127.0.0.1:"
+                      f"{site._server.sockets[0].getsockname()[1]}")
+
+        config_path = os.path.join(tempfile.mkdtemp(), "dyn.json")
+        base = free_port()
+        spec = FleetSpec(
+            pools=[PoolSpec(
+                name="decode", role="decode", min_replicas=1,
+                max_replicas=3, model="bench-fake",
+                command=[sys.executable, "-m",
+                         "production_stack_tpu.testing.fake_engine",
+                         "--host", "127.0.0.1", "--port", "{port}",
+                         "--model", "{model}", "--role", "{role}",
+                         "--speed", str(speed), "--ttft", "0.0"],
+                autoscaler=AutoscalerSpec(
+                    target_waiting_per_replica=4.0, tolerance=0.1,
+                    scale_up_cooldown_s=0.0,
+                    scale_down_cooldown_s=0.0))],
+            port_start=base, port_end=base + 9,
+            router_url=router_url, router_config_path=config_path,
+            drain_timeout_s=30.0,
+        )
+        mgr = FleetManager(spec)
+        session = aiohttp.ClientSession()
+        trajectory = []  # (seconds since start, desired, live)
+        results = []     # per-request {status, ttft, itl[], error}
+
+        def live_count():
+            return sum(1 for r in mgr.replicas["decode"]
+                       if r.state == LIVE)
+
+        def sample():
+            trajectory.append((round(time.time() - t_start, 2),
+                               mgr.desired["decode"], live_count()))
+
+        async def settle(want):
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                await mgr.reconcile_once()
+                replicas = mgr.replicas["decode"]
+                if (live_count() == want
+                        and len(replicas) == want):
+                    sample()
+                    return
+                await asyncio.sleep(0.05)
+            raise RuntimeError(f"pool never settled at {want}")
+
+        async def one_request():
+            rec = {"status": None, "ttft": None, "itl": [],
+                   "error": None}
+            body = {"model": "bench-fake",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": out_len, "stream": True}
+            t0 = time.time()
+            last = None
+            try:
+                async with session.post(
+                        router_url + "/v1/chat/completions",
+                        json=body) as resp:
+                    rec["status"] = resp.status
+                    async for raw in resp.content:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if (not line.startswith("data: ")
+                                or line == "data: [DONE]"):
+                            continue
+                        delta = json.loads(
+                            line[len("data: "):])["choices"][0]["delta"]
+                        if not delta.get("content"):
+                            continue
+                        now = time.time()
+                        if rec["ttft"] is None:
+                            rec["ttft"] = now - t0
+                        elif last is not None:
+                            rec["itl"].append(now - last)
+                        last = now
+            except Exception as e:
+                rec["error"] = f"{type(e).__name__}: {e}"
+            results.append(rec)
+
+        async def burst(n):
+            await asyncio.gather(*(one_request() for _ in range(n)))
+
+        await settle(1)
+        watcher = initialize_dynamic_config_watcher(config_path, 3600.0)
+        watcher.check_and_apply()
+        (first,) = mgr.replicas["decode"]
+        await burst(4)
+
+        # Load step up: injected queue depth breaches the 4/replica
+        # target; requests keep flowing through the transition.
+        async with session.post(first.url + "/gauges",
+                                json={"waiting": 8}):
+            pass
+        get_engine_stats_scraper().scrape_once()
+        t_breach = time.time()
+        desired = await mgr.autoscale_once()
+        assert desired["decode"] == 2, desired
+        sample()
+        inflight = asyncio.ensure_future(burst(4))
+        await settle(2)
+        scale_up_s = time.time() - t_breach
+        watcher.check_and_apply()
+        await inflight
+        await burst(6)
+
+        # Recovery: queues empty; the newest replica drains while it
+        # still owns a live stream, and router traffic keeps flowing.
+        live = list(mgr.replicas["decode"])
+        for replica in live:
+            async with session.post(replica.url + "/gauges",
+                                    json={"waiting": 0}):
+                pass
+        get_engine_stats_scraper().scrape_once()
+        victim = max(live, key=lambda r: r.port)
+        n_stream = int(2 * speed)  # ~2s: spans the whole drain
+        parked = await session.post(
+            victim.url + "/v1/chat/completions",
+            json={"model": "bench-fake",
+                  "messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": n_stream, "stream": True})
+        t_recover = time.time()
+        desired = await mgr.autoscale_once()
+        assert desired["decode"] == 1, desired
+        await mgr.reconcile_once()
+        sample()
+        watcher.check_and_apply()
+        inflight = asyncio.ensure_future(burst(6))
+        parked_text = await parked.text()
+        parked_tokens = parked_text.count('"content": "tok')
+        await settle(1)
+        scale_down_s = time.time() - t_recover
+        await inflight
+        drained_clean = victim.process.poll() is not None
+
+        await mgr.drain_all()
+        await mgr.close()
+        await session.close()
+        await runner.cleanup()
+        return dict(
+            trajectory=trajectory, results=results,
+            scale_up_s=scale_up_s, scale_down_s=scale_down_s,
+            parked_tokens=parked_tokens, n_stream=n_stream,
+            drained_clean=drained_clean)
+
+    out = asyncio.run(run())
+
+    def pctl(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    results = out["results"]
+    dropped = sum(1 for r in results if r["error"] is not None)
+    n_5xx = sum(1 for r in results
+                if r["status"] is not None and r["status"] >= 500)
+    ttfts = [r["ttft"] for r in results if r["ttft"] is not None]
+    itls = [gap for r in results for gap in r["itl"]]
+    good = sum(
+        1 for r in results
+        if r["status"] == 200 and r["error"] is None
+        and r["ttft"] is not None and r["ttft"] <= slo_ttft
+        and (pctl(r["itl"], 0.99) or 0.0) <= slo_itl)
+    goodput = good / len(results) if results else 0.0
+    print(json.dumps({
+        "metric": "fleet autoscale bench: SLO goodput across a "
+                  "1->2->1 scale cycle with zero-loss drain",
+        "value": round(goodput, 4),
+        "unit": "fraction",
+        "vs_baseline": 0.0,
+        "extra": {
+            "autoscale_replica_trajectory": out["trajectory"],
+            "autoscale_requests_total": len(results),
+            "autoscale_dropped": dropped,
+            "autoscale_5xx": n_5xx,
+            "autoscale_goodput": round(goodput, 4),
+            "autoscale_slo_ttft_s": slo_ttft,
+            "autoscale_slo_itl_s": slo_itl,
+            "autoscale_ttft_p50_s": round(pctl(ttfts, 0.5) or -1.0, 4),
+            "autoscale_ttft_p99_s": round(pctl(ttfts, 0.99) or -1.0, 4),
+            "autoscale_itl_p99_s": round(pctl(itls, 0.99) or -1.0, 4),
+            "autoscale_scale_up_s": round(out["scale_up_s"], 2),
+            "autoscale_scale_down_s": round(out["scale_down_s"], 2),
+            "autoscale_drained_stream_tokens": out["parked_tokens"],
+            "autoscale_drained_stream_expected": out["n_stream"],
+            "autoscale_drained_stream_intact": (
+                out["parked_tokens"] == out["n_stream"]),
+            "autoscale_drained_replica_exited": out["drained_clean"],
+        },
+    }))
+
+
 def _spawn_worker(impl: str, tpu: bool, timeout: int, extra_env=None):
     """Run one benchmark worker; returns (result_dict | None, error)."""
     cmd = [sys.executable, os.path.abspath(__file__),
@@ -1022,6 +1293,8 @@ def main() -> None:
         elif impl == "unified":
             run_unified_worker(
                 os.environ.get("BENCH_UNIFIED_MODE", "off"))
+        elif impl == "autoscale":
+            run_autoscale_worker()
         else:
             run_worker(impl, tpu="--tpu" in sys.argv)
         return
@@ -1187,6 +1460,23 @@ def main() -> None:
                         "interactive_tokens",
                         "long_requests_finished"):
                 result["extra"][f"{tag}_{key}"] = ue.get(key)
+
+        # Fleet autoscale phase (docs/fleet.md): the control loop +
+        # zero-loss drain over fake-engine subprocesses — replica
+        # trajectory, SLO goodput, and a hard zero dropped/5xx count
+        # across the 1->2->1 cycle ride in extra under autoscale_*.
+        sys.stderr.write(f"[bench] running autoscale worker "
+                         f"(timeout {timeout}s)...\n")
+        as_result, as_err = _spawn_worker(
+            "autoscale", False, timeout,
+            extra_env={"JAX_PLATFORMS": "cpu"})
+        if as_result is None:
+            errors["autoscale_error"] = as_err
+            sys.stderr.write(f"[bench] WARNING: {as_err}\n")
+        else:
+            for key, value in as_result.get("extra", {}).items():
+                if key.startswith("autoscale_"):
+                    result["extra"][key] = value
 
     if result is None:
         # Never hang the driver: report the failure as the metric line.

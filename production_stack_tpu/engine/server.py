@@ -412,7 +412,8 @@ class EngineServer:
     def __init__(self, engine: LLMEngine, served_model_name: str,
                  pooling: str = "last",
                  profile_dir: Optional[str] = None,
-                 chat_template: Optional[str] = None):
+                 chat_template: Optional[str] = None,
+                 drain_exit_timeout_s: float = 0.0):
         self.async_engine = AsyncEngine(engine)
         self.engine = engine
         self.model_name = served_model_name
@@ -425,6 +426,14 @@ class EngineServer:
         # Jinja source overriding the model's chat template (vLLM's
         # --chat-template; a path is read by main()).
         self.chat_template = chat_template
+        # Zero-loss drain (docs/fleet.md): once POST /drain flips this,
+        # new admissions get 503+Retry-After (the resilience layer's
+        # retryable-rejection semantics) while in-flight generation
+        # requests run to completion untouched.
+        self.draining = False
+        self.drain_exit_timeout_s = drain_exit_timeout_s
+        self._active_generations = 0
+        self._drain_exit_task: Optional[asyncio.Task] = None
 
     # -- decoding helpers ---------------------------------------------------
 
@@ -1367,11 +1376,94 @@ class EngineServer:
     async def health(self, request: web.Request):
         # ``role`` feeds the router's role-aware discovery
         # (router/service_discovery.py probes it; absent on older
-        # engines -> treated as "both").
+        # engines -> treated as "both"). ``draining`` makes the active
+        # health prober fail the endpoint out of routing while its
+        # in-flight streams finish (docs/fleet.md); the fleet manager
+        # polls ``active_requests`` to know when a SIGTERM is loss-free.
         return web.json_response({
             "status": "ok",
             "role": self.engine.config.engine_role,
+            "draining": self.draining,
+            "active_requests": self._active_generations,
         })
+
+    # -- zero-loss drain (docs/fleet.md) ------------------------------------
+
+    def _drain_rejection(self) -> Optional[web.Response]:
+        if not self.draining:
+            return None
+        return web.json_response(
+            {"error": {"message": "engine is draining; retry on "
+                                  "another replica"}},
+            status=503, headers={"Retry-After": "1"},
+        )
+
+    def _guarded(self, handler):
+        """Wrap a generation handler: reject while draining, count the
+        request as in-flight otherwise. The counter — not the engine's
+        queue depth alone — gates drain-exit, because a stream keeps
+        writing after its last engine step."""
+        async def wrapped(request: web.Request):
+            rejection = self._drain_rejection()
+            if rejection is not None:
+                return rejection
+            self._active_generations += 1
+            try:
+                return await handler(request)
+            finally:
+                self._active_generations -= 1
+        return wrapped
+
+    async def drain(self, request: web.Request):
+        """POST /drain: flip to DRAINING. New admissions are rejected
+        with 503+Retry-After (the router retries them on another
+        replica); everything already admitted finishes normally. With
+        ``{"exit": true}`` the process exits clean once idle — the path
+        the fleet manager uses so it never has to SIGKILL an engine
+        that still has running sequences."""
+        body: dict = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                body = {}
+        already = self.draining
+        self.draining = True
+        if not already:
+            logger.info("Drain requested: rejecting new admissions, "
+                        "%d generation request(s) in flight",
+                        self._active_generations)
+        if body.get("exit") and self._drain_exit_task is None:
+            self._drain_exit_task = asyncio.ensure_future(
+                self._exit_when_idle())
+        stats = self.engine.stats()
+        return web.json_response({
+            "status": "draining",
+            "active_requests": self._active_generations,
+            "running": stats["num_requests_running"],
+            "waiting": stats["num_requests_waiting"],
+        })
+
+    async def _exit_when_idle(self) -> None:
+        """Wait for every in-flight generation to finish, then stop the
+        process via SIGTERM (aiohttp's run_app shuts down gracefully on
+        it). --drain-exit-timeout-s bounds the wait; 0 waits forever —
+        the fleet manager applies its own deadline instead."""
+        import os
+        import signal
+        deadline = (time.time() + self.drain_exit_timeout_s
+                    if self.drain_exit_timeout_s > 0 else None)
+        while (self._active_generations > 0
+               or self.engine.has_work()):
+            if deadline is not None and time.time() >= deadline:
+                logger.warning(
+                    "Drain exit timeout (%.1fs) with %d request(s) "
+                    "still in flight; exiting anyway",
+                    self.drain_exit_timeout_s, self._active_generations)
+                break
+            await asyncio.sleep(0.05)
+        logger.info("Drain complete; exiting")
+        os.kill(os.getpid(), signal.SIGTERM)
 
     async def profiler_start(self, request: web.Request):
         """Start a JAX profiler trace (view in TensorBoard/XProf).
@@ -1457,6 +1549,10 @@ class EngineServer:
         lines.append("# TYPE vllm:disagg_awaiting_kv_requests gauge")
         lines.append("vllm:disagg_awaiting_kv_requests "
                      f"{float(stats['disagg_awaiting_kv_requests'])}")
+        # Zero-loss drain (docs/fleet.md): 1 while new admissions are
+        # rejected and in-flight sequences finish.
+        lines.append("# TYPE vllm:engine_draining gauge")
+        lines.append(f"vllm:engine_draining {float(self.draining)}")
         # vLLM-parity request-latency histograms + token counters.
         lines.extend(self.engine.metrics.render())
         lines.append("")
@@ -1465,10 +1561,15 @@ class EngineServer:
 
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=1024 ** 3)
-        app.router.add_post("/v1/chat/completions", self.chat_completions)
-        app.router.add_post("/v1/completions", self.completions)
-        app.router.add_post("/v1/disagg/prefill", self.disagg_prefill)
-        app.router.add_post("/v1/disagg/handoff", self.disagg_handoff)
+        app.router.add_post("/v1/chat/completions",
+                            self._guarded(self.chat_completions))
+        app.router.add_post("/v1/completions",
+                            self._guarded(self.completions))
+        app.router.add_post("/v1/disagg/prefill",
+                            self._guarded(self.disagg_prefill))
+        app.router.add_post("/v1/disagg/handoff",
+                            self._guarded(self.disagg_handoff))
+        app.router.add_post("/drain", self.drain)
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/score", self.score)
         app.router.add_post("/score", self.score)
@@ -1819,6 +1920,13 @@ def parse_args(argv=None):
                              "handoff in AWAITING_KV waiting for an "
                              "unreachable offload tier before "
                              "degrading to full recompute")
+    parser.add_argument("--drain-exit-timeout-s", type=float,
+                        default=0.0,
+                        help="After POST /drain {\"exit\": true}, the "
+                             "longest the server waits for in-flight "
+                             "requests before exiting anyway (0 = "
+                             "wait forever; the fleet manager applies "
+                             "its own drain deadline)")
     return parser.parse_args(argv)
 
 
@@ -1913,7 +2021,8 @@ def main(argv=None) -> None:
         engine.runner.bridge = bridge
         server = EngineServer(engine, served_name, pooling=args.pooling,
                           profile_dir=args.profile_dir,
-                          chat_template=_load_chat_template(args))
+                          chat_template=_load_chat_template(args),
+                          drain_exit_timeout_s=args.drain_exit_timeout_s)
         if embedder is not None:
             embedder.bridge = bridge
             server._embedder = embedder
@@ -1929,7 +2038,8 @@ def main(argv=None) -> None:
     engine, served_name = build_engine_from_args(args)
     server = EngineServer(engine, served_name, pooling=args.pooling,
                           profile_dir=args.profile_dir,
-                          chat_template=_load_chat_template(args))
+                          chat_template=_load_chat_template(args),
+                          drain_exit_timeout_s=args.drain_exit_timeout_s)
     logger.info("tpu-engine %s serving %s on %s:%d",
                 __version__, served_name, args.host, args.port)
     web.run_app(server.build_app(), host=args.host, port=args.port,

@@ -1,0 +1,27 @@
+"""SLO-driven fleet manager: declarative engine pools, a reconciler
+that owns engine lifecycle, and zero-loss drain.
+
+The C++ control-plane agent (``controlplane/``) renders configuration
+for engines that something else runs; this package is that something
+else for bare-metal / single-host deployments: it spawns engine
+server processes from a declarative :class:`FleetSpec`, registers them
+with the router through the dynamic-config hot-reload file, scales
+pools against router-exported SLO metrics, and drains replicas to
+zero in-flight before ever stopping a process.  See docs/fleet.md.
+"""
+
+from production_stack_tpu.fleet.spec import (  # noqa: F401
+    AutoscalerSpec,
+    FleetSpec,
+    PoolSpec,
+    load_fleet_spec,
+)
+from production_stack_tpu.fleet.autoscaler import (  # noqa: F401
+    PoolAutoscaler,
+    PoolSignals,
+    signals_from_router_metrics,
+)
+from production_stack_tpu.fleet.manager import (  # noqa: F401
+    FleetManager,
+    Replica,
+)

@@ -1,0 +1,70 @@
+"""Fleet manager CLI: ``python -m production_stack_tpu.fleet``.
+
+Loads a fleet spec, then runs the reconcile + autoscale loops until
+interrupted; Ctrl-C drains every replica to zero in-flight before
+exiting.  Flags override the matching spec fields so one spec file
+can serve several environments (see docs/fleet.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from production_stack_tpu.fleet.manager import FleetManager
+from production_stack_tpu.fleet.spec import load_fleet_spec
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="python -m production_stack_tpu.fleet",
+        description="SLO-driven engine fleet manager")
+    parser.add_argument("--spec", required=True,
+                        help="Path to the fleet spec JSON (docs/fleet.md)")
+    parser.add_argument("--router-url", default=None,
+                        help="Override the spec's router_url (autoscaler "
+                             "metrics source)")
+    parser.add_argument("--router-config-path", default=None,
+                        help="Override the spec's router_config_path "
+                             "(dynamic-config JSON the router watches)")
+    parser.add_argument("--reconcile-interval-s", type=float, default=None,
+                        help="Override the spec's reconcile_interval_s")
+    parser.add_argument("--autoscale-interval-s", type=float, default=None,
+                        help="Override the spec's autoscale_interval_s")
+    parser.add_argument("--drain-timeout-s", type=float, default=None,
+                        help="Override the spec's drain_timeout_s")
+    return parser.parse_args(argv)
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    spec = load_fleet_spec(args.spec)
+    if args.router_url is not None:
+        spec.router_url = args.router_url
+    if args.router_config_path is not None:
+        spec.router_config_path = args.router_config_path
+    if args.reconcile_interval_s is not None:
+        spec.reconcile_interval_s = args.reconcile_interval_s
+    if args.autoscale_interval_s is not None:
+        spec.autoscale_interval_s = args.autoscale_interval_s
+    if args.drain_timeout_s is not None:
+        spec.drain_timeout_s = args.drain_timeout_s
+
+    manager = FleetManager(spec)
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, manager.request_stop)
+    logger.info("Fleet manager running: %d pool(s), ports [%d, %d]",
+                len(spec.pools), spec.port_start, spec.port_end)
+    await manager.run()
+
+
+def main(argv=None) -> None:
+    asyncio.run(_amain(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""SLO autoscaler: router metrics -> desired replicas per pool.
+
+Pure target tracking, deliberately boring: the desired count is
+``ceil(current * ratio)`` for the worst observed/target ratio across
+enabled signals, with a hysteresis dead-band so noise inside
+``tolerance`` of the target never scales, and per-direction cooldowns
+so a breach can't flap the pool.  Prefill and decode pools each get
+their own :class:`PoolAutoscaler`, so they scale independently.
+
+Signals come from the router's aggregated ``/metrics`` exposition
+(one scrape covers the whole fleet): per-server ``vllm:ttft_p99_seconds``
+/ ``vllm:itl_p99_seconds`` (request stats), ``vllm:num_requests_waiting``
+and ``vllm:engine_gpu_cache_usage_perc`` (engine-authoritative), and
+``vllm:engine_disagg_awaiting_kv_requests`` for decode pools fed by
+prefill handoffs.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from production_stack_tpu.fleet.spec import AutoscalerSpec, PoolSpec
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)")
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_prometheus_text(
+        text: str) -> Iterable[Tuple[str, Dict[str, str], float]]:
+    """Yields (metric name, labels, value) from an exposition body."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        yield m.group("name"), labels, value
+
+
+@dataclass
+class PoolSignals:
+    """Aggregated per-pool observations for one autoscale tick."""
+
+    ttft_p99_s: float = -1.0   # worst replica
+    itl_p99_s: float = -1.0    # worst replica
+    waiting: float = -1.0      # summed across replicas
+    cache_usage: float = -1.0  # worst replica
+    awaiting_kv: float = -1.0  # summed across replicas
+
+    def _max(self, attr: str, value: float) -> None:
+        setattr(self, attr, max(getattr(self, attr), value))
+
+    def _sum(self, attr: str, value: float) -> None:
+        current = getattr(self, attr)
+        setattr(self, attr, value + (current if current >= 0 else 0.0))
+
+
+# metric name -> (PoolSignals attr, aggregation across replicas)
+_SIGNAL_METRICS = {
+    "vllm:ttft_p99_seconds": ("ttft_p99_s", "max"),
+    "vllm:itl_p99_seconds": ("itl_p99_s", "max"),
+    "vllm:num_requests_waiting": ("waiting", "sum"),
+    "vllm:engine_gpu_cache_usage_perc": ("cache_usage", "max"),
+    "vllm:engine_disagg_awaiting_kv_requests": ("awaiting_kv", "sum"),
+}
+
+
+def signals_from_router_metrics(
+        text: str, url_to_pool: Dict[str, str]) -> Dict[str, PoolSignals]:
+    """Groups the router's per-server gauges into per-pool signals.
+
+    ``url_to_pool`` maps each replica's base URL (the router's
+    ``server`` label) to its pool name; servers the fleet manager does
+    not own are ignored.
+    """
+    out: Dict[str, PoolSignals] = {
+        pool: PoolSignals() for pool in set(url_to_pool.values())}
+    for name, labels, value in parse_prometheus_text(text):
+        target = _SIGNAL_METRICS.get(name)
+        if target is None:
+            continue
+        pool = url_to_pool.get(labels.get("server", ""))
+        if pool is None or value < 0:
+            continue  # -1 means "no observation yet", not zero load
+        attr, agg = target
+        signals = out[pool]
+        (signals._max if agg == "max" else signals._sum)(attr, value)
+    return out
+
+
+class PoolAutoscaler:
+    """Target tracking with hysteresis and cooldowns for one pool."""
+
+    def __init__(self, pool: PoolSpec,
+                 clock: Callable[[], float] = time.monotonic):
+        self.pool = pool
+        self.spec: AutoscalerSpec = pool.autoscaler
+        self._clock = clock
+        self._last_scale_up = -math.inf
+        self._last_scale_down = -math.inf
+
+    def _ratios(self, current: int,
+                signals: PoolSignals) -> List[Tuple[str, float]]:
+        spec = self.spec
+        out: List[Tuple[str, float]] = []
+        if spec.target_ttft_p99_s > 0 and signals.ttft_p99_s >= 0:
+            out.append(("ttft_p99",
+                        signals.ttft_p99_s / spec.target_ttft_p99_s))
+        if spec.target_itl_p99_s > 0 and signals.itl_p99_s >= 0:
+            out.append(("itl_p99",
+                        signals.itl_p99_s / spec.target_itl_p99_s))
+        if spec.target_waiting_per_replica > 0 and signals.waiting >= 0:
+            per_replica = signals.waiting / max(1, current)
+            out.append(("waiting",
+                        per_replica / spec.target_waiting_per_replica))
+        if spec.target_cache_usage > 0 and signals.cache_usage >= 0:
+            out.append(("cache_usage",
+                        signals.cache_usage / spec.target_cache_usage))
+        if spec.target_awaiting_kv > 0 and signals.awaiting_kv >= 0:
+            per_replica = signals.awaiting_kv / max(1, current)
+            out.append(("awaiting_kv",
+                        per_replica / spec.target_awaiting_kv))
+        return out
+
+    def desired(self, current: int,
+                signals: Optional[PoolSignals]) -> int:
+        """Desired replica count given the current count and signals.
+
+        Stateful: applying a change here starts the matching cooldown.
+        Callers must pass the count of replicas that serve traffic
+        (live, not draining).
+        """
+        low = self.pool.min_replicas
+        high = self.pool.max_replicas
+        clamped = min(high, max(low, current))
+        if not self.spec.enable or signals is None:
+            return clamped
+        ratios = self._ratios(current, signals)
+        if not ratios:
+            return clamped
+        driver, ratio = max(ratios, key=lambda kv: kv[1])
+        now = self._clock()
+        if ratio > 1.0 + self.spec.tolerance:
+            want = min(high, max(clamped, math.ceil(current * ratio)))
+            if want > clamped:
+                if now - self._last_scale_up < self.spec.scale_up_cooldown_s:
+                    return clamped
+                logger.info(
+                    "pool %s: scale up %d -> %d (%s ratio %.2f)",
+                    self.pool.name, current, want, driver, ratio)
+                self._last_scale_up = now
+                return want
+        elif ratio < 1.0 - self.spec.tolerance:
+            want = max(low, min(clamped, math.ceil(current * ratio)))
+            if want < clamped:
+                # Scale-down waits out both cooldowns: shrinking right
+                # after an expansion would thrash the very replicas the
+                # breach just bought.
+                last = max(self._last_scale_up, self._last_scale_down)
+                if now - last < self.spec.scale_down_cooldown_s:
+                    return clamped
+                logger.info(
+                    "pool %s: scale down %d -> %d (%s ratio %.2f)",
+                    self.pool.name, current, want, driver, ratio)
+                self._last_scale_down = now
+                return want
+        return clamped

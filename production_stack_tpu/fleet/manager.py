@@ -1,0 +1,359 @@
+"""Reconciler-owned engine lifecycle.
+
+The :class:`FleetManager` makes observed state (spawned engine
+processes) converge on the declarative :class:`FleetSpec`:
+
+- spawn: allocate a port from the fleet range, start the engine
+  server process, and register it with the router (rewrite the
+  dynamic-config JSON the router's ``DynamicConfigWatcher`` polls)
+  only once its ``/health`` answers;
+- scale: an SLO autoscaler per pool turns router metrics into a
+  desired replica count; prefill and decode pools move independently;
+- drain (zero-loss scale-down): deregister the replica first so the
+  router stops routing to it, then ``POST /drain {"exit": true}`` —
+  the engine rejects new admissions with 503 + Retry-After, finishes
+  every in-flight sequence, and exits itself.  The reconciler only
+  escalates to SIGTERM after ``drain_timeout_s`` *and* only while the
+  replica reports zero active requests; it never SIGKILLs an engine
+  with running sequences.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import aiohttp
+
+from production_stack_tpu.fleet.autoscaler import (
+    PoolAutoscaler,
+    PoolSignals,
+    signals_from_router_metrics,
+)
+from production_stack_tpu.fleet.spec import FleetSpec, PoolSpec
+from production_stack_tpu.router.services.metrics_service import (
+    fleet_desired_replicas,
+    fleet_live_replicas,
+    fleet_scale_events,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+STARTING = "starting"
+LIVE = "live"
+DRAINING = "draining"
+
+# Grace between "drained replica reports idle but ignored SIGTERM"
+# and SIGKILL.  Only ever reached with zero running sequences.
+_SIGKILL_GRACE_S = 10.0
+
+
+@dataclass
+class Replica:
+    """One spawned engine process and its lifecycle state."""
+
+    pool: str
+    port: int
+    url: str
+    process: subprocess.Popen
+    state: str = STARTING
+    drain_started: float = -1.0
+    sigterm_sent: float = -1.0
+
+
+class FleetManager:
+    """Reconcile + autoscale loops over a :class:`FleetSpec`."""
+
+    def __init__(self, spec: FleetSpec,
+                 clock: Callable[[], float] = time.monotonic):
+        self.spec = spec
+        self._clock = clock
+        self._pools: Dict[str, PoolSpec] = {p.name: p for p in spec.pools}
+        self.replicas: Dict[str, List[Replica]] = {
+            p.name: [] for p in spec.pools}
+        self.desired: Dict[str, int] = {
+            p.name: p.min_replicas for p in spec.pools}
+        self.autoscalers: Dict[str, PoolAutoscaler] = {
+            p.name: PoolAutoscaler(p, clock) for p in spec.pools}
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._stopping = False
+
+    # ---- plumbing ---------------------------------------------------------
+
+    async def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5.0))
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    def _alloc_port(self) -> int:
+        used = {r.port for reps in self.replicas.values() for r in reps}
+        for port in range(self.spec.port_start, self.spec.port_end + 1):
+            if port not in used:
+                return port
+        raise RuntimeError(
+            f"fleet port range [{self.spec.port_start}, "
+            f"{self.spec.port_end}] exhausted")
+
+    def _command(self, pool: PoolSpec, port: int) -> List[str]:
+        if pool.command:
+            return [c.format(port=port, model=pool.model, role=pool.role)
+                    for c in pool.command]
+        argv = [sys.executable, "-m", "production_stack_tpu.engine.server",
+                "--model", pool.model, "--host", "127.0.0.1",
+                "--port", str(port), "--engine-role", pool.role]
+        return argv + list(pool.engine_flags)
+
+    async def _probe_health(self, replica: Replica) -> Optional[dict]:
+        try:
+            session = await self._http()
+            async with session.get(replica.url + "/health") as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.json()
+        except Exception:
+            return None
+
+    # ---- registration -----------------------------------------------------
+
+    def _write_router_config(self) -> None:
+        """Rewrites the dynamic-config JSON with the LIVE membership.
+
+        Atomic (tmp + rename) so the watcher never reads a torn file;
+        draining and still-starting replicas are excluded, which is
+        the primary mechanism keeping new work off a draining engine.
+        """
+        path = self.spec.router_config_path
+        if not path:
+            return
+        backends: List[str] = []
+        models: List[str] = []
+        roles: List[str] = []
+        for pool in self.spec.pools:
+            for replica in self.replicas[pool.name]:
+                if replica.state == LIVE:
+                    backends.append(replica.url)
+                    models.append(pool.model)
+                    roles.append(pool.role)
+        payload = {
+            "service_discovery": "static",
+            "routing_logic": self.spec.routing_logic,
+            "static_backends": backends,
+            "static_models": models,
+            "static_roles": roles,
+        }
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2)
+        os.replace(tmp, path)
+
+    def _refresh_gauges(self) -> None:
+        for pool in self.spec.pools:
+            live = sum(1 for r in self.replicas[pool.name]
+                       if r.state == LIVE)
+            fleet_desired_replicas.labels(pool=pool.name).set(
+                self.desired[pool.name])
+            fleet_live_replicas.labels(pool=pool.name).set(live)
+
+    # ---- reconcile --------------------------------------------------------
+
+    def _spawn(self, pool: PoolSpec) -> Replica:
+        port = self._alloc_port()
+        argv = self._command(pool, port)
+        process = subprocess.Popen(argv, stdout=subprocess.DEVNULL)
+        replica = Replica(pool=pool.name, port=port,
+                          url=f"http://127.0.0.1:{port}", process=process)
+        self.replicas[pool.name].append(replica)
+        logger.info("pool %s: spawned replica %s (pid %d)",
+                    pool.name, replica.url, process.pid)
+        return replica
+
+    async def _start_drain(self, replica: Replica) -> None:
+        replica.state = DRAINING
+        replica.drain_started = self._clock()
+        # Deregister before asking the engine to drain: the router must
+        # stop choosing this replica before it starts 503ing admissions.
+        self._write_router_config()
+        try:
+            session = await self._http()
+            async with session.post(replica.url + "/drain",
+                                    json={"exit": True}) as resp:
+                await resp.read()
+        except Exception as e:
+            logger.warning("pool %s: drain request to %s failed: %s",
+                           replica.pool, replica.url, e)
+
+    async def _escalate_drain(self, replica: Replica) -> None:
+        """Post-timeout escalation. Never kills a busy engine."""
+        timeout = self.spec.drain_timeout_s
+        if timeout <= 0:
+            return
+        if self._clock() - replica.drain_started < timeout:
+            return
+        payload = await self._probe_health(replica)
+        if payload is not None and payload.get("active_requests"):
+            logger.warning(
+                "pool %s: %s still has %s in-flight past the %.0fs drain "
+                "timeout; waiting (never killing a busy engine)",
+                replica.pool, replica.url,
+                payload.get("active_requests"), timeout)
+            return
+        if replica.sigterm_sent < 0:
+            logger.warning("pool %s: %s idle but did not exit after "
+                           "drain; sending SIGTERM",
+                           replica.pool, replica.url)
+            replica.process.terminate()
+            replica.sigterm_sent = self._clock()
+        elif self._clock() - replica.sigterm_sent > _SIGKILL_GRACE_S:
+            logger.error("pool %s: %s ignored SIGTERM while idle; "
+                         "killing", replica.pool, replica.url)
+            replica.process.kill()
+
+    async def reconcile_once(self) -> None:
+        """One convergence pass: reap, promote, drain, spawn."""
+        changed = False
+        for pool in self.spec.pools:
+            replicas = self.replicas[pool.name]
+
+            for replica in list(replicas):
+                if replica.process.poll() is None:
+                    continue
+                if replica.state != DRAINING:
+                    logger.warning(
+                        "pool %s: replica %s exited unexpectedly (rc=%s)",
+                        pool.name, replica.url, replica.process.returncode)
+                else:
+                    logger.info("pool %s: drained replica %s exited",
+                                pool.name, replica.url)
+                replicas.remove(replica)
+                changed = True
+
+            for replica in replicas:
+                if replica.state != STARTING:
+                    continue
+                payload = await self._probe_health(replica)
+                if payload is not None and not payload.get("draining"):
+                    replica.state = LIVE
+                    changed = True
+
+            for replica in replicas:
+                if replica.state == DRAINING:
+                    await self._escalate_drain(replica)
+
+            want = self.desired[pool.name]
+            active = [r for r in replicas if r.state != DRAINING]
+            while len(active) < want:
+                active.append(self._spawn(pool))
+            # Scale down newest-first; a replica still starting never
+            # served traffic, so stop those before draining live ones.
+            excess = len(active) - want
+            for victim in sorted(active, key=lambda r: r.port,
+                                 reverse=True)[:max(0, excess)]:
+                if victim.state == STARTING:
+                    victim.process.terminate()
+                    victim.state = DRAINING  # reaped next pass
+                    victim.drain_started = self._clock()
+                    victim.sigterm_sent = self._clock()
+                else:
+                    await self._start_drain(victim)
+                changed = True
+
+        if changed:
+            self._write_router_config()
+        self._refresh_gauges()
+
+    # ---- autoscale --------------------------------------------------------
+
+    async def _scrape_signals(self) -> Dict[str, PoolSignals]:
+        if not self.spec.router_url:
+            return {}
+        # Draining replicas are excluded: their last-scraped gauges go
+        # stale, and counting them would inflate the pool's load right
+        # when the autoscaler is trying to confirm the scale-down.
+        url_to_pool = {
+            replica.url: pool.name
+            for pool in self.spec.pools
+            for replica in self.replicas[pool.name]
+            if replica.state != DRAINING}
+        try:
+            session = await self._http()
+            url = self.spec.router_url.rstrip("/") + "/metrics"
+            async with session.get(url) as resp:
+                text = await resp.text()
+        except Exception as e:
+            logger.warning("cannot scrape router metrics: %s", e)
+            return {}
+        return signals_from_router_metrics(text, url_to_pool)
+
+    async def autoscale_once(self) -> Dict[str, int]:
+        """One autoscale tick; returns the desired counts per pool.
+
+        Target tracking runs against the control variable (the current
+        desired count), not the momentary live count — a replica that
+        is still booting toward the target must not read as scale-down.
+        """
+        signals_by_pool = await self._scrape_signals()
+        for pool in self.spec.pools:
+            current = self.desired[pool.name]
+            want = self.autoscalers[pool.name].desired(
+                current, signals_by_pool.get(pool.name))
+            if want != current:
+                direction = "up" if want > current else "down"
+                fleet_scale_events.labels(
+                    pool=pool.name, direction=direction).inc()
+                self.desired[pool.name] = want
+        self._refresh_gauges()
+        return dict(self.desired)
+
+    # ---- loops ------------------------------------------------------------
+
+    def request_stop(self) -> None:
+        self._stopping = True
+
+    async def run(self) -> None:
+        """Reconcile every ``reconcile_interval_s``; autoscale every
+        ``autoscale_interval_s`` (when ``router_url`` is set)."""
+        import asyncio
+
+        next_autoscale = self._clock()
+        try:
+            while not self._stopping:
+                await self.reconcile_once()
+                if (self.spec.router_url
+                        and self._clock() >= next_autoscale):
+                    await self.autoscale_once()
+                    next_autoscale = (
+                        self._clock() + self.spec.autoscale_interval_s)
+                await asyncio.sleep(self.spec.reconcile_interval_s)
+            await self.drain_all()
+        finally:
+            await self.close()
+
+    async def drain_all(self) -> None:
+        """Graceful teardown: drain every replica, wait for clean exits."""
+        import asyncio
+
+        for pool in self.spec.pools:
+            self.desired[pool.name] = 0
+        for pool in self.spec.pools:
+            for replica in self.replicas[pool.name]:
+                if replica.state != DRAINING:
+                    await self._start_drain(replica)
+        while any(r.process.poll() is None
+                  for reps in self.replicas.values() for r in reps):
+            for reps in self.replicas.values():
+                for replica in reps:
+                    if replica.process.poll() is None:
+                        await self._escalate_drain(replica)
+            await asyncio.sleep(0.1)
+        await self.reconcile_once()

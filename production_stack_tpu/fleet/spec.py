@@ -1,0 +1,191 @@
+"""Declarative fleet specification.
+
+A fleet spec is the CRD / values.yaml analog for bare-metal serving:
+named pools of engine replicas, each with a role, replica bounds,
+engine flags and autoscaler targets.  The reconciler
+(:mod:`production_stack_tpu.fleet.manager`) owns making reality match
+the spec; this module only parses and validates it.
+
+Contract (enforced by the ``config-contract`` staticcheck rule, same
+convention as EngineConfig): every dataclass field below must be
+parsed from its JSON key in this file and documented in
+docs/fleet.md, or listed in ``FLEET_INTERNAL_FIELDS`` — "operators
+can't reach this knob" is always a decision, never an accident.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+POOL_ROLES = ("prefill", "decode", "both")
+
+# Fleet-spec fields that are deliberately not operator surface.
+# Mirrors INTERNAL_FIELDS in engine/config.py; currently every field
+# is reachable from the spec file.
+FLEET_INTERNAL_FIELDS = ()
+
+_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9-]*$")
+
+
+@dataclass
+class AutoscalerSpec:
+    """Target-tracking autoscaler knobs for one pool.
+
+    A target of 0 disables that signal.  The desired replica count is
+    ``ceil(current * ratio)`` where ratio is the worst (largest)
+    observed/target ratio across enabled signals, clamped to the
+    pool's replica bounds, with a hysteresis dead-band of
+    ``tolerance`` around 1.0 and per-direction cooldowns.
+    """
+
+    enable: bool = True
+    target_ttft_p99_s: float = 0.0
+    target_itl_p99_s: float = 0.0
+    target_waiting_per_replica: float = 0.0
+    target_cache_usage: float = 0.0
+    target_awaiting_kv: float = 0.0
+    tolerance: float = 0.1
+    scale_up_cooldown_s: float = 15.0
+    scale_down_cooldown_s: float = 60.0
+
+    def __post_init__(self) -> None:
+        for knob in ("target_ttft_p99_s", "target_itl_p99_s",
+                     "target_waiting_per_replica", "target_cache_usage",
+                     "target_awaiting_kv", "scale_up_cooldown_s",
+                     "scale_down_cooldown_s"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"autoscaler.{knob} must be >= 0")
+        if not 0.0 <= self.tolerance < 1.0:
+            raise ValueError("autoscaler.tolerance must be in [0, 1)")
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "AutoscalerSpec":
+        return cls(
+            enable=bool(raw.get("enable", True)),
+            target_ttft_p99_s=float(raw.get("target_ttft_p99_s", 0.0)),
+            target_itl_p99_s=float(raw.get("target_itl_p99_s", 0.0)),
+            target_waiting_per_replica=float(
+                raw.get("target_waiting_per_replica", 0.0)),
+            target_cache_usage=float(raw.get("target_cache_usage", 0.0)),
+            target_awaiting_kv=float(raw.get("target_awaiting_kv", 0.0)),
+            tolerance=float(raw.get("tolerance", 0.1)),
+            scale_up_cooldown_s=float(raw.get("scale_up_cooldown_s", 15.0)),
+            scale_down_cooldown_s=float(
+                raw.get("scale_down_cooldown_s", 60.0)),
+        )
+
+
+@dataclass
+class PoolSpec:
+    """One named pool of interchangeable engine replicas."""
+
+    name: str
+    role: str = "both"
+    min_replicas: int = 1
+    max_replicas: int = 1
+    model: str = "fake"
+    engine_flags: List[str] = field(default_factory=list)
+    # Optional argv template overriding the default engine-server
+    # command; each element is ``str.format``-ed with {port}, {model}
+    # and {role}.  Tests use this to run pools of fake engines.
+    command: List[str] = field(default_factory=list)
+    autoscaler: AutoscalerSpec = field(default_factory=AutoscalerSpec)
+
+    def __post_init__(self) -> None:
+        if not _NAME_RE.match(self.name or ""):
+            raise ValueError(
+                f"pool name {self.name!r} must match {_NAME_RE.pattern}")
+        if self.role not in POOL_ROLES:
+            raise ValueError(
+                f"pool {self.name}: role {self.role!r} not in {POOL_ROLES}")
+        if self.min_replicas < 0:
+            raise ValueError(f"pool {self.name}: min_replicas must be >= 0")
+        if self.max_replicas < max(1, self.min_replicas):
+            raise ValueError(
+                f"pool {self.name}: max_replicas must be >= "
+                "max(1, min_replicas)")
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "PoolSpec":
+        return cls(
+            name=raw.get("name", ""),
+            role=raw.get("role", "both"),
+            min_replicas=int(raw.get("min_replicas", 1)),
+            max_replicas=int(raw.get("max_replicas", 1)),
+            model=raw.get("model", "fake"),
+            engine_flags=[str(f) for f in raw.get("engine_flags", [])],
+            command=[str(c) for c in raw.get("command", [])],
+            autoscaler=AutoscalerSpec.from_dict(raw.get("autoscaler", {})),
+        )
+
+
+@dataclass
+class FleetSpec:
+    """The whole fleet: pools plus shared wiring."""
+
+    pools: List[PoolSpec] = field(default_factory=list)
+    # Replica ports are allocated from [port_start, port_end].
+    port_start: int = 8100
+    port_end: int = 8199
+    # Router /metrics base URL the autoscaler scrapes; empty disables
+    # autoscaling (desired counts stay at min_replicas / manual).
+    router_url: str = ""
+    # Dynamic-config JSON the router watches; the reconciler rewrites
+    # it on every membership change (registration/deregistration).
+    router_config_path: str = ""
+    routing_logic: str = "roundrobin"
+    # How long a draining replica may take to finish in-flight work
+    # before the reconciler escalates to SIGTERM (never SIGKILL while
+    # sequences are running).  0 waits forever.
+    drain_timeout_s: float = 120.0
+    reconcile_interval_s: float = 1.0
+    autoscale_interval_s: float = 5.0
+
+    def __post_init__(self) -> None:
+        if not self.pools:
+            raise ValueError("fleet spec needs at least one pool")
+        names = [p.name for p in self.pools]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate pool names in {names}")
+        if not 0 < self.port_start <= self.port_end <= 65535:
+            raise ValueError(
+                f"bad port range [{self.port_start}, {self.port_end}]")
+        capacity = self.port_end - self.port_start + 1
+        ceiling = sum(p.max_replicas for p in self.pools)
+        if ceiling > capacity:
+            raise ValueError(
+                f"port range holds {capacity} replicas but pools allow "
+                f"up to {ceiling}")
+        if self.drain_timeout_s < 0:
+            raise ValueError("drain_timeout_s must be >= 0")
+        if self.reconcile_interval_s <= 0 or self.autoscale_interval_s <= 0:
+            raise ValueError("reconcile/autoscale intervals must be > 0")
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "FleetSpec":
+        return cls(
+            pools=[PoolSpec.from_dict(p) for p in raw.get("pools", [])],
+            port_start=int(raw.get("port_start", 8100)),
+            port_end=int(raw.get("port_end", 8199)),
+            router_url=raw.get("router_url", ""),
+            router_config_path=raw.get("router_config_path", ""),
+            routing_logic=raw.get("routing_logic", "roundrobin"),
+            drain_timeout_s=float(raw.get("drain_timeout_s", 120.0)),
+            reconcile_interval_s=float(raw.get("reconcile_interval_s", 1.0)),
+            autoscale_interval_s=float(raw.get("autoscale_interval_s", 5.0)),
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FleetSpec":
+        raw = json.loads(text)
+        if not isinstance(raw, dict):
+            raise ValueError("fleet spec must be a JSON object")
+        return cls.from_dict(raw)
+
+
+def load_fleet_spec(path: str) -> FleetSpec:
+    with open(path) as f:
+        return FleetSpec.from_json(f.read())

@@ -33,6 +33,10 @@ class DynamicRouterConfig:
     routing_logic: str = "roundrobin"
     static_backends: List[str] = field(default_factory=list)
     static_models: List[str] = field(default_factory=list)
+    # Per-backend engine roles ("prefill"/"decode"/"both"), aligned
+    # with static_backends — the fleet manager registers disagg pools
+    # through this file, so roles must survive the hot-reload path.
+    static_roles: List[str] = field(default_factory=list)
     session_key: Optional[str] = None
     k8s_namespace: str = "default"
     k8s_port: int = 8000
@@ -43,17 +47,21 @@ class DynamicRouterConfig:
         raw = json.loads(text)
         backends = raw.get("static_backends", "")
         models = raw.get("static_models", "")
+        roles = raw.get("static_roles", "")
         if isinstance(backends, list):
             backends = ",".join(backends)
         # Same validation/normalization as the --static-backends CLI path.
         backends = parse_comma_separated_urls(backends)
         if isinstance(models, str):
             models = [m.strip() for m in models.split(",") if m.strip()]
+        if isinstance(roles, str):
+            roles = [r.strip() for r in roles.split(",") if r.strip()]
         return cls(
             service_discovery=raw.get("service_discovery", "static"),
             routing_logic=raw.get("routing_logic", "roundrobin"),
             static_backends=backends,
             static_models=models,
+            static_roles=roles,
             session_key=raw.get("session_key"),
             k8s_namespace=raw.get("k8s_namespace", "default"),
             k8s_port=int(raw.get("k8s_port", 8000)),
@@ -66,6 +74,7 @@ class DynamicRouterConfig:
             "routing_logic": self.routing_logic,
             "static_backends": self.static_backends,
             "static_models": self.static_models,
+            "static_roles": self.static_roles,
             "session_key": self.session_key,
         }
 
@@ -82,6 +91,7 @@ def apply_dynamic_config(config: DynamicRouterConfig) -> None:
         reconfigure_service_discovery(
             "static", urls=config.static_backends,
             models=config.static_models or None,
+            roles=config.static_roles or None,
         )
     else:
         reconfigure_service_discovery(

@@ -333,6 +333,18 @@ class HealthChecker:
                     total=self._config.health_check_timeout),
             ) as resp:
                 ok = resp.status < 400
+                if ok:
+                    # A draining engine (docs/fleet.md) still answers
+                    # 200 for its in-flight clients but advertises
+                    # ``draining``: routing must stop sending it new
+                    # work, so the probe counts as a failure.
+                    try:
+                        payload = await resp.json()
+                    except Exception:
+                        payload = None
+                    if (isinstance(payload, dict)
+                            and payload.get("draining")):
+                        ok = False
         except asyncio.CancelledError:
             raise
         except Exception:

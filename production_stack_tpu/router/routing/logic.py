@@ -13,6 +13,7 @@ from __future__ import annotations
 import abc
 import asyncio
 import enum
+import hashlib
 import heapq
 import itertools
 import random
@@ -246,8 +247,13 @@ class HeadRoomAdmissionPolicy(RoutingPolicy):
     def route_request(self, endpoints, engine_stats, request_stats, headers,
                       request_id, num_prefill_tokens=0,
                       prompt_text=None):
+        # get_running_loop, not get_event_loop: the policy only ever
+        # runs inside the router's serving loop, and under Python 3.12
+        # semantics get_event_loop() from a coroutine without a set
+        # loop deprecation-warns (and will raise) instead of returning
+        # the running one.
         future: "asyncio.Future[str]" = (
-            asyncio.get_event_loop().create_future()
+            asyncio.get_running_loop().create_future()
         )
         max_admissible = int(
             TOTAL_NUMBER_OF_BLOCKS * (1 - SAFETY_FRACTION)
@@ -366,10 +372,20 @@ class PrefixAwarePolicy(RoutingPolicy):
         self._initialized = True
 
     def _chain(self, text: str) -> List[int]:
-        out, h = [], 0
+        # blake2b, not builtin hash(): str hashing is salted per
+        # process (PYTHONHASHSEED), so replicated routers — or one
+        # router across restarts — would score the same prefix with
+        # different chains and place it inconsistently. The chain must
+        # be a pure function of the text.
+        out: List[int] = []
+        h = b""
         for i in range(0, len(text), self.BLOCK_CHARS):
-            h = hash((h, text[i:i + self.BLOCK_CHARS]))
-            out.append(h)
+            block = text[i:i + self.BLOCK_CHARS]
+            h = hashlib.blake2b(
+                h + block.encode("utf-8", "surrogatepass"),
+                digest_size=8,
+            ).digest()
+            out.append(int.from_bytes(h, "big"))
         return out
 
     def _remember(self, url: str, chain: List[int]) -> None:

@@ -27,6 +27,14 @@ healthy_pods_total = Gauge(
 avg_latency = Gauge(
     "vllm:avg_latency", "Average end-to-end request latency", _LBL)
 avg_itl = Gauge("vllm:avg_itl", "Average Inter-Token Latency", _LBL)
+ttft_p99 = Gauge(
+    "vllm:ttft_p99_seconds",
+    "p99 time-to-first-token over the stats window (fleet autoscaler "
+    "SLO signal)", _LBL)
+itl_p99 = Gauge(
+    "vllm:itl_p99_seconds",
+    "p99 inter-token latency over the stats window (fleet autoscaler "
+    "SLO signal)", _LBL)
 num_requests_swapped = Gauge(
     "vllm:num_requests_swapped", "Number of swapped requests", _LBL)
 allocated_blocks = Gauge(
@@ -138,6 +146,27 @@ engine_disagg_handoff_latency_mean = Gauge(
     "vllm:engine_disagg_handoff_latency_mean_seconds",
     "Mean handoff-admission latency from the engine's histogram "
     "sum/count (scraped)", _LBL)
+engine_draining = Gauge(
+    "vllm:engine_draining",
+    "Engine-reported draining state: 1 while the engine rejects new "
+    "admissions and finishes in-flight sequences (scraped)", _LBL)
+
+# -- fleet manager (production_stack_tpu/fleet/, docs/fleet.md) -------------
+# Set by an in-process fleet manager (or its embedded exporter); the
+# router re-exports them off the shared default registry so one scrape
+# target carries both SLO signals and the replica-count decisions made
+# from them.
+fleet_desired_replicas = Gauge(
+    "vllm:fleet_desired_replicas",
+    "Fleet-manager desired replica count per pool", ["pool"])
+fleet_live_replicas = Gauge(
+    "vllm:fleet_live_replicas",
+    "Fleet-manager live (spawned and registered) replicas per pool",
+    ["pool"])
+fleet_scale_events = Gauge(
+    "vllm:fleet_scale_events_total",
+    "Fleet-manager scale decisions applied per pool and direction",
+    ["pool", "direction"])
 
 # -- resilience layer (router/resilience.py) --------------------------------
 circuit_breaker_state = Gauge(
@@ -198,6 +227,8 @@ def refresh_gauges() -> None:
             stat.in_prefill_requests + stat.in_decoding_requests)
         avg_latency.labels(server=server).set(stat.avg_latency)
         avg_itl.labels(server=server).set(stat.avg_itl)
+        ttft_p99.labels(server=server).set(stat.ttft_p99)
+        itl_p99.labels(server=server).set(stat.itl_p99)
         num_requests_swapped.labels(server=server).set(
             stat.num_swapped_requests)
         allocated_blocks.labels(server=server).set(stat.allocated_blocks)
@@ -277,6 +308,7 @@ def refresh_gauges() -> None:
             engine_disagg_handoff_latency_mean.labels(server=server).set(
                 es.disagg_handoff_latency_sum
                 / es.disagg_handoff_latency_count)
+        engine_draining.labels(server=server).set(es.engine_draining)
     from production_stack_tpu.router.services import request_service
     router_disagg_handoffs.set(request_service.disagg_handoffs_total)
     router_disagg_fallbacks.set(request_service.disagg_fallbacks_total)

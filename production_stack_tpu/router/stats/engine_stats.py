@@ -71,6 +71,9 @@ _METRIC_MAP = {
     "vllm:disagg_decode_requests_total": "disagg_decode_requests",
     "vllm:disagg_kv_bytes_shipped_total": "disagg_kv_bytes_shipped",
     "vllm:disagg_awaiting_kv_requests": "disagg_awaiting_kv_requests",
+    # Zero-loss drain (docs/fleet.md): 1 while the engine rejects new
+    # admissions and finishes its in-flight sequences.
+    "vllm:engine_draining": "engine_draining",
 }
 
 # Handoff-latency histogram (submission to leaving AWAITING_KV on the
@@ -143,6 +146,8 @@ class EngineStats:
     disagg_awaiting_kv_requests: float = 0.0
     disagg_handoff_latency_sum: float = 0.0
     disagg_handoff_latency_count: float = 0.0
+    # Zero-loss drain (docs/fleet.md): 1 while the engine is draining.
+    engine_draining: float = 0.0
 
     @classmethod
     def from_prometheus_text(cls, text: str) -> "EngineStats":
@@ -207,20 +212,28 @@ class EngineStatsScraper(metaclass=SingletonMeta):
             logger.warning("Failed to scrape %s/metrics: %s", url, e)
             return None
 
+    def scrape_once(self) -> None:
+        """One synchronous scrape pass over the discovered engines.
+
+        The daemon thread calls this on its interval; tests and the
+        fleet bench rig call it directly for a deterministic refresh.
+        """
+        urls = self._engine_urls()
+        fresh: Dict[str, EngineStats] = {}
+        for url in urls:
+            stats = self._scrape_one(url)
+            if stats is not None:
+                fresh[url] = stats
+        with self._lock:
+            # Drop engines that disappeared from discovery.
+            self._stats = {
+                u: fresh.get(u, self._stats.get(u, EngineStats()))
+                for u in urls
+            }
+
     def _run(self) -> None:
         while not self._stop.wait(self.scrape_interval):
-            urls = self._engine_urls()
-            fresh: Dict[str, EngineStats] = {}
-            for url in urls:
-                stats = self._scrape_one(url)
-                if stats is not None:
-                    fresh[url] = stats
-            with self._lock:
-                # Drop engines that disappeared from discovery.
-                self._stats = {
-                    u: fresh.get(u, self._stats.get(u, EngineStats()))
-                    for u in urls
-                }
+            self.scrape_once()
 
     def get_engine_stats(self) -> Dict[str, EngineStats]:
         with self._lock:

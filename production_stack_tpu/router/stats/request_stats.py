@@ -42,6 +42,10 @@ class RequestStats:
 
     qps: float = -1.0
     ttft: float = -1.0
+    # Tail latencies over the same sliding window — the fleet
+    # autoscaler's SLO signals (docs/fleet.md). -1 until observed.
+    ttft_p99: float = -1.0
+    itl_p99: float = -1.0
     in_prefill_requests: int = 0
     in_decoding_requests: int = 0
     # Ages (seconds) of requests currently in prefill / decode.
@@ -91,6 +95,14 @@ class SlidingWindow:
 
     def total(self) -> float:
         return sum(self._vals)
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile of the windowed values (-1 if empty)."""
+        if not self._vals:
+            return -1.0
+        ordered = sorted(self._vals)
+        rank = min(len(ordered) - 1, max(0, math.ceil(q * len(ordered)) - 1))
+        return ordered[rank]
 
 
 class RequestStatsMonitor(metaclass=SingletonMeta):
@@ -276,6 +288,15 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
         win.advance(now)
         return win.average()
 
+    @staticmethod
+    def _window_p99(table: Dict[str, SlidingWindow], url: str,
+                    now: float) -> float:
+        win = table.get(url)
+        if win is None:
+            return -1.0
+        win.advance(now)
+        return win.percentile(0.99)
+
     def get_request_stats(self, current_time: float) -> Dict[str, RequestStats]:
         with self._lock:
             out: Dict[str, RequestStats] = {}
@@ -303,6 +324,10 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
                 out[url] = RequestStats(
                     qps=qps,
                     ttft=ttft,
+                    ttft_p99=self._window_p99(self._ttft, url,
+                                              current_time),
+                    itl_p99=self._window_p99(self._itl, url,
+                                             current_time),
                     in_prefill_requests=len(prefill_ids),
                     in_decoding_requests=len(decode_ids),
                     ts_prefill_enqueue=[

@@ -21,7 +21,12 @@ exclusivity check without a test rots). Checks, all static:
    ``pytest.raises`` and references both the token and the second
    field — so the rejection can never be deleted silently;
 4. every ``--flag`` appears in the docs (docs/**/*.md or README.md);
-   docs/engine_flags.md is the canonical flag table.
+   docs/engine_flags.md is the canonical flag table — this covers the
+   fleet-manager CLI (fleet/__main__.py) as well as the engine server;
+5. the fleet spec (fleet/spec.py) honours the same contract: every
+   field of FleetSpec/PoolSpec/AutoscalerSpec is parsed from its JSON
+   key in spec.py and documented in docs/fleet.md, or listed in the
+   ``FLEET_INTERNAL_FIELDS`` marker (which must itself be honest).
 
 Cross-file contract findings (line 0); fixed by code/markers/docs,
 not waiver comments.
@@ -44,6 +49,9 @@ from production_stack_tpu.staticcheck.core import (
 
 CONFIG_FILE = "production_stack_tpu/engine/config.py"
 SERVER_FILE = "production_stack_tpu/engine/server.py"
+FLEET_SPEC_FILE = "production_stack_tpu/fleet/spec.py"
+FLEET_CLI_FILE = "production_stack_tpu/fleet/__main__.py"
+FLEET_DOC_FILE = "docs/fleet.md"
 DOC_PATTERNS = ("docs/**/*.md", "*.md")
 TEST_PATTERN = "tests/test_*.py"
 
@@ -55,6 +63,14 @@ _SECTION_CLASSES = {
     "parallel": "ParallelConfig",
     "lora": "LoRAConfig",
     "offload": "OffloadConfig",
+}
+
+# Fleet-spec classes whose dataclass fields are operator surface,
+# keyed by how the field path reads in a spec file.
+_FLEET_SECTION_CLASSES = {
+    "": "FleetSpec",
+    "pools[].": "PoolSpec",
+    "pools[].autoscaler.": "AutoscalerSpec",
 }
 
 
@@ -232,14 +248,80 @@ def check(project: Project) -> List[Finding]:
                 f"test referencing both '{token}' and '{tail_b}' "
                 "under tests/ — the rejection is untested"))
 
-    # (4) every flag documented.
+    # (4) every flag documented — engine server and fleet CLI alike.
     doc_text = "\n".join(
         sf.text for sf in project.files(*DOC_PATTERNS))
-    for flag in sorted(flags):
-        if not re.search(re.escape(flag) + r"(?![\w-])", doc_text):
+    flag_sources = [(SERVER_FILE, flags)]
+    fleet_cli = project.source(FLEET_CLI_FILE)
+    if fleet_cli is None or fleet_cli.tree is None:
+        findings.append(_finding(
+            FLEET_CLI_FILE,
+            "config-contract surface file missing — if the fleet CLI "
+            "moved, update staticcheck/analyzers/config_contract.py"))
+    else:
+        flag_sources.append((FLEET_CLI_FILE, _cli_flags(fleet_cli.tree)))
+    for path, source_flags in flag_sources:
+        for flag in sorted(source_flags):
+            if not re.search(re.escape(flag) + r"(?![\w-])", doc_text):
+                findings.append(_finding(
+                    path,
+                    f"CLI flag {flag} appears in no markdown doc "
+                    "(docs/**/*.md, README.md) — add it to "
+                    "docs/engine_flags.md"))
+
+    # (5) fleet spec fields parsed + documented (or marked internal).
+    findings.extend(_check_fleet_spec(project))
+    return findings
+
+
+def _check_fleet_spec(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    spec = project.source(FLEET_SPEC_FILE)
+    if spec is None or spec.tree is None:
+        return [_finding(
+            FLEET_SPEC_FILE,
+            "config-contract surface file missing — if the fleet layer "
+            "moved, update staticcheck/analyzers/config_contract.py")]
+    classes = _dataclass_fields(spec.tree)
+    internal = _literal_value(
+        _module_literal(spec.tree, "FLEET_INTERNAL_FIELDS")) or ()
+    literals: Set[str] = set()
+    for node in ast.walk(spec.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            literals.add(node.value)
+    doc = project.source(FLEET_DOC_FILE)
+    doc_text = doc.text if doc is not None else ""
+    if not doc_text:
+        findings.append(_finding(
+            FLEET_DOC_FILE,
+            "docs/fleet.md missing — the fleet spec has no documented "
+            "contract surface"))
+
+    fields: Set[Tuple[str, str]] = set()
+    for prefix, cls in _FLEET_SECTION_CLASSES.items():
+        for field in classes.get(cls, set()):
+            fields.add((prefix + field, field))
+    paths = {path for path, _ in fields}
+    for path, name in sorted(fields):
+        if path in internal:
+            continue
+        if name not in literals:
             findings.append(_finding(
-                SERVER_FILE,
-                f"CLI flag {flag} appears in no markdown doc "
-                "(docs/**/*.md, README.md) — add it to "
-                "docs/engine_flags.md"))
+                FLEET_SPEC_FILE,
+                f"fleet spec field {path} is never parsed — no '{name}' "
+                "string key in fleet/spec.py, so a spec file cannot set "
+                "it and nothing says that is intentional (add it to "
+                "from_dict or to FLEET_INTERNAL_FIELDS)"))
+        if doc_text and not re.search(
+                r"(?<!\w)" + re.escape(name) + r"(?![\w-])", doc_text):
+            findings.append(_finding(
+                FLEET_SPEC_FILE,
+                f"fleet spec field {path} is not documented in "
+                "docs/fleet.md"))
+    for path in sorted(internal):
+        if path not in paths:
+            findings.append(_finding(
+                FLEET_SPEC_FILE,
+                f"FLEET_INTERNAL_FIELDS references unknown fleet spec "
+                f"field {path} — stale marker entry"))
     return findings

@@ -25,6 +25,15 @@ fakes serve ``/v1/disagg/prefill`` (returns a handoff descriptor) and
 ``/v1/disagg/handoff`` (streams from a descriptor) with output
 byte-identical to the monolithic fake endpoints.
 
+Fleet-manager support (docs/fleet.md), mirroring the real engine server:
+
+- ``POST /drain`` flips DRAINING — new admissions answer 503 +
+  Retry-After while in-flight streams finish byte-identically; with
+  ``{"exit": true}`` the process exits clean once idle.
+- ``POST /gauges`` injects deterministic load-gauge values (waiting
+  depth, cache usage) into ``/metrics`` so autoscaler tests can drive
+  SLO signals without real load.
+
 Connection refusal needs no mode: point the router at an unbound port.
 
 Run: ``python -m production_stack_tpu.testing.fake_engine --port 9001``
@@ -68,12 +77,23 @@ class FakeEngineState:
         self.role = role  # reported in /health for role discovery
         self.disagg_prefills = 0  # descriptors emitted
         self.disagg_decodes = 0  # handoffs streamed
+        self.draining = False  # POST /drain flips; 503s new admissions
+        self.cache_usage = None  # POST /gauges override; None = derived
 
 
 async def _apply_api_fault(state: FakeEngineState,
                            request: web.Request) -> Optional[web.Response]:
     """Returns an error response (or hangs) per the active fault mode;
     None when the request should proceed normally."""
+    if state.draining:
+        # Zero-loss drain: mirror the real engine server's retryable
+        # rejection — the router fails the request over to a live
+        # replica (never a client-visible 5xx).
+        return web.json_response(
+            {"error": {"message": "engine is draining; retry on "
+                                  "another replica"}},
+            status=503, headers={"Retry-After": "1"},
+        )
     if state.fault == "error500":
         return web.json_response(
             {"error": {"message": "injected fault", "type": "server_error"}},
@@ -347,7 +367,58 @@ async def health(request: web.Request) -> web.Response:
         return web.json_response({"status": "injected fault"}, status=500)
     if state.fault == "hang":
         await asyncio.sleep(3600)
-    return web.json_response({"status": "ok", "role": state.role})
+    return web.json_response({
+        "status": "ok",
+        "role": state.role,
+        "draining": state.draining,
+        "active_requests": state.running,
+    })
+
+
+async def drain(request: web.Request) -> web.Response:
+    """POST /drain: same contract as the real engine server — reject
+    new admissions 503+Retry-After, finish in-flight streams, and with
+    ``{"exit": true}`` exit the process once idle."""
+    state: FakeEngineState = request.app["state"]
+    body: dict = {}
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+    state.draining = True
+    if body.get("exit"):
+        async def exit_when_idle():
+            import os
+            import signal
+            while state.running > 0:
+                await asyncio.sleep(0.02)
+            os.kill(os.getpid(), signal.SIGTERM)
+        asyncio.ensure_future(exit_when_idle())
+    return web.json_response({
+        "status": "draining",
+        "active_requests": state.running,
+        "running": state.running,
+        "waiting": state.waiting,
+    })
+
+
+async def set_gauges(request: web.Request) -> web.Response:
+    """POST /gauges: deterministic load-gauge injection for autoscaler
+    tests — drive the SLO signals the fleet manager scrapes without
+    generating real load. {"waiting": 12, "cache_usage": 0.95};
+    null/absent clears an override."""
+    state: FakeEngineState = request.app["state"]
+    body = await request.json()
+    if "waiting" in body:
+        state.waiting = int(body["waiting"] or 0)
+    if "cache_usage" in body:
+        state.cache_usage = (None if body["cache_usage"] is None
+                             else float(body["cache_usage"]))
+    return web.json_response({
+        "waiting": state.waiting,
+        "cache_usage": state.cache_usage,
+    })
 
 
 async def set_fault(request: web.Request) -> web.Response:
@@ -369,6 +440,8 @@ async def set_fault(request: web.Request) -> web.Response:
 
 async def metrics(request: web.Request) -> web.Response:
     state: FakeEngineState = request.app["state"]
+    cache_usage = (state.cache_usage if state.cache_usage is not None
+                   else min(1.0, state.running / 16))
     text = "\n".join([
         "# TYPE vllm:num_requests_running gauge",
         f"vllm:num_requests_running {float(state.running)}",
@@ -379,7 +452,9 @@ async def metrics(request: web.Request) -> web.Response:
         "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
         "vllm:gpu_prefix_cache_hit_rate 0.0",
         "# TYPE vllm:gpu_cache_usage_perc gauge",
-        f"vllm:gpu_cache_usage_perc {min(1.0, state.running / 16)}",
+        f"vllm:gpu_cache_usage_perc {float(cache_usage)}",
+        "# TYPE vllm:engine_draining gauge",
+        f"vllm:engine_draining {float(state.draining)}",
         "",
     ])
     return web.Response(text=text, content_type="text/plain")
@@ -401,6 +476,8 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/fault", set_fault)
+    app.router.add_post("/drain", drain)
+    app.router.add_post("/gauges", set_gauges)
     return app
 
 

@@ -386,3 +386,71 @@ def test_prefix_aware_spills_hot_prefix_under_load():
     # ... and the prefix is now indexed on BOTH engines, so with even
     # load the spill target can win on its own.
     assert policy._score(other, policy._chain(text)) > 0
+
+
+def test_hra_routes_from_loop_without_default_set():
+    """Regression: HRA's admission future must come from
+    asyncio.get_running_loop(). The old get_event_loop() call relied
+    on a thread-default loop being set — a router worker thread that
+    never called set_event_loop would deprecation-warn today and
+    break outright under future asyncio semantics."""
+    import threading
+    import warnings
+
+    result = {}
+
+    def worker():
+        # Deliberately no default loop for this thread.
+        asyncio.set_event_loop(None)
+
+        async def main():
+            policy = initialize_routing_logic("hra")
+            with warnings.catch_warnings():
+                warnings.simplefilter("error", DeprecationWarning)
+                fut = policy.route_request(EPS[:1], {}, {}, {}, "rl", 64)
+                assert fut.get_loop() is asyncio.get_running_loop()
+                result["url"] = await asyncio.wait_for(fut, 2.0)
+
+        asyncio.run(main())
+
+    thread = threading.Thread(target=worker)
+    thread.start()
+    thread.join(10)
+    assert result.get("url") == EPS[0].url
+
+
+def test_prefix_chain_identical_across_hash_seeds():
+    """Regression: the prefix chain must be a pure function of the
+    text. builtin hash() is salted per process, so two router
+    replicas (or one router restarted) would score the same prefix
+    differently — verified by hashing in fresh interpreters pinned to
+    different PYTHONHASHSEED values."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    from production_stack_tpu.router.routing.logic import (
+        PrefixAwarePolicy,
+    )
+
+    text = "A very long system prompt shared by every request. " * 40
+    script = (
+        "import json, sys\n"
+        "from production_stack_tpu.router.routing.logic import "
+        "PrefixAwarePolicy\n"
+        "p = PrefixAwarePolicy.__new__(PrefixAwarePolicy)\n"
+        "print(json.dumps(p._chain(sys.argv[1])))\n"
+    )
+    chains = []
+    for seed in ("1", "4242"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", script, text], env=env,
+            capture_output=True, text=True, timeout=60, check=True,
+        )
+        chains.append(_json.loads(out.stdout))
+
+    local = PrefixAwarePolicy.__new__(PrefixAwarePolicy)._chain(text)
+    assert len(local) > 4  # multiple blocks actually chained
+    assert chains[0] == chains[1] == local

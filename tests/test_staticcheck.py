@@ -298,6 +298,26 @@ _CONFIG_FIXTURE = {
         def parse_args(parser):
             parser.add_argument("--page-size", type=int)
         """,
+    "production_stack_tpu/fleet/spec.py": """\
+        FLEET_INTERNAL_FIELDS = ()
+
+        class AutoscalerSpec:
+            tolerance: float = 0.1
+
+        class PoolSpec:
+            name: str = ""
+
+        class FleetSpec:
+            pools: list = None
+
+        def from_dict(raw):
+            return (raw.get("pools"), raw.get("name"),
+                    raw.get("tolerance"))
+        """,
+    "production_stack_tpu/fleet/__main__.py": """\
+        def parse_args(parser):
+            parser.add_argument("--fleet-spec-file")
+        """,
 }
 
 
@@ -310,6 +330,8 @@ def test_config_contract_catches_planted_drift():
     assert "rejection is untested" in messages
     # Flag missing from every markdown doc.
     assert "--page-size appears in no markdown doc" in messages
+    # Fleet CLI flags are held to the same docs bar.
+    assert "--fleet-spec-file appears in no markdown doc" in messages
 
 
 def test_config_contract_accepts_markers_docs_and_tests():
@@ -317,7 +339,9 @@ def test_config_contract_accepts_markers_docs_and_tests():
     fixture["production_stack_tpu/engine/config.py"] += (
         'INTERNAL_FIELDS = {"cache.secret_knob"}\n')
     fixture["docs/engine_flags.md"] = (
-        "| `--page-size` | 16 | Tokens per KV page |\n")
+        "| `--page-size` | 16 | Tokens per KV page |\n"
+        "| `--fleet-spec-file` | required | Fleet spec path |\n")
+    fixture["docs/fleet.md"] = "pools name tolerance\n"
     fixture["tests/test_exclusivity.py"] = textwrap.dedent("""\
         import pytest
 
@@ -327,6 +351,34 @@ def test_config_contract_accepts_markers_docs_and_tests():
                 make_config(secret_knob=1)
         """)
     assert _run(fixture, "config-contract") == []
+
+
+def test_config_contract_catches_fleet_spec_drift():
+    fixture = dict(_CONFIG_FIXTURE)
+    fixture["production_stack_tpu/fleet/spec.py"] = textwrap.dedent("""\
+        FLEET_INTERNAL_FIELDS = ("ghost_field",)
+
+        class PoolSpec:
+            name: str = ""
+            secret_pool_knob: int = 0
+
+        class FleetSpec:
+            pools: list = None
+
+        def from_dict(raw):
+            return (raw.get("pools"), raw.get("name"))
+        """)
+    fixture["docs/fleet.md"] = "pools name\n"
+    findings = _run(fixture, "config-contract")
+    messages = "\n".join(f.message for f in findings)
+    # Spec field that no JSON key reaches.
+    assert ("fleet spec field pools[].secret_pool_knob is never parsed"
+            in messages)
+    # The same field is also absent from docs/fleet.md.
+    assert ("fleet spec field pools[].secret_pool_knob is not "
+            "documented" in messages)
+    # Marker naming a field that does not exist.
+    assert "unknown fleet spec field ghost_field" in messages
 
 
 # ---- kv-parity ---------------------------------------------------------
