@@ -163,6 +163,76 @@ def build():
                       '{mountpoint="/"}',
                       "{{instance}}")],
               18, 47, w=6, unit="percentunit"),
+        # ---- Disaggregated serving (docs/disaggregation.md) ----------------
+        row("Disaggregated Serving", 54),
+        panel("Prefill / Decode Requests per Engine",
+              [target('vllm:engine_disagg_prefill_requests',
+                      "prefill {{server}}"),
+               target('vllm:engine_disagg_decode_requests',
+                      "decode {{server}}")],
+              0, 55),
+        panel("Handoff KV Bytes Shipped",
+              [target('vllm:engine_disagg_kv_bytes_shipped')],
+              8, 55, unit="bytes"),
+        panel("AWAITING_KV Queue Depth",
+              [target('vllm:engine_disagg_awaiting_kv_requests')],
+              16, 55),
+        panel("Handoff Admission Latency (mean)",
+              [target('vllm:engine_disagg_handoff_latency_mean_seconds')],
+              0, 62, unit="s"),
+        panel("Router Two-Hop Handoffs",
+              [target('vllm:router_disagg_handoffs_total', "handoffs")],
+              8, 62, w=4, kind="stat"),
+        panel("Router Monolithic Fallbacks",
+              [target('vllm:router_disagg_fallbacks_total', "fallbacks")],
+              12, 62, w=4, kind="stat"),
+        # Per-phase request latency means (docs/observability.md): the
+        # router re-exports each engine phase histogram's mean; full
+        # distributions come from cluster Prometheus on the engines.
+        panel("Request Phase Latency (means)",
+              [target('vllm:engine_request_queue_time_mean_seconds',
+                      "queue {{server}}"),
+               target('vllm:engine_request_prefill_time_mean_seconds',
+                      "prefill {{server}}"),
+               target(
+                   'vllm:engine_request_awaiting_kv_time_mean_seconds',
+                   "awaiting-kv {{server}}"),
+               target('vllm:engine_request_decode_time_mean_seconds',
+                      "decode {{server}}")],
+              16, 62, unit="s"),
+        # ---- Unified ragged step (docs/unified_step.md) --------------------
+        row("Unified Ragged Step", 69),
+        panel("Step Row Split (prefill / decode / pad)",
+              [target('vllm:engine_step_prefill_rows',
+                      "prefill {{server}}"),
+               target('vllm:engine_step_decode_rows',
+                      "decode {{server}}"),
+               target('vllm:engine_step_pad_rows', "pad {{server}}")],
+              0, 70),
+        panel("Cumulative Pad-Row Ratio",
+              [target('vllm:engine_ragged_pad_rows / '
+                      'clamp_min(vllm:engine_ragged_rows, 1)')],
+              8, 70, unit="percentunit"),
+        panel("Async Pipeline (ahead-step share)",
+              [target('vllm:engine_pipeline_ahead_steps / '
+                      'clamp_min(vllm:engine_pipeline_steps, 1)')],
+              16, 70, unit="percentunit"),
+        # ---- Fleet & drain (docs/fleet.md) ---------------------------------
+        row("Fleet & Drain", 77),
+        panel("Fleet Replicas (desired vs live)",
+              [target('vllm:fleet_desired_replicas',
+                      "desired {{server}}"),
+               target('vllm:fleet_live_replicas', "live {{server}}")],
+              0, 78),
+        panel("Draining Engines",
+              [target('vllm:engine_draining')], 8, 78),
+        panel("Fleet Scale Events",
+              [target('vllm:fleet_scale_events_total')],
+              16, 78, w=4, kind="stat"),
+        panel("Request Retries / Failovers",
+              [target('vllm:request_retries_total', "retries"),
+               target('vllm:request_failovers_total', "failovers")],
+              20, 78, w=4, kind="stat"),
     ]
     return {
         "title": "TPU Stack — Serving Overview",

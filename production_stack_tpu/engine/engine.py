@@ -85,6 +85,10 @@ class LLMEngine:
         # pipeline exists to shrink.
         self._in_flight = None
         self._idle_mark: Optional[float] = None
+        # Row/spec detail for the step about to be accounted, staged
+        # by the execute helpers for the flight recorder (tracer set
+        # only); drained by _account_step.
+        self._step_note: Optional[dict] = None
         self.offload = None
         if config.offload.enable:
             self._init_offload()
@@ -95,6 +99,23 @@ class LLMEngine:
         self.disagg_prefill_requests = 0
         self.disagg_decode_requests = 0
         self.disagg_kv_bytes_shipped = 0
+        # End-to-end tracing (docs/observability.md): the server
+        # installs an engine/tracing.EngineTracer here; the library
+        # default is None and every emission site is behind an
+        # ``is None`` check, so untraced engines allocate no span
+        # objects on the hot path.
+        self._tracer = None
+
+    @property
+    def tracer(self):
+        return self._tracer
+
+    @tracer.setter
+    def tracer(self, tracer) -> None:
+        # Mirrored onto the scheduler so chunk/preempt/first-token
+        # events emit without a back-reference to the engine.
+        self._tracer = tracer
+        self.scheduler.tracer = tracer
 
     def _init_offload(self) -> None:
         import numpy as np
@@ -184,7 +205,8 @@ class LLMEngine:
                     seq_id: Optional[str] = None,
                     output_sink=None,
                     lora_name: Optional[str] = None,
-                    handoff_prefill: bool = False) -> str:
+                    handoff_prefill: bool = False,
+                    request_id: Optional[str] = None) -> str:
         sampling = sampling or SamplingParams()
         stop_ids = list(sampling.stop_token_ids)
         if (not sampling.ignore_eos
@@ -220,6 +242,7 @@ class LLMEngine:
                         if lora_id else 0),
             fsm_state=fsm_state,
             handoff_prefill=handoff_prefill,
+            request_id=request_id,
         )
         with self._lock:
             self.sequences[seq.seq_id] = seq
@@ -228,13 +251,18 @@ class LLMEngine:
             except Exception:
                 self.sequences.pop(seq.seq_id, None)
                 raise
+            if self._tracer is not None:
+                self._tracer.start(
+                    seq.seq_id, request_id=request_id,
+                    prompt_tokens=seq.num_prompt_tokens)
         return seq.seq_id
 
     def add_handoff(self, prompt_token_ids: List[int],
                     first_token: int,
                     sampling: Optional[SamplingParams] = None,
                     seq_id: Optional[str] = None,
-                    output_sink=None) -> str:
+                    output_sink=None,
+                    request_id: Optional[str] = None) -> str:
         """Accept a disaggregated prefill->decode handoff
         (docs/disaggregation.md): park the sequence in AWAITING_KV
         until its shipped pages are reachable in an offload tier
@@ -267,6 +295,7 @@ class LLMEngine:
             state=SequenceState.AWAITING_KV,
             num_prior_output_tokens=1,
             handoff_arrival_time=time.time(),
+            request_id=request_id,
         )
         with self._lock:
             self.sequences[seq.seq_id] = seq
@@ -275,6 +304,11 @@ class LLMEngine:
             except Exception:
                 self.sequences.pop(seq.seq_id, None)
                 raise
+            if self._tracer is not None:
+                self._tracer.start(
+                    seq.seq_id, request_id=request_id,
+                    prompt_tokens=seq.num_prompt_tokens)
+                self._tracer.event(seq.seq_id, "awaiting_kv_park")
             # Undo the admission clamp: it counts the folded first
             # token as prompt, which would end generation one token
             # earlier than the monolithic path. num_prior_output_tokens
@@ -286,6 +320,10 @@ class LLMEngine:
                 # No tier to restore from: degrade to recompute now.
                 seq.state = SequenceState.WAITING
                 self.metrics.on_handoff_admitted(0.0)
+                if self._tracer is not None:
+                    self._tracer.event(
+                        seq.seq_id, "awaiting_kv_restore",
+                        waited_ms=0.0, outcome="no_tier")
         return seq.seq_id
 
     def take_handoff_info(self, seq_id: str) -> Optional[dict]:
@@ -319,6 +357,11 @@ class LLMEngine:
         self._handoff_info[seq.seq_id] = info
         self.disagg_prefill_requests += 1
         self.disagg_kv_bytes_shipped += info["kv_bytes"]
+        if self._tracer is not None:
+            self._tracer.event(
+                seq.seq_id, "handoff_ship",
+                num_pages=info["num_pages"],
+                kv_bytes=info["kv_bytes"])
         self.scheduler.finish_handoff(seq)
 
     def _handoff_kv_ready(self, seq: Sequence) -> Optional[bool]:
@@ -362,6 +405,14 @@ class LLMEngine:
                 seq.state = SequenceState.WAITING
                 self.metrics.on_handoff_admitted(
                     now - seq.handoff_arrival_time)
+                if self._tracer is not None:
+                    self._tracer.event(
+                        seq.seq_id, "awaiting_kv_restore",
+                        waited_ms=round(
+                            (now - seq.handoff_arrival_time) * 1e3, 2),
+                        outcome=("ready" if ready
+                                 else "timeout" if ready is None
+                                 else "lost"))
 
     def register_lora(self, name_or_path: str,
                       name: Optional[str] = None) -> int:
@@ -387,6 +438,21 @@ class LLMEngine:
             if seq is not None:
                 self.scheduler.abort_sequence(seq)
                 self.metrics.on_finished(seq)
+                if self._tracer is not None:
+                    self._trace_finish(seq)
+
+    def _trace_finish(self, seq: Sequence) -> None:
+        """Finalize ``seq``'s engine span (caller checked the tracer)."""
+        self._tracer.finish(
+            seq.seq_id,
+            reason=(seq.finish_reason.value
+                    if seq.finish_reason else None),
+            arrival_ts=seq.arrival_time,
+            first_scheduled_ts=seq.first_scheduled_time,
+            first_token_ts=seq.first_token_time,
+            finish_ts=seq.finish_time,
+            prompt_tokens=seq.num_prompt_tokens,
+            output_tokens=seq.num_generated)
 
     def has_work(self) -> bool:
         # A dispatched-but-unread decode step is work: the loop must
@@ -436,11 +502,27 @@ class LLMEngine:
             wait_s = self._execute_prefill(plan, outputs)
         else:
             wait_s = self._execute_decode_sync(plan, outputs)
-        self.metrics.on_pipeline_step(
+        self._account_step(
             host_s=(time.perf_counter() - t0) - wait_s,
-            device_wait_s=wait_s, ahead=False)
+            wait_s=wait_s, ahead=False)
         self._pop_finished(outputs)
         return outputs
+
+    def _account_step(self, host_s: float, wait_s: float, ahead: bool,
+                      pipeline_break: bool = False, **extra) -> None:
+        """One step's accounting fan-out: the aggregate pipeline
+        metrics, plus a flight-recorder record (engine/tracing.py)
+        carrying the row/spec note the execute helper staged."""
+        self.metrics.on_pipeline_step(
+            host_s=host_s, device_wait_s=wait_s, ahead=ahead)
+        if self._tracer is not None:
+            note = self._step_note or {}
+            self._step_note = None
+            note.update(extra)
+            self._tracer.on_step(
+                host_ms=round(host_s * 1e3, 3),
+                device_wait_ms=round(wait_s * 1e3, 3),
+                ahead=ahead, pipeline_break=pipeline_break, **note)
 
     def _execute_prefill(self, plan, outputs) -> float:
         td = time.perf_counter()
@@ -464,6 +546,12 @@ class LLMEngine:
                     outputs.append(self._delta(
                         chunk.seq, token,
                         lp_rows[i] if lp_rows else None))
+            if self._tracer is not None:
+                self._step_note = {
+                    "kind": "prefill",
+                    "prefill_rows": len(plan.prefill.chunks),
+                    "row_bucket": self.runner.prefill_width,
+                }
         return tr - td
 
     def _execute_decode_sync(self, plan, outputs) -> float:
@@ -499,6 +587,16 @@ class LLMEngine:
                     self.scheduler.on_spec_executed(seq)
             if spec_drafts is not None:
                 self.metrics.on_spec_step(drafted, accepted)
+            if self._tracer is not None:
+                self._step_note = {
+                    "kind": "spec" if spec_drafts is not None
+                    else "decode",
+                    "decode_rows": len(plan.decode.seqs),
+                    "row_bucket": self.runner.decode_width,
+                    "window": plan.decode.window,
+                    "spec_drafted": drafted,
+                    "spec_accepted": accepted,
+                }
         return tr - td
 
     def _execute_unified(self, plan, outputs) -> float:
@@ -552,6 +650,18 @@ class LLMEngine:
                     outputs.append(self._delta(
                         chunk.seq, token,
                         prefill_lps[i] if prefill_lps else None))
+            if self._tracer is not None:
+                self._step_note = {
+                    "kind": "unified",
+                    "prefill_rows": len(chunks),
+                    "decode_rows": len(seqs),
+                    "pad_rows": (self.runner.last_unified_rows
+                                 - len(chunks) - len(seqs)),
+                    "row_bucket": self.runner.last_unified_rows,
+                    "window": plan.decode.window,
+                    "spec_drafted": drafted,
+                    "spec_accepted": accepted,
+                }
         return tr - td
 
     # ---- overlapped async pipeline (docs/async_pipeline.md) ---------------
@@ -595,9 +705,9 @@ class LLMEngine:
                 outputs, wait_s = self._complete(handle)
                 # No _idle_mark here: step N+1 was queued before step
                 # N's results were read — the device never idled.
-                self.metrics.on_pipeline_step(
+                self._account_step(
                     host_s=(time.perf_counter() - t0) - wait_s,
-                    device_wait_s=wait_s, ahead=True)
+                    wait_s=wait_s, ahead=True)
                 return outputs
             # Pipeline break (prefill waiting / ineligible row / no
             # boundary pages): drain the in-flight step, then let the
@@ -606,9 +716,9 @@ class LLMEngine:
             self.metrics.set_inflight_depth(0)
             outputs, wait_s = self._complete(handle)
             self._idle_mark = time.perf_counter()
-            self.metrics.on_pipeline_step(
+            self._account_step(
                 host_s=(time.perf_counter() - t0) - wait_s,
-                device_wait_s=wait_s, ahead=False)
+                wait_s=wait_s, ahead=False, pipeline_break=True)
             return outputs
         outputs: List[StepOutput] = []
         t0 = time.perf_counter()
@@ -625,9 +735,9 @@ class LLMEngine:
                 wait_s = self._execute_unified(plan, outputs)
             else:
                 wait_s = self._execute_prefill(plan, outputs)
-            self.metrics.on_pipeline_step(
+            self._account_step(
                 host_s=(time.perf_counter() - t0) - wait_s,
-                device_wait_s=wait_s, ahead=False)
+                wait_s=wait_s, ahead=False, pipeline_break=True)
             self._pop_finished(outputs)
             return outputs
         if plan.decode.drafts is not None:
@@ -639,9 +749,9 @@ class LLMEngine:
             self._note_dispatch(time.perf_counter())
             self._in_flight = self.runner.dispatch_spec(plan.decode)
             self.metrics.set_inflight_depth(1)
-            self.metrics.on_pipeline_step(
-                host_s=time.perf_counter() - t0, device_wait_s=0.0,
-                ahead=False)
+            self._account_step(
+                host_s=time.perf_counter() - t0, wait_s=0.0,
+                ahead=False, kind="spec_dispatch")
             self._pop_finished(outputs)
             return outputs
         if plan.decode.window > 1:
@@ -650,9 +760,9 @@ class LLMEngine:
             # rather than through the depth-1 pipeline (stacking both
             # overlaps would speculate window tokens ahead).
             wait_s = self._execute_decode_sync(plan, outputs)
-            self.metrics.on_pipeline_step(
+            self._account_step(
                 host_s=(time.perf_counter() - t0) - wait_s,
-                device_wait_s=wait_s, ahead=False)
+                wait_s=wait_s, ahead=False)
             self._pop_finished(outputs)
             return outputs
         # Single-step pure-decode plan: dispatch and return without
@@ -661,9 +771,9 @@ class LLMEngine:
         self._in_flight = self.runner.dispatch_decode(
             plan.decode.seqs[: self.runner.decode_width])
         self.metrics.set_inflight_depth(1)
-        self.metrics.on_pipeline_step(
-            host_s=time.perf_counter() - t0, device_wait_s=0.0,
-            ahead=False)
+        self._account_step(
+            host_s=time.perf_counter() - t0, wait_s=0.0,
+            ahead=False, kind="decode_dispatch")
         self._pop_finished(outputs)
         return outputs
 
@@ -718,6 +828,15 @@ class LLMEngine:
                     self.scheduler.on_spec_executed(seq)
             if spec_drafts is not None:
                 self.metrics.on_spec_step(drafted, accepted)
+            if self._tracer is not None:
+                self._step_note = {
+                    "kind": "spec" if handle.is_spec else "decode",
+                    "decode_rows": sum(
+                        1 for seq in handle.rows if seq is not None),
+                    "row_bucket": self.runner.decode_width,
+                    "spec_drafted": drafted,
+                    "spec_accepted": accepted,
+                }
         self._pop_finished(outputs)
         return outputs, wait_s
 
@@ -727,6 +846,8 @@ class LLMEngine:
                 seq = self.sequences.pop(out.seq_id, None)
                 if seq is not None:
                     self.metrics.on_finished(seq)
+                    if self._tracer is not None:
+                        self._trace_finish(seq)
 
     def _note_dispatch(self, now: float) -> None:
         """Device-idle accounting: accumulate the gap between the

@@ -68,6 +68,13 @@ class EngineMetrics:
         # review asked the stack to expose.
         self.queue_time = Histogram(_TTFT_BUCKETS)
         self.prefill_time = Histogram(_TTFT_BUCKETS)
+        # Remaining request phases (docs/observability.md): decode
+        # (first token -> finish) and, on disagg decode engines, the
+        # AWAITING_KV park (handoff arrival -> admission — the phase
+        # family view of the handoff-admission latency). Always
+        # rendered (empty when unused) for a stable scrape surface.
+        self.decode_time = Histogram(_E2E_BUCKETS)
+        self.awaiting_kv_time = Histogram(_TTFT_BUCKETS)
         self.prompt_tokens_total = 0
         self.generation_tokens_total = 0
         self.requests_total: Dict[str, int] = {}
@@ -152,6 +159,7 @@ class EngineMetrics:
         """One disagg handoff left AWAITING_KV after ``latency_s``."""
         with self._lock:
             self.handoff_latency.observe(max(0.0, latency_s))
+            self.awaiting_kv_time.observe(max(0.0, latency_s))
 
     def on_decode_tokens(self, seq, n_tokens: int,
                          now: float) -> None:
@@ -195,6 +203,9 @@ class EngineMetrics:
                 # Inter-token latency is observed per token as decode
                 # steps complete (on_decode_tokens) — no per-request
                 # mean here, which would double-count.
+                if seq.finish_time is not None:
+                    self.decode_time.observe(
+                        seq.finish_time - seq.first_token_time)
             if seq.finish_time is not None:
                 self.e2e.observe(seq.finish_time - seq.arrival_time)
 
@@ -209,6 +220,10 @@ class EngineMetrics:
                 "vllm:request_queue_time_seconds")
             lines += self.prefill_time.render(
                 "vllm:request_prefill_time_seconds")
+            lines += self.decode_time.render(
+                "vllm:request_decode_time_seconds")
+            lines += self.awaiting_kv_time.render(
+                "vllm:request_awaiting_kv_time_seconds")
             lines += self.handoff_latency.render(
                 "vllm:disagg_handoff_latency_seconds")
             lines += [

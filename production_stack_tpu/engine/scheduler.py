@@ -107,6 +107,9 @@ class Scheduler:
         # Optional offload-tier restore hook:
         # (prompt_token_ids, matched_pages) -> extra restored page ids.
         self.restore_hook = None
+        # End-to-end tracing (docs/observability.md): mirror of
+        # LLMEngine.tracer, installed via its setter; None = untraced.
+        self.tracer = None
         # Sequences aborted by the scheduler itself (oversized prompts,
         # permanent cache starvation); the engine drains this to emit
         # terminal outputs to their clients.
@@ -462,10 +465,15 @@ class Scheduler:
                 matched = self.cache.match_prefix(
                     seq.prompt_token_ids, seq.cache_salt)
                 if self.restore_hook is not None:
-                    matched = matched + self.restore_hook(
+                    restored = self.restore_hook(
                         seq.prompt_token_ids, matched,
                         seq.cache_salt,
                     )
+                    if restored and self.tracer is not None:
+                        self.tracer.event(
+                            seq.seq_id, "offload_restore",
+                            pages=len(restored))
+                    matched = matched + restored
                 if (self.sp_threshold is not None
                         and not matched
                         and seq.num_prompt_tokens >= self.sp_threshold):
@@ -594,6 +602,9 @@ class Scheduler:
     def _preempt(self, seq: Sequence) -> None:
         logger.warning("Preempting %s (KV cache pressure)", seq.seq_id)
         self.num_preemptions += 1
+        if self.tracer is not None:
+            self.tracer.event(seq.seq_id, "preempt",
+                              generated=len(seq.output_token_ids))
         self.running.remove(seq)
         self.cache.free_sequence(seq.pages)
         seq.pages = []
@@ -620,6 +631,12 @@ class Scheduler:
             return  # aborted while the chunk was in flight on device
         seq.num_computed_tokens = (chunk.chunk_start
                                    + len(chunk.chunk_tokens))
+        if self.tracer is not None:
+            self.tracer.event(
+                seq.seq_id, "prefill_chunk",
+                start=chunk.chunk_start,
+                tokens=len(chunk.chunk_tokens),
+                last=chunk.is_last_chunk)
         self.cache.commit_full_pages(
             seq.prompt_token_ids[:seq.num_computed_tokens],
             seq.pages, seq.num_hashed_pages, seq.cache_salt,
@@ -636,6 +653,9 @@ class Scheduler:
                 return  # raced with an abort that already dequeued it
             seq.state = SequenceState.RUNNING
             seq.first_token_time = time.time()
+            if self.tracer is not None:
+                self.tracer.event(seq.seq_id, "first_token",
+                                  token=int(sampled_token))
             self.running.append(seq)
             self._append_token(seq, sampled_token)
 

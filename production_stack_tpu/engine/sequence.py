@@ -136,6 +136,10 @@ class Sequence:
     # Decode-side handoff bookkeeping: when the sequence was parked in
     # AWAITING_KV (admission latency = admit time - this).
     handoff_arrival_time: Optional[float] = None
+    # End-to-end trace id (docs/observability.md): the router's
+    # x-request-id, carried so engine spans on every hop of a
+    # disaggregated request stitch to the same router span.
+    request_id: Optional[str] = None
 
     @property
     def num_generated(self) -> int:

@@ -96,6 +96,7 @@ class AsyncEngine:
                         self.engine.add_handoff(
                             item["prompt"], item["first_token"],
                             item["sampling"], seq_id=seq_id,
+                            request_id=item.get("request_id"),
                         )
                     else:
                         self.engine.add_request(
@@ -104,6 +105,7 @@ class AsyncEngine:
                             lora_name=item.get("lora_name"),
                             handoff_prefill=item.get(
                                 "handoff_prefill", False),
+                            request_id=item.get("request_id"),
                         )
                 except Exception as e:
                     # Queue full / invalid request: fail THIS request,
@@ -145,6 +147,7 @@ class AsyncEngine:
     async def submit(self, prompt: List[int], sampling: SamplingParams,
                      lora_name: Optional[str] = None,
                      handoff_prefill: bool = False,
+                     request_id: Optional[str] = None,
                      ) -> tuple[str, asyncio.Queue]:
         seq_id = f"seq-{uuid.uuid4().hex[:16]}"
         stream: asyncio.Queue = asyncio.Queue()
@@ -153,12 +156,14 @@ class AsyncEngine:
             "kind": "request", "prompt": prompt, "sampling": sampling,
             "seq_id": seq_id, "lora_name": lora_name,
             "handoff_prefill": handoff_prefill,
+            "request_id": request_id,
         })
         self._wakeup.set()
         return seq_id, stream
 
     async def submit_handoff(self, prompt: List[int], first_token: int,
                              sampling: SamplingParams,
+                             request_id: Optional[str] = None,
                              ) -> tuple[str, asyncio.Queue]:
         """Submit a disagg handoff descriptor's sequence
         (docs/disaggregation.md); the stream carries tokens FROM THE
@@ -169,7 +174,7 @@ class AsyncEngine:
         self._submit_q.put({
             "kind": "handoff", "prompt": prompt,
             "first_token": first_token, "sampling": sampling,
-            "seq_id": seq_id,
+            "seq_id": seq_id, "request_id": request_id,
         })
         self._wakeup.set()
         return seq_id, stream
@@ -636,8 +641,10 @@ class EngineServer:
             return dataclasses.replace(sampling,
                                        seed=sampling.seed + i)
 
+        trace_id = request.headers.get("x-request-id")
         subs = [await self.async_engine.submit(
-            prompt, choice_sampling(i), lora_name=lora_name)
+            prompt, choice_sampling(i), lora_name=lora_name,
+            request_id=trace_id)
             for i in range(candidates)]
 
         def legacy_lp(lps):
@@ -989,7 +996,8 @@ class EngineServer:
                 status=400,
             )
         seq_id, stream = await self.async_engine.submit(
-            prompt, sampling, handoff_prefill=True)
+            prompt, sampling, handoff_prefill=True,
+            request_id=request.headers.get("x-request-id"))
         try:
             out = await stream.get()
         finally:
@@ -1073,7 +1081,8 @@ class EngineServer:
         stream: Optional[asyncio.Queue] = None
         if not finish_hint and sampling.max_tokens > 1:
             seq_id, stream = await self.async_engine.submit_handoff(
-                token_ids, first_token, sampling)
+                token_ids, first_token, sampling,
+                request_id=request.headers.get("x-request-id"))
         # Peek the first engine event so a rejected submission (queue
         # full) surfaces as a retryable 503, not a stream that aborts
         # after the headers already went out.
@@ -1495,6 +1504,38 @@ class EngineServer:
         self._profiling = False
         return web.json_response({"status": "stopped"})
 
+    async def debug_trace(self, request: web.Request):
+        """GET /debug/trace/{request_id}: the flight recorder's event
+        timeline for one request, looked up by router x-request-id or
+        engine seq id (docs/observability.md)."""
+        tracer = self.engine.tracer
+        if tracer is None:
+            return web.json_response(
+                {"error": {"message": "tracing disabled"}}, status=404)
+        found = tracer.lookup(request.match_info["request_id"])
+        if found is None:
+            return web.json_response(
+                {"error": {"message": "no trace for that id (expired "
+                                      "from the ring or never seen)"}},
+                status=404)
+        return web.json_response(found)
+
+    async def debug_steps(self, request: web.Request):
+        """GET /debug/steps[?limit=N]: most recent per-step flight
+        recorder records, oldest first."""
+        tracer = self.engine.tracer
+        if tracer is None:
+            return web.json_response(
+                {"error": {"message": "tracing disabled"}}, status=404)
+        try:
+            limit = int(request.query.get("limit", "100"))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "limit must be an integer"}},
+                status=400)
+        return web.json_response(
+            {"steps": tracer.recent_steps(limit=limit)})
+
     async def version(self, request: web.Request):
         return web.json_response({"version": __version__})
 
@@ -1581,6 +1622,8 @@ class EngineServer:
         app.router.add_get("/metrics", self.metrics)
         app.router.add_post("/debug/profiler/start", self.profiler_start)
         app.router.add_post("/debug/profiler/stop", self.profiler_stop)
+        app.router.add_get("/debug/trace/{request_id}", self.debug_trace)
+        app.router.add_get("/debug/steps", self.debug_steps)
 
         async def on_startup(app):
             self.async_engine.start(asyncio.get_event_loop())
@@ -1767,6 +1810,16 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
                 f"--lora-modules entries must be name=path, got {module!r}"
             )
         engine.register_lora(path, name=name)
+    if args.request_span_log or args.trace_ring_size > 0:
+        # Server default: flight recorder on (ring > 0), span log off.
+        # Library/tests constructing LLMEngine directly keep
+        # engine.tracer None — zero tracing cost there.
+        from production_stack_tpu.engine.tracing import EngineTracer
+        engine.tracer = EngineTracer(
+            span_log_path=args.request_span_log,
+            ring_size=max(1, args.trace_ring_size),
+            role=args.engine_role,
+        )
     return engine, served_name
 
 
@@ -1883,6 +1936,20 @@ def parse_args(argv=None):
     parser.add_argument("--profile-dir", default=None,
                         help="Default output dir for "
                              "/debug/profiler/start traces")
+    parser.add_argument("--request-span-log", default=None,
+                        help="Emit one JSON engine-span line per "
+                             "finished request to this path ('-' = "
+                             "the engine log). Same span family as "
+                             "the router's --request-span-log; stitch "
+                             "with python -m "
+                             "production_stack_tpu.traceview "
+                             "(docs/observability.md)")
+    parser.add_argument("--trace-ring-size", type=int, default=256,
+                        help="Flight-recorder depth: recent request "
+                             "timelines kept for /debug/trace/{id} "
+                             "and step records for /debug/steps. "
+                             "0 disables the recorder (and, with no "
+                             "--request-span-log, all tracing)")
     parser.add_argument("--compilation-cache-dir", default=None,
                         help="Persistent XLA compilation cache (point "
                              "at the PVC so pod restarts skip "

@@ -146,6 +146,25 @@ engine_disagg_handoff_latency_mean = Gauge(
     "vllm:engine_disagg_handoff_latency_mean_seconds",
     "Mean handoff-admission latency from the engine's histogram "
     "sum/count (scraped)", _LBL)
+# Per-phase request latency means (docs/observability.md): each
+# engine's phase histogram sum/count re-exported as a mean so the
+# dashboard decomposes TTFT/e2e without scraping every engine.
+engine_request_queue_time_mean = Gauge(
+    "vllm:engine_request_queue_time_mean_seconds",
+    "Mean time in the engine waiting queue, arrival to first "
+    "scheduled (scraped histogram sum/count)", _LBL)
+engine_request_prefill_time_mean = Gauge(
+    "vllm:engine_request_prefill_time_mean_seconds",
+    "Mean prefill compute time, first scheduled to first token "
+    "(scraped histogram sum/count)", _LBL)
+engine_request_awaiting_kv_time_mean = Gauge(
+    "vllm:engine_request_awaiting_kv_time_mean_seconds",
+    "Mean time parked in AWAITING_KV on disagg decode engines "
+    "(scraped histogram sum/count)", _LBL)
+engine_request_decode_time_mean = Gauge(
+    "vllm:engine_request_decode_time_mean_seconds",
+    "Mean decode time, first token to finish (scraped histogram "
+    "sum/count)", _LBL)
 engine_draining = Gauge(
     "vllm:engine_draining",
     "Engine-reported draining state: 1 while the engine rejects new "
@@ -308,6 +327,23 @@ def refresh_gauges() -> None:
             engine_disagg_handoff_latency_mean.labels(server=server).set(
                 es.disagg_handoff_latency_sum
                 / es.disagg_handoff_latency_count)
+        if es.request_queue_time_count > 0:
+            engine_request_queue_time_mean.labels(server=server).set(
+                es.request_queue_time_sum
+                / es.request_queue_time_count)
+        if es.request_prefill_time_count > 0:
+            engine_request_prefill_time_mean.labels(server=server).set(
+                es.request_prefill_time_sum
+                / es.request_prefill_time_count)
+        if es.request_awaiting_kv_time_count > 0:
+            engine_request_awaiting_kv_time_mean.labels(
+                server=server).set(
+                es.request_awaiting_kv_time_sum
+                / es.request_awaiting_kv_time_count)
+        if es.request_decode_time_count > 0:
+            engine_request_decode_time_mean.labels(server=server).set(
+                es.request_decode_time_sum
+                / es.request_decode_time_count)
         engine_draining.labels(server=server).set(es.engine_draining)
     from production_stack_tpu.router.services import request_service
     router_disagg_handoffs.set(request_service.disagg_handoffs_total)

@@ -460,6 +460,10 @@ async def _route_disagg(request: web.Request, body: bytes, payload: dict,
             mgr.retries_total += 1
     if descriptor is None:
         return None
+    if span is not None:
+        # Hop fields, not on_routed: the prefill->decode transition
+        # is two-hop dispatch, never a failover retry.
+        span.on_prefill_routed(url)
 
     handoff_body = json.dumps({
         "descriptor": descriptor,
@@ -481,7 +485,7 @@ async def _route_disagg(request: web.Request, body: bytes, payload: dict,
             tried.add(server_url)
             continue
         if span is not None:
-            span.on_routed(server_url)
+            span.on_decode_routed(server_url)
         try:
             response = await _proxy_stream(
                 request, server_url, "/v1/disagg/handoff", handoff_body,

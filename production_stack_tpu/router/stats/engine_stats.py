@@ -76,10 +76,20 @@ _METRIC_MAP = {
     "vllm:engine_draining": "engine_draining",
 }
 
-# Handoff-latency histogram (submission to leaving AWAITING_KV on the
-# decode engine): the scraper keeps the running sum/count so the
-# router can re-export a mean; buckets stay with cluster Prometheus.
-_HANDOFF_HIST = "vllm:disagg_handoff_latency_seconds"
+# Engine latency histograms the scraper summarizes: it keeps each
+# one's running sum/count (exposition name -> EngineStats field
+# prefix, fields ``<prefix>_sum``/``<prefix>_count``) so the router
+# can re-export a mean; buckets stay with cluster Prometheus. Covers
+# the handoff-admission latency and the per-phase request histograms
+# (queue / prefill-compute / awaiting-KV / decode,
+# docs/observability.md).
+_SUMMARY_HISTS = {
+    "vllm:disagg_handoff_latency_seconds": "disagg_handoff_latency",
+    "vllm:request_queue_time_seconds": "request_queue_time",
+    "vllm:request_prefill_time_seconds": "request_prefill_time",
+    "vllm:request_awaiting_kv_time_seconds": "request_awaiting_kv_time",
+    "vllm:request_decode_time_seconds": "request_decode_time",
+}
 
 # Engine metrics the router deliberately does NOT scrape: request
 # latency histograms and lifecycle counters are read by cluster
@@ -87,13 +97,11 @@ _HANDOFF_HIST = "vllm:disagg_handoff_latency_seconds"
 # per-request stats monitor computes its own latency view from live
 # traffic). Listed here so the staticcheck metrics-contract analyzer
 # can tell a decided drop from silent drift — a NEW engine metric
-# must be added to _METRIC_MAP or to this set.
+# must be added to _METRIC_MAP, _SUMMARY_HISTS, or this set.
 _ROUTER_UNSCRAPED = frozenset({
     "vllm:time_to_first_token_seconds",
     "vllm:time_per_output_token_seconds",
     "vllm:e2e_request_latency_seconds",
-    "vllm:request_queue_time_seconds",
-    "vllm:request_prefill_time_seconds",
     "vllm:prompt_tokens_total",
     "vllm:generation_tokens_total",
     "vllm:request_success_total",
@@ -146,6 +154,16 @@ class EngineStats:
     disagg_awaiting_kv_requests: float = 0.0
     disagg_handoff_latency_sum: float = 0.0
     disagg_handoff_latency_count: float = 0.0
+    # Per-phase request latency histograms (docs/observability.md):
+    # running sum/count per phase; mean = sum / count when count > 0.
+    request_queue_time_sum: float = 0.0
+    request_queue_time_count: float = 0.0
+    request_prefill_time_sum: float = 0.0
+    request_prefill_time_count: float = 0.0
+    request_awaiting_kv_time_sum: float = 0.0
+    request_awaiting_kv_time_count: float = 0.0
+    request_decode_time_sum: float = 0.0
+    request_decode_time_count: float = 0.0
     # Zero-loss drain (docs/fleet.md): 1 while the engine is draining.
     engine_draining: float = 0.0
 
@@ -154,11 +172,12 @@ class EngineStats:
         stats = cls()
         for family in text_string_to_metric_families(text):
             for sample in family.samples:
-                if sample.name == _HANDOFF_HIST + "_sum":
-                    stats.disagg_handoff_latency_sum = sample.value
-                    continue
-                if sample.name == _HANDOFF_HIST + "_count":
-                    stats.disagg_handoff_latency_count = sample.value
+                base, _, suffix = sample.name.rpartition("_")
+                if (suffix in ("sum", "count")
+                        and base in _SUMMARY_HISTS):
+                    setattr(stats,
+                            f"{_SUMMARY_HISTS[base]}_{suffix}",
+                            sample.value)
                     continue
                 if (sample.name == "vllm:engine_kv_cache_dtype"
                         and sample.value == 1.0):

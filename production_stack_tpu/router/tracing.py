@@ -44,6 +44,13 @@ class RequestSpan:
     status: str = "ok"  # ok | killed | rejected | error
     retries: int = 0
     tried_backends: list = field(default_factory=list)
+    # Disaggregated two-hop dispatch (docs/disaggregation.md): the
+    # prefill hop's backend and the descriptor-received -> decode-hop-
+    # routed gap. Explicit hop fields — the prefill->decode transition
+    # is NOT a failover and must not touch retries/tried_backends.
+    prefill_backend: Optional[str] = None
+    prefill_done_ts: Optional[float] = None
+    handoff_ms: Optional[float] = None
 
     def on_routed(self, backend: str) -> None:
         if self.backend is not None and backend != self.backend:
@@ -52,6 +59,23 @@ class RequestSpan:
             self.retries += 1
         self.backend = backend
         self.routed_ts = time.time()
+
+    def on_prefill_routed(self, backend: str) -> None:
+        """The disagg prefill hop returned its descriptor from
+        ``backend``. Recorded as a hop, never as a failover."""
+        self.prefill_backend = backend
+        self.prefill_done_ts = time.time()
+
+    def on_decode_routed(self, backend: str) -> None:
+        """The disagg decode hop routed to ``backend``: ordinary
+        routing (failover semantics apply among decode candidates)
+        plus the descriptor-received -> decode-routed handoff gap.
+        If no decode hop is ever routed (straight to the monolithic
+        fallback), handoff_ms stays unset — no handoff happened."""
+        self.on_routed(backend)
+        if self.prefill_done_ts is not None:
+            self.handoff_ms = round(
+                (self.routed_ts - self.prefill_done_ts) * 1e3, 2)
 
     def on_chunk(self) -> None:
         if self.first_chunk_ts is None:
@@ -80,6 +104,8 @@ class RequestSpan:
             "status": self.status,
             "retries": self.retries,
             "tried_backends": list(self.tried_backends),
+            "prefill_backend": self.prefill_backend,
+            "handoff_ms": self.handoff_ms,
         })
 
 

@@ -11,5 +11,6 @@ from production_stack_tpu.staticcheck.analyzers import (  # noqa: F401
     kv_parity,
     metrics_contract,
     network_timeout,
+    span_contract,
     tracer_hygiene,
 )

@@ -1,0 +1,142 @@
+"""Rule ``span-contract``: the engine span-event vocabulary is closed
+and documented.
+
+Engine spans (engine/tracing.py) are a string-keyed timeline: every
+producer — the engine, the scheduler, the fake engine — names its
+events with bare string literals, and every consumer (traceview, the
+flight-recorder endpoints, dashboards grepping span logs) matches on
+those names. Nothing at runtime rejects a typo'd or novel name; it
+just becomes an event no tool recognizes. Checks:
+
+- every string literal passed as the event name to an
+  ``*.event(...)`` call anywhere in the package is a member of the
+  ``SPAN_EVENTS`` tuple in engine/tracing.py;
+- every ``SPAN_EVENTS`` name appears (backticked) inside the
+  ``<!-- span-events:begin -->`` / ``<!-- span-events:end -->`` block
+  of docs/observability.md, and every documented name is in
+  ``SPAN_EVENTS`` — the docs table and the vocabulary cannot drift
+  apart in either direction.
+
+Event-name call sites are recognized positionally: ``EngineSpan.event``
+takes the name first, ``EngineTracer.event`` takes it second (after
+the seq id), so the first string literal among a call's first two
+positional arguments is taken as the name. Dynamic names (a variable)
+are invisible to this rule by design — the one dynamic site is the
+tracer's own pass-through.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set, Tuple
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    rule,
+)
+
+TRACING_FILE = "production_stack_tpu/engine/tracing.py"
+DOCS_FILE = "docs/observability.md"
+
+_BLOCK_RE = re.compile(
+    r"<!--\s*span-events:begin\s*-->(.*?)<!--\s*span-events:end\s*-->",
+    re.DOTALL)
+_DOC_NAME_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`", re.MULTILINE)
+
+
+def _event_name_sites(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(line, name) for each ``*.event(...)`` call whose event name is
+    a string literal (first literal among the first two positional
+    args)."""
+    sites: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "event"):
+            continue
+        for arg in node.args[:2]:
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str):
+                sites.append((node.lineno, arg.value))
+                break
+    return sites
+
+
+def _span_events(tree: ast.AST) -> Set[str]:
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                if (isinstance(target, ast.Name)
+                        and target.id == "SPAN_EVENTS"
+                        and isinstance(stmt.value, (ast.Tuple, ast.List))):
+                    return {el.value for el in stmt.value.elts
+                            if isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)}
+    return set()
+
+
+@rule("span-contract",
+      "span event names are in SPAN_EVENTS and documented in "
+      "docs/observability.md")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def missing(path):
+        return Finding(
+            rule="span-contract", path=path, line=0,
+            message="span-contract surface file missing — if the "
+                    "layer moved, update "
+                    "staticcheck/analyzers/span_contract.py")
+
+    tracing = project.source(TRACING_FILE)
+    docs = project.source(DOCS_FILE)
+    if tracing is None or tracing.tree is None:
+        findings.append(missing(TRACING_FILE))
+    if docs is None:
+        findings.append(missing(DOCS_FILE))
+    if findings:
+        return findings
+
+    vocab = _span_events(tracing.tree)
+    if not vocab:
+        return [Finding(
+            rule="span-contract", path=TRACING_FILE, line=0,
+            message="SPAN_EVENTS tuple not found (or empty) — the "
+                    "span vocabulary must be a module-level literal")]
+
+    for sf in project.files("production_stack_tpu/**/*.py"):
+        if sf.tree is None:
+            continue  # parse-error rule reports it
+        for line, name in _event_name_sites(sf.tree):
+            if name not in vocab:
+                findings.append(sf.finding(
+                    "span-contract", line,
+                    f"span event '{name}' is not in SPAN_EVENTS "
+                    "(engine/tracing.py) — add it to the vocabulary "
+                    "and the docs/observability.md event table, or "
+                    "fix the typo"))
+
+    block = _BLOCK_RE.search(docs.text)
+    if block is None:
+        findings.append(Finding(
+            rule="span-contract", path=DOCS_FILE, line=0,
+            message="docs/observability.md is missing the "
+                    "<!-- span-events:begin/end --> marker block the "
+                    "event table lives in"))
+        return findings
+    documented = set(_DOC_NAME_RE.findall(block.group(1)))
+    for name in sorted(vocab - documented):
+        findings.append(Finding(
+            rule="span-contract", path=DOCS_FILE, line=0,
+            message=f"span event '{name}' is in SPAN_EVENTS but "
+                    "undocumented — add a row to the span-events "
+                    "table in docs/observability.md"))
+    for name in sorted(documented - vocab):
+        findings.append(Finding(
+            rule="span-contract", path=DOCS_FILE, line=0,
+            message=f"docs/observability.md documents span event "
+                    f"'{name}' which is not in SPAN_EVENTS — stale "
+                    "row or renamed event"))
+    return findings

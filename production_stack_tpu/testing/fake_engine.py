@@ -50,6 +50,11 @@ from typing import Optional
 
 from aiohttp import web
 
+# Stdlib-only by design (no JAX, no engine imports beyond it): the fake
+# reuses the real engine's tracer so router-side stitching tests see
+# genuine {"span": "engine_request"} lines without a TPU.
+from production_stack_tpu.engine.tracing import EngineTracer
+
 
 FAULT_MODES = (
     "error500", "hang", "slow_first_token", "abort_mid_stream", "unhealthy",
@@ -79,6 +84,10 @@ class FakeEngineState:
         self.disagg_decodes = 0  # handoffs streamed
         self.draining = False  # POST /drain flips; 503s new admissions
         self.cache_usage = None  # POST /gauges override; None = derived
+        # Real EngineTracer (engine/tracing.py): fakes emit the same
+        # engine-span lines and serve /debug/trace/{id} as the real
+        # server. None disables tracing entirely.
+        self.tracer: Optional[EngineTracer] = None
 
 
 async def _apply_api_fault(state: FakeEngineState,
@@ -105,6 +114,13 @@ async def _apply_api_fault(state: FakeEngineState,
     if state.fault == "slow_first_token":
         await asyncio.sleep(state.fault_ttft)
     return None
+
+
+def _echo_headers(request: web.Request) -> dict:
+    """Echo the router's x-request-id so clients (and tests) can
+    correlate a response with its /debug/trace/{id} timeline."""
+    trace_id = request.headers.get("x-request-id")
+    return {"x-request-id": trace_id} if trace_id else {}
 
 
 def _sse(payload: dict) -> bytes:
@@ -145,13 +161,30 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     request_id = f"chatcmpl-{uuid.uuid4().hex[:16]}"
     model = body.get("model", state.model)
     words = [f"tok{i} " for i in range(n_tokens)]
+    tracer, arrival = state.tracer, time.time()
+    if tracer is not None:
+        tracer.start(request_id,
+                     request_id=request.headers.get("x-request-id"),
+                     prompt_tokens=8)
 
     state.running += 1
     try:
         await asyncio.sleep(state.ttft)
+        first_ts = time.time()
+        if tracer is not None:
+            tracer.event(request_id, "prefill_chunk",
+                         start=0, tokens=8, last=True)
+            tracer.event(request_id, "first_token", token=0)
         if not stream:
             await asyncio.sleep(n_tokens / state.speed)
             state.total_served += 1
+            if tracer is not None:
+                tracer.finish(request_id, reason="stop",
+                              arrival_ts=arrival,
+                              first_scheduled_ts=arrival,
+                              first_token_ts=first_ts,
+                              finish_ts=time.time(),
+                              prompt_tokens=8, output_tokens=n_tokens)
             return web.json_response({
                 "id": request_id,
                 "object": "chat.completion",
@@ -168,10 +201,11 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                     "completion_tokens": n_tokens,
                     "total_tokens": n_tokens,
                 },
-            })
+            }, headers=_echo_headers(request))
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
+            **_echo_headers(request),
         })
         await resp.prepare(request)
         await resp.write(_sse(_chunk(request_id, model, None,
@@ -180,6 +214,10 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             if state.fault == "abort_mid_stream" and i >= 2:
                 # A couple of chunks are downstream; now drop the socket
                 # without a terminating chunk or [DONE].
+                if tracer is not None:
+                    tracer.finish(request_id, reason="abort",
+                                  arrival_ts=arrival,
+                                  first_token_ts=first_ts)
                 if request.transport is not None:
                     request.transport.close()
                 return resp
@@ -190,6 +228,13 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         state.total_served += 1
+        if tracer is not None:
+            tracer.finish(request_id, reason="stop",
+                          arrival_ts=arrival,
+                          first_scheduled_ts=arrival,
+                          first_token_ts=first_ts,
+                          finish_ts=time.time(),
+                          prompt_tokens=8, output_tokens=n_tokens)
         return resp
     finally:
         state.running -= 1
@@ -240,13 +285,31 @@ async def disagg_prefill(request: web.Request) -> web.Response:
         or state.max_tokens_default
     )
     chat = isinstance(body.get("messages"), list)
+    seq_id = f"disagg-{uuid.uuid4().hex[:16]}"
+    tracer, arrival = state.tracer, time.time()
+    if tracer is not None:
+        tracer.start(seq_id,
+                     request_id=request.headers.get("x-request-id"),
+                     prompt_tokens=8)
     await asyncio.sleep(state.ttft)
     state.disagg_prefills += 1
     state.total_served += 1
     available = state.fault != "kv_missing"
+    if tracer is not None:
+        first_ts = time.time()
+        tracer.event(seq_id, "prefill_chunk",
+                     start=0, tokens=8, last=True)
+        tracer.event(seq_id, "first_token", token=0)
+        tracer.event(seq_id, "handoff_ship",
+                     num_pages=1 if available else 0,
+                     kv_bytes=4096 if available else 0)
+        tracer.finish(seq_id, reason="handoff", arrival_ts=arrival,
+                      first_scheduled_ts=arrival, first_token_ts=first_ts,
+                      finish_ts=first_ts, prompt_tokens=8,
+                      output_tokens=1)
     return web.json_response({"descriptor": {
         "version": 1,
-        "request_id": f"disagg-{uuid.uuid4().hex[:16]}",
+        "request_id": seq_id,
         "chat": chat,
         "model": body.get("model", state.model),
         "token_ids": [0] * 8,
@@ -258,7 +321,7 @@ async def disagg_prefill(request: web.Request) -> web.Response:
         "kv_bytes": 4096 if available else 0,
         "pages_available": available,
         "sampling": {"max_tokens": n_tokens},
-    }})
+    }}, headers=_echo_headers(request))
 
 
 async def disagg_handoff(request: web.Request) -> web.StreamResponse:
@@ -287,6 +350,24 @@ async def disagg_handoff(request: web.Request) -> web.StreamResponse:
     model = desc.get("model", state.model)
     request_id = f"chatcmpl-{uuid.uuid4().hex[:16]}"
     words = [f"tok{i} " for i in range(n_tokens)]
+    tracer, arrival = state.tracer, time.time()
+    if tracer is not None:
+        tracer.start(request_id,
+                     request_id=request.headers.get("x-request-id"),
+                     prompt_tokens=len(desc.get("token_ids") or []))
+        tracer.event(request_id, "awaiting_kv_park")
+        tracer.event(request_id, "awaiting_kv_restore",
+                     waited_ms=0.0, outcome="ready")
+        tracer.event(request_id, "first_token",
+                     token=int(desc.get("first_token") or 0))
+
+    def _finish_span(reason: str) -> None:
+        if tracer is not None:
+            tracer.finish(request_id, reason=reason, arrival_ts=arrival,
+                          first_scheduled_ts=arrival,
+                          first_token_ts=arrival, finish_ts=time.time(),
+                          prompt_tokens=len(desc.get("token_ids") or []),
+                          output_tokens=n_tokens)
 
     state.running += 1
     state.disagg_decodes += 1
@@ -294,6 +375,7 @@ async def disagg_handoff(request: web.Request) -> web.StreamResponse:
         if not stream:
             await asyncio.sleep(n_tokens / state.speed)
             state.total_served += 1
+            _finish_span("stop")
             if chat:
                 return web.json_response({
                     "id": request_id,
@@ -311,7 +393,7 @@ async def disagg_handoff(request: web.Request) -> web.StreamResponse:
                         "completion_tokens": n_tokens,
                         "total_tokens": n_tokens,
                     },
-                })
+                }, headers=_echo_headers(request))
             return web.json_response({
                 "id": f"cmpl-{uuid.uuid4().hex[:16]}",
                 "object": "text_completion",
@@ -325,16 +407,18 @@ async def disagg_handoff(request: web.Request) -> web.StreamResponse:
                 "usage": {"prompt_tokens": 0,
                           "completion_tokens": n_tokens,
                           "total_tokens": n_tokens},
-            })
+            }, headers=_echo_headers(request))
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
+            **_echo_headers(request),
         })
         await resp.prepare(request)
         await resp.write(_sse(_chunk(request_id, model, None,
                                      role="assistant")))
         for i, word in enumerate(words):
             if state.fault == "abort_mid_stream" and i >= 2:
+                _finish_span("abort")
                 if request.transport is not None:
                     request.transport.close()
                 return resp
@@ -345,6 +429,7 @@ async def disagg_handoff(request: web.Request) -> web.StreamResponse:
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         state.total_served += 1
+        _finish_span("stop")
         return resp
     finally:
         state.running -= 1
@@ -438,6 +523,20 @@ async def set_fault(request: web.Request) -> web.Response:
     return web.json_response({"fault": state.fault})
 
 
+async def debug_trace(request: web.Request) -> web.Response:
+    """GET /debug/trace/{request_id}: same flight-recorder lookup the
+    real engine server exposes (docs/observability.md)."""
+    state: FakeEngineState = request.app["state"]
+    if state.tracer is None:
+        return web.json_response(
+            {"error": {"message": "tracing disabled"}}, status=404)
+    found = state.tracer.lookup(request.match_info["request_id"])
+    if found is None:
+        return web.json_response(
+            {"error": {"message": "no trace for that id"}}, status=404)
+    return web.json_response(found)
+
+
 async def metrics(request: web.Request) -> web.Response:
     state: FakeEngineState = request.app["state"]
     cache_usage = (state.cache_usage if state.cache_usage is not None
@@ -462,12 +561,20 @@ async def metrics(request: web.Request) -> web.Response:
 
 def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
                       ttft: float = 0.02, fault: Optional[str] = None,
-                      fault_ttft: float = 5.0,
-                      role: str = "both") -> web.Application:
+                      fault_ttft: float = 5.0, role: str = "both",
+                      span_log: Optional[str] = None,
+                      trace_ring: int = 256) -> web.Application:
+    state = FakeEngineState(model=model, speed=speed, ttft=ttft,
+                            fault=fault, fault_ttft=fault_ttft,
+                            role=role)
+    if span_log or trace_ring > 0:
+        # Same default as the real server: flight recorder on, span
+        # log only when a path is given.
+        state.tracer = EngineTracer(span_log_path=span_log,
+                                    ring_size=max(1, trace_ring),
+                                    role=role)
     app = web.Application()
-    app["state"] = FakeEngineState(model=model, speed=speed, ttft=ttft,
-                                   fault=fault, fault_ttft=fault_ttft,
-                                   role=role)
+    app["state"] = state
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/disagg/prefill", disagg_prefill)
@@ -475,6 +582,7 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/trace/{request_id}", debug_trace)
     app.router.add_post("/fault", set_fault)
     app.router.add_post("/drain", drain)
     app.router.add_post("/gauges", set_gauges)
@@ -497,10 +605,15 @@ def main(argv=None) -> None:
     parser.add_argument("--role", default="both", choices=ENGINE_ROLES,
                         help="engine role reported in /health "
                              "(disaggregated-serving discovery)")
+    parser.add_argument("--span-log", default=None,
+                        help="Emit engine-span JSON lines to this "
+                             "path ('-' = the process log), same "
+                             "format as the real engine server's "
+                             "--request-span-log")
     args = parser.parse_args(argv)
     app = build_fake_engine(args.model, args.speed, args.ttft,
                             fault=args.fault, fault_ttft=args.fault_ttft,
-                            role=args.role)
+                            role=args.role, span_log=args.span_log)
     web.run_app(app, host=args.host, port=args.port, print=None)
 
 

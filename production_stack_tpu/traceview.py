@@ -1,0 +1,157 @@
+"""Cross-hop trace stitching: merge router + engine span logs into a
+per-request text waterfall.
+
+The router (router/tracing.py) and every engine (engine/tracing.py)
+each write their own ``--request-span-log`` JSON lines. One
+disaggregated request therefore leaves up to three span lines — the
+router's ``"span": "request"`` record and one ``"span":
+"engine_request"`` record per hop (prefill role, decode role) — all
+keyed by the router's ``x-request-id``. This module merges those files
+offline into one time-ordered waterfall per request:
+
+    $ python -m production_stack_tpu.traceview router.jsonl \\
+          prefill-engine.jsonl decode-engine.jsonl --request-id ID
+
+Stitching is pure timestamp arithmetic on the span records: engine
+event lines carry absolute ``ts`` values, and the router span's
+derived millisecond fields (queue_delay_ms, handoff_ms, ttft_ms,
+latency_ms) are re-anchored onto its ``arrival_ts``. Clocks are
+assumed to come from the same host family (the test rig runs all
+parties in one process); cross-machine skew shows up as out-of-order
+rows, not a crash.
+
+Importable pieces — ``load_spans``, ``stitch``, ``render_waterfall`` —
+are reused by the golden-merge test (tests/test_traceview.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def load_spans(paths: List[str]) -> List[dict]:
+    """Parse span JSON lines from ``paths``; non-span lines (plain log
+    text, partial writes) are skipped, not fatal."""
+    spans: List[dict] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                # Spans may ride inside ordinary log lines when the
+                # sink is "-": recover the JSON object by its brace.
+                start = line.find("{")
+                if start < 0:
+                    continue
+                try:
+                    obj = json.loads(line[start:])
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) and obj.get("span") in (
+                        "request", "engine_request"):
+                    spans.append(obj)
+    return spans
+
+
+def _router_rows(span: dict) -> List[Tuple[float, str, str, str]]:
+    """The router span's derived ms fields, re-anchored to absolute
+    times: (ts, source, event, details) rows."""
+    t0 = span["arrival_ts"]
+    rows = [(t0, "router", "arrival",
+             f"path={span.get('path')} model={span.get('model')}")]
+
+    def at(ms_field: str, event: str, details: str = "") -> None:
+        ms = span.get(ms_field)
+        if ms is not None:
+            rows.append((t0 + ms / 1e3, "router", event, details))
+
+    at("queue_delay_ms", "routed",
+       f"backend={span.get('backend')}" + (
+           f" retries={span['retries']}" if span.get("retries") else ""))
+    if span.get("prefill_backend") is not None:
+        # The prefill hop has no own ms field; its completion is the
+        # decode hop's route time minus handoff_ms.
+        q, h = span.get("queue_delay_ms"), span.get("handoff_ms")
+        if q is not None and h is not None:
+            rows.append((t0 + (q - h) / 1e3, "router", "prefill_hop_done",
+                         f"prefill_backend={span['prefill_backend']} "
+                         f"handoff_ms={h}"))
+    at("ttft_ms", "first_chunk")
+    at("latency_ms", "finish",
+       f"status={span.get('status')} chunks={span.get('chunks')}")
+    return rows
+
+
+def _engine_rows(span: dict) -> List[Tuple[float, str, str, str]]:
+    role = span.get("role", "?")
+    src = f"engine[{role} {span.get('seq_id')}]"
+    rows = []
+    for ev in span.get("events", []):
+        extras = {k: v for k, v in ev.items() if k not in ("event", "ts")}
+        details = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+        rows.append((ev["ts"], src, ev["event"], details))
+    return rows
+
+
+def stitch(spans: List[dict], request_id: str) -> List[dict]:
+    """All spans belonging to ``request_id``, router span first."""
+    mine = [s for s in spans if s.get("request_id") == request_id]
+    return sorted(mine, key=lambda s: s.get("span") != "request")
+
+
+def render_waterfall(spans: List[dict], request_id: str) -> str:
+    """One text waterfall for ``request_id`` over stitched ``spans``."""
+    mine = stitch(spans, request_id)
+    if not mine:
+        return f"no spans for request {request_id}\n"
+    rows: List[Tuple[float, str, str, str]] = []
+    for span in mine:
+        rows.extend(_router_rows(span) if span["span"] == "request"
+                    else _engine_rows(span))
+    rows.sort(key=lambda r: r[0])
+    t0 = rows[0][0]
+    src_w = max(len(r[1]) for r in rows)
+    ev_w = max(len(r[2]) for r in rows)
+    out = [f"request {request_id}  ({len(mine)} spans)"]
+    for ts, src, event, details in rows:
+        out.append(f"  t+{(ts - t0) * 1e3:9.2f}ms  {src:<{src_w}}  "
+                   f"{event:<{ev_w}}  {details}".rstrip())
+    return "\n".join(out) + "\n"
+
+
+def _request_ids(spans: List[dict]) -> List[str]:
+    seen: Dict[str, None] = {}
+    for s in spans:
+        rid = s.get("request_id")
+        if rid is not None:
+            seen.setdefault(rid, None)
+    return list(seen)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m production_stack_tpu.traceview",
+        description="Merge router + engine span logs into per-request "
+                    "waterfalls (docs/observability.md)")
+    parser.add_argument("logs", nargs="+",
+                        help="Span JSON-line files (router and/or "
+                             "engine --request-span-log outputs)")
+    parser.add_argument("--request-id", default=None,
+                        help="Render only this request (default: every "
+                             "request id found, in first-seen order)")
+    args = parser.parse_args(argv)
+    spans = load_spans(args.logs)
+    ids = ([args.request_id] if args.request_id
+           else _request_ids(spans))
+    if not ids:
+        print("no spans found", file=sys.stderr)
+        return 1
+    for rid in ids:
+        sys.stdout.write(render_waterfall(spans, rid))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,7 +42,7 @@ def test_all_advertised_rules_are_registered():
     import production_stack_tpu.staticcheck.analyzers  # noqa: F401
     expected = {"tracer-hygiene", "async-blocking", "metrics-contract",
                 "config-contract", "no-timeout", "host-read",
-                "kv-parity"}
+                "kv-parity", "span-contract"}
     assert expected <= set(REGISTRY)
 
 
@@ -271,6 +271,67 @@ def test_metrics_contract_accepts_explicit_drop_marker():
             num_running_requests: int = 0
         """
     assert _run(fixture, "metrics-contract") == []
+
+
+# ---- span-contract -----------------------------------------------------
+
+_SPAN_FIXTURE = {
+    "production_stack_tpu/engine/tracing.py": """\
+        SPAN_EVENTS = (
+            "enqueue",
+            "finish",
+        )
+        """,
+    "production_stack_tpu/engine/engine.py": """\
+        def step(tracer, seq_id):
+            tracer.event(seq_id, "enqueue")
+            tracer.event(seq_id, "fist_token")
+        """,
+    "docs/observability.md": """\
+        <!-- span-events:begin -->
+        | Event | When |
+        |---|---|
+        | `enqueue` | admitted |
+        | `ghost_event` | never |
+        <!-- span-events:end -->
+        """,
+}
+
+
+def test_span_contract_catches_planted_drift():
+    findings = _run(_SPAN_FIXTURE, "span-contract")
+    messages = "\n".join(f.message for f in findings)
+    # Emitted literal outside the vocabulary (the classic typo).
+    assert "span event 'fist_token' is not in SPAN_EVENTS" in messages
+    # Vocabulary entry with no docs row.
+    assert "'finish' is in SPAN_EVENTS but undocumented" in messages
+    # Documented name not in the vocabulary.
+    assert "'ghost_event'" in messages and "stale row" in messages
+
+
+def test_span_contract_accepts_agreeing_surfaces():
+    fixture = dict(_SPAN_FIXTURE)
+    fixture["production_stack_tpu/engine/engine.py"] = """\
+        def step(tracer, seq_id):
+            tracer.event(seq_id, "enqueue")
+            tracer.event(seq_id, "finish")
+        """
+    fixture["docs/observability.md"] = """\
+        <!-- span-events:begin -->
+        | Event | When |
+        |---|---|
+        | `enqueue` | admitted |
+        | `finish` | closed |
+        <!-- span-events:end -->
+        """
+    assert _run(fixture, "span-contract") == []
+
+
+def test_span_contract_requires_marker_block():
+    fixture = dict(_SPAN_FIXTURE)
+    fixture["docs/observability.md"] = "no markers here\n"
+    findings = _run(fixture, "span-contract")
+    assert any("marker block" in f.message for f in findings)
 
 
 # ---- config-contract ---------------------------------------------------

@@ -1,0 +1,279 @@
+"""Cross-hop trace stitching (production_stack_tpu/traceview.py,
+docs/observability.md).
+
+Two layers: a golden merge over hand-written span lines with fixed
+timestamps (exact waterfall ordering, no live servers), and the
+acceptance path — a greedy streaming request over the router's
+disaggregated two-hop dispatch with span logging on everywhere, whose
+three span lines (router, prefill engine, decode engine) must stitch
+into one waterfall with non-negative phase durations, populated hop
+fields, and zero failover retries.
+"""
+
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router import tracing as router_tracing
+from production_stack_tpu.router.resilience import (
+    ResilienceConfig,
+    initialize_resilience,
+)
+from production_stack_tpu.router.service_discovery import (
+    initialize_service_discovery,
+)
+from production_stack_tpu.router.services import request_service
+from production_stack_tpu.router.services.rewriter import (
+    initialize_request_rewriter,
+)
+from production_stack_tpu.router.stats.engine_stats import (
+    initialize_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.request_stats import (
+    initialize_request_stats_monitor,
+)
+from production_stack_tpu.testing.fake_engine import build_fake_engine
+from production_stack_tpu.traceview import (
+    load_spans,
+    main as traceview_main,
+    render_waterfall,
+    stitch,
+)
+
+
+# ---- golden merge ------------------------------------------------------
+
+_ROUTER_LINE = {
+    "span": "request", "request_id": "rid-g", "model": "m1",
+    "path": "/v1/chat/completions", "backend": "http://dec:1",
+    "arrival_ts": 1000.0, "queue_delay_ms": 8.0, "ttft_ms": 20.0,
+    "latency_ms": 30.0, "chunks": 3, "status": "ok", "retries": 0,
+    "tried_backends": [], "prefill_backend": "http://pre:1",
+    "handoff_ms": 2.0,
+}
+
+_PREFILL_LINE = {
+    "span": "engine_request", "request_id": "rid-g", "seq_id": "seq-p",
+    "role": "prefill", "arrival_ts": 1000.001,
+    "finish_reason": "handoff", "prompt_tokens": 8, "output_tokens": 1,
+    "queue_ms": 0.5, "ttft_ms": 3.0, "decode_ms": 0.0,
+    "latency_ms": 3.5,
+    "events": [
+        {"event": "enqueue", "ts": 1000.001, "prompt_tokens": 8},
+        {"event": "prefill_chunk", "ts": 1000.003, "start": 0,
+         "tokens": 8, "last": True},
+        {"event": "first_token", "ts": 1000.004, "token": 7},
+        {"event": "handoff_ship", "ts": 1000.0045, "num_pages": 1,
+         "kv_bytes": 4096},
+        {"event": "finish", "ts": 1000.005, "reason": "handoff"},
+    ],
+}
+
+_DECODE_LINE = {
+    "span": "engine_request", "request_id": "rid-g", "seq_id": "seq-d",
+    "role": "decode", "arrival_ts": 1000.008, "finish_reason": "stop",
+    "prompt_tokens": 8, "output_tokens": 3, "queue_ms": 0.2,
+    "ttft_ms": 1.0, "decode_ms": 10.0, "latency_ms": 11.0,
+    "events": [
+        {"event": "enqueue", "ts": 1000.008, "prompt_tokens": 8},
+        {"event": "awaiting_kv_park", "ts": 1000.0085},
+        {"event": "awaiting_kv_restore", "ts": 1000.009,
+         "waited_ms": 0.5, "outcome": "ready"},
+        {"event": "first_token", "ts": 1000.0095, "token": 7},
+        {"event": "finish", "ts": 1000.019, "reason": "stop"},
+    ],
+}
+
+
+def _write_lines(path, *objs):
+    with open(path, "w") as f:
+        for obj in objs:
+            f.write(json.dumps(obj) + "\n")
+
+
+def test_traceview_golden_merge(tmp_path):
+    router_log = str(tmp_path / "router.jsonl")
+    engines_log = str(tmp_path / "engines.jsonl")
+    _write_lines(router_log, _ROUTER_LINE)
+    # Engine file also carries a plain log line and a foreign request
+    # that must both be ignored.
+    with open(engines_log, "w") as f:
+        f.write("INFO some ordinary log line\n")
+        f.write(json.dumps(_PREFILL_LINE) + "\n")
+        f.write(json.dumps(_DECODE_LINE) + "\n")
+        f.write(json.dumps({**_DECODE_LINE, "request_id": "other"})
+                + "\n")
+
+    spans = load_spans([router_log, engines_log])
+    assert len(spans) == 4
+    mine = stitch(spans, "rid-g")
+    assert len(mine) == 3
+    assert mine[0]["span"] == "request"  # router span leads
+
+    text = render_waterfall(spans, "rid-g")
+    lines = text.splitlines()
+    assert lines[0] == "request rid-g  (3 spans)"
+
+    def row_index(source_frag, event):
+        for i, line in enumerate(lines):
+            if source_frag in line and f" {event}" in line:
+                return i
+        raise AssertionError(f"no row {source_frag}/{event}:\n{text}")
+
+    # The acceptance waterfall: router arrival -> prefill engine chunk
+    # -> handoff ship -> decode engine restore -> first token ->
+    # finish, in that order.
+    order = [
+        row_index("router", "arrival"),
+        row_index("engine[prefill seq-p]", "prefill_chunk"),
+        row_index("engine[prefill seq-p]", "handoff_ship"),
+        row_index("engine[decode seq-d]", "awaiting_kv_restore"),
+        row_index("engine[decode seq-d]", "first_token"),
+        row_index("engine[decode seq-d]", "finish"),
+    ]
+    assert order == sorted(order)
+    # Offsets are anchored at the earliest row: all non-negative.
+    for line in lines[1:]:
+        assert float(line.split("t+")[1].split("ms")[0]) >= 0
+    # Hop details surface in the router rows.
+    assert "prefill_backend=http://pre:1" in text
+    assert "handoff_ms=2.0" in text
+
+
+def test_traceview_cli(tmp_path, capsys):
+    log = str(tmp_path / "all.jsonl")
+    _write_lines(log, _ROUTER_LINE, _PREFILL_LINE, _DECODE_LINE)
+    assert traceview_main([log, "--request-id", "rid-g"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("request rid-g")
+    # No --request-id: render every id found.
+    assert traceview_main([log]) == 0
+    # Empty input errors.
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert traceview_main([empty]) == 1
+
+
+def test_traceview_unknown_request(tmp_path):
+    log = str(tmp_path / "r.jsonl")
+    _write_lines(log, _ROUTER_LINE)
+    assert "no spans for request nope" in render_waterfall(
+        load_spans([log]), "nope")
+
+
+# ---- live disagg two-hop stitch (acceptance) ---------------------------
+
+
+async def _start_disagg_router(backends):
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.routing.logic import (
+        initialize_routing_logic,
+    )
+    request_service.disagg_handoffs_total = 0
+    request_service.disagg_fallbacks_total = 0
+    initialize_service_discovery(
+        "static",
+        urls=[b[0] for b in backends],
+        models=[b[1] for b in backends],
+        roles=[b[2] for b in backends],
+    )
+    initialize_request_stats_monitor(60.0)
+    initialize_engine_stats_scraper(3600.0)
+    initialize_routing_logic("roundrobin")
+    initialize_request_rewriter("noop")
+    initialize_resilience(ResilienceConfig(
+        max_retries=2, backend_connect_timeout=1.0,
+        backend_timeout=10.0, health_check_interval=0.0,
+    ))
+    # build_app() with no args: the singletons above (with engine
+    # roles) stay in force, and the span logger is installed directly.
+    client = TestClient(TestServer(build_app()))
+    await client.start_server()
+    return client
+
+
+async def test_disagg_two_hop_stitched_waterfall(tmp_path):
+    """A greedy streaming request over the two-hop path leaves three
+    span lines that stitch into one waterfall."""
+    router_log = str(tmp_path / "router.jsonl")
+    pre_log = str(tmp_path / "prefill.jsonl")
+    dec_log = str(tmp_path / "decode.jsonl")
+
+    pre = TestServer(build_fake_engine(
+        model="m1", speed=1000, ttft=0.0, role="prefill",
+        span_log=pre_log))
+    dec = TestServer(build_fake_engine(
+        model="m1", speed=1000, ttft=0.0, role="decode",
+        span_log=dec_log))
+    await pre.start_server()
+    await dec.start_server()
+    pre_url = f"http://127.0.0.1:{pre.port}"
+    dec_url = f"http://127.0.0.1:{dec.port}"
+    router_tracing.initialize_span_logger(router_log)
+    client = None
+    try:
+        client = await _start_disagg_router([
+            (pre_url, "m1", "prefill"),
+            (dec_url, "m1", "decode"),
+        ])
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"model": "m1",
+                  "messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 3, "stream": True,
+                  "temperature": 0.0})
+        assert resp.status == 200
+        body = await resp.text()
+        assert "tok0" in body and "data: [DONE]" in body
+        assert request_service.disagg_handoffs_total == 1
+        assert request_service.disagg_fallbacks_total == 0
+    finally:
+        if client is not None:
+            await client.close()
+        router_tracing.initialize_span_logger(None)
+        await pre.close()
+        await dec.close()
+
+    router_span = json.loads(open(router_log).read().splitlines()[0])
+    rid = router_span["request_id"]
+    # Hop attribution, not failover: two-hop dispatch counts no
+    # retries, and both hop fields are populated.
+    assert router_span["status"] == "ok"
+    assert router_span["retries"] == 0
+    assert router_span["tried_backends"] == []
+    assert router_span["prefill_backend"] == pre_url
+    assert router_span["backend"] == dec_url
+    assert router_span["handoff_ms"] is not None
+    assert router_span["handoff_ms"] >= 0
+
+    spans = load_spans([router_log, pre_log, dec_log])
+    mine = stitch(spans, rid)
+    assert len(mine) == 3
+    roles = {s.get("role") for s in mine if s["span"] == "engine_request"}
+    assert roles == {"prefill", "decode"}
+    for span in mine:
+        if span["span"] == "engine_request":
+            for key in ("queue_ms", "ttft_ms", "latency_ms"):
+                assert span[key] is not None and span[key] >= 0
+
+    text = render_waterfall(spans, rid)
+    lines = text.splitlines()
+    assert lines[0] == f"request {rid}  (3 spans)"
+
+    def row_index(source_frag, event):
+        for i, line in enumerate(lines):
+            if source_frag in line and f" {event}" in line:
+                return i
+        raise AssertionError(f"no row {source_frag}/{event}:\n{text}")
+
+    order = [
+        row_index("router", "arrival"),
+        row_index("engine[prefill", "prefill_chunk"),
+        row_index("engine[prefill", "handoff_ship"),
+        row_index("engine[decode", "awaiting_kv_restore"),
+        row_index("engine[decode", "first_token"),
+        row_index("engine[decode", "finish"),
+    ]
+    assert order == sorted(order)
+    for line in lines[1:]:
+        assert float(line.split("t+")[1].split("ms")[0]) >= 0

@@ -103,6 +103,209 @@ def test_router_emits_spans_through_proxy(tmp_path):
         initialize_span_logger(None)
 
 
+# ---- engine-side spans (engine/tracing.py) -----------------------------
+
+
+def test_engine_span_schema_roundtrip(tmp_path):
+    import time as _time
+
+    from production_stack_tpu.engine.tracing import (
+        SPAN_EVENTS, EngineTracer,
+    )
+
+    path = str(tmp_path / "engine-spans.jsonl")
+    tracer = EngineTracer(span_log_path=path, ring_size=4,
+                          role="prefill")
+    t0 = _time.time()
+    tracer.start("seq-1", request_id="rid-9", prompt_tokens=7)
+    tracer.event("seq-1", "prefill_chunk", start=0, tokens=7, last=True)
+    tracer.event("seq-1", "first_token", token=3)
+    tracer.finish("seq-1", reason="stop", arrival_ts=t0,
+                  first_scheduled_ts=t0 + 0.001,
+                  first_token_ts=t0 + 0.002, finish_ts=t0 + 0.003,
+                  prompt_tokens=7, output_tokens=4)
+
+    lines = open(path).read().splitlines()
+    assert len(lines) == 1
+    data = json.loads(lines[0])
+    assert data["span"] == "engine_request"
+    assert data["request_id"] == "rid-9"
+    assert data["seq_id"] == "seq-1"
+    assert data["role"] == "prefill"
+    assert [e["event"] for e in data["events"]] == [
+        "enqueue", "prefill_chunk", "first_token", "finish"]
+    assert all(e["event"] in SPAN_EVENTS for e in data["events"])
+    assert data["finish_reason"] == "stop"
+    assert data["queue_ms"] == 1.0
+    assert data["ttft_ms"] == 2.0
+    assert data["decode_ms"] == 1.0
+    assert data["latency_ms"] == 3.0
+
+    # Lookup by router id or engine seq id; unknown ids miss.
+    assert tracer.lookup("rid-9")["spans"][0]["seq_id"] == "seq-1"
+    assert tracer.lookup("seq-1") is not None
+    assert tracer.lookup("nope") is None
+    # finish is idempotent: the abort/drain race emits one line.
+    tracer.finish("seq-1", reason="abort")
+    assert len(open(path).read().splitlines()) == 1
+
+
+def _tiny_engine():
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config("llama"),
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_model_len=128,
+                                  prefill_chunk_size=32),
+    ))
+
+
+def _greedy_run(engine, request_id=None):
+    from production_stack_tpu.engine.sequence import SamplingParams
+
+    sid = engine.add_request(
+        [5, 6, 7] * 15, SamplingParams(temperature=0.0, max_tokens=6,
+                                       ignore_eos=True),
+        request_id=request_id)
+    seq = engine.sequences[sid]
+    for _ in range(200):
+        engine.step()
+        if not engine.has_work():
+            break
+    assert not engine.has_work()
+    return list(seq.output_token_ids)
+
+
+def test_engine_tracer_default_none_and_output_identical():
+    """The overhead guard: a library-constructed engine has no tracer,
+    and installing one changes nothing about what gets generated."""
+    from production_stack_tpu.engine.tracing import EngineTracer
+
+    plain = _tiny_engine()
+    assert plain.tracer is None
+    baseline = _greedy_run(plain)
+
+    traced = _tiny_engine()
+    traced.tracer = EngineTracer(ring_size=8)
+    assert traced.scheduler.tracer is traced.tracer
+    tokens = _greedy_run(traced, request_id="rid-trace")
+    assert tokens == baseline
+
+    found = traced.tracer.lookup("rid-trace")
+    assert found is not None
+    events = [e["event"] for e in found["spans"][0]["events"]]
+    assert events[0] == "enqueue"
+    assert "prefill_chunk" in events
+    assert "first_token" in events
+    assert events[-1] == "finish"
+    assert events.index("prefill_chunk") < events.index("first_token")
+    # 45-token prompt with chunk 32 -> two prefill chunks.
+    assert events.count("prefill_chunk") == 2
+    summary = found["spans"][0]
+    assert summary["finish_reason"] == "length"
+    assert summary["output_tokens"] == 6
+    for key in ("queue_ms", "ttft_ms", "decode_ms", "latency_ms"):
+        assert summary[key] is not None and summary[key] >= 0
+
+    # The step flight recorder saw both prefill and decode steps.
+    steps = traced.tracer.recent_steps()
+    kinds = {s.get("kind") for s in steps}
+    assert "prefill" in kinds
+    assert "decode" in kinds
+    for s in steps:
+        assert s["host_ms"] >= 0
+        assert "row_bucket" in s
+
+
+def test_engine_debug_endpoints():
+    from production_stack_tpu.engine.server import EngineServer
+    from production_stack_tpu.engine.tracing import EngineTracer
+
+    engine = _tiny_engine()
+    engine.tracer = EngineTracer(ring_size=8)
+    engine.tracer.start("seq-dbg", request_id="rid-dbg",
+                        prompt_tokens=3)
+    engine.tracer.on_step(host_ms=1.0, kind="decode")
+    server = EngineServer(engine, "tiny-llama")
+
+    async def run():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/debug/trace/rid-dbg")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["spans"][0]["seq_id"] == "seq-dbg"
+
+            resp = await client.get("/debug/trace/seq-dbg")
+            assert resp.status == 200
+
+            resp = await client.get("/debug/trace/unknown-id")
+            assert resp.status == 404
+
+            resp = await client.get("/debug/steps?limit=5")
+            assert resp.status == 200
+            steps = (await resp.json())["steps"]
+            assert steps and steps[-1]["kind"] == "decode"
+
+            resp = await client.get("/debug/steps?limit=bogus")
+            assert resp.status == 400
+
+            engine.tracer = None
+            resp = await client.get("/debug/trace/rid-dbg")
+            assert resp.status == 404
+            resp = await client.get("/debug/steps")
+            assert resp.status == 404
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_fake_engine_spans_and_trace_endpoint(tmp_path):
+    """The fake engine mirrors the real server's tracing surface:
+    x-request-id echo, engine-span lines, /debug/trace/{id}."""
+    from production_stack_tpu.testing.fake_engine import (
+        build_fake_engine,
+    )
+
+    path = str(tmp_path / "fake-spans.jsonl")
+
+    async def run():
+        client = TestClient(TestServer(build_fake_engine(
+            model="m1", speed=1000, ttft=0.0, span_log=path)))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={"model": "m1",
+                      "messages": [{"role": "user", "content": "x"}],
+                      "max_tokens": 3},
+                headers={"x-request-id": "rid-fake"})
+            assert resp.status == 200
+            assert resp.headers.get("x-request-id") == "rid-fake"
+            await resp.read()
+
+            resp = await client.get("/debug/trace/rid-fake")
+            assert resp.status == 200
+            data = await resp.json()
+            events = [e["event"] for e in data["spans"][0]["events"]]
+            assert events == ["enqueue", "prefill_chunk",
+                              "first_token", "finish"]
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+    lines = [json.loads(ln) for ln in open(path).read().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["span"] == "engine_request"
+    assert lines[0]["request_id"] == "rid-fake"
+
+
 def test_engine_profiler_endpoints(tmp_path):
     from production_stack_tpu.engine.config import (
         CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
