@@ -1250,6 +1250,220 @@ def run_autoscale_worker() -> None:
     }))
 
 
+def run_overload_worker(mode: str) -> None:
+    """QoS overload bench (docs/qos.md): router + two finite-capacity
+    fake engines driven at ~2x capacity by three well-behaved
+    interactive tenants plus one adversarial batch tenant, with the
+    router's QoS layer on (``mode=on``: per-tenant buckets, degrade
+    ladder, fair gate) vs off (``mode=off``). Reports the well-behaved
+    tenants' interactive goodput (fraction answered within the SLO),
+    the Jain fairness index over per-tenant served tokens, and hard
+    zero counts of 5xx and silent drops — shed requests must be honest
+    429 + Retry-After, never an error or a hang.
+
+    Fake engines only (CPU, no JAX): the phase measures the admission
+    policy, not model throughput. The fakes' --max-concurrency slot
+    model is what makes overload visible (excess requests queue and
+    TTFT inflates, like a saturated pod).
+    """
+    import asyncio
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import aiohttp
+    from aiohttp import web
+
+    from production_stack_tpu.qos import jain_index
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.qos import (
+        RouterQoSConfig,
+        get_router_qos,
+        initialize_router_qos,
+    )
+    from production_stack_tpu.router.resilience import (
+        ResilienceConfig,
+        initialize_resilience,
+    )
+    from production_stack_tpu.router.routing.logic import (
+        initialize_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        initialize_service_discovery,
+    )
+    from production_stack_tpu.router.services.rewriter import (
+        initialize_request_rewriter,
+    )
+    from production_stack_tpu.router.stats.engine_stats import (
+        initialize_engine_stats_scraper,
+    )
+    from production_stack_tpu.router.stats.request_stats import (
+        initialize_request_stats_monitor,
+    )
+    from production_stack_tpu.testing.fake_engine import build_fake_engine
+
+    speed = float(os.environ.get("BENCH_OVERLOAD_SPEED", "40"))
+    out_len = int(os.environ.get("BENCH_OVERLOAD_OUT_LEN", "16"))
+    slots = int(os.environ.get("BENCH_OVERLOAD_SLOTS", "2"))
+    n_engines = 2
+    n_good = 3
+    good_rate = float(os.environ.get("BENCH_OVERLOAD_GOOD_RATE", "1.5"))
+    adv_rate = float(os.environ.get("BENCH_OVERLOAD_ADV_RATE", "16"))
+    window = float(os.environ.get("BENCH_OVERLOAD_DURATION_S", "4"))
+    slo_s = float(os.environ.get("BENCH_OVERLOAD_SLO_S", "1.5"))
+    # Analytic capacity of the slot model: total decode slots over the
+    # per-request service time. The offered load above is ~2x this.
+    service_s = out_len / speed
+    capacity = n_engines * slots / service_s
+    offered = n_good * good_rate + adv_rate
+
+    async def run():
+        engine_runners = []
+        urls = []
+        for _ in range(n_engines):
+            runner = web.AppRunner(build_fake_engine(
+                model="bench-fake", speed=speed, ttft=0.0,
+                priority_aware=True, max_concurrency=slots))
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            urls.append("http://127.0.0.1:"
+                        f"{site._server.sockets[0].getsockname()[1]}")
+            engine_runners.append(runner)
+
+        initialize_service_discovery(
+            "static", urls=urls, models=["bench-fake"] * n_engines,
+            roles=None)
+        initialize_request_stats_monitor(60.0)
+        initialize_engine_stats_scraper(3600.0)
+        initialize_routing_logic("roundrobin")
+        initialize_request_rewriter("noop")
+        # Generous backend timeout: under QoS-off the whole point is
+        # that queues build; a timeout mid-queue would masquerade as a
+        # drop.
+        initialize_resilience(ResilienceConfig(
+            max_retries=2, backend_connect_timeout=5.0,
+            backend_timeout=60.0, health_check_interval=0.0))
+        initialize_router_qos(RouterQoSConfig(
+            tenant_rate=2.0, tenant_burst=4.0, degrade_max_tokens=4,
+            shed_deficit=5.0, max_concurrency=n_engines * slots,
+        ) if mode == "on" else RouterQoSConfig(tenant_rate=0.0))
+
+        router_runner = web.AppRunner(build_app())
+        await router_runner.setup()
+        site = web.TCPSite(router_runner, "127.0.0.1", 0)
+        await site.start()
+        router_url = ("http://127.0.0.1:"
+                      f"{site._server.sockets[0].getsockname()[1]}")
+        session = aiohttp.ClientSession()
+        records = []
+
+        async def one(tenant, cls):
+            rec = {"tenant": tenant, "cls": cls, "status": None,
+                   "latency": None, "tokens": 0, "retry_after": None,
+                   "error": None}
+            t0 = time.time()
+            try:
+                async with session.post(
+                        router_url + "/v1/chat/completions",
+                        json={"model": "bench-fake",
+                              "messages": [{"role": "user",
+                                            "content": "hi"}],
+                              "max_tokens": out_len},
+                        headers={"x-api-key": tenant,
+                                 "x-priority": cls}) as resp:
+                    rec["status"] = resp.status
+                    rec["retry_after"] = resp.headers.get("Retry-After")
+                    body = await resp.json()
+                    rec["latency"] = time.time() - t0
+                    if resp.status == 200:
+                        rec["tokens"] = int(
+                            (body.get("usage") or {})
+                            .get("completion_tokens", 0))
+            except Exception as e:
+                rec["error"] = f"{type(e).__name__}: {e}"
+            records.append(rec)
+
+        async def offer(tenant, cls, rate, t_end):
+            # Open loop: requests fire on the arrival clock regardless
+            # of how slow earlier ones are — that's what makes 2x
+            # offered load actually land on the stack.
+            tasks = []
+            while time.time() < t_end:
+                tasks.append(asyncio.ensure_future(one(tenant, cls)))
+                await asyncio.sleep(1.0 / rate)
+            return tasks
+
+        t_end = time.time() + window
+        offers = await asyncio.gather(
+            offer("adversary", "batch", adv_rate, t_end),
+            *(offer(f"good-{i}", "interactive", good_rate, t_end)
+              for i in range(n_good)))
+        await asyncio.wait_for(
+            asyncio.gather(*(t for ts in offers for t in ts)),
+            timeout=120.0)
+
+        rqos = get_router_qos()
+        qos_counters = {
+            "router_throttled": (rqos.tenant_throttled_total
+                                 if rqos else 0),
+            "router_shed": dict(rqos.shed_by_class) if rqos else {},
+        }
+        await session.close()
+        await router_runner.cleanup()
+        for runner in engine_runners:
+            await runner.cleanup()
+        return records, qos_counters
+
+    records, qos_counters = asyncio.run(run())
+
+    inter = [r for r in records if r["cls"] == "interactive"]
+    goodput = (sum(1 for r in inter
+                   if r["status"] == 200 and r["error"] is None
+                   and r["latency"] is not None
+                   and r["latency"] <= slo_s)
+               / len(inter) if inter else 0.0)
+    tenants = sorted({r["tenant"] for r in records})
+    tokens_by_tenant = {
+        t: sum(r["tokens"] for r in records
+               if r["tenant"] == t and r["status"] == 200)
+        for t in tenants}
+    served_by_tenant = {
+        t: sum(1 for r in records
+               if r["tenant"] == t and r["status"] == 200)
+        for t in tenants}
+    n_429 = sum(1 for r in records if r["status"] == 429)
+    print(json.dumps({
+        "metric": f"qos overload bench ({mode}): well-behaved tenants' "
+                  "interactive goodput at ~2x capacity",
+        "value": round(goodput, 4),
+        "unit": "fraction",
+        "vs_baseline": 0.0,
+        "extra": {
+            "mode": mode,
+            "offered_req_per_s": round(offered, 2),
+            "capacity_req_per_s": round(capacity, 2),
+            "offered_x_capacity": round(offered / capacity, 2),
+            "interactive_goodput": round(goodput, 4),
+            "interactive_slo_s": slo_s,
+            "jain_tokens": round(
+                jain_index(tokens_by_tenant.values()), 4),
+            "served_by_tenant": served_by_tenant,
+            "tokens_by_tenant": tokens_by_tenant,
+            "n_requests": len(records),
+            "n_429": n_429,
+            "n_429_with_retry_after": sum(
+                1 for r in records
+                if r["status"] == 429 and r["retry_after"]),
+            "n_5xx": sum(1 for r in records
+                         if r["status"] is not None
+                         and r["status"] >= 500),
+            "dropped": sum(1 for r in records
+                           if r["error"] is not None),
+            **qos_counters,
+        },
+    }))
+
+
 def _spawn_worker(impl: str, tpu: bool, timeout: int, extra_env=None):
     """Run one benchmark worker; returns (result_dict | None, error)."""
     cmd = [sys.executable, os.path.abspath(__file__),
@@ -1295,6 +1509,9 @@ def main() -> None:
                 os.environ.get("BENCH_UNIFIED_MODE", "off"))
         elif impl == "autoscale":
             run_autoscale_worker()
+        elif impl == "overload":
+            run_overload_worker(
+                os.environ.get("BENCH_OVERLOAD_QOS", "off"))
         else:
             run_worker(impl, tpu="--tpu" in sys.argv)
         return
@@ -1477,6 +1694,30 @@ def main() -> None:
             for key, value in as_result.get("extra", {}).items():
                 if key.startswith("autoscale_"):
                     result["extra"][key] = value
+
+        # QoS overload A/B (docs/qos.md): the same ~2x-capacity mixed-
+        # tenant load with the router's QoS layer as the only variable.
+        # Interactive goodput, Jain fairness over served tokens, and
+        # the zero-5xx / zero-silent-drop invariants ride in extra
+        # under overload_qos_off_* / overload_qos_on_*.
+        for tag, qmode in (("overload_qos_off", "off"),
+                           ("overload_qos_on", "on")):
+            sys.stderr.write(f"[bench] running {tag} worker "
+                             f"(timeout {timeout}s)...\n")
+            ov_result, ov_err = _spawn_worker(
+                "overload", False, timeout,
+                extra_env={"BENCH_OVERLOAD_QOS": qmode,
+                           "JAX_PLATFORMS": "cpu"})
+            if ov_result is None:
+                errors[f"{tag}_error"] = ov_err
+                sys.stderr.write(f"[bench] WARNING: {ov_err}\n")
+                continue
+            oe = ov_result.get("extra", {})
+            for key in ("interactive_goodput", "jain_tokens",
+                        "offered_x_capacity", "n_requests", "n_429",
+                        "n_429_with_retry_after", "n_5xx", "dropped",
+                        "router_throttled"):
+                result["extra"][f"{tag}_{key}"] = oe.get(key)
 
     if result is None:
         # Never hang the driver: report the failure as the metric line.

@@ -233,6 +233,29 @@ def build():
               [target('vllm:request_retries_total', "retries"),
                target('vllm:request_failovers_total', "failovers")],
               20, 78, w=4, kind="stat"),
+        # ---- QoS & overload (docs/qos.md) -----------------------------------
+        row("QoS & Overload", 85),
+        panel("Preempt-to-Offload Outcomes",
+              [target('sum by(outcome) (rate('
+                      'vllm:preempt_offload_total[5m]))',
+                      "{{outcome}}")],
+              0, 86),
+        panel("Shed Requests by Class",
+              [target('sum by(class) (rate(vllm:qos_shed_total[5m]))',
+                      "{{class}}")],
+              8, 86),
+        panel("Tenants Throttled (degraded)",
+              [target('sum(rate(vllm:tenant_throttled_total[5m])) * 60',
+                      "degraded / min")],
+              16, 86, w=4, kind="stat"),
+        panel("Preempt Restore Latency (p50 / p99)",
+              [target('histogram_quantile(0.5, sum by(le) (rate('
+                      'vllm:preempt_restore_latency_seconds_bucket'
+                      '[5m])))', "p50"),
+               target('histogram_quantile(0.99, sum by(le) (rate('
+                      'vllm:preempt_restore_latency_seconds_bucket'
+                      '[5m])))', "p99")],
+              20, 86, w=4, unit="s"),
     ]
     return {
         "title": "TPU Stack — Serving Overview",

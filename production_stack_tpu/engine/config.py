@@ -14,6 +14,8 @@ from typing import Optional
 
 import jax.numpy as jnp
 
+from production_stack_tpu.qos import parse_priority
+
 _DTYPE_MAP = {
     "bfloat16": jnp.bfloat16,
     "float32": jnp.float32,
@@ -315,6 +317,36 @@ class OffloadConfig:
 
 
 @dataclasses.dataclass
+class QoSConfig:
+    """Overload quality-of-service (docs/qos.md): priority classes,
+    preempt-to-offload, and engine-side shedding."""
+
+    # Priority class assumed for requests without an x-priority
+    # header: interactive | batch | background. Defaults to the
+    # middle class so unlabeled traffic stays sheddable.
+    default_priority: str = "batch"
+    # Under page pressure, ship the preemption victim's committed KV
+    # pages to the offload tier (when one is configured) instead of
+    # discarding them, so re-admission restores pages instead of
+    # recomputing the whole prompt. Inert without --enable-kv-offload.
+    preempt_to_offload: bool = True
+    # Waiting-queue fill fraction (of max_queue_len) past which the
+    # server sheds non-interactive submissions with 429 + Retry-After
+    # instead of letting them age out in the queue.
+    shed_threshold: float = 0.95
+
+    def __post_init__(self):
+        # Raises ValueError on anything outside the priority
+        # vocabulary — the config-contract's tested rejection for
+        # invalid priority strings.
+        parse_priority(self.default_priority)
+        if not 0.0 < self.shed_threshold <= 1.0:
+            raise ValueError(
+                "qos.shed_threshold must be in (0, 1] "
+                f"(got {self.shed_threshold!r})")
+
+
+@dataclasses.dataclass
 class EngineConfig:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
@@ -325,6 +357,7 @@ class EngineConfig:
     offload: OffloadConfig = dataclasses.field(
         default_factory=OffloadConfig)
     lora: LoRAConfig = dataclasses.field(default_factory=LoRAConfig)
+    qos: QoSConfig = dataclasses.field(default_factory=QoSConfig)
     seed: int = 0
     # Disaggregated serving role (docs/disaggregation.md):
     #   both    -> monolithic engine (default; fully backward

@@ -75,6 +75,11 @@ class LLMEngine:
         if self.guided_fsm is not None:
             self.runner.set_guided_tables(self.guided_fsm)
         self.sequences: Dict[str, Sequence] = {}
+        # QoS (docs/qos.md): priority class for requests that don't
+        # carry an explicit one.
+        from production_stack_tpu.qos import parse_priority
+        self.default_priority = int(
+            parse_priority(config.qos.default_priority))
         self._lock = threading.Lock()
         from production_stack_tpu.engine.metrics import EngineMetrics
         self.metrics = EngineMetrics()
@@ -139,6 +144,11 @@ class LLMEngine:
         )
         self.cache_manager.evict_listener = self._on_page_evicted
         self.scheduler.restore_hook = self._restore_offloaded_prefix
+        if self.config.qos.preempt_to_offload:
+            # Preempt-to-offload (docs/qos.md): preemption victims
+            # ship their committed pages over the same wire instead of
+            # discarding them.
+            self.scheduler.evict_hook = self._evict_sequence_kv
         logger.info("KV offload enabled (host pool %d MiB%s)",
                     self.config.offload.host_pool_bytes // 2 ** 20,
                     ", remote tier" if remote else "")
@@ -148,6 +158,41 @@ class LLMEngine:
         # int8 pages; the tiers carry the tuple opaquely.
         payload = self.runner.read_page(page_id)
         self.offload.offload_page(page_hash, *payload)
+
+    def _evict_sequence_kv(self, seq: Sequence) -> int:
+        """Preempt-to-offload (docs/qos.md): ship the victim's
+        committed KV pages to the offload tier before the scheduler
+        frees them, returning the shipped page count.
+
+        The restorable prefix is everything but the last token (the
+        prefix-cache ``usable`` bound: the final token must reprefill
+        to produce logits), and its KV is fully written — decode
+        commits a token's KV one step after sampling it, so positions
+        0..total_len-2 are always on device at a plan boundary. The
+        generated-token pages are first committed to the hash table
+        (prompt-time hashing stopped at the prompt), so the shipped
+        chain and the first-touch restore chain are the same
+        content-hash sequence — that identity is what makes the
+        offload round trip byte-exact. The cache's lazy
+        evict_listener cannot do this job: it fires on HBM slot
+        reuse, long after the victim's pages were freed."""
+        from production_stack_tpu.engine.kv_cache import (
+            PagedCacheManager,
+        )
+        if self.offload is None or not seq.pages:
+            return 0
+        usable = seq.total_len - 1
+        tokens = seq.all_token_ids[:usable]
+        self.cache_manager.commit_full_pages(
+            tokens, seq.pages, seq.num_hashed_pages, seq.cache_salt)
+        hashes = PagedCacheManager.chain_hashes(
+            tokens, self.cache_manager.page_size, seq.cache_salt)
+        shipped = 0
+        for page_id, page_hash in zip(seq.pages, hashes):
+            payload = self.runner.read_page(page_id)
+            self.offload.offload_page(page_hash, *payload)
+            shipped += 1
+        return shipped
 
     def _restore_offloaded_prefix(self, prompt_token_ids,
                                   matched_pages, cache_salt=0):
@@ -170,6 +215,7 @@ class LLMEngine:
             pages = self.cache_manager.allocate_pages(n)
         except OutOfPagesError:
             return []
+        t0 = time.perf_counter()
         restored = []
         # One batched round trip for every remote miss in the chain
         # (POST /kv/batch_get) instead of N sequential GETs.
@@ -196,6 +242,10 @@ class LLMEngine:
             self.cache_manager.prefix_hit_tokens += (
                 len(restored) * self.cache_manager.page_size
             )
+            # Restore latency (vllm:preempt_restore_latency_seconds):
+            # the page-transfer cost that replaced a prompt recompute.
+            self.metrics.on_preempt_restore(
+                time.perf_counter() - t0)
         return restored
 
     # ---- request API ------------------------------------------------------
@@ -206,7 +256,9 @@ class LLMEngine:
                     output_sink=None,
                     lora_name: Optional[str] = None,
                     handoff_prefill: bool = False,
-                    request_id: Optional[str] = None) -> str:
+                    request_id: Optional[str] = None,
+                    priority: Optional[int] = None,
+                    spec_off: bool = False) -> str:
         sampling = sampling or SamplingParams()
         stop_ids = list(sampling.stop_token_ids)
         if (not sampling.ignore_eos
@@ -243,6 +295,9 @@ class LLMEngine:
             fsm_state=fsm_state,
             handoff_prefill=handoff_prefill,
             request_id=request_id,
+            priority=(self.default_priority if priority is None
+                      else int(priority)),
+            spec_off=spec_off,
         )
         with self._lock:
             self.sequences[seq.seq_id] = seq

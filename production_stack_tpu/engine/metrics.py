@@ -114,6 +114,12 @@ class EngineMetrics:
         # it degraded to recompute). Always rendered (empty when the
         # engine never receives handoffs) for a stable scrape surface.
         self.handoff_latency = Histogram(_TTFT_BUCKETS)
+        # QoS preempt-to-offload (docs/qos.md): time spent pulling a
+        # preemption victim's pages back from the offload tier — the
+        # page-transfer cost that replaced a prompt recompute. Always
+        # rendered (empty without an offload tier) for a stable
+        # scrape surface.
+        self.preempt_restore_latency = Histogram(_TTFT_BUCKETS)
 
     def on_spec_step(self, drafted: int, accepted: int) -> None:
         """One speculative verify step's draft/accept counts."""
@@ -160,6 +166,11 @@ class EngineMetrics:
         with self._lock:
             self.handoff_latency.observe(max(0.0, latency_s))
             self.awaiting_kv_time.observe(max(0.0, latency_s))
+
+    def on_preempt_restore(self, latency_s: float) -> None:
+        """One offload-tier page restore completed (docs/qos.md)."""
+        with self._lock:
+            self.preempt_restore_latency.observe(max(0.0, latency_s))
 
     def on_decode_tokens(self, seq, n_tokens: int,
                          now: float) -> None:
@@ -226,6 +237,8 @@ class EngineMetrics:
                 "vllm:request_awaiting_kv_time_seconds")
             lines += self.handoff_latency.render(
                 "vllm:disagg_handoff_latency_seconds")
+            lines += self.preempt_restore_latency.render(
+                "vllm:preempt_restore_latency_seconds")
             lines += [
                 "# TYPE vllm:prompt_tokens_total counter",
                 f"vllm:prompt_tokens_total {self.prompt_tokens_total}",

@@ -37,6 +37,11 @@ from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
 
+# Sustained overload preempts on every planning pass; the per-victim
+# warning is rate-limited to one line per interval (with a
+# suppressed-count) so logging can't become the bottleneck.
+_PREEMPT_LOG_INTERVAL_S = 5.0
+
 
 @dataclass
 class PrefillChunk:
@@ -107,6 +112,19 @@ class Scheduler:
         # Optional offload-tier restore hook:
         # (prompt_token_ids, matched_pages) -> extra restored page ids.
         self.restore_hook = None
+        # Optional preempt-to-offload hook (docs/qos.md): seq -> count
+        # of committed KV pages shipped to the offload tier before the
+        # victim's pages are freed. None / 0 = classic
+        # drop-and-recompute. Installed by the engine when an offload
+        # tier is configured and qos.preempt_to_offload is on.
+        self.evict_hook = None
+        # vllm:preempt_offload_total{outcome}: "offloaded" victims had
+        # their pages shipped; "recompute" victims fell back to the
+        # classic full-prompt recompute.
+        self.preempt_offload_outcomes: Dict[str, int] = {
+            "offloaded": 0, "recompute": 0}
+        self._preempt_log_ts = float("-inf")
+        self._preempt_log_suppressed = 0
         # End-to-end tracing (docs/observability.md): mirror of
         # LLMEngine.tracer, installed via its setter; None = untraced.
         self.tracer = None
@@ -257,6 +275,10 @@ class Scheduler:
                 return None
         drafts: Dict[str, List[int]] = {}
         for seq in self.running:
+            if seq.spec_off:
+                # QoS degradation (docs/qos.md): throttled-tenant rows
+                # ride the verify step as plain single-token rows.
+                continue
             # Cap so emitted tokens (accepted + bonus) never exceed
             # the row's budget — a draft the budget can't emit would
             # also write KV past max_model_len.
@@ -315,6 +337,8 @@ class Scheduler:
         drafts: Dict[str, List[int]] = {}
         if self.proposer is not None:
             for seq in self.running:
+                if seq.spec_off:
+                    continue
                 d = self.proposer.propose(seq,
                                           self._seq_budget(seq) - 1)
                 if d:
@@ -441,17 +465,20 @@ class Scheduler:
         chunks: List[PrefillChunk] = []
         tokens_planned = 0
         admitting = 0  # rows that will join `running` this step
-        idx = 0
-        while (idx < len(self.waiting)
-               and len(chunks) < self.config.prefill_batch_size):
-            seq = self.waiting[idx]
+        # QoS admission order (docs/qos.md): priority class first, then
+        # arrival. The sort is stable and preempted victims keep their
+        # original arrival_time, so a restored victim leads its class
+        # rather than re-queueing at the back.
+        for seq in sorted(self.waiting,
+                          key=lambda s: (s.priority, s.arrival_time)):
+            if len(chunks) >= self.config.prefill_batch_size:
+                break
             if seq.state == SequenceState.ABORTED:
-                del self.waiting[idx]
+                self.waiting.remove(seq)
                 continue
             if seq.state == SequenceState.AWAITING_KV:
                 # Parked handoff: its KV pages are not reachable yet
                 # (engine._admit_handoffs flips it to WAITING).
-                idx += 1
                 continue
             if (len(self.running) + admitting
                     >= self.config.max_num_seqs):
@@ -494,7 +521,7 @@ class Scheduler:
                             logger.error(
                                 "Request %s can never fit in the KV "
                                 "cache; aborting", seq.seq_id)
-                            del self.waiting[idx]
+                            self.waiting.remove(seq)
                             self._finish(seq, FinishReason.ABORT)
                             self.newly_aborted.append(seq)
                             continue
@@ -528,7 +555,7 @@ class Scheduler:
                             "Request %s can never fit in the KV cache; "
                             "aborting", seq.seq_id
                         )
-                        del self.waiting[idx]
+                        self.waiting.remove(seq)
                         self._finish(seq, FinishReason.ABORT)
                         self.newly_aborted.append(seq)
                         continue
@@ -553,7 +580,6 @@ class Scheduler:
             tokens_planned += end - start
             if is_last:
                 admitting += 1
-            idx += 1
         if not chunks:
             return None
         return PrefillPlan(chunks=chunks)
@@ -588,9 +614,13 @@ class Scheduler:
             try:
                 seq.pages.extend(self.cache.allocate_pages(needed))
             except OutOfPagesError:
-                # Preempt: drop the newest sequence back to waiting,
-                # recomputing later (simple, correct v1 policy).
-                victim = self.running[-1]
+                # Preempt the lowest-priority, newest running sequence
+                # (docs/qos.md): max over (priority, arrival) — the
+                # exact inverse of the admission sort, and never a
+                # sequence more important than the one needing pages
+                # (seq itself is in the candidate set).
+                victim = max(self.running,
+                             key=lambda s: (s.priority, s.arrival_time))
                 self._preempt(victim)
                 if victim is seq:
                     continue
@@ -600,12 +630,32 @@ class Scheduler:
                     self._preempt(seq)
 
     def _preempt(self, seq: Sequence) -> None:
-        logger.warning("Preempting %s (KV cache pressure)", seq.seq_id)
+        self._log_preemption(seq)
         self.num_preemptions += 1
         if self.tracer is not None:
             self.tracer.event(seq.seq_id, "preempt",
                               generated=len(seq.output_token_ids))
         self.running.remove(seq)
+        # Preempt-to-offload (docs/qos.md): ship the victim's committed
+        # KV pages to the offload tier BEFORE freeing them — the cache
+        # fires evict_listener lazily on slot reuse, far too late for a
+        # deterministic restore. 0 pages / no hook / hook failure all
+        # degrade to the classic drop-and-recompute.
+        evicted = 0
+        if self.evict_hook is not None:
+            try:
+                evicted = self.evict_hook(seq)
+            except Exception:
+                logger.exception(
+                    "Preempt-to-offload failed for %s; falling back to "
+                    "recompute", seq.seq_id)
+                evicted = 0
+        outcome = "offloaded" if evicted else "recompute"
+        self.preempt_offload_outcomes[outcome] = (
+            self.preempt_offload_outcomes.get(outcome, 0) + 1)
+        if evicted and self.tracer is not None:
+            self.tracer.event(seq.seq_id, "preempt_offload",
+                              pages=evicted)
         self.cache.free_sequence(seq.pages)
         seq.pages = []
         seq.num_hashed_pages = 0
@@ -619,8 +669,37 @@ class Scheduler:
         seq.prompt_token_ids = seq.all_token_ids
         seq.output_token_ids = []
         seq.num_computed_tokens = 0
-        seq.state = SequenceState.WAITING
+        if evicted:
+            # Park like a disagg handoff (docs/disaggregation.md): the
+            # engine re-admits via _admit_handoffs once the shipped
+            # pages are reachable (immediately for the host tier), and
+            # the ordinary first-touch restore path pulls them back —
+            # miss/unreachable degrades to recompute via the same
+            # tri-state the handoff path already handles.
+            seq.state = SequenceState.AWAITING_KV
+            seq.handoff_arrival_time = time.time()
+            if self.tracer is not None:
+                self.tracer.event(seq.seq_id, "awaiting_kv_park",
+                                  pages=evicted)
+        else:
+            seq.state = SequenceState.WAITING
         self.waiting.appendleft(seq)
+
+    def _log_preemption(self, seq: Sequence) -> None:
+        now = time.monotonic()
+        if now - self._preempt_log_ts < _PREEMPT_LOG_INTERVAL_S:
+            self._preempt_log_suppressed += 1
+            return
+        if self._preempt_log_suppressed:
+            logger.warning(
+                "Preempting %s (KV cache pressure; %d preemptions "
+                "suppressed in the last %.0fs)", seq.seq_id,
+                self._preempt_log_suppressed, _PREEMPT_LOG_INTERVAL_S)
+        else:
+            logger.warning("Preempting %s (KV cache pressure)",
+                           seq.seq_id)
+        self._preempt_log_ts = now
+        self._preempt_log_suppressed = 0
 
     # ---- completion callbacks (driven by the engine) ----------------------
 

@@ -140,6 +140,16 @@ class Sequence:
     # x-request-id, carried so engine spans on every hop of a
     # disaggregated request stitch to the same router span.
     request_id: Optional[str] = None
+    # QoS priority class (docs/qos.md): int value of qos.Priority —
+    # lower is more important. Admission sorts waiting sequences by
+    # (priority, arrival_time); preemption picks the max of the same
+    # tuple (lowest-priority, newest victim). Plain int so this module
+    # stays import-light.
+    priority: int = 1
+    # QoS degradation ladder: the router marks throttled-tenant
+    # requests spec-off; the scheduler then never spends speculative
+    # draft/verify slack on them (docs/qos.md).
+    spec_off: bool = False
 
     @property
     def num_generated(self) -> int:

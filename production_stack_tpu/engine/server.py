@@ -37,10 +37,21 @@ from production_stack_tpu.engine.config import (
     ModelConfig,
     OffloadConfig,
     ParallelConfig,
+    QoSConfig,
     SchedulerConfig,
     tiny_model_config,
 )
 from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.qos import (
+    parse_priority,
+    Priority,
+    PRIORITY_HEADER,
+    PRIORITY_NAMES,
+    priority_name,
+    shed_counter_dict,
+    shed_retry_after_s,
+    SPEC_OFF_HEADER,
+)
 from production_stack_tpu.engine.sequence import SamplingParams
 from production_stack_tpu.engine.tokenizer import (
     get_tokenizer,
@@ -106,6 +117,8 @@ class AsyncEngine:
                             handoff_prefill=item.get(
                                 "handoff_prefill", False),
                             request_id=item.get("request_id"),
+                            priority=item.get("priority"),
+                            spec_off=item.get("spec_off", False),
                         )
                 except Exception as e:
                     # Queue full / invalid request: fail THIS request,
@@ -148,6 +161,8 @@ class AsyncEngine:
                      lora_name: Optional[str] = None,
                      handoff_prefill: bool = False,
                      request_id: Optional[str] = None,
+                     priority: Optional[int] = None,
+                     spec_off: bool = False,
                      ) -> tuple[str, asyncio.Queue]:
         seq_id = f"seq-{uuid.uuid4().hex[:16]}"
         stream: asyncio.Queue = asyncio.Queue()
@@ -157,6 +172,7 @@ class AsyncEngine:
             "seq_id": seq_id, "lora_name": lora_name,
             "handoff_prefill": handoff_prefill,
             "request_id": request_id,
+            "priority": priority, "spec_off": spec_off,
         })
         self._wakeup.set()
         return seq_id, stream
@@ -439,6 +455,10 @@ class EngineServer:
         self.drain_exit_timeout_s = drain_exit_timeout_s
         self._active_generations = 0
         self._drain_exit_task: Optional[asyncio.Task] = None
+        # QoS graceful shedding (docs/qos.md): per-priority-class count
+        # of requests turned away with 429 at the shed gate. Rendered
+        # as vllm:qos_shed_total{class=...} on /metrics.
+        self.qos_shed_counts = shed_counter_dict()
 
     # -- decoding helpers ---------------------------------------------------
 
@@ -524,9 +544,57 @@ class EngineServer:
             request, body, prompt, chat=False, prompt_text=prompt_text
         )
 
+    def _qos_admit(self, request: web.Request):
+        """Parse the request's QoS class and apply the shed gate.
+
+        Returns ``(priority, spec_off, rejection)``. An unparseable
+        ``x-priority`` header is the caller's bug -> 400. Under queue
+        pressure (waiting depth at or past ``qos.shed_threshold`` of
+        ``--max-queue-len``) non-interactive classes are turned away
+        with an honest ``429 + Retry-After`` BEFORE they enter the
+        engine queue — never a silent drop, never a 5xx; interactive
+        requests are always admitted (the queue-full reject in
+        ``Scheduler.add`` remains the hard backstop). Retry-After is
+        queue_depth / running-slots (one request per slot-second is
+        the deliberately pessimistic service-rate proxy; docs/qos.md).
+        """
+        raw = request.headers.get(PRIORITY_HEADER)
+        if raw is None:
+            priority = Priority(self.engine.default_priority)
+        else:
+            try:
+                priority = parse_priority(raw)
+            except ValueError as e:
+                return None, False, web.json_response(
+                    {"error": {"message": str(e),
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+        spec_off = request.headers.get(SPEC_OFF_HEADER) == "1"
+        qos = self.engine.config.qos
+        max_queue = self.engine.config.scheduler.max_queue_len
+        depth = self.engine.scheduler.num_waiting
+        if (priority != Priority.INTERACTIVE
+                and depth >= qos.shed_threshold * max_queue):
+            retry_after = shed_retry_after_s(
+                depth, max(1.0, float(self.engine.scheduler.num_running)))
+            self.qos_shed_counts[priority_name(priority)] += 1
+            return priority, spec_off, web.json_response(
+                {"error": {"message": (
+                    f"engine overloaded ({depth} requests waiting); "
+                    f"{priority_name(priority)} requests are being "
+                    f"shed — retry after {retry_after}s"),
+                    "type": "overloaded_error"}},
+                status=429, headers={"Retry-After": str(retry_after)},
+            )
+        return priority, spec_off, None
+
     async def _generate_response(self, request: web.Request, body: dict,
                                  prompt: List[int], chat: bool,
                                  prompt_text: Optional[str] = None):
+        priority, spec_off, rejection = self._qos_admit(request)
+        if rejection is not None:
+            return rejection
         try:
             sampling = _sampling_from_body(
                 body, self.engine.config.scheduler.max_model_len,
@@ -644,7 +712,8 @@ class EngineServer:
         trace_id = request.headers.get("x-request-id")
         subs = [await self.async_engine.submit(
             prompt, choice_sampling(i), lora_name=lora_name,
-            request_id=trace_id)
+            request_id=trace_id, priority=int(priority),
+            spec_off=spec_off)
             for i in range(candidates)]
 
         def legacy_lp(lps):
@@ -1594,6 +1663,19 @@ class EngineServer:
         # rejected and in-flight sequences finish.
         lines.append("# TYPE vllm:engine_draining gauge")
         lines.append(f"vllm:engine_draining {float(self.draining)}")
+        # QoS under overload (docs/qos.md): per-class shed counts from
+        # the 429 gate and per-outcome preemption counts (did the
+        # victim's KV pages ship to the offload tier, or will the
+        # victim recompute from scratch?).
+        lines.append("# TYPE vllm:qos_shed_total counter")
+        for cls, count in sorted(self.qos_shed_counts.items()):
+            lines.append("vllm:qos_shed_total{class=\""
+                         f"{cls}\"}} {float(count)}")
+        lines.append("# TYPE vllm:preempt_offload_total counter")
+        for outcome, count in sorted(
+                self.engine.scheduler.preempt_offload_outcomes.items()):
+            lines.append("vllm:preempt_offload_total{outcome=\""
+                         f"{outcome}\"}} {float(count)}")
         # vLLM-parity request-latency histograms + token counters.
         lines.extend(self.engine.metrics.render())
         lines.append("")
@@ -1797,6 +1879,11 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             max_loras=args.max_loras,
             max_lora_rank=args.max_lora_rank,
         ),
+        qos=QoSConfig(
+            default_priority=args.default_priority,
+            preempt_to_offload=args.preempt_to_offload == "on",
+            shed_threshold=args.shed_threshold,
+        ),
         seed=args.seed,
         engine_role=args.engine_role,
         handoff_timeout_s=args.handoff_timeout_s,
@@ -1982,6 +2069,28 @@ def parse_args(argv=None):
                              "handoffs, 'both' (default) serves "
                              "monolithically. Advertised via /health "
                              "for role-aware routing")
+    parser.add_argument("--default-priority", default="batch",
+                        choices=list(PRIORITY_NAMES),
+                        help="QoS class assumed for requests without "
+                             "an x-priority header (docs/qos.md). "
+                             "Priority orders waiting-queue admission "
+                             "and picks preemption victims "
+                             "(lowest class, newest arrival first)")
+    parser.add_argument("--preempt-to-offload", default="on",
+                        choices=["on", "off"],
+                        help="Under KV page pressure, ship a preempted "
+                             "victim's committed pages to the "
+                             "configured offload tier and restore "
+                             "them on re-admission instead of "
+                             "recomputing (docs/qos.md). Inert "
+                             "without --enable-kv-offload or "
+                             "--kv-remote-url")
+    parser.add_argument("--shed-threshold", type=float, default=0.95,
+                        help="Fraction of --max-queue-len at which "
+                             "non-interactive requests are shed with "
+                             "429 + Retry-After instead of queued "
+                             "(docs/qos.md); interactive requests are "
+                             "never shed by this gate")
     parser.add_argument("--handoff-timeout-s", type=float, default=30.0,
                         help="How long a decode-role engine holds a "
                              "handoff in AWAITING_KV waiting for an "

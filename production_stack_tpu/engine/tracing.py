@@ -54,6 +54,8 @@ SPAN_EVENTS = (
     "prefill_chunk",
     "first_token",
     "preempt",
+    "preempt_offload",
+    "qos_shed",
     "handoff_ship",
     "finish",
 )

@@ -1,0 +1,154 @@
+"""Shared QoS types: priority classes, tenant identity, token buckets.
+
+One vocabulary for both hops of the stack (docs/qos.md): the router
+stamps a priority class on every request (``x-priority`` header,
+defaulted per deployment), the engine scheduler admits waiting
+sequences in priority-then-arrival order and picks the lowest-
+priority, newest victim under page pressure, and the router's
+fairness layer (router/qos.py) meters tenants with the token buckets
+defined here. Stdlib-only so the engine hot path imports nothing
+heavy.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Iterable, Optional
+
+# Carried end-to-end: client -> router -> engine. The router forwards
+# the client's header verbatim (or stamps its configured default), the
+# engine server maps it to Sequence.priority.
+PRIORITY_HEADER = "x-priority"
+
+# Tenant identity for fairness accounting: the API key header when the
+# client sends one, else the client IP (router/qos.py identify_tenant).
+TENANT_HEADER = "x-api-key"
+
+# Degradation-ladder hint (docs/qos.md): the router sets this on
+# requests it admits in degraded mode; the engine skips speculative
+# drafting for them so saturated pods spend no verify-step slack on
+# throttled tenants.
+SPEC_OFF_HEADER = "x-qos-spec-off"
+
+
+class Priority(enum.IntEnum):
+    """Request priority class. Lower value = more important, so tuples
+    like ``(seq.priority, seq.arrival_time)`` sort admission order and
+    ``max()`` over the same tuple picks the preemption victim."""
+
+    INTERACTIVE = 0
+    BATCH = 1
+    BACKGROUND = 2
+
+
+PRIORITY_NAMES = tuple(p.name.lower() for p in Priority)
+
+# Unlabeled traffic lands in the middle class: sheddable under
+# overload, but ahead of explicit background work. Interactive must be
+# requested explicitly — a default-everyone-is-interactive policy
+# would make the classes meaningless the first time load exceeds
+# capacity.
+DEFAULT_PRIORITY = Priority.BATCH
+
+
+def parse_priority(name: str) -> Priority:
+    """'interactive' | 'batch' | 'background' -> Priority.
+
+    Raises ValueError on anything else (the server maps it to HTTP
+    400; engine/config.py re-raises it at config time for
+    --default-priority typos).
+    """
+    try:
+        return Priority[str(name).strip().upper()]
+    except KeyError:
+        raise ValueError(
+            f"invalid priority {name!r} (expected one of: "
+            f"{', '.join(PRIORITY_NAMES)})")
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/second, ``burst`` deep.
+
+    Callers drive the clock explicitly (``now``) so policy code and
+    tests are deterministic; router/qos.py passes event-loop time.
+    """
+
+    def __init__(self, rate: float, burst: float):
+        if rate <= 0 or burst <= 0:
+            raise ValueError("rate and burst must be > 0")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self._last: Optional[float] = None
+
+    def _refill(self, now: float) -> None:
+        if self._last is not None and now > self._last:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def take(self, n: float, now: float) -> bool:
+        """Consume ``n`` tokens if available; False = over budget."""
+        self._refill(now)
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+    def charge(self, n: float, now: float,
+               max_debt: float = 0.0) -> None:
+        """Consume ``n`` tokens unconditionally, letting the level go
+        negative (debt, floored at ``-max_debt``). The degradation
+        ladder charges served-but-degraded requests this way, so
+        sustained overage accumulates measurable debt that ``deficit``
+        reads and refill pays down at ``rate`` — while the floor bounds
+        how long a tenant that stops hammering stays in the penalty
+        box."""
+        self._refill(now)
+        self.tokens = max(self.tokens - n, -float(max_debt))
+
+    def deficit(self, now: float) -> float:
+        """Current token debt: how far below empty the bucket sits
+        (0.0 while any credit remains). Grows one unit per charged
+        over-budget request, drains at ``rate``."""
+        self._refill(now)
+        return max(0.0, -self.tokens)
+
+    def retry_after_s(self, now: float, n: float = 1.0) -> float:
+        """Seconds until ``n`` tokens will be available."""
+        self._refill(now)
+        short = n - self.tokens
+        if short <= 0:
+            return 0.0
+        return short / self.rate
+
+
+def jain_index(values: Iterable[float]) -> float:
+    """Jain fairness index over per-tenant allocations: 1.0 =
+    perfectly fair, 1/n = one tenant takes everything."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return 1.0
+    total = sum(vals)
+    if total == 0:
+        return 1.0
+    sq = sum(v * v for v in vals)
+    return (total * total) / (len(vals) * sq)
+
+
+def shed_retry_after_s(queue_depth: int, service_rate: float) -> int:
+    """Honest Retry-After for a shed request: the time the current
+    queue needs to drain at the observed/configured service rate,
+    floored at 1s (docs/qos.md §retry-after-math)."""
+    if service_rate <= 0:
+        return 1
+    return max(1, int(round(queue_depth / service_rate)))
+
+
+def priority_name(priority: "Priority | int") -> str:
+    return Priority(int(priority)).name.lower()
+
+
+def shed_counter_dict() -> Dict[str, int]:
+    """Zeroed per-class shed counter (stable label set for metrics)."""
+    return {name: 0 for name in PRIORITY_NAMES}

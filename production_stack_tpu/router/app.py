@@ -336,6 +336,17 @@ def initialize_all(app: web.Application, args) -> None:
         breaker_open_base_s=args.breaker_open_seconds,
         breaker_open_max_s=args.breaker_max_open_seconds,
     ))
+    from production_stack_tpu.router.qos import (
+        initialize_router_qos,
+        RouterQoSConfig,
+    )
+    initialize_router_qos(RouterQoSConfig(
+        tenant_rate=getattr(args, "qos_tenant_rate", 0.0),
+        tenant_burst=getattr(args, "qos_tenant_burst", 20.0),
+        degrade_max_tokens=getattr(args, "qos_degrade_max_tokens", 128),
+        shed_deficit=getattr(args, "qos_shed_deficit", 10.0),
+        max_concurrency=getattr(args, "qos_max_concurrency", 0),
+    ))
     initialize_engine_stats_scraper(args.engine_stats_interval)
     initialize_request_stats_monitor(args.request_stats_window)
     initialize_routing_logic(args.routing_logic,

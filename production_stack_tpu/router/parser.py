@@ -101,6 +101,37 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--breaker-max-open-seconds", type=float,
                         default=60.0)
 
+    # QoS (router/qos.py; docs/qos.md): per-tenant token-bucket rate
+    # limiting, the degradation ladder (clamp max_tokens -> spec-off ->
+    # shed 429), and weighted-fair admission across tenants.
+    parser.add_argument(
+        "--qos-tenant-rate", type=float, default=0.0,
+        help="Sustained per-tenant request rate (req/s) before the "
+             "degradation ladder engages; tenant = x-api-key header, "
+             "else client IP (0 disables router QoS entirely)",
+    )
+    parser.add_argument(
+        "--qos-tenant-burst", type=float, default=20.0,
+        help="Token-bucket burst per tenant (requests)",
+    )
+    parser.add_argument(
+        "--qos-degrade-max-tokens", type=int, default=128,
+        help="max_tokens clamp applied to over-rate tenants' requests "
+             "(ladder rung 1, with speculative decoding forced off)",
+    )
+    parser.add_argument(
+        "--qos-shed-deficit", type=float, default=10.0,
+        help="Bucket deficit (request-units) past which non-interactive "
+             "requests are shed with 429 + Retry-After; interactive "
+             "requests are degraded but never rate-shed",
+    )
+    parser.add_argument(
+        "--qos-max-concurrency", type=int, default=0,
+        help="Concurrent proxied generations admitted at once; excess "
+             "waiters dequeue weighted-fair across tenants (stride "
+             "scheduling, priority-class weights). 0 disables the gate",
+    )
+
     parser.add_argument("--engine-stats-interval", type=float, default=30.0)
     parser.add_argument("--request-stats-window", type=float, default=60.0)
     parser.add_argument("--log-stats", action="store_true")
@@ -179,3 +210,14 @@ def validate_args(args: argparse.Namespace) -> None:
             raise ValueError(f"--{name.replace('_', '-')} must be >= 0")
     if not 0.0 < args.breaker_failure_rate <= 1.0:
         raise ValueError("--breaker-failure-rate must be in (0, 1]")
+    if args.qos_tenant_rate < 0:
+        raise ValueError("--qos-tenant-rate must be >= 0")
+    if args.qos_tenant_rate > 0:
+        if args.qos_tenant_burst <= 0:
+            raise ValueError("--qos-tenant-burst must be > 0")
+        if args.qos_degrade_max_tokens < 1:
+            raise ValueError("--qos-degrade-max-tokens must be >= 1")
+        if args.qos_shed_deficit <= 0:
+            raise ValueError("--qos-shed-deficit must be > 0")
+    if args.qos_max_concurrency < 0:
+        raise ValueError("--qos-max-concurrency must be >= 0")

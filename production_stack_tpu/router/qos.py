@@ -1,0 +1,302 @@
+"""Router-side QoS: per-tenant rate limiting, weighted fair queueing,
+and the graceful degradation ladder (docs/qos.md).
+
+The engine's shed gate (engine/server.py) protects one pod; this layer
+protects the *fleet* from one tenant. A tenant is the ``x-api-key``
+header value, falling back to the client's peer IP, falling back to
+``"anonymous"`` — cheap, deterministic, and good enough to stop a
+single greedy client from starving everyone else without an auth
+subsystem.
+
+Three cooperating mechanisms, applied in order on the proxy hot path
+(services/request_service.py) before any backend is contacted:
+
+1. **Token buckets** — one per tenant (``--qos-tenant-rate`` requests/s,
+   ``--qos-tenant-burst`` burst). A request that fits its bucket passes
+   untouched.
+2. **Degradation ladder** — a tenant mildly over its bucket is served
+   *degraded* rather than refused: ``max_tokens`` is clamped to
+   ``--qos-degrade-max-tokens`` and the ``x-qos-spec-off`` header tells
+   the engine to skip speculative drafting for the row (existing
+   engine capability, zero new engine surface). Counted in
+   ``vllm:tenant_throttled_total``.
+3. **Shedding** — a tenant deeply over its bucket (deficit past
+   ``--qos-shed-deficit`` request-units) gets an honest
+   ``429 + Retry-After`` computed from the bucket's refill rate —
+   never a silent drop, never a 5xx. ``interactive`` requests are
+   degraded but NEVER rate-shed: a human at a prompt always gets an
+   answer; the ladder takes its pound of flesh from max_tokens
+   instead.
+
+Optionally (``--qos-max-concurrency`` > 0) a stride-scheduled
+``FairGate`` bounds concurrent proxied generations and dequeues
+waiters weighted-fair across tenants (weights by priority class), so
+one tenant's thousand queued requests cannot monopolize admission
+order even when every request individually fits its bucket.
+
+Disabled-by-default: ``get_router_qos()`` returns ``None`` until
+``initialize_router_qos`` runs with a positive tenant rate, and the
+hot path treats ``None`` as "no QoS" — the pre-QoS behavior.
+
+Determinism: every time-dependent entry point takes an explicit
+``now`` so tests drive a synthetic clock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, Optional, Tuple
+
+from production_stack_tpu.qos import (
+    Priority,
+    TokenBucket,
+    priority_name,
+    shed_counter_dict,
+)
+from production_stack_tpu.utils import SingletonMeta
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+# Priority-class weights for the fair gate's stride scheduler: an
+# interactive waiter advances its tenant's virtual pass 4x slower than
+# a background one, so interactive tenants dequeue ~4x as often under
+# contention.
+PRIORITY_WEIGHTS = {
+    Priority.INTERACTIVE: 4.0,
+    Priority.BATCH: 2.0,
+    Priority.BACKGROUND: 1.0,
+}
+
+# Bound on distinct tenants tracked before the least-recently-seen
+# bucket is dropped (an adversary minting tenant ids must not grow
+# router memory without bound; a dropped tenant just starts a fresh
+# full bucket, which is the generous direction).
+MAX_TRACKED_TENANTS = 10_000
+
+
+@dataclass
+class RouterQoSConfig:
+    """Knobs, mirrored 1:1 by router CLI flags (see parser.py)."""
+
+    # Sustained per-tenant admission rate (requests/s) and burst.
+    tenant_rate: float = 10.0
+    tenant_burst: float = 20.0
+    # Ladder rung 1: clamp for over-bucket tenants' max_tokens.
+    degrade_max_tokens: int = 128
+    # Ladder rung 2: bucket deficit (request-units) past which
+    # non-interactive requests are shed with 429.
+    shed_deficit: float = 10.0
+    # Fair gate: max concurrent proxied generations (0 = gate off).
+    max_concurrency: int = 0
+
+
+@dataclass
+class QoSVerdict:
+    """One admission decision for one request."""
+
+    action: str  # "admit" | "degrade" | "shed"
+    tenant: str
+    priority: Priority
+    # Set on "degrade": clamp the request's max_tokens to this.
+    clamp_max_tokens: Optional[int] = None
+    # Set on "degrade": forward x-qos-spec-off to the engine.
+    spec_off: bool = False
+    # Set on "shed": honest Retry-After seconds.
+    retry_after_s: int = 0
+
+
+@dataclass
+class _TenantState:
+    bucket: TokenBucket
+    admitted_total: int = 0
+    throttled_total: int = 0
+    shed_total: int = 0
+    pass_value: float = 0.0  # fair-gate virtual time
+
+
+class RouterQoS:
+    """Per-tenant rate limiting + degradation ladder + counters."""
+
+    def __init__(self, config: Optional[RouterQoSConfig] = None):
+        self.config = config or RouterQoSConfig()
+        self._tenants: "OrderedDict[str, _TenantState]" = OrderedDict()
+        # Router-wide counters exported at /metrics
+        # (services/metrics_service.py).
+        self.tenant_throttled_total = 0
+        self.shed_by_class = shed_counter_dict()
+        self.gate: Optional[FairGate] = (
+            FairGate(self.config.max_concurrency, self)
+            if self.config.max_concurrency > 0 else None
+        )
+
+    # -- tenant identity ----------------------------------------------------
+
+    @staticmethod
+    def tenant_of(headers, remote: Optional[str]) -> str:
+        """x-api-key header, else peer IP, else "anonymous"."""
+        from production_stack_tpu.qos import TENANT_HEADER
+        key = headers.get(TENANT_HEADER)
+        if key:
+            return f"key:{key}"
+        if remote:
+            return f"ip:{remote}"
+        return "anonymous"
+
+    def _state(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = _TenantState(bucket=TokenBucket(
+                rate=self.config.tenant_rate,
+                burst=self.config.tenant_burst,
+            ))
+            self._tenants[tenant] = st
+            while len(self._tenants) > MAX_TRACKED_TENANTS:
+                self._tenants.popitem(last=False)
+        else:
+            self._tenants.move_to_end(tenant)
+        return st
+
+    # -- the ladder ---------------------------------------------------------
+
+    def decide(self, tenant: str, priority: Priority,
+               now: Optional[float] = None) -> QoSVerdict:
+        """One request costs one bucket unit. In-bucket -> admit;
+        mildly over -> degrade (clamp + spec-off); deeply over and
+        non-interactive -> shed. Interactive is never rate-shed."""
+        if now is None:
+            now = time.monotonic()
+        st = self._state(tenant)
+        if st.bucket.take(1.0, now):
+            st.admitted_total += 1
+            return QoSVerdict("admit", tenant, priority)
+        deficit = st.bucket.deficit(now)
+        if (priority == Priority.INTERACTIVE
+                or deficit < self.config.shed_deficit):
+            # Degraded requests still cost real engine work, so they
+            # charge the bucket into debt: a tenant that keeps
+            # hammering crosses the shed line; one that backs off pays
+            # the (bounded) debt down at the refill rate.
+            st.bucket.charge(
+                1.0, now,
+                max_debt=self.config.shed_deficit
+                + self.config.tenant_burst)
+            st.throttled_total += 1
+            self.tenant_throttled_total += 1
+            return QoSVerdict(
+                "degrade", tenant, priority,
+                clamp_max_tokens=self.config.degrade_max_tokens,
+                spec_off=True,
+            )
+        st.shed_total += 1
+        self.shed_by_class[priority_name(priority)] += 1
+        return QoSVerdict(
+            "shed", tenant, priority,
+            retry_after_s=max(1, int(st.bucket.retry_after_s(now))),
+        )
+
+    def tenant_snapshot(self) -> Dict[str, _TenantState]:
+        return dict(self._tenants)
+
+
+class FairGate:
+    """Stride-scheduled concurrency gate: at most ``max_concurrency``
+    requests proxy at once; excess waiters queue per tenant and are
+    dequeued by lowest tenant virtual pass, advancing the winner's
+    pass by 1/weight(priority). FIFO within a tenant.
+
+    Single-event-loop discipline (same as the rest of the router): all
+    state is touched from the router loop, no locks. ``release`` must
+    be called exactly once per successful ``acquire``.
+    """
+
+    def __init__(self, max_concurrency: int, qos: RouterQoS):
+        self.max_concurrency = max(1, int(max_concurrency))
+        self._qos = qos
+        self.active = 0
+        self._global_pass = 0.0
+        # tenant -> FIFO of (priority, future)
+        self._waiting: Dict[
+            str, Deque[Tuple[Priority, "asyncio.Future"]]] = {}
+
+    @property
+    def queued(self) -> int:
+        return sum(len(q) for q in self._waiting.values())
+
+    def _charge(self, tenant: str, priority: Priority) -> None:
+        st = self._qos._state(tenant)
+        # Classic stride join rule: a tenant resumes at the current
+        # global virtual time, never earlier — an idle tenant cannot
+        # bank unbounded credit while others worked.
+        pass_value = max(st.pass_value, self._global_pass)
+        self._global_pass = pass_value
+        st.pass_value = pass_value + 1.0 / PRIORITY_WEIGHTS[priority]
+
+    async def acquire(self, tenant: str, priority: Priority) -> None:
+        if self.active < self.max_concurrency and not self._waiting:
+            self.active += 1
+            self._charge(tenant, priority)
+            return
+        fut: "asyncio.Future" = asyncio.get_event_loop().create_future()
+        self._waiting.setdefault(tenant, deque()).append((priority, fut))
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # Abandoned waiter (client gone): unlink so release() never
+            # wakes a dead future.
+            q = self._waiting.get(tenant)
+            if q is not None:
+                try:
+                    q.remove((priority, fut))
+                except ValueError:
+                    pass
+                if not q:
+                    self._waiting.pop(tenant, None)
+            raise
+
+    def release(self) -> None:
+        self.active = max(0, self.active - 1)
+        while self._waiting and self.active < self.max_concurrency:
+            # Lowest virtual pass wins; ties break by tenant name for
+            # determinism.
+            tenant = min(
+                self._waiting,
+                key=lambda t: (self._qos._state(t).pass_value, t),
+            )
+            q = self._waiting[tenant]
+            priority, fut = q.popleft()
+            if not q:
+                del self._waiting[tenant]
+            if fut.cancelled():
+                continue
+            self.active += 1
+            self._charge(tenant, priority)
+            fut.set_result(None)
+
+
+class _QoSHolder(metaclass=SingletonMeta):
+    """SingletonMeta so the test harness resets it between tests."""
+
+    def __init__(self):
+        self.instance: Optional[RouterQoS] = None
+
+
+def initialize_router_qos(
+        config: Optional[RouterQoSConfig] = None) -> Optional[RouterQoS]:
+    holder = _QoSHolder()
+    cfg = config or RouterQoSConfig()
+    holder.instance = RouterQoS(cfg) if cfg.tenant_rate > 0 else None
+    return holder.instance
+
+
+def get_router_qos() -> Optional[RouterQoS]:
+    """None until initialized with a positive tenant rate: the proxy
+    path applies no tenant fairness or shedding — pre-QoS behavior."""
+    return _QoSHolder().instance
+
+
+def shutdown_router_qos() -> None:
+    _QoSHolder().instance = None

@@ -169,6 +169,23 @@ engine_draining = Gauge(
     "vllm:engine_draining",
     "Engine-reported draining state: 1 while the engine rejects new "
     "admissions and finishes in-flight sequences (scraped)", _LBL)
+# QoS under overload (docs/qos.md): engine shed/preemption counters
+# re-exported with their class/outcome labels, plus the mean
+# preempt-restore latency from the engine's histogram sum/count.
+engine_qos_shed = Gauge(
+    "vllm:engine_qos_shed",
+    "Engine-reported requests shed with 429 at the QoS gate, per "
+    "priority class (scraped)", ["server", "class"])
+engine_preempt_offload = Gauge(
+    "vllm:engine_preempt_offload",
+    "Engine-reported preemptions per outcome: 'offloaded' (victim KV "
+    "shipped to the offload tier) vs 'recompute' (scraped)",
+    ["server", "outcome"])
+engine_preempt_restore_latency_mean = Gauge(
+    "vllm:engine_preempt_restore_latency_mean_seconds",
+    "Mean time to restore a preempted victim's KV pages from the "
+    "offload tier on re-admission (scraped histogram sum/count)",
+    _LBL)
 
 # -- fleet manager (production_stack_tpu/fleet/, docs/fleet.md) -------------
 # Set by an in-process fleet manager (or its embedded exporter); the
@@ -213,6 +230,16 @@ requests_shed = Gauge(
     "vllm:requests_shed_total",
     "Requests answered 503 because no endpoint was admittable "
     "(router-wide)", [])
+
+# -- router QoS (router/qos.py, docs/qos.md) --------------------------------
+tenant_throttled = Gauge(
+    "vllm:tenant_throttled_total",
+    "Requests served degraded (max_tokens clamped, speculation off) "
+    "because their tenant was over its rate bucket (router-wide)", [])
+router_qos_shed = Gauge(
+    "vllm:router_qos_shed_total",
+    "Requests shed with 429 at the router's tenant rate limiter, per "
+    "priority class (router-wide)", ["class"])
 
 # -- disaggregated dispatch (services/request_service.py) -------------------
 router_disagg_handoffs = Gauge(
@@ -345,6 +372,17 @@ def refresh_gauges() -> None:
                 es.request_decode_time_sum
                 / es.request_decode_time_count)
         engine_draining.labels(server=server).set(es.engine_draining)
+        for cls, value in es.qos_shed_by_class.items():
+            engine_qos_shed.labels(
+                **{"server": server, "class": cls}).set(value)
+        for outcome, value in es.preempt_offload_by_outcome.items():
+            engine_preempt_offload.labels(
+                server=server, outcome=outcome).set(value)
+        if es.preempt_restore_latency_count > 0:
+            engine_preempt_restore_latency_mean.labels(
+                server=server).set(
+                es.preempt_restore_latency_sum
+                / es.preempt_restore_latency_count)
     from production_stack_tpu.router.services import request_service
     router_disagg_handoffs.set(request_service.disagg_handoffs_total)
     router_disagg_fallbacks.set(request_service.disagg_fallbacks_total)
@@ -372,6 +410,12 @@ def refresh_gauges() -> None:
         request_retries.set(mgr.retries_total)
         request_failovers.set(mgr.failovers_total)
         requests_shed.set(mgr.shed_requests_total)
+    from production_stack_tpu.router.qos import get_router_qos
+    rqos = get_router_qos()
+    if rqos is not None:
+        tenant_throttled.set(rqos.tenant_throttled_total)
+        for cls, value in rqos.shed_by_class.items():
+            router_qos_shed.labels(**{"class": cls}).set(value)
 
 
 def render_exposition() -> tuple[bytes, str]:

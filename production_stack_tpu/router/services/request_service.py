@@ -32,6 +32,13 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.qos import (
+    DEFAULT_PRIORITY,
+    parse_priority,
+    PRIORITY_HEADER,
+    SPEC_OFF_HEADER,
+)
+from production_stack_tpu.router.qos import get_router_qos
 from production_stack_tpu.router.resilience import get_resilience
 from production_stack_tpu.router.service_discovery import (
     get_service_discovery,
@@ -82,12 +89,18 @@ disagg_fallbacks_total = 0
 
 
 class RetryableUpstreamError(Exception):
-    """Backend failed before the first byte reached the client: connect
-    error, timeout, or 5xx status. Safe to re-route elsewhere."""
+    """Backend failed — or, for 429, refused — before the first byte
+    reached the client: connect error, timeout, 5xx status, or a QoS
+    shed (429). Safe to re-route elsewhere. A 429 carries the engine's
+    ``Retry-After`` so exhaustion can answer the client honestly, and
+    is NOT breaker blame: a saturated engine is healthy, and opening
+    breakers on overload turns one hot spot into a routing storm."""
 
-    def __init__(self, reason: str, status: Optional[int] = None):
+    def __init__(self, reason: str, status: Optional[int] = None,
+                 retry_after: Optional[int] = None):
         super().__init__(reason)
         self.status = status
+        self.retry_after = retry_after
 
 
 class _BackendStreamError(Exception):
@@ -212,6 +225,50 @@ async def route_general_request(request: web.Request,
     if not model:
         return _error(400, "Request body must contain a 'model' field")
 
+    # Router QoS (docs/qos.md): tenant identification, per-tenant rate
+    # limiting, and the degradation ladder — applied before any backend
+    # work. Shed answers are honest 429 + Retry-After; degrade clamps
+    # max_tokens and marks the request spec-off for the engine.
+    qos = get_router_qos()
+    qos_verdict = None
+    qos_headers: Optional[dict] = None
+    if qos is not None and endpoint_path in ("/v1/chat/completions",
+                                             "/v1/completions"):
+        raw_priority = request.headers.get(PRIORITY_HEADER)
+        try:
+            priority = (parse_priority(raw_priority)
+                        if raw_priority is not None else DEFAULT_PRIORITY)
+        except ValueError as e:
+            return _error(400, str(e))
+        tenant = qos.tenant_of(request.headers, request.remote)
+        qos_verdict = qos.decide(tenant, priority)
+        if qos_verdict.action == "shed":
+            return _error(
+                429,
+                f"tenant over rate limit; retry after "
+                f"{qos_verdict.retry_after_s}s",
+                err_type="overloaded_error",
+                headers={"Retry-After": str(qos_verdict.retry_after_s)},
+            )
+        if qos_verdict.action == "degrade":
+            clamp = qos_verdict.clamp_max_tokens
+            changed = False
+            for key in ("max_tokens", "max_completion_tokens"):
+                current = payload.get(key)
+                if isinstance(current, int) and current > clamp:
+                    payload[key] = clamp
+                    changed = True
+            if ("max_tokens" not in payload
+                    and "max_completion_tokens" not in payload):
+                # Unset means the engine applies the OpenAI default
+                # (256), which the ladder must still clamp.
+                payload["max_tokens"] = clamp
+                changed = True
+            if changed:
+                body = json.dumps(payload).encode()
+            if qos_verdict.spec_off:
+                qos_headers = {SPEC_OFF_HEADER: "1"}
+
     rewriter = get_request_rewriter()
     rewritten = rewriter.rewrite_request(body, model, endpoint_path)
     if rewritten is not body:
@@ -269,104 +326,148 @@ async def route_general_request(request: web.Request,
                 "path", request_id)
 
     max_attempts = 1 + (mgr.config.max_retries if mgr is not None else 0)
-    tried: set = set()
-    last_error: Optional[RetryableUpstreamError] = None
-    attempts = 0
-    while attempts < max_attempts:
-        candidates = usable_endpoints(healthy, exclude=tried)
-        if not candidates:
-            break
-        engine_stats = get_engine_stats_scraper().get_engine_stats()
-        request_stats = monitor.get_request_stats(time.time())
-        choice = policy.route_request(
-            candidates, engine_stats, request_stats, request.headers,
-            request_id, num_prefill_tokens, prompt_text=prompt_text,
-        )
-        if hasattr(choice, "__await__"):
-            try:
-                server_url = await choice
-            except Exception as e:  # admission rejected (can never fit)
-                monitor.on_request_kill("<unrouted>", request_id)
-                _finish_span(span, "rejected")
-                return _error(429, f"Request not admitted: {e}")
-        else:
-            server_url = choice
-        if mgr is not None and not mgr.on_attempt(server_url):
-            # Lost the half-open probe-slot race between the
-            # usable_endpoints filter and dispatch (a concurrent request
-            # took the probe): skip this endpoint without burning retry
-            # budget.
-            monitor.on_request_kill(server_url, request_id)
-            policy.on_request_complete(server_url)
-            tried.add(server_url)
-            continue
-        if span is not None:
-            span.on_routed(server_url)
-        if attempts:
-            logger.info("Failover attempt %d: re-routing %s to %s",
-                        attempts, request_id, server_url)
-        queue_delay = time.time() - in_router_time
-        logger.debug("Routing %s to %s (queued %.1f ms)",
-                     request_id, server_url, queue_delay * 1e3)
-        attempts += 1
-        try:
-            response = await _proxy_stream(
-                request, server_url, endpoint_path, body, request_id,
-                policy, store_callback, span=span, mgr=mgr,
-            )
-        except RetryableUpstreamError as e:
-            last_error = e
-            tried.add(server_url)
-            if mgr is not None:
-                mgr.retries_total += 1
-            logger.warning(
-                "Pre-stream failure from %s for %s (%s); %s",
-                server_url, request_id, e,
-                "failing over" if attempts < max_attempts
-                else "retry budget exhausted")
-            continue
-        except _BackendStreamError as e:
-            # Bytes already reached the client: no retry. Abort the
-            # connection so the client sees truncation rather than a
-            # falsely-complete body; aiohttp treats the resulting write
-            # failure as a premature disconnect (debug log), not an
-            # unhandled handler error.
-            if request.transport is not None:
-                request.transport.close()
-            return e.response
-        except _ClientDisconnectedError as e:
-            # Routine client disconnect: nothing to send and nobody to
-            # send it to — end quietly instead of surfacing a 500.
-            if e.response is not None:
-                return e.response
-            return web.Response(status=499,
-                                reason="Client Closed Request")
-        if mgr is not None and attempts > 1:
-            mgr.failovers_total += 1
-        return response
 
-    # Retry budget or candidate pool exhausted.
-    monitor.on_request_kill("<unrouted>", request_id)
-    _finish_span(span, "error")
-    if not usable_endpoints(healthy):
-        # Every serving endpoint is unhealthy or breaker-open: shed with
-        # a hint for when a probe slot next opens, so clients and
-        # autoscalers can tell "no capacity" from "broken upstream".
-        if mgr is not None:
-            mgr.shed_requests_total += 1
-        hint = (mgr.retry_after_hint([ep.url for ep in healthy or serving])
-                if mgr is not None else 1)
+    async def _dispatch() -> web.StreamResponse:
+        tried: set = set()
+        last_error: Optional[RetryableUpstreamError] = None
+        attempts = 0
+        # QoS 429 accounting (docs/qos.md): a saturated engine's 429 is
+        # retried on another backend, but when EVERY attempt came back
+        # 429 the fleet is saturated — answer 429 with the largest
+        # engine-provided Retry-After rather than hammering backends
+        # (no failover storm) or lying with a 5xx.
+        failed_attempts = 0
+        saturated_attempts = 0
+        throttle_hints: list = []
+        while attempts < max_attempts:
+            candidates = usable_endpoints(healthy, exclude=tried)
+            if not candidates:
+                break
+            engine_stats = get_engine_stats_scraper().get_engine_stats()
+            request_stats = monitor.get_request_stats(time.time())
+            choice = policy.route_request(
+                candidates, engine_stats, request_stats, request.headers,
+                request_id, num_prefill_tokens, prompt_text=prompt_text,
+            )
+            if hasattr(choice, "__await__"):
+                try:
+                    server_url = await choice
+                except Exception as e:  # admission rejected (can never fit)
+                    monitor.on_request_kill("<unrouted>", request_id)
+                    _finish_span(span, "rejected")
+                    return _error(429, f"Request not admitted: {e}")
+            else:
+                server_url = choice
+            if mgr is not None and not mgr.on_attempt(server_url):
+                # Lost the half-open probe-slot race between the
+                # usable_endpoints filter and dispatch (a concurrent
+                # request took the probe): skip this endpoint without
+                # burning retry budget.
+                monitor.on_request_kill(server_url, request_id)
+                policy.on_request_complete(server_url)
+                tried.add(server_url)
+                continue
+            if span is not None:
+                span.on_routed(server_url)
+            if attempts:
+                logger.info("Failover attempt %d: re-routing %s to %s",
+                            attempts, request_id, server_url)
+            queue_delay = time.time() - in_router_time
+            logger.debug("Routing %s to %s (queued %.1f ms)",
+                         request_id, server_url, queue_delay * 1e3)
+            attempts += 1
+            try:
+                response = await _proxy_stream(
+                    request, server_url, endpoint_path, body, request_id,
+                    policy, store_callback, span=span, mgr=mgr,
+                    extra_headers=qos_headers,
+                )
+            except RetryableUpstreamError as e:
+                last_error = e
+                tried.add(server_url)
+                failed_attempts += 1
+                if e.status == 429:
+                    saturated_attempts += 1
+                    throttle_hints.append(max(1, int(e.retry_after or 1)))
+                if mgr is not None:
+                    mgr.retries_total += 1
+                logger.warning(
+                    "Pre-stream failure from %s for %s (%s); %s",
+                    server_url, request_id, e,
+                    "failing over" if attempts < max_attempts
+                    else "retry budget exhausted")
+                continue
+            except _BackendStreamError as e:
+                # Bytes already reached the client: no retry. Abort the
+                # connection so the client sees truncation rather than a
+                # falsely-complete body; aiohttp treats the resulting
+                # write failure as a premature disconnect (debug log),
+                # not an unhandled handler error.
+                if request.transport is not None:
+                    request.transport.close()
+                return e.response
+            except _ClientDisconnectedError as e:
+                # Routine client disconnect: nothing to send and nobody
+                # to send it to — end quietly instead of surfacing a 500.
+                if e.response is not None:
+                    return e.response
+                return web.Response(status=499,
+                                    reason="Client Closed Request")
+            if mgr is not None and attempts > 1:
+                mgr.failovers_total += 1
+            return response
+
+        # Retry budget or candidate pool exhausted.
+        monitor.on_request_kill("<unrouted>", request_id)
+        if failed_attempts and failed_attempts == saturated_attempts:
+            # Every attempted engine said 429: the fleet is saturated,
+            # not broken. Relay the longest backoff any engine asked
+            # for so clients respect it instead of re-storming.
+            _finish_span(span, "rejected")
+            hint = max(throttle_hints) if throttle_hints else 1
+            return _error(
+                429,
+                f"all {len(tried)} engine(s) serving model {model} "
+                f"are saturated; retry after {hint}s",
+                err_type="overloaded_error",
+                headers={"Retry-After": str(hint)},
+            )
+        _finish_span(span, "error")
+        if not usable_endpoints(healthy):
+            # Every serving endpoint is unhealthy or breaker-open: shed
+            # with a hint for when a probe slot next opens, so clients
+            # and autoscalers can tell "no capacity" from "broken
+            # upstream".
+            if mgr is not None:
+                mgr.shed_requests_total += 1
+            hint = (mgr.retry_after_hint(
+                        [ep.url for ep in healthy or serving])
+                    if mgr is not None else 1)
+            return _error(
+                503,
+                f"No healthy endpoint currently serves model {model}",
+                err_type="service_unavailable_error",
+                headers={"Retry-After": str(hint)},
+            )
         return _error(
-            503, f"No healthy endpoint currently serves model {model}",
-            err_type="service_unavailable_error",
-            headers={"Retry-After": str(hint)},
+            502,
+            f"Upstream engine error after {len(tried)} attempt(s): "
+            f"{last_error}",
+            err_type="upstream_error",
         )
-    return _error(
-        502,
-        f"Upstream engine error after {len(tried)} attempt(s): "
-        f"{last_error}",
-        err_type="upstream_error",
-    )
+
+    # Weighted-fair admission (docs/qos.md): with --qos-max-concurrency
+    # set, the whole dispatch (including the stream) holds one gate
+    # slot; waiters dequeue stride-fair across tenants.
+    gate = qos.gate if (qos is not None
+                        and qos_verdict is not None) else None
+    if gate is None:
+        return await _dispatch()
+    await gate.acquire(qos_verdict.tenant, qos_verdict.priority)
+    try:
+        return await _dispatch()
+    finally:
+        gate.release()
 
 
 async def _route_disagg(request: web.Request, body: bytes, payload: dict,
@@ -555,7 +656,9 @@ async def _proxy_stream(request: web.Request, server_url: str,
                         endpoint_path: str, body: bytes, request_id: str,
                         policy, store_callback=None,
                         span=None, mgr=None,
-                        reject_statuses: tuple = ()) -> web.StreamResponse:
+                        reject_statuses: tuple = (),
+                        extra_headers: Optional[dict] = None
+                        ) -> web.StreamResponse:
     """One proxy attempt. Raises ``RetryableUpstreamError`` when the
     backend failed before anything was streamed to the client; once the
     client response is prepared, failures are terminal.
@@ -572,6 +675,8 @@ async def _proxy_stream(request: web.Request, server_url: str,
         if k.lower() not in _HOP_HEADERS
     }
     fwd_headers["x-request-id"] = request_id
+    if extra_headers:
+        fwd_headers.update(extra_headers)
 
     start_time = time.time()
     monitor.on_request_start(server_url, request_id, start_time)
@@ -591,6 +696,21 @@ async def _proxy_stream(request: web.Request, server_url: str,
                 raise RetryableUpstreamError(
                     f"upstream returned {backend.status}",
                     status=backend.status,
+                )
+            if backend.status == 429:
+                # QoS shed (docs/qos.md): another engine may have
+                # room, so fail over — but carry the engine's
+                # Retry-After so all-saturated exhaustion can relay
+                # the honest backoff. No breaker blame (see
+                # RetryableUpstreamError).
+                try:
+                    retry_after = int(
+                        backend.headers.get("Retry-After", "1"))
+                except ValueError:
+                    retry_after = 1
+                raise RetryableUpstreamError(
+                    "upstream saturated (429)", status=429,
+                    retry_after=retry_after,
                 )
             if backend.status in reject_statuses:
                 # Caller-designated rejection statuses (disagg handoff
@@ -648,8 +768,12 @@ async def _proxy_stream(request: web.Request, server_url: str,
                 store_callback(bytes(cache_buffer))
             _finish_span(span, "ok")
             return response
-    except RetryableUpstreamError:
-        blame = True
+    except RetryableUpstreamError as e:
+        # A 429 is a healthy engine answering fast that it is full —
+        # success for breaker purposes. Blaming it would open breakers
+        # fleet-wide exactly when the fleet is saturated, converting
+        # overload into an outage.
+        blame = e.status != 429
         raise
     except _BackendStreamError as e:
         blame = True

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 import requests
@@ -89,6 +89,10 @@ _SUMMARY_HISTS = {
     "vllm:request_prefill_time_seconds": "request_prefill_time",
     "vllm:request_awaiting_kv_time_seconds": "request_awaiting_kv_time",
     "vllm:request_decode_time_seconds": "request_decode_time",
+    # Preempt-to-offload restore latency (docs/qos.md): allocate +
+    # fetch_many + write_page time when a preempted victim's KV comes
+    # back from the offload tier instead of being recomputed.
+    "vllm:preempt_restore_latency_seconds": "preempt_restore_latency",
 }
 
 # Engine metrics the router deliberately does NOT scrape: request
@@ -166,6 +170,16 @@ class EngineStats:
     request_decode_time_count: float = 0.0
     # Zero-loss drain (docs/fleet.md): 1 while the engine is draining.
     engine_draining: float = 0.0
+    # QoS under overload (docs/qos.md): labeled counters — requests
+    # shed at the engine's 429 gate per priority class
+    # (vllm:qos_shed_total{class=...}), preemptions per outcome
+    # (vllm:preempt_offload_total{outcome="offloaded"|"recompute"}) —
+    # and the preempt-restore latency histogram's running sum/count.
+    qos_shed_by_class: Dict[str, float] = field(default_factory=dict)
+    preempt_offload_by_outcome: Dict[str, float] = field(
+        default_factory=dict)
+    preempt_restore_latency_sum: float = 0.0
+    preempt_restore_latency_count: float = 0.0
 
     @classmethod
     def from_prometheus_text(cls, text: str) -> "EngineStats":
@@ -178,6 +192,14 @@ class EngineStats:
                     setattr(stats,
                             f"{_SUMMARY_HISTS[base]}_{suffix}",
                             sample.value)
+                    continue
+                if sample.name == "vllm:qos_shed_total":
+                    stats.qos_shed_by_class[
+                        sample.labels.get("class", "")] = sample.value
+                    continue
+                if sample.name == "vllm:preempt_offload_total":
+                    stats.preempt_offload_by_outcome[
+                        sample.labels.get("outcome", "")] = sample.value
                     continue
                 if (sample.name == "vllm:engine_kv_cache_dtype"
                         and sample.value == 1.0):

@@ -63,6 +63,7 @@ _SECTION_CLASSES = {
     "parallel": "ParallelConfig",
     "lora": "LoRAConfig",
     "offload": "OffloadConfig",
+    "qos": "QoSConfig",
 }
 
 # Fleet-spec classes whose dataclass fields are operator surface,

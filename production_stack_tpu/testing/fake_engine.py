@@ -17,6 +17,15 @@ Fault injection (for resilience tests): ``--fault MODE`` at startup or
 - ``kv_missing``     disagg: a prefill-role fake emits descriptors whose
                      pages are unavailable; a decode-role fake answers
                      409 to every handoff (KV never restorable here)
+- ``overload``       QoS (docs/qos.md): the fake is "saturated" — it
+                     keeps serving ``interactive`` requests but answers
+                     429 + Retry-After to every other priority class,
+                     counting them in ``vllm:qos_shed_total{class=...}``
+                     and emitting a ``qos_shed`` span event. With
+                     ``--priority-aware`` the class comes from the
+                     request's ``x-priority`` header; without it every
+                     request is treated as the deployment default
+                     (batch), i.e. everything is shed.
 - ``null``/absent    healthy (clears a previously set fault)
 
 Disaggregation (docs/disaggregation.md): ``--role prefill|decode|both``
@@ -54,11 +63,19 @@ from aiohttp import web
 # reuses the real engine's tracer so router-side stitching tests see
 # genuine {"span": "engine_request"} lines without a TPU.
 from production_stack_tpu.engine.tracing import EngineTracer
+from production_stack_tpu.qos import (
+    DEFAULT_PRIORITY,
+    parse_priority,
+    Priority,
+    PRIORITY_HEADER,
+    priority_name,
+    shed_counter_dict,
+)
 
 
 FAULT_MODES = (
     "error500", "hang", "slow_first_token", "abort_mid_stream", "unhealthy",
-    "kv_missing",
+    "kv_missing", "overload",
 )
 
 ENGINE_ROLES = ("prefill", "decode", "both")
@@ -68,7 +85,8 @@ class FakeEngineState:
     def __init__(self, model: str, speed: float, ttft: float,
                  max_tokens_default: int = 32,
                  fault: Optional[str] = None, fault_ttft: float = 5.0,
-                 role: str = "both"):
+                 role: str = "both", priority_aware: bool = False,
+                 max_concurrency: int = 0):
         self.model = model
         self.speed = speed  # tokens per second
         self.ttft = ttft  # seconds before first token
@@ -84,10 +102,43 @@ class FakeEngineState:
         self.disagg_decodes = 0  # handoffs streamed
         self.draining = False  # POST /drain flips; 503s new admissions
         self.cache_usage = None  # POST /gauges override; None = derived
+        # QoS (docs/qos.md): when priority-aware the fake reads the
+        # x-priority header; the overload fault sheds non-interactive
+        # classes and these counters back vllm:qos_shed_total.
+        self.priority_aware = priority_aware
+        self.qos_shed_counts = shed_counter_dict()
+        # Capacity model (bench.py overload phase): > 0 = that many
+        # decode slots; excess requests QUEUE (waiting gauge rises,
+        # TTFT inflates) exactly like a saturated pod — without it the
+        # fake serves unlimited concurrency and overload is invisible.
+        self.max_concurrency = max_concurrency
+        self._slots: Optional[asyncio.Semaphore] = None
         # Real EngineTracer (engine/tracing.py): fakes emit the same
         # engine-span lines and serve /debug/trace/{id} as the real
         # server. None disables tracing entirely.
         self.tracer: Optional[EngineTracer] = None
+
+    def slot_sem(self) -> Optional[asyncio.Semaphore]:
+        # Lazily created so the semaphore binds to the serving loop.
+        if self.max_concurrency > 0 and self._slots is None:
+            self._slots = asyncio.Semaphore(self.max_concurrency)
+        return self._slots
+
+
+def _request_priority(state: FakeEngineState,
+                      request: web.Request) -> Priority:
+    """Priority class of a request: the x-priority header when the fake
+    is --priority-aware (malformed values fall back to the default, the
+    fake never 400s on it), else the deployment default."""
+    if not state.priority_aware:
+        return DEFAULT_PRIORITY
+    raw = request.headers.get(PRIORITY_HEADER)
+    if not raw:
+        return DEFAULT_PRIORITY
+    try:
+        return parse_priority(raw)
+    except ValueError:
+        return DEFAULT_PRIORITY
 
 
 async def _apply_api_fault(state: FakeEngineState,
@@ -103,6 +154,30 @@ async def _apply_api_fault(state: FakeEngineState,
                                   "another replica"}},
             status=503, headers={"Retry-After": "1"},
         )
+    if state.fault == "overload":
+        # Saturated-but-healthy: interactive traffic still flows, every
+        # other class gets the same honest 429 + Retry-After the real
+        # engine's shed gate produces (never a 5xx, never a drop).
+        pri = _request_priority(state, request)
+        if pri != Priority.INTERACTIVE:
+            state.qos_shed_counts[priority_name(pri)] += 1
+            if state.tracer is not None:
+                seq_id = f"shed-{uuid.uuid4().hex[:12]}"
+                state.tracer.start(
+                    seq_id,
+                    request_id=request.headers.get("x-request-id"),
+                    prompt_tokens=0)
+                state.tracer.event(seq_id, "qos_shed",
+                                   priority=priority_name(pri),
+                                   retry_after_s=1)
+                state.tracer.finish(seq_id, reason="shed",
+                                    arrival_ts=time.time())
+            return web.json_response(
+                {"error": {"message": "engine overloaded (injected); "
+                                      "retry later",
+                           "type": "overloaded_error"}},
+                status=429, headers={"Retry-After": "1"},
+            )
     if state.fault == "error500":
         return web.json_response(
             {"error": {"message": "injected fault", "type": "server_error"}},
@@ -167,6 +242,13 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                      request_id=request.headers.get("x-request-id"),
                      prompt_tokens=8)
 
+    sem = state.slot_sem()
+    if sem is not None:
+        state.waiting += 1
+        try:
+            await sem.acquire()
+        finally:
+            state.waiting -= 1
     state.running += 1
     try:
         await asyncio.sleep(state.ttft)
@@ -238,6 +320,8 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         return resp
     finally:
         state.running -= 1
+        if sem is not None:
+            sem.release()
 
 
 async def completions(request: web.Request) -> web.Response:
@@ -248,6 +332,13 @@ async def completions(request: web.Request) -> web.Response:
         return fault_resp
     body = await request.json()
     n_tokens = int(body.get("max_tokens") or state.max_tokens_default)
+    sem = state.slot_sem()
+    if sem is not None:
+        state.waiting += 1
+        try:
+            await sem.acquire()
+        finally:
+            state.waiting -= 1
     state.running += 1
     try:
         await asyncio.sleep(state.ttft + n_tokens / state.speed)
@@ -267,6 +358,8 @@ async def completions(request: web.Request) -> web.Response:
         })
     finally:
         state.running -= 1
+        if sem is not None:
+            sem.release()
 
 
 async def disagg_prefill(request: web.Request) -> web.Response:
@@ -554,6 +647,11 @@ async def metrics(request: web.Request) -> web.Response:
         f"vllm:gpu_cache_usage_perc {float(cache_usage)}",
         "# TYPE vllm:engine_draining gauge",
         f"vllm:engine_draining {float(state.draining)}",
+        "# TYPE vllm:qos_shed_total counter",
+        *(
+            "vllm:qos_shed_total{class=\"" f"{cls}\"}} {float(count)}"
+            for cls, count in sorted(state.qos_shed_counts.items())
+        ),
         "",
     ])
     return web.Response(text=text, content_type="text/plain")
@@ -563,10 +661,13 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
                       ttft: float = 0.02, fault: Optional[str] = None,
                       fault_ttft: float = 5.0, role: str = "both",
                       span_log: Optional[str] = None,
-                      trace_ring: int = 256) -> web.Application:
+                      trace_ring: int = 256,
+                      priority_aware: bool = False,
+                      max_concurrency: int = 0) -> web.Application:
     state = FakeEngineState(model=model, speed=speed, ttft=ttft,
                             fault=fault, fault_ttft=fault_ttft,
-                            role=role)
+                            role=role, priority_aware=priority_aware,
+                            max_concurrency=max_concurrency)
     if span_log or trace_ring > 0:
         # Same default as the real server: flight recorder on, span
         # log only when a path is given.
@@ -605,6 +706,16 @@ def main(argv=None) -> None:
     parser.add_argument("--role", default="both", choices=ENGINE_ROLES,
                         help="engine role reported in /health "
                              "(disaggregated-serving discovery)")
+    parser.add_argument("--priority-aware", action="store_true",
+                        help="honor the x-priority request header "
+                             "(QoS tests; docs/qos.md) — the overload "
+                             "fault then sheds only non-interactive "
+                             "classes")
+    parser.add_argument("--max-concurrency", type=int, default=0,
+                        help="decode-slot capacity model: requests "
+                             "beyond this many queue (TTFT inflates) "
+                             "instead of running concurrently; 0 = "
+                             "unlimited")
     parser.add_argument("--span-log", default=None,
                         help="Emit engine-span JSON lines to this "
                              "path ('-' = the process log), same "
@@ -613,7 +724,9 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
     app = build_fake_engine(args.model, args.speed, args.ttft,
                             fault=args.fault, fault_ttft=args.fault_ttft,
-                            role=args.role, span_log=args.span_log)
+                            role=args.role, span_log=args.span_log,
+                            priority_aware=args.priority_aware,
+                            max_concurrency=args.max_concurrency)
     web.run_app(app, host=args.host, port=args.port, print=None)
 
 

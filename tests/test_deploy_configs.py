@@ -128,6 +128,10 @@ def test_dashboard_metrics_exist():
         "vllm:num_requests_running", "vllm:num_requests_waiting",
         "vllm:gpu_cache_usage_perc", "vllm:gpu_prefix_cache_hit_rate",
         "vllm:num_preemptions_total",
+        # QoS labeled counters rendered by engine/server.py /metrics
+        # (and the router's aggregated re-export) rather than by
+        # EngineMetrics or a prometheus_client Gauge (docs/qos.md).
+        "vllm:preempt_offload_total", "vllm:qos_shed_total",
     }
     from production_stack_tpu.engine.metrics import EngineMetrics
     for line in EngineMetrics().render():
