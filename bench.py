@@ -556,6 +556,18 @@ def run_worker(impl: str, tpu: bool) -> None:
         extra["kv_page_capacity"] // pages_per_seq)
     if mfu is not None:
         extra["mfu"] = round(mfu, 4)
+    # Device performance observatory (docs/observability.md): compile
+    # counts, HBM category peaks, and the engine's own useful-token
+    # MFU so benchcompare can flag compile storms and memory
+    # regressions across BENCH_* rounds.
+    obs = getattr(engine.runner, "observatory", None)
+    if obs is not None:
+        extra["compile_events"] = obs.compile_events_by_kind()
+        extra["compile_seconds"] = {
+            k: round(v, 3)
+            for k, v in obs.compile_seconds_by_kind().items()}
+        extra["hbm_bytes"] = obs.hbm_bytes()
+        extra["observatory_mfu"] = round(obs.mfu(), 4)
     print(json.dumps({
         "metric": (f"multi-round-qa-style req/s, {config.model.name}, "
                    "1 TPU chip" if tpu else

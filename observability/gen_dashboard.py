@@ -256,6 +256,38 @@ def build():
                       'vllm:preempt_restore_latency_seconds_bucket'
                       '[5m])))', "p99")],
               20, 86, w=4, unit="s"),
+        # ---- Device performance observatory (docs/observability.md) --------
+        row("Device Performance", 92),
+        panel("Compile Events by Kind (rate)",
+              [target('sum by(kind) (rate('
+                      'vllm:engine_compile_events[5m]))',
+                      "{{kind}}")],
+              0, 93),
+        panel("Compile Wall Time by Kind (rate)",
+              [target('sum by(kind) (rate('
+                      'vllm:engine_compile_seconds[5m]))',
+                      "{{kind}}")],
+              8, 93, unit="s"),
+        panel("Executable Cache Size by Kind",
+              [target('vllm:engine_executable_cache_size',
+                      "{{kind}} {{server}}")],
+              16, 93),
+        panel("HBM Bytes by Category",
+              [target('sum by(category) (vllm:engine_hbm_bytes)',
+                      "{{category}}")],
+              0, 100, unit="bytes"),
+        panel("Model FLOPs Utilization (useful tokens)",
+              [target('vllm:engine_mfu')],
+              8, 100, w=4, unit="percentunit"),
+        panel("Step Device Seconds by Kind (rate)",
+              [target('sum by(kind) (rate('
+                      'vllm:engine_step_device_seconds[5m]))',
+                      "{{kind}}")],
+              12, 100, w=6, unit="s"),
+        panel("Attention Impl (one-hot)",
+              [target('vllm:engine_attention_impl',
+                      "{{phase}}={{impl}} {{server}}")],
+              18, 100, w=6, kind="stat"),
     ]
     return {
         "title": "TPU Stack — Serving Overview",

@@ -1,0 +1,164 @@
+"""Compare two bench.py JSON result files and flag regressions.
+
+Usage::
+
+    python -m production_stack_tpu.benchcompare old.json new.json \
+        [--threshold 0.05]
+
+Each input file holds the JSON lines (or a single object, or a JSON
+array) printed by ``bench.py`` — objects of the shape
+``{"metric": ..., "value": ..., "unit": ..., "extra": {...}}``. The
+tool flattens every numeric field (including nested ``extra`` dicts
+such as the device observatory's ``compile_events`` /
+``hbm_bytes``) into dotted keys, classifies each key as
+higher-is-better or lower-is-better by name, and compares the two
+runs. Exit status is 0 when no metric regressed beyond the relative
+threshold and 1 otherwise — suitable for CI gates around the
+BENCH_* rounds.
+
+Keys whose direction cannot be inferred (and non-numeric fields) are
+reported as informational only and never fail the comparison.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Substring → direction heuristics, checked in order. The first
+# matching fragment wins, so more specific fragments go first
+# (``tokens_per_s`` must win over the lower-is-better ``_s``).
+_HIGHER_BETTER = (
+    "tok_s", "tokens_per_s", "tokens/s", "per_s", "req_per_s", "rate",
+    "goodput", "mfu", "jain", "acceptance", "hit", "overlap",
+    "capacity", "throughput",
+)
+_LOWER_BETTER = (
+    "p50", "p90", "p99", "latency", "itl", "ttft", "seconds", "_ms",
+    "_s", "pad_ratio", "compile_events", "queueing", "hbm_bytes",
+    "shed", "preempt",
+)
+
+
+def classify(key: str) -> Optional[str]:
+    """Return ``"higher"``, ``"lower"``, or None when unknown."""
+    low = key.lower()
+    for frag in _HIGHER_BETTER:
+        if frag in low:
+            return "higher"
+    for frag in _LOWER_BETTER:
+        if frag in low:
+            return "lower"
+    return None
+
+
+def _flatten(prefix: str, value: Any, out: Dict[str, float]) -> None:
+    if isinstance(value, bool):
+        out[prefix] = 1.0 if value else 0.0
+    elif isinstance(value, (int, float)):
+        out[prefix] = float(value)
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+
+
+def _records(text: str) -> List[Dict[str, Any]]:
+    """Parse a bench results file: a JSON array, a single object, or
+    one JSON object per line (bench.py's native output)."""
+    text = text.strip()
+    if not text:
+        return []
+    try:
+        data = json.loads(text)
+    except ValueError:
+        data = [json.loads(line) for line in text.splitlines()
+                if line.strip()]
+    if isinstance(data, dict):
+        data = [data]
+    return [rec for rec in data if isinstance(rec, dict)]
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    with open(path) as fh:
+        records = _records(fh.read())
+    out: Dict[str, float] = {}
+    for rec in records:
+        name = str(rec.get("metric", "bench"))
+        # Fold the unit into the key so direction classification sees
+        # it ("req/s" -> ".value.req_per_s" -> higher-is-better).
+        unit = str(rec.get("unit", "")).replace("/", "_per_")
+        key = f"{name}.value.{unit}" if unit else f"{name}.value"
+        _flatten(key, rec.get("value"), out)
+        _flatten(name, rec.get("extra", {}), out)
+    return out
+
+
+def compare(old: Dict[str, float], new: Dict[str, float],
+            threshold: float) -> Tuple[List[str], List[str]]:
+    """Return (report_lines, regression_lines)."""
+    lines: List[str] = []
+    regressions: List[str] = []
+    for key in sorted(set(old) & set(new)):
+        before, after = old[key], new[key]
+        direction = classify(key)
+        if before == after:
+            delta = 0.0
+        elif before == 0:
+            delta = float("inf") if after > 0 else float("-inf")
+        else:
+            delta = (after - before) / abs(before)
+        regressed = False
+        if direction == "higher":
+            regressed = delta < -threshold
+        elif direction == "lower":
+            regressed = delta > threshold
+        tag = ("?" if direction is None
+               else "REGRESSION" if regressed else "ok")
+        line = (f"{key}: {before:g} -> {after:g} "
+                f"({delta:+.1%}) [{tag}]")
+        lines.append(line)
+        if regressed:
+            regressions.append(line)
+    for key in sorted(set(old) - set(new)):
+        lines.append(f"{key}: {old[key]:g} -> (missing)")
+    for key in sorted(set(new) - set(old)):
+        lines.append(f"{key}: (new) -> {new[key]:g}")
+    return lines, regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m production_stack_tpu.benchcompare",
+        description="Compare two bench.py JSON outputs; exit 1 when "
+                    "any direction-classified metric regresses beyond "
+                    "the relative threshold.")
+    parser.add_argument("old", help="baseline bench JSON file")
+    parser.add_argument("new", help="candidate bench JSON file")
+    parser.add_argument("--threshold", type=float, default=0.05,
+                        help="relative regression tolerance "
+                             "(default 0.05 = 5%%)")
+    args = parser.parse_args(argv)
+
+    old = load_metrics(args.old)
+    new = load_metrics(args.new)
+    if not old or not new:
+        print("benchcompare: no numeric metrics found "
+              f"(old={len(old)}, new={len(new)})", file=sys.stderr)
+        return 2
+    lines, regressions = compare(old, new, args.threshold)
+    for line in lines:
+        print(line)
+    if regressions:
+        print(f"\n{len(regressions)} regression(s) beyond "
+              f"{args.threshold:.0%}:", file=sys.stderr)
+        for line in regressions:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    print("\nno regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

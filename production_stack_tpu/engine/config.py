@@ -373,6 +373,11 @@ class EngineConfig:
     # its pages are unreachable (remote tier down) before degrading to
     # a full prompt recompute. 0 = recompute immediately on a miss.
     handoff_timeout_s: float = 30.0
+    # Per-chip peak FLOP/s for the observatory's MFU gauge
+    # (engine/perf_observatory.py). 0 = resolve from the device-kind
+    # table; unknown devices (including CPU) then report MFU 0 rather
+    # than a guessed utilization.
+    device_peak_flops: float = 0.0
 
     def __post_init__(self):
         if self.engine_role not in ("prefill", "decode", "both"):
@@ -381,6 +386,8 @@ class EngineConfig:
                 f"(got {self.engine_role!r})")
         if self.handoff_timeout_s < 0:
             raise ValueError("handoff_timeout_s must be >= 0")
+        if self.device_peak_flops < 0:
+            raise ValueError("device_peak_flops must be >= 0")
         if self.engine_role == "prefill":
             # A prefill-role engine never decodes past the first
             # sampled token, so decode-side machinery is dead weight

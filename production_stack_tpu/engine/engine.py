@@ -94,6 +94,12 @@ class LLMEngine:
         # by the execute helpers for the flight recorder (tracer set
         # only); drained by _account_step.
         self._step_note: Optional[dict] = None
+        # (kind, useful tokens) for the step about to be accounted,
+        # staged by the execute helpers for the device performance
+        # observatory's step/MFU ledger; drained by _account_step.
+        # A cheap tuple, staged unconditionally (unlike _step_note,
+        # which allocates a dict and is tracer-gated).
+        self._obs_note: Optional[tuple] = None
         self.offload = None
         if config.offload.enable:
             self._init_offload()
@@ -570,6 +576,12 @@ class LLMEngine:
         carrying the row/spec note the execute helper staged."""
         self.metrics.on_pipeline_step(
             host_s=host_s, device_wait_s=wait_s, ahead=ahead)
+        obs_note = self._obs_note
+        if obs_note is not None:
+            self._obs_note = None
+            obs = getattr(self.runner, "observatory", None)
+            if obs is not None:
+                obs.on_step(obs_note[0], wait_s, obs_note[1])
         if self._tracer is not None:
             note = self._step_note or {}
             self._step_note = None
@@ -607,6 +619,9 @@ class LLMEngine:
                     "prefill_rows": len(plan.prefill.chunks),
                     "row_bucket": self.runner.prefill_width,
                 }
+        self._obs_note = ("prefill",
+                          sum(len(c.chunk_tokens)
+                              for c in plan.prefill.chunks))
         return tr - td
 
     def _execute_decode_sync(self, plan, outputs) -> float:
@@ -618,7 +633,7 @@ class LLMEngine:
         now = time.time()
         spec_drafts = plan.decode.drafts
         with self._lock:
-            drafted = accepted = 0
+            drafted = accepted = step_tokens = 0
             for i, (seq, toks) in enumerate(
                     zip(plan.decode.seqs, token_lists)):
                 if spec_drafts is not None:
@@ -637,6 +652,7 @@ class LLMEngine:
                     outputs.append(self._delta(
                         seq, tok,
                         lp_lists[i][k] if lp_lists else None))
+                step_tokens += emitted
                 self.metrics.on_decode_tokens(seq, emitted, now)
                 if spec_drafts is not None:
                     self.scheduler.on_spec_executed(seq)
@@ -652,6 +668,8 @@ class LLMEngine:
                     "spec_drafted": drafted,
                     "spec_accepted": accepted,
                 }
+        self._obs_note = ("spec" if spec_drafts is not None
+                          else "decode", step_tokens)
         return tr - td
 
     def _execute_unified(self, plan, outputs) -> float:
@@ -675,7 +693,7 @@ class LLMEngine:
             pad_rows=(self.runner.last_unified_rows
                       - len(chunks) - len(seqs)))
         with self._lock:
-            drafted = accepted = 0
+            drafted = accepted = step_tokens = 0
             for i, (seq, toks) in enumerate(zip(seqs, token_lists)):
                 if spec_drafts is not None:
                     drafted += len(spec_drafts[i])
@@ -689,6 +707,7 @@ class LLMEngine:
                     outputs.append(self._delta(
                         seq, tok,
                         lp_lists[i][k] if lp_lists else None))
+                step_tokens += emitted
                 self.metrics.on_decode_tokens(seq, emitted, now)
                 if spec_drafts is not None:
                     self.scheduler.on_spec_executed(seq)
@@ -717,6 +736,9 @@ class LLMEngine:
                     "spec_drafted": drafted,
                     "spec_accepted": accepted,
                 }
+        self._obs_note = ("unified",
+                          step_tokens + sum(len(c.chunk_tokens)
+                                            for c in chunks))
         return tr - td
 
     # ---- overlapped async pipeline (docs/async_pipeline.md) ---------------
@@ -851,7 +873,7 @@ class LLMEngine:
         expected = handle.expected_lens
         spec_drafts = handle.drafts if handle.is_spec else None
         with self._lock:
-            drafted = accepted = 0
+            drafted = accepted = step_tokens = 0
             for i, (seq, toks) in enumerate(
                     zip(handle.rows, token_lists)):
                 if seq is None:  # plan-ahead masked slot
@@ -878,6 +900,7 @@ class LLMEngine:
                     outputs.append(self._delta(
                         seq, tok,
                         lp_lists[i][k] if lp_lists else None))
+                step_tokens += emitted
                 self.metrics.on_decode_tokens(seq, emitted, now)
                 if spec_drafts is not None:
                     self.scheduler.on_spec_executed(seq)
@@ -892,6 +915,8 @@ class LLMEngine:
                     "spec_drafted": drafted,
                     "spec_accepted": accepted,
                 }
+        self._obs_note = ("spec" if handle.is_spec else "decode",
+                          step_tokens)
         self._pop_finished(outputs)
         return outputs, wait_s
 

@@ -19,6 +19,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.perf_observatory import (
+    InstrumentedJit,
+    PerfObservatory,
+)
 from production_stack_tpu.engine.scheduler import DecodePlan, PrefillPlan
 from production_stack_tpu.engine.sequence import Sequence, decode_budget
 from production_stack_tpu.models.registry import get_model
@@ -457,6 +461,31 @@ class ModelRunner:
                 params = quantize_params(params, model_config)
         self.params = shard_params(params, model_config, mesh)
 
+        # Device performance observatory (engine/perf_observatory.py):
+        # exact param-tree sizes (array metadata only — no host
+        # reads), the real device kind for the peak-FLOPs table, and
+        # the resolved attention impls so the silent XLA fallback is
+        # an alarmable gauge rather than a log line. Set to None to
+        # disable every hook (the parity tests pin that path).
+        _leaves = jax.tree_util.tree_leaves(self.params)
+        try:
+            _device_kind = getattr(jax.devices()[0], "device_kind", "")
+        except Exception:
+            _device_kind = ""
+        self.observatory = PerfObservatory(
+            config,
+            param_count=sum(int(getattr(x, "size", 0))
+                            for x in _leaves),
+            params_bytes=sum(int(getattr(x, "nbytes", 0))
+                             for x in _leaves),
+            device_kind=_device_kind)
+        self.observatory.set_attention_impl(
+            "decode", model_config.attention_impl_decode
+            or model_config.attention_impl)
+        self.observatory.set_attention_impl(
+            "prefill", model_config.attention_impl_prefill
+            or model_config.attention_impl)
+
         # Head-major paged cache: [L, kv_heads, pages, d, page_size].
         # The kv axis is major so TP shards a leading axis; pages are
         # token-minor so the Pallas kernels DMA (d, 128)-tile-aligned
@@ -545,11 +574,11 @@ class ModelRunner:
                 config.lora.max_lora_rank,
             )
 
-        self._step_jit = jax.jit(
+        self._step_jit = InstrumentedJit("step", jax.jit(
             self._step_impl,
             static_argnames=("sample_index_mode", "want_logprobs"),
             donate_argnums=(1, 2),  # k_cache, v_cache
-        )
+        ), self)
         # Decode burst: K decode iterations fused into one compiled
         # program via lax.scan — sampled tokens feed back on device
         # and per-sequence budgets + stop sets are evaluated on device
@@ -559,12 +588,12 @@ class ModelRunner:
         # mixed-progress batches). One dispatch + one device_get per K
         # tokens; on a tunneled TPU (60 ms+ RTT per sync) this is the
         # difference between host-bound and device-bound serving.
-        self._decode_burst_jit = jax.jit(
+        self._decode_burst_jit = InstrumentedJit("decode_burst", jax.jit(
             (self._decode_burst_deferred_impl if self._deferred
              else self._decode_burst_impl),
             static_argnames=("num_steps", "want_logprobs"),
             donate_argnums=(1, 2),  # k_cache, v_cache
-        )
+        ), self)
         if self._sp_size > 1:
             from production_stack_tpu.parallel.context_serving import (
                 sp_prefill_forward,
@@ -603,9 +632,11 @@ class ModelRunner:
                     return (sampled,) + lp, k_cache, v_cache
                 return sampled, k_cache, v_cache
 
-            self._sp_prefill_jit = jax.jit(
-                _sp_step, donate_argnums=(1, 2),
-                static_argnames=("want_logprobs",))
+            self._sp_prefill_jit = InstrumentedJit(
+                "sp_prefill",
+                jax.jit(_sp_step, donate_argnums=(1, 2),
+                        static_argnames=("want_logprobs",)),
+                self)
 
         # Speculative verify (docs/speculative.md): ONE fixed-shape
         # program scores S = speculative_k + 1 positions per decode
@@ -640,11 +671,14 @@ class ModelRunner:
                     spec_model = copy.copy(model_config)
                     spec_model.attention_impl_prefill = "xla"
             self._spec_model = spec_model
-            self._spec_jit = jax.jit(
+            self.observatory.set_attention_impl(
+                "spec_verify", spec_model.attention_impl_prefill
+                or spec_model.attention_impl)
+            self._spec_jit = InstrumentedJit("spec_verify", jax.jit(
                 self._spec_verify_impl,
                 static_argnames=("want_logprobs",),
                 donate_argnums=(1, 2),  # k_cache, v_cache
-            )
+            ), self)
 
         # Unified ragged step (docs/unified_step.md): ONE jitted
         # program serves genuinely mixed batches — decode/draft rows
@@ -699,11 +733,23 @@ class ModelRunner:
                     unified_model = copy.copy(unified_model)
                     unified_model.attention_impl_prefill = "xla"
             self._unified_model = unified_model
-            self._unified_jit = jax.jit(
+            self.observatory.set_attention_impl(
+                "unified", unified_model.attention_impl_prefill
+                or unified_model.attention_impl)
+            self._unified_jit = InstrumentedJit("unified", jax.jit(
                 self._unified_impl,
                 static_argnames=("want_logprobs",),
                 donate_argnums=(1, 2),  # k_cache, v_cache
-            )
+            ), self)
+
+    def _record_timing(self, kind: str, t: int, wall: float) -> None:
+        """PSTPU_TIMING walls: keep the log line, and fold the same
+        wall into the observatory's dispatch ledger so
+        ``GET /debug/compiles`` carries per-kind timing aggregates."""
+        _timing_log(kind, t, wall)
+        obs = self.observatory
+        if obs is not None:
+            obs.on_timing(kind, wall)
 
     def _spec_lowering_error(self, model_config,
                              config) -> Optional[str]:
@@ -1784,7 +1830,7 @@ class ModelRunner:
         if _TIMING:
             if host is None:  # async dispatch: sync so the wall is real
                 jax.device_get(sampled)
-            _timing_log("prefill", t, time.perf_counter() - t0)
+            self._record_timing("prefill", t, time.perf_counter() - t0)
         return out, (lps if want_lp else None)
 
     # ---- decode -----------------------------------------------------------
@@ -1957,7 +2003,8 @@ class ModelRunner:
             t0 = time.perf_counter() if _TIMING else 0.0
             out = self.dispatch_decode(seqs).result()
             if _TIMING:
-                _timing_log("decode", 1, time.perf_counter() - t0)
+                self._record_timing("decode", 1,
+                                    time.perf_counter() - t0)
             return out
         stop_w = STOP_SET_WIDTH
 
@@ -2024,7 +2071,8 @@ class ModelRunner:
         sampled = self._dispatch(2, window, payload)
         host = jax.device_get(sampled)
         if _TIMING:
-            _timing_log("decode", window, time.perf_counter() - t0)
+            self._record_timing("decode", window,
+                                time.perf_counter() - t0)
         if not want_lp:
             if window == 1:
                 return [[int(host[i])] for i in range(len(seqs))], None
@@ -2137,8 +2185,8 @@ class ModelRunner:
         t0 = time.perf_counter() if _TIMING else 0.0
         out = self.dispatch_spec(plan).result()
         if _TIMING:
-            _timing_log("spec", self.spec_width,
-                        time.perf_counter() - t0)
+            self._record_timing("spec", self.spec_width,
+                                time.perf_counter() - t0)
         return out
 
     # ---- unified ragged step (docs/unified_step.md) -----------------------
@@ -2251,7 +2299,8 @@ class ModelRunner:
         sampled = self._dispatch(KIND_UNIFIED, w, payload)
         host = jax.device_get(sampled)
         if _TIMING:
-            _timing_log("unified", w, time.perf_counter() - t0)
+            self._record_timing("unified", w,
+                                time.perf_counter() - t0)
         if want_lp:
             toks, slp, tids, tlps = host
         else:

@@ -444,6 +444,10 @@ class EngineServer:
         self._embed_lock = asyncio.Lock()
         self.profile_dir = profile_dir
         self._profiling = False
+        # Synthetic span id for the active profiler capture window, so
+        # the capture shows up in traceview next to the requests it
+        # overlapped (docs/observability.md).
+        self._profiler_span_id: Optional[str] = None
         # Jinja source overriding the model's chat template (vLLM's
         # --chat-template; a path is read by main()).
         self.chat_template = chat_template
@@ -1559,6 +1563,15 @@ class EngineServer:
             )
         jax.profiler.start_trace(trace_dir)
         self._profiling = True
+        tracer = self.engine.tracer
+        if tracer is not None:
+            sid = f"prof-{uuid.uuid4().hex[:12]}"
+            self._profiler_span_id = sid
+            tracer.start(
+                sid,
+                request_id=request.headers.get("x-request-id"),
+                prompt_tokens=0)
+            tracer.event(sid, "profiler_start", dir=trace_dir)
         return web.json_response({"status": "started",
                                   "dir": trace_dir})
 
@@ -1571,6 +1584,12 @@ class EngineServer:
             )
         jax.profiler.stop_trace()
         self._profiling = False
+        tracer = self.engine.tracer
+        sid, self._profiler_span_id = self._profiler_span_id, None
+        if tracer is not None and sid is not None:
+            tracer.event(sid, "profiler_stop")
+            tracer.finish(sid, reason="profiler",
+                          arrival_ts=time.time())
         return web.json_response({"status": "stopped"})
 
     async def debug_trace(self, request: web.Request):
@@ -1604,6 +1623,36 @@ class EngineServer:
                 status=400)
         return web.json_response(
             {"steps": tracer.recent_steps(limit=limit)})
+
+    async def debug_compiles(self, request: web.Request):
+        """GET /debug/compiles[?limit=N]: the device performance
+        observatory's compile ledger — per-kind event/seconds
+        counters, live executable-cache sizes, the bounded ring of
+        recent compiles with their (rows, W) shape keys, and the
+        PSTPU_TIMING dispatch aggregates (docs/observability.md)."""
+        obs = getattr(self.engine.runner, "observatory", None)
+        if obs is None:
+            return web.json_response(
+                {"error": {"message": "observatory disabled"}},
+                status=404)
+        try:
+            limit = int(request.query.get("limit", "32"))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "limit must be an integer"}},
+                status=400)
+        return web.json_response(obs.compile_report(limit=limit))
+
+    async def debug_memory(self, request: web.Request):
+        """GET /debug/memory: the observatory's HBM ledger — analytic
+        per-category breakdown (always available) plus
+        device.memory_stats() where the backend supports it."""
+        obs = getattr(self.engine.runner, "observatory", None)
+        if obs is None:
+            return web.json_response(
+                {"error": {"message": "observatory disabled"}},
+                status=404)
+        return web.json_response(obs.memory_report())
 
     async def version(self, request: web.Request):
         return web.json_response({"version": __version__})
@@ -1676,6 +1725,50 @@ class EngineServer:
                 self.engine.scheduler.preempt_offload_outcomes.items()):
             lines.append("vllm:preempt_offload_total{outcome=\""
                          f"{outcome}\"}} {float(count)}")
+        # Device performance observatory (docs/observability.md):
+        # compile ledger, HBM breakdown, step-time/MFU, and the
+        # resolved attention impls as a labeled one-hot info gauge
+        # (the silent-XLA-fallback alarm).
+        obs = getattr(self.engine.runner, "observatory", None)
+        if obs is not None:
+            lines.append("# TYPE vllm:engine_compile_events_total "
+                         "counter")
+            for kind, count in sorted(
+                    obs.compile_events_by_kind().items()):
+                lines.append(
+                    "vllm:engine_compile_events_total{kind=\""
+                    f"{kind}\"}} {float(count)}")
+            lines.append("# TYPE vllm:engine_compile_seconds_total "
+                         "counter")
+            for kind, secs in sorted(
+                    obs.compile_seconds_by_kind().items()):
+                lines.append(
+                    "vllm:engine_compile_seconds_total{kind=\""
+                    f"{kind}\"}} {float(secs)}")
+            lines.append("# TYPE vllm:engine_executable_cache_size "
+                         "gauge")
+            for kind, size in sorted(
+                    obs.executable_cache_sizes().items()):
+                lines.append(
+                    "vllm:engine_executable_cache_size{kind=\""
+                    f"{kind}\"}} {float(size)}")
+            lines.append("# TYPE vllm:engine_hbm_bytes gauge")
+            for category, nbytes in sorted(obs.hbm_bytes().items()):
+                lines.append("vllm:engine_hbm_bytes{category=\""
+                             f"{category}\"}} {float(nbytes)}")
+            lines.append(
+                "# TYPE vllm:engine_step_device_seconds_total counter")
+            for kind, secs in sorted(
+                    obs.device_seconds_by_kind().items()):
+                lines.append(
+                    "vllm:engine_step_device_seconds_total{kind=\""
+                    f"{kind}\"}} {float(secs)}")
+            lines.append("# TYPE vllm:engine_mfu gauge")
+            lines.append(f"vllm:engine_mfu {float(obs.mfu())}")
+            lines.append("# TYPE vllm:engine_attention_impl gauge")
+            for phase, impl in sorted(obs.attention_impls().items()):
+                lines.append("vllm:engine_attention_impl{phase=\""
+                             f"{phase}\",impl=\"{impl}\"}} 1.0")
         # vLLM-parity request-latency histograms + token counters.
         lines.extend(self.engine.metrics.render())
         lines.append("")
@@ -1706,6 +1799,8 @@ class EngineServer:
         app.router.add_post("/debug/profiler/stop", self.profiler_stop)
         app.router.add_get("/debug/trace/{request_id}", self.debug_trace)
         app.router.add_get("/debug/steps", self.debug_steps)
+        app.router.add_get("/debug/compiles", self.debug_compiles)
+        app.router.add_get("/debug/memory", self.debug_memory)
 
         async def on_startup(app):
             self.async_engine.start(asyncio.get_event_loop())
@@ -1887,6 +1982,7 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
         seed=args.seed,
         engine_role=args.engine_role,
         handoff_timeout_s=args.handoff_timeout_s,
+        device_peak_flops=args.device_peak_flops,
     )
     engine = LLMEngine(config, mesh=mesh, params=params,
                        tokenizer=tokenizer)
@@ -2020,9 +2116,16 @@ def parse_args(argv=None):
     parser.add_argument("--chat-template", default=None,
                         help="Jinja chat template source or file path, "
                              "overriding the model's own template")
-    parser.add_argument("--profile-dir", default=None,
+    parser.add_argument("--profile-dir", "--profiler-dir",
+                        dest="profile_dir", default=None,
                         help="Default output dir for "
-                             "/debug/profiler/start traces")
+                             "/debug/profiler/start traces "
+                             "(--profiler-dir is an alias)")
+    parser.add_argument("--device-peak-flops", type=float, default=0.0,
+                        help="Per-chip peak FLOP/s for the "
+                             "observatory's vllm:engine_mfu gauge; 0 "
+                             "resolves from the device-kind table "
+                             "(unknown devices report MFU 0)")
     parser.add_argument("--request-span-log", default=None,
                         help="Emit one JSON engine-span line per "
                              "finished request to this path ('-' = "

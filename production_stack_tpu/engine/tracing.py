@@ -57,6 +57,8 @@ SPAN_EVENTS = (
     "preempt_offload",
     "qos_shed",
     "handoff_ship",
+    "profiler_start",
+    "profiler_stop",
     "finish",
 )
 

@@ -186,6 +186,40 @@ engine_preempt_restore_latency_mean = Gauge(
     "Mean time to restore a preempted victim's KV pages from the "
     "offload tier on re-admission (scraped histogram sum/count)",
     _LBL)
+# Device performance observatory (docs/observability.md): re-exported
+# compile ledger, HBM breakdown, step-time/MFU, and attention-impl
+# info gauge. Counter families drop their _total suffix here (router
+# Gauges, same idiom as engine_ragged_steps).
+engine_compile_events = Gauge(
+    "vllm:engine_compile_events",
+    "Engine-reported jit compile events per program kind (scraped)",
+    ["server", "kind"])
+engine_compile_seconds = Gauge(
+    "vllm:engine_compile_seconds",
+    "Engine-reported cumulative compile wall seconds per program "
+    "kind (scraped)", ["server", "kind"])
+engine_executable_cache_size = Gauge(
+    "vllm:engine_executable_cache_size",
+    "Engine-reported live jit executable-cache size per program kind "
+    "(scraped)", ["server", "kind"])
+engine_hbm_bytes = Gauge(
+    "vllm:engine_hbm_bytes",
+    "Engine-reported analytic HBM bytes per category: weights, "
+    "kv_pages, kv_scales, step_buffers (scraped)",
+    ["server", "category"])
+engine_step_device_seconds = Gauge(
+    "vllm:engine_step_device_seconds",
+    "Engine-reported cumulative device step seconds per step kind "
+    "(scraped)", ["server", "kind"])
+engine_mfu = Gauge(
+    "vllm:engine_mfu",
+    "Engine-reported useful-token model FLOPs utilization against "
+    "the device peak; 0 when the peak is unknown (scraped)", _LBL)
+engine_attention_impl = Gauge(
+    "vllm:engine_attention_impl",
+    "Engine-reported resolved attention impl per phase as a one-hot "
+    "labeled info gauge — alarms the silent XLA fallback (scraped)",
+    ["server", "phase", "impl"])
 
 # -- fleet manager (production_stack_tpu/fleet/, docs/fleet.md) -------------
 # Set by an in-process fleet manager (or its embedded exporter); the
@@ -383,6 +417,25 @@ def refresh_gauges() -> None:
                 server=server).set(
                 es.preempt_restore_latency_sum
                 / es.preempt_restore_latency_count)
+        for kind, value in es.compile_events_by_kind.items():
+            engine_compile_events.labels(
+                server=server, kind=kind).set(value)
+        for kind, value in es.compile_seconds_by_kind.items():
+            engine_compile_seconds.labels(
+                server=server, kind=kind).set(value)
+        for kind, value in es.executable_cache_size_by_kind.items():
+            engine_executable_cache_size.labels(
+                server=server, kind=kind).set(value)
+        for category, value in es.hbm_bytes_by_category.items():
+            engine_hbm_bytes.labels(
+                server=server, category=category).set(value)
+        for kind, value in es.step_device_seconds_by_kind.items():
+            engine_step_device_seconds.labels(
+                server=server, kind=kind).set(value)
+        engine_mfu.labels(server=server).set(es.engine_mfu)
+        for phase, impl in es.attention_impl_by_phase.items():
+            engine_attention_impl.labels(
+                server=server, phase=phase, impl=impl).set(1)
     from production_stack_tpu.router.services import request_service
     router_disagg_handoffs.set(request_service.disagg_handoffs_total)
     router_disagg_fallbacks.set(request_service.disagg_fallbacks_total)

@@ -74,6 +74,10 @@ _METRIC_MAP = {
     # Zero-loss drain (docs/fleet.md): 1 while the engine rejects new
     # admissions and finishes its in-flight sequences.
     "vllm:engine_draining": "engine_draining",
+    # Device performance observatory (docs/observability.md): the
+    # unlabeled MFU gauge; the labeled compile/HBM/step-time families
+    # are handled in from_prometheus_text.
+    "vllm:engine_mfu": "engine_mfu",
 }
 
 # Engine latency histograms the scraper summarizes: it keeps each
@@ -180,6 +184,27 @@ class EngineStats:
         default_factory=dict)
     preempt_restore_latency_sum: float = 0.0
     preempt_restore_latency_count: float = 0.0
+    # Device performance observatory (docs/observability.md): per-kind
+    # compile events/seconds (vllm:engine_compile_events_total{kind},
+    # vllm:engine_compile_seconds_total{kind}), live executable-cache
+    # sizes (vllm:engine_executable_cache_size{kind}), the analytic
+    # HBM breakdown (vllm:engine_hbm_bytes{category}), per-kind device
+    # step time (vllm:engine_step_device_seconds_total{kind}), the
+    # scalar MFU gauge, and the resolved attention impl per phase
+    # (vllm:engine_attention_impl{phase,impl} one-hot).
+    compile_events_by_kind: Dict[str, float] = field(
+        default_factory=dict)
+    compile_seconds_by_kind: Dict[str, float] = field(
+        default_factory=dict)
+    executable_cache_size_by_kind: Dict[str, float] = field(
+        default_factory=dict)
+    hbm_bytes_by_category: Dict[str, float] = field(
+        default_factory=dict)
+    step_device_seconds_by_kind: Dict[str, float] = field(
+        default_factory=dict)
+    engine_mfu: float = 0.0
+    attention_impl_by_phase: Dict[str, str] = field(
+        default_factory=dict)
 
     @classmethod
     def from_prometheus_text(cls, text: str) -> "EngineStats":
@@ -200,6 +225,35 @@ class EngineStats:
                 if sample.name == "vllm:preempt_offload_total":
                     stats.preempt_offload_by_outcome[
                         sample.labels.get("outcome", "")] = sample.value
+                    continue
+                if sample.name == "vllm:engine_compile_events_total":
+                    stats.compile_events_by_kind[
+                        sample.labels.get("kind", "")] = sample.value
+                    continue
+                if sample.name == "vllm:engine_compile_seconds_total":
+                    stats.compile_seconds_by_kind[
+                        sample.labels.get("kind", "")] = sample.value
+                    continue
+                if sample.name == "vllm:engine_executable_cache_size":
+                    stats.executable_cache_size_by_kind[
+                        sample.labels.get("kind", "")] = sample.value
+                    continue
+                if sample.name == "vllm:engine_hbm_bytes":
+                    stats.hbm_bytes_by_category[
+                        sample.labels.get("category", "")
+                    ] = sample.value
+                    continue
+                if (sample.name
+                        == "vllm:engine_step_device_seconds_total"):
+                    stats.step_device_seconds_by_kind[
+                        sample.labels.get("kind", "")] = sample.value
+                    continue
+                if (sample.name == "vllm:engine_attention_impl"
+                        and sample.value == 1.0):
+                    # One-hot labeled info gauge: phase -> impl.
+                    stats.attention_impl_by_phase[
+                        sample.labels.get("phase", "")
+                    ] = sample.labels.get("impl", "")
                     continue
                 if (sample.name == "vllm:engine_kv_cache_dtype"
                         and sample.value == 1.0):

@@ -652,9 +652,77 @@ async def metrics(request: web.Request) -> web.Response:
             "vllm:qos_shed_total{class=\"" f"{cls}\"}} {float(count)}"
             for cls, count in sorted(state.qos_shed_counts.items())
         ),
+        # Device performance observatory (docs/observability.md):
+        # static deterministic values so router-side scrape/re-export
+        # tests run without JAX.
+        "# TYPE vllm:engine_compile_events_total counter",
+        'vllm:engine_compile_events_total{kind="step"} 3.0',
+        'vllm:engine_compile_events_total{kind="unified"} 1.0',
+        "# TYPE vllm:engine_compile_seconds_total counter",
+        'vllm:engine_compile_seconds_total{kind="step"} 1.25',
+        'vllm:engine_compile_seconds_total{kind="unified"} 0.5',
+        "# TYPE vllm:engine_executable_cache_size gauge",
+        'vllm:engine_executable_cache_size{kind="step"} 3.0',
+        'vllm:engine_executable_cache_size{kind="unified"} 1.0',
+        "# TYPE vllm:engine_hbm_bytes gauge",
+        'vllm:engine_hbm_bytes{category="weights"} 1048576.0',
+        'vllm:engine_hbm_bytes{category="kv_pages"} 524288.0',
+        'vllm:engine_hbm_bytes{category="kv_scales"} 0.0',
+        'vllm:engine_hbm_bytes{category="step_buffers"} 65536.0',
+        "# TYPE vllm:engine_step_device_seconds_total counter",
+        'vllm:engine_step_device_seconds_total{kind="decode"} 2.5',
+        "# TYPE vllm:engine_mfu gauge",
+        "vllm:engine_mfu 0.37",
+        "# TYPE vllm:engine_attention_impl gauge",
+        'vllm:engine_attention_impl{phase="decode",impl="xla"} 1.0',
+        'vllm:engine_attention_impl{phase="prefill",impl="xla"} 1.0',
         "",
     ])
     return web.Response(text=text, content_type="text/plain")
+
+
+async def debug_compiles(request: web.Request) -> web.Response:
+    """GET /debug/compiles[?limit=N]: deterministic compile-ledger
+    payload matching the real server's shape (engine/server.py
+    debug_compiles)."""
+    try:
+        limit = int(request.query.get("limit", "32"))
+    except ValueError:
+        return web.json_response(
+            {"error": {"message": "limit must be an integer"}},
+            status=400)
+    recent = [
+        {"kind": "step", "key": [4, 16], "seconds": 0.4,
+         "cache_size": 1, "ts": 0.0},
+        {"kind": "step", "key": [4, 32], "seconds": 0.45,
+         "cache_size": 2, "ts": 1.0},
+        {"kind": "step", "key": [8, 32], "seconds": 0.4,
+         "cache_size": 3, "ts": 2.0},
+        {"kind": "unified", "key": [12, 32], "seconds": 0.5,
+         "cache_size": 1, "ts": 3.0},
+    ]
+    return web.json_response({
+        "events": {"step": 3, "unified": 1},
+        "seconds": {"step": 1.25, "unified": 0.5},
+        "executable_cache_sizes": {"step": 3, "unified": 1},
+        "recent": recent[-limit:] if limit >= 0 else recent,
+        "timings": {},
+    })
+
+
+async def debug_memory(request: web.Request) -> web.Response:
+    """GET /debug/memory: deterministic HBM-ledger payload matching
+    the real server's shape (engine/server.py debug_memory)."""
+    analytic = {"weights": 1048576, "kv_pages": 524288,
+                "kv_scales": 0, "step_buffers": 65536}
+    return web.json_response({
+        "analytic": analytic,
+        "total_analytic_bytes": sum(analytic.values()),
+        "kv_cache_dtype": "bf16",
+        "num_pages": 512,
+        "page_size": 16,
+        "param_count": 524288,
+    })
 
 
 def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
@@ -684,6 +752,8 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/trace/{request_id}", debug_trace)
+    app.router.add_get("/debug/compiles", debug_compiles)
+    app.router.add_get("/debug/memory", debug_memory)
     app.router.add_post("/fault", set_fault)
     app.router.add_post("/drain", drain)
     app.router.add_post("/gauges", set_gauges)

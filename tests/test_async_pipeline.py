@@ -130,18 +130,20 @@ def test_greedy_parity_byte_identical_and_no_recompile():
 
     # Executable-cache stability: flipping async on for the SAME
     # runner introduces no new compiled program shapes (dispatch_decode
-    # feeds the identical [B, 1] step program).
-    jit = sync.runner._step_jit
-    if hasattr(jit, "_cache_size"):
-        before = jit._cache_size()
-        sync.config.scheduler.async_scheduling = True
-        sid = sync.add_request(_prompts()[0], SamplingParams(
-            temperature=0.0, max_tokens=8, ignore_eos=True))
-        seq = sync.sequences[sid]
-        while sync.has_work():
-            sync.step()
-        assert len(seq.output_token_ids) == 8
-        assert jit._cache_size() == before
+    # feeds the identical [B, 1] step program). The compile ledger is
+    # the public witness: zero new "step" events, same cache size.
+    obs = sync.runner.observatory
+    before_events = obs.compile_events_total("step")
+    before_size = obs.executable_cache_sizes()["step"]
+    sync.config.scheduler.async_scheduling = True
+    sid = sync.add_request(_prompts()[0], SamplingParams(
+        temperature=0.0, max_tokens=8, ignore_eos=True))
+    seq = sync.sequences[sid]
+    while sync.has_work():
+        sync.step()
+    assert len(seq.output_token_ids) == 8
+    assert obs.compile_events_total("step") == before_events
+    assert obs.executable_cache_sizes()["step"] == before_size
 
 
 def test_abort_mid_flight_no_page_leak():

@@ -157,13 +157,14 @@ def test_mixed_run_zero_recompiles():
     _run_mixed(engine, seed=7)
     ragged0 = engine.metrics.ragged_steps_total
     assert ragged0 > 0
-    jits = [engine.runner._unified_jit, engine.runner._step_jit]
-    if not all(hasattr(j, "_cache_size") for j in jits):
-        pytest.skip("jit cache introspection unavailable")
-    before = [j._cache_size() for j in jits]
+    obs = engine.runner.observatory
+    assert obs.compile_events_total() > 0  # the warm-up compiled
+    before_events = obs.compile_events_total()
+    before_caches = obs.executable_cache_sizes()
     _run_mixed(engine, seed=13)
     assert engine.metrics.ragged_steps_total > ragged0
-    assert [j._cache_size() for j in jits] == before
+    assert obs.compile_events_total() == before_events
+    assert obs.executable_cache_sizes() == before_caches
 
 
 def test_finish_mid_ragged_batch_no_page_leak():
