@@ -1476,6 +1476,228 @@ def run_overload_worker(mode: str) -> None:
     }))
 
 
+def run_chaos_worker(mode: str) -> None:
+    """Crash-chaos bench (docs/crash_recovery.md): router + a crash-
+    fault fake engine (SIGKILLed mid-stream, respawned between
+    streams) + a healthy peer, streaming greedy requests through the
+    kills. ``mode="on"``: engines relay resume checkpoints and the
+    router must finish every stream byte-exact with zero broken
+    streams and zero client-visible 5xx; ``mode="off"``: no
+    checkpoints — each crashed stream must end in an honest terminal
+    SSE error event (counted as broken; never a silent truncation).
+    The resumed-tail TTFB (the client-visible stall a kill causes) is
+    the largest inter-chunk gap of each resumed stream.
+
+    Fake engines only (CPU, no JAX): the phase measures the failover
+    protocol, not model throughput.
+    """
+    import asyncio
+    import socket
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import aiohttp
+    from aiohttp import web
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.resilience import (
+        ResilienceConfig,
+        initialize_resilience,
+    )
+    from production_stack_tpu.router.routing.logic import (
+        initialize_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        initialize_service_discovery,
+    )
+    from production_stack_tpu.router.services import request_service
+    from production_stack_tpu.router.services.rewriter import (
+        initialize_request_rewriter,
+    )
+    from production_stack_tpu.router.stats.engine_stats import (
+        initialize_engine_stats_scraper,
+    )
+    from production_stack_tpu.router.stats.request_stats import (
+        initialize_request_stats_monitor,
+    )
+
+    n_streams = int(os.environ.get("BENCH_CHAOS_STREAMS", "12"))
+    out_len = int(os.environ.get("BENCH_CHAOS_OUT_LEN", "16"))
+    speed = float(os.environ.get("BENCH_CHAOS_SPEED", "200"))
+    crash_after = int(os.environ.get("BENCH_CHAOS_CRASH_AFTER", "5"))
+    ckpt = 2 if mode == "on" else 0
+
+    def free_ports(n):
+        socks = [socket.socket() for _ in range(n)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        # Roundrobin orders endpoints lexicographically by URL: the
+        # first (chaotic) port must sort first so kills actually land.
+        return sorted(ports, key=str)
+
+    crash_port, ok_port = free_ports(2)
+    crash_url = f"http://127.0.0.1:{crash_port}"
+    ok_url = f"http://127.0.0.1:{ok_port}"
+
+    def spawn_fake(port, *extra):
+        argv = [sys.executable, "-m",
+                "production_stack_tpu.testing.fake_engine",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--model", "bench-fake", "--speed", str(speed),
+                "--ttft", "0.0"]
+        if ckpt:
+            argv += ["--checkpoint-interval-tokens", str(ckpt)]
+        argv += list(extra)
+        return subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    def spawn_crash():
+        return spawn_fake(crash_port, "--fault", "crash",
+                          "--crash-after-tokens", str(crash_after))
+
+    async def wait_up(session, url):
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            try:
+                async with session.get(url + "/health") as resp:
+                    if resp.status == 200:
+                        return
+            except Exception:
+                pass
+            await asyncio.sleep(0.05)
+        raise RuntimeError(f"fake engine at {url} never came up")
+
+    async def run():
+        request_service.stream_resumes_by_outcome.clear()
+        request_service.poison_quarantines_total = 0
+        request_service._poison_crashes.clear()
+        initialize_service_discovery(
+            "static", urls=[crash_url, ok_url],
+            models=["bench-fake"] * 2)
+        initialize_request_stats_monitor(60.0)
+        initialize_engine_stats_scraper(3600.0)
+        initialize_routing_logic("roundrobin")
+        initialize_request_rewriter("noop")
+        initialize_resilience(ResilienceConfig(
+            max_retries=2, backend_connect_timeout=2.0,
+            backend_timeout=30.0, health_check_interval=0.0))
+        runner = web.AppRunner(build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        router_url = ("http://127.0.0.1:"
+                      f"{site._server.sockets[0].getsockname()[1]}")
+
+        crash_proc = spawn_crash()
+        ok_proc = spawn_fake(ok_port)
+        session = aiohttp.ClientSession()
+        records = []
+        try:
+            await wait_up(session, crash_url)
+            await wait_up(session, ok_url)
+            body = {"model": "bench-fake",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": out_len, "stream": True}
+            for _ in range(n_streams):
+                if crash_proc.poll() is not None:
+                    # The chaos monkey's respawn: a fresh victim for
+                    # the next stream that routes to this slot.
+                    crash_proc = spawn_crash()
+                    await wait_up(session, crash_url)
+                rec = {"status": None, "text": "", "max_gap": 0.0,
+                       "terminal_error": False, "error": None,
+                       "crashed": False}
+                parts = []
+                last = None
+                try:
+                    async with session.post(
+                            router_url + "/v1/chat/completions",
+                            json=body) as resp:
+                        rec["status"] = resp.status
+                        async for raw in resp.content:
+                            line = raw.decode("utf-8",
+                                              "replace").strip()
+                            if (not line.startswith("data: ")
+                                    or line == "data: [DONE]"):
+                                continue
+                            event = json.loads(line[len("data: "):])
+                            if "choices" not in event:
+                                rec["terminal_error"] = True
+                                continue
+                            delta = (event["choices"][0].get("delta")
+                                     or {})
+                            if not delta.get("content"):
+                                continue
+                            now = time.time()
+                            if last is not None:
+                                rec["max_gap"] = max(
+                                    rec["max_gap"], now - last)
+                            last = now
+                            parts.append(delta["content"])
+                except Exception as e:
+                    rec["error"] = f"{type(e).__name__}: {e}"
+                rec["text"] = "".join(parts)
+                rec["crashed"] = crash_proc.poll() is not None
+                records.append(rec)
+            outcomes = dict(request_service.stream_resumes_by_outcome)
+        finally:
+            for proc in (crash_proc, ok_proc):
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait(timeout=10)
+            await session.close()
+            await runner.cleanup()
+        return records, outcomes
+
+    records, outcomes = asyncio.run(run())
+
+    def pctl(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    expected = "".join(f"tok{i} " for i in range(out_len))
+    total = len(records)
+    crashed = sum(1 for r in records if r["crashed"])
+    byte_exact = sum(1 for r in records if r["text"] == expected)
+    broken = sum(1 for r in records
+                 if r["terminal_error"] or r["error"] is not None)
+    resume_gaps = [r["max_gap"] for r in records
+                   if r["crashed"] and not r["terminal_error"]
+                   and r["error"] is None]
+    survival = byte_exact / total if total else 0.0
+    print(json.dumps({
+        "metric": f"crash chaos bench ({mode}): byte-exact stream "
+                  "survival through mid-stream engine kills",
+        "value": round(survival, 4),
+        "unit": "fraction",
+        "vs_baseline": 0.0,
+        "extra": {
+            "mode": mode,
+            "chaos_streams_total": total,
+            "chaos_crashed_streams": crashed,
+            "chaos_resumed_streams": outcomes.get("resumed", 0),
+            "chaos_broken_streams": broken,
+            "chaos_byte_exact_streams": byte_exact,
+            "chaos_survival": round(survival, 4),
+            "chaos_5xx": sum(1 for r in records
+                             if r["status"] is not None
+                             and r["status"] >= 500),
+            "chaos_dropped": sum(1 for r in records
+                                 if r["error"] is not None),
+            "chaos_resume_gap_p50_s": round(
+                pctl(resume_gaps, 0.5) or -1.0, 4),
+            "chaos_resume_gap_p99_s": round(
+                pctl(resume_gaps, 0.99) or -1.0, 4),
+            "chaos_resume_outcomes": outcomes,
+        },
+    }))
+
+
 def _spawn_worker(impl: str, tpu: bool, timeout: int, extra_env=None):
     """Run one benchmark worker; returns (result_dict | None, error)."""
     cmd = [sys.executable, os.path.abspath(__file__),
@@ -1524,6 +1746,8 @@ def main() -> None:
         elif impl == "overload":
             run_overload_worker(
                 os.environ.get("BENCH_OVERLOAD_QOS", "off"))
+        elif impl == "chaos":
+            run_chaos_worker(os.environ.get("BENCH_CHAOS_CKPT", "on"))
         else:
             run_worker(impl, tpu="--tpu" in sys.argv)
         return
@@ -1730,6 +1954,34 @@ def main() -> None:
                         "n_429_with_retry_after", "n_5xx", "dropped",
                         "router_throttled"):
                 result["extra"][f"{tag}_{key}"] = oe.get(key)
+
+        # Mid-stream crash chaos A/B (docs/crash_recovery.md): the
+        # same kill-an-engine-mid-stream workload with resume
+        # checkpointing as the only variable. With it on, every
+        # crashed stream must finish byte-exact (broken == 0, 5xx ==
+        # 0); with it off, crashed streams end in honest terminal SSE
+        # errors. Survival, resume counts and the resumed-tail stall
+        # ride in extra under chaos_ckpt_on_* / chaos_ckpt_off_*.
+        for tag, cmode in (("chaos_ckpt_on", "on"),
+                           ("chaos_ckpt_off", "off")):
+            sys.stderr.write(f"[bench] running {tag} worker "
+                             f"(timeout {timeout}s)...\n")
+            ch_result, ch_err = _spawn_worker(
+                "chaos", False, timeout,
+                extra_env={"BENCH_CHAOS_CKPT": cmode,
+                           "JAX_PLATFORMS": "cpu"})
+            if ch_result is None:
+                errors[f"{tag}_error"] = ch_err
+                sys.stderr.write(f"[bench] WARNING: {ch_err}\n")
+                continue
+            ce = ch_result.get("extra", {})
+            for key in ("chaos_streams_total", "chaos_crashed_streams",
+                        "chaos_resumed_streams", "chaos_broken_streams",
+                        "chaos_byte_exact_streams", "chaos_survival",
+                        "chaos_5xx", "chaos_dropped",
+                        "chaos_resume_gap_p50_s",
+                        "chaos_resume_gap_p99_s"):
+                result["extra"][f"{tag}_{key}"] = ce.get(key)
 
     if result is None:
         # Never hang the driver: report the failure as the metric line.

@@ -378,6 +378,20 @@ class EngineConfig:
     # table; unknown devices (including CPU) then report MFU 0 rather
     # than a guessed utilization.
     device_peak_flops: float = 0.0
+    # Mid-stream crash safety (docs/crash_recovery.md): every N
+    # generated tokens, ship a streaming sequence's committed KV pages
+    # to the offload tier and publish a resume descriptor so the
+    # router can re-submit the stream to another engine after this
+    # process dies. 0 = no checkpointing (streams die with the
+    # engine). Inert without an offload tier for the page ship, but
+    # the descriptor (token journal) is still published so a resume
+    # can recompute.
+    checkpoint_interval_tokens: int = 0
+    # Seconds a single engine step may run before /health flips to
+    # 503 so the router's prober rotates the replica out (a hung
+    # device program blocks the step thread; the asyncio health
+    # handler keeps serving). 0 = watchdog disabled.
+    step_watchdog_s: float = 0.0
 
     def __post_init__(self):
         if self.engine_role not in ("prefill", "decode", "both"):
@@ -388,6 +402,10 @@ class EngineConfig:
             raise ValueError("handoff_timeout_s must be >= 0")
         if self.device_peak_flops < 0:
             raise ValueError("device_peak_flops must be >= 0")
+        if self.checkpoint_interval_tokens < 0:
+            raise ValueError("checkpoint_interval_tokens must be >= 0")
+        if self.step_watchdog_s < 0:
+            raise ValueError("step_watchdog_s must be >= 0")
         if self.engine_role == "prefill":
             # A prefill-role engine never decodes past the first
             # sampled token, so decode-side machinery is dead weight

@@ -110,6 +110,17 @@ class LLMEngine:
         self.disagg_prefill_requests = 0
         self.disagg_decode_requests = 0
         self.disagg_kv_bytes_shipped = 0
+        # Mid-stream crash safety (docs/crash_recovery.md): latest
+        # resume descriptor per live streaming sequence (drained by
+        # the server via take_checkpoint and relayed to the router as
+        # an SSE comment frame), plus per-seq cadence/ship bookkeeping
+        # and cumulative counters.
+        self._checkpoints: Dict[str, dict] = {}
+        self._ckpt_last_tokens: Dict[str, int] = {}
+        self._ckpt_shipped_pages: Dict[str, int] = {}
+        self.checkpoint_ships = 0
+        self.checkpoint_kv_bytes = 0
+        self.stream_resumes = 0
         # End-to-end tracing (docs/observability.md): the server
         # installs an engine/tracing.EngineTracer here; the library
         # default is None and every emission site is behind an
@@ -387,6 +398,148 @@ class LLMEngine:
                         waited_ms=0.0, outcome="no_tier")
         return seq.seq_id
 
+    def add_resume(self, token_ids: List[int],
+                   num_prior_output_tokens: int,
+                   sampling: Optional[SamplingParams] = None,
+                   seq_id: Optional[str] = None,
+                   output_sink=None,
+                   request_id: Optional[str] = None) -> str:
+        """Resume a stream whose engine died mid-generation
+        (docs/crash_recovery.md): ``token_ids`` is the journaled
+        committed context (original prompt + every generated token up
+        to the last checkpoint), folded into the prompt exactly like
+        ``scheduler._preempt`` folds generated tokens, with
+        ``num_prior_output_tokens`` keeping every budget honest. The
+        sequence parks in ``AWAITING_KV``; the tri-state probe then
+        restores the checkpointed pages from the offload tier — or
+        degrades to a full recompute from the journal on a miss.
+        Either way generation continues byte-identically for greedy
+        sampling; nothing is replayed to the client (the server skips
+        already-delivered text)."""
+        sampling = sampling or SamplingParams()
+        stop_ids = list(sampling.stop_token_ids)
+        if (not sampling.ignore_eos
+                and self.tokenizer.eos_token_id is not None
+                and self.tokenizer.eos_token_id not in stop_ids):
+            stop_ids.append(self.tokenizer.eos_token_id)
+        sampling.stop_token_ids = stop_ids
+        if sampling.guided is not None:
+            raise ValueError(
+                "guided decoding is not supported across a resume "
+                "(automaton state does not transfer)")
+        orig_max_tokens = sampling.max_tokens
+        seq = Sequence(
+            seq_id=seq_id or f"seq-{uuid.uuid4().hex[:16]}",
+            prompt_token_ids=[int(t) for t in token_ids],
+            sampling=sampling,
+            output_sink=output_sink,
+            state=SequenceState.AWAITING_KV,
+            num_prior_output_tokens=int(num_prior_output_tokens),
+            handoff_arrival_time=time.time(),
+            request_id=request_id,
+        )
+        with self._lock:
+            self.sequences[seq.seq_id] = seq
+            try:
+                self.scheduler.add_sequence(seq)
+            except Exception:
+                self.sequences.pop(seq.seq_id, None)
+                raise
+            if self._tracer is not None:
+                self._tracer.start(
+                    seq.seq_id, request_id=request_id,
+                    prompt_tokens=seq.num_prompt_tokens)
+                self._tracer.event(
+                    seq.seq_id, "resume_restore",
+                    prior_tokens=int(num_prior_output_tokens))
+                self._tracer.event(seq.seq_id, "awaiting_kv_park")
+            # Undo the admission clamp (see add_handoff): the folded
+            # prior output would otherwise shrink the token budget.
+            sampling.max_tokens = orig_max_tokens
+            self.stream_resumes += 1
+            if self.offload is None:
+                # No tier to restore from: recompute from the journal.
+                seq.state = SequenceState.WAITING
+                self.metrics.on_handoff_admitted(0.0)
+                if self._tracer is not None:
+                    self._tracer.event(
+                        seq.seq_id, "awaiting_kv_restore",
+                        waited_ms=0.0, outcome="no_tier")
+        return seq.seq_id
+
+    def take_checkpoint(self, seq_id: str) -> Optional[dict]:
+        """Drain the latest unsent resume descriptor for ``seq_id``
+        (None when no new checkpoint landed since the last take)."""
+        with self._lock:
+            return self._checkpoints.pop(seq_id, None)
+
+    def _checkpoint_tick(self) -> None:
+        """Mid-stream crash safety (docs/crash_recovery.md): every
+        ``config.checkpoint_interval_tokens`` generated tokens, ship a
+        running stream's committed KV pages to the offload tier over
+        the preempt-to-offload wire (incrementally — only pages not
+        yet shipped) and stage a resume descriptor journaling the full
+        committed token context. Skips guided and LoRA sequences
+        (automaton state / adapter identity don't transfer). Without
+        an offload tier the journal alone is staged, so a resume still
+        recomputes rather than dying with this process."""
+        from production_stack_tpu.engine.kv_cache import (
+            PagedCacheManager,
+        )
+        interval = self.config.checkpoint_interval_tokens
+        with self._lock:
+            for seq in list(self.scheduler.running):
+                if (seq.state != SequenceState.RUNNING
+                        or seq.sampling.guided is not None
+                        or seq.lora_id != 0):
+                    continue
+                last = self._ckpt_last_tokens.get(seq.seq_id, 0)
+                if seq.num_generated - last < interval:
+                    continue
+                self._ckpt_last_tokens[seq.seq_id] = seq.num_generated
+                # Committed restorable prefix: everything but the last
+                # token (same bound as _evict_sequence_kv — the final
+                # token's KV lands one step later and must reprefill).
+                usable = seq.total_len - 1
+                tokens = seq.all_token_ids[:usable]
+                shipped = kv_bytes = 0
+                if self.offload is not None and seq.pages:
+                    self.cache_manager.commit_full_pages(
+                        tokens, seq.pages, seq.num_hashed_pages,
+                        seq.cache_salt)
+                    hashes = PagedCacheManager.chain_hashes(
+                        tokens, self.cache_manager.page_size,
+                        seq.cache_salt)
+                    done = self._ckpt_shipped_pages.get(seq.seq_id, 0)
+                    pairs = list(zip(seq.pages, hashes))
+                    for page_id, page_hash in pairs[done:]:
+                        payload = self.runner.read_page(page_id)
+                        self.offload.offload_page(page_hash, *payload)
+                        kv_bytes += sum(int(a.nbytes) for a in payload)
+                        shipped += 1
+                    self._ckpt_shipped_pages[seq.seq_id] = len(pairs)
+                self.checkpoint_ships += 1
+                self.checkpoint_kv_bytes += kv_bytes
+                self._checkpoints[seq.seq_id] = {
+                    "tokens": [int(t) for t in seq.all_token_ids],
+                    "prompt_tokens": seq.total_len - seq.num_generated,
+                    "output_tokens": seq.num_generated,
+                    "num_pages": self._ckpt_shipped_pages.get(
+                        seq.seq_id, 0),
+                    "kv_bytes": kv_bytes,
+                }
+                if self._tracer is not None:
+                    self._tracer.event(
+                        seq.seq_id, "checkpoint_ship",
+                        pages=shipped, kv_bytes=kv_bytes,
+                        tokens=seq.num_generated)
+
+    def _drop_checkpoint_state(self, seq_id: str) -> None:
+        """Caller holds self._lock (or the seq is already retired)."""
+        self._checkpoints.pop(seq_id, None)
+        self._ckpt_last_tokens.pop(seq_id, None)
+        self._ckpt_shipped_pages.pop(seq_id, None)
+
     def take_handoff_info(self, seq_id: str) -> Optional[dict]:
         """Drain the descriptor payload recorded when ``seq_id``
         finished its prefill handoff (None if it never shipped)."""
@@ -499,6 +652,7 @@ class LLMEngine:
             if seq is not None:
                 self.scheduler.abort_sequence(seq)
                 self.metrics.on_finished(seq)
+                self._drop_checkpoint_state(seq_id)
                 if self._tracer is not None:
                     self._trace_finish(seq)
 
@@ -534,6 +688,8 @@ class LLMEngine:
         """
         if self.scheduler.num_awaiting_kv:
             self._admit_handoffs()
+        if self.config.checkpoint_interval_tokens > 0:
+            self._checkpoint_tick()
         if (self.config.scheduler.async_scheduling
                 and self.runner.bridge is None):
             return self._step_async()
@@ -926,6 +1082,7 @@ class LLMEngine:
                 seq = self.sequences.pop(out.seq_id, None)
                 if seq is not None:
                     self.metrics.on_finished(seq)
+                    self._drop_checkpoint_state(out.seq_id)
                     if self._tracer is not None:
                         self._trace_finish(seq)
 
@@ -1016,6 +1173,10 @@ class LLMEngine:
                 self.disagg_kv_bytes_shipped,
             "disagg_awaiting_kv_requests":
                 self.scheduler.num_awaiting_kv,
+            # Mid-stream crash safety (docs/crash_recovery.md).
+            "checkpoint_ships_total": self.checkpoint_ships,
+            "checkpoint_kv_bytes_total": self.checkpoint_kv_bytes,
+            "stream_resumes_total": self.stream_resumes,
         }
         if self.offload is not None:
             out.update({

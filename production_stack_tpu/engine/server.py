@@ -80,6 +80,19 @@ class AsyncEngine:
         # sleep — cuts TTFT for requests that land on an idle engine.
         self._wakeup = threading.Event()
         self.uptime_start = time.time()
+        # Step watchdog (docs/crash_recovery.md): wall-clock start of
+        # the step currently executing on the device thread, None
+        # between steps. The asyncio /health handler reads it — a hung
+        # device program blocks this thread, not the event loop.
+        self._step_started: Optional[float] = None
+
+    def current_step_s(self) -> float:
+        """Seconds the in-flight engine step has been running
+        (0.0 when no step is executing)."""
+        started = self._step_started
+        if started is None:
+            return 0.0
+        return time.time() - started
 
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
         self._loop = loop
@@ -109,6 +122,15 @@ class AsyncEngine:
                             item["sampling"], seq_id=seq_id,
                             request_id=item.get("request_id"),
                         )
+                    elif item.get("kind") == "resume":
+                        # Mid-stream failover: park until the
+                        # checkpointed KV is reachable, or recompute
+                        # from the journal (engine.add_resume).
+                        self.engine.add_resume(
+                            item["tokens"], item["prior"],
+                            item["sampling"], seq_id=seq_id,
+                            request_id=item.get("request_id"),
+                        )
                     else:
                         self.engine.add_request(
                             item["prompt"], item["sampling"],
@@ -131,6 +153,7 @@ class AsyncEngine:
                 continue  # admit as many as possible before stepping
             if not self.engine.has_work():
                 continue
+            self._step_started = time.time()
             try:
                 outputs = self.engine.step()
             except Exception as e:
@@ -141,6 +164,8 @@ class AsyncEngine:
                 self._wakeup.wait(0.05)
                 self._wakeup.clear()
                 continue
+            finally:
+                self._step_started = None
             if not outputs:
                 # Planner produced no executable work (e.g. transient
                 # KV-cache starvation, or an async dispatch that owes
@@ -191,6 +216,24 @@ class AsyncEngine:
             "kind": "handoff", "prompt": prompt,
             "first_token": first_token, "sampling": sampling,
             "seq_id": seq_id, "request_id": request_id,
+        })
+        self._wakeup.set()
+        return seq_id, stream
+
+    async def submit_resume(self, tokens: List[int], prior: int,
+                            sampling: SamplingParams,
+                            request_id: Optional[str] = None,
+                            ) -> tuple[str, asyncio.Queue]:
+        """Submit a crashed stream's resume journal
+        (docs/crash_recovery.md); the stream carries only NEW tokens —
+        the journaled context is replayed by the handler."""
+        seq_id = f"seq-{uuid.uuid4().hex[:16]}"
+        stream: asyncio.Queue = asyncio.Queue()
+        self._streams[seq_id] = stream
+        self._submit_q.put({
+            "kind": "resume", "tokens": tokens, "prior": prior,
+            "sampling": sampling, "seq_id": seq_id,
+            "request_id": request_id,
         })
         self._wakeup.set()
         return seq_id, stream
@@ -463,6 +506,9 @@ class EngineServer:
         # of requests turned away with 429 at the shed gate. Rendered
         # as vllm:qos_shed_total{class=...} on /metrics.
         self.qos_shed_counts = shed_counter_dict()
+        # Step watchdog (docs/crash_recovery.md): latched once per hung
+        # step so the trip is logged/span-evented once, not per probe.
+        self._watchdog_tripped = False
 
     # -- decoding helpers ---------------------------------------------------
 
@@ -946,12 +992,41 @@ class EngineServer:
 
         write_lock = asyncio.Lock()
         completion_tokens = [0] * n
+        # Mid-stream crash safety (docs/crash_recovery.md): single-
+        # choice plain streams relay the engine's latest resume
+        # descriptor as an SSE comment frame — invisible to SSE
+        # clients, stripped and remembered by the router for a
+        # /v1/resume re-submission if this process dies. Multi-choice,
+        # logprobs and echo streams carry wire state one descriptor
+        # cannot reconstruct, so they stream without a safety net.
+        relay_ckpt = (self.engine.config.checkpoint_interval_tokens > 0
+                      and candidates == 1 and not sampling.logprobs
+                      and not echo)
+
+        def ckpt_frame(ckpt: dict) -> bytes:
+            desc = {
+                "version": 1,
+                "request_id": trace_id,
+                "response_id": rid,
+                "created": created,
+                "chat": chat,
+                "model": response_model,
+                "kv_dtype":
+                    self.engine.config.cache.resolved_kv_dtype(),
+                "sampling": _sampling_to_wire(sampling),
+            }
+            desc.update(ckpt)
+            return f": checkpoint {json.dumps(desc)}\n\n".encode()
 
         async def stream_choice(index, seq_id, stream):
             async def on_delta(text, lps):
                 async with write_lock:
                     await resp.write(sse(chunk(index, text, None,
                                                lps=lps)))
+                    if relay_ckpt:
+                        ckpt = self.engine.take_checkpoint(seq_id)
+                        if ckpt is not None:
+                            await resp.write(ckpt_frame(ckpt))
 
             _, n_toks, finish_reason, _ = await consume_choice(
                 seq_id, stream, on_delta=on_delta)
@@ -1282,6 +1357,232 @@ class EngineServer:
             raise
         return resp
 
+    async def resume(self, request: web.Request):
+        """POST /v1/resume: continue a stream whose engine died
+        mid-generation (docs/crash_recovery.md). The body carries the
+        checkpoint descriptor the dead engine attached to its SSE
+        stream plus ``delivered_text_chars`` — how much content text
+        the router already forwarded to the client. The journaled
+        context parks in ``AWAITING_KV`` (restore the checkpointed
+        pages, or recompute from the token journal on a miss); the
+        handler replays the journal through the same detokenizer +
+        stop-scanner pipeline the dead engine ran, skips the
+        already-delivered characters, and streams the rest — for
+        greedy sampling the concatenated client stream is
+        byte-identical to an uninterrupted run."""
+        body = await self._json_body(request)
+        desc = body.get("descriptor")
+        if not isinstance(desc, dict):
+            return web.json_response(
+                {"error": {"message": "'descriptor' object is "
+                                      "required"}}, status=400)
+        token_ids = desc.get("tokens")
+        output_tokens = desc.get("output_tokens")
+        if (not isinstance(token_ids, list) or not token_ids
+                or not all(isinstance(t, int) for t in token_ids)
+                or not isinstance(output_tokens, int)
+                or not 0 < output_tokens < len(token_ids)):
+            return web.json_response(
+                {"error": {"message": "descriptor missing "
+                                      "tokens/output_tokens"}},
+                status=400)
+        my_dtype = self.engine.config.cache.resolved_kv_dtype()
+        desc_dtype = desc.get("kv_dtype") or my_dtype
+        if desc_dtype != my_dtype:
+            # 409: this pod can NEVER restore those pages (tier keys
+            # are dtype-namespaced) — the router must pick a
+            # same-dtype replacement or accept a recompute elsewhere.
+            return web.json_response(
+                {"error": {"message": (
+                    f"checkpoint KV not restorable here (descriptor "
+                    f"kv_dtype {desc_dtype!r}, engine "
+                    f"{my_dtype!r})")}},
+                status=409)
+        try:
+            sampling = _sampling_from_wire(desc.get("sampling") or {})
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message":
+                           f"bad descriptor sampling: {e}"}},
+                status=400)
+        if sampling.guided is not None:
+            return web.json_response(
+                {"error": {"message": "guided streams cannot be "
+                                      "resumed"}}, status=400)
+        try:
+            delivered = int(body.get("delivered_text_chars") or 0)
+        except (TypeError, ValueError):
+            delivered = -1
+        if delivered < 0:
+            return web.json_response(
+                {"error": {"message": "delivered_text_chars must be "
+                                      "a non-negative integer"}},
+                status=400)
+        chat = bool(desc.get("chat", True))
+        stream_mode = bool(body.get("stream", True))
+        # The original stream's identity: resumed chunks must carry
+        # the SAME id/created/model for the concatenated stream to be
+        # byte-identical to an uninterrupted run.
+        rid = (desc.get("response_id")
+               or ("chatcmpl-" if chat else "cmpl-")
+               + uuid.uuid4().hex[:16])
+        created = int(desc.get("created") or time.time())
+        response_model = desc.get("model") or self.model_name
+        prompt_len = len(token_ids) - output_tokens
+        output_ids = token_ids[prompt_len:]
+
+        seq_id, stream = await self.async_engine.submit_resume(
+            token_ids, output_tokens, sampling,
+            request_id=request.headers.get("x-request-id"))
+        # Peek the first engine event so a rejected submission (queue
+        # full / draining race) surfaces as a retryable 503, not a
+        # stream that aborts after the headers went out.
+        first_out = await stream.get()
+        if (first_out.finished and first_out.new_token is None
+                and first_out.finish_reason == "abort"):
+            self.async_engine.finish_stream(seq_id)
+            return web.json_response(
+                {"error": {"message":
+                           "engine rejected the resume"}},
+                status=503, headers={"Retry-After": "1"},
+            )
+
+        async def produce(on_text):
+            """Replay the journal through a fresh detokenizer + stop
+            scanner (rebuilding the dead engine's exact text state),
+            skip the already-delivered chars, then stream new tokens.
+            Returns (completion_tokens, finish_reason)."""
+            decoder = self._delta_decoder()
+            scanner = _StopStringScanner(sampling.stop_strings)
+            n_tokens = output_tokens
+            skip = delivered
+
+            async def put(text):
+                nonlocal skip
+                if not text:
+                    return
+                if skip:
+                    if len(text) <= skip:
+                        skip -= len(text)
+                        return
+                    text = text[skip:]
+                    skip = 0
+                await on_text(text)
+
+            try:
+                for tok in output_ids:
+                    await put(scanner.feed(decoder(tok)))
+                    if scanner.stopped:
+                        self.async_engine.abort(seq_id)
+                        return n_tokens, "stop"
+                out = first_out
+                while True:
+                    if out.new_token is not None:
+                        n_tokens += 1
+                        await put(scanner.feed(decoder(out.new_token)))
+                        if scanner.stopped:
+                            self.async_engine.abort(seq_id)
+                            return n_tokens, "stop"
+                    if out.finished:
+                        finish = out.finish_reason or "stop"
+                        tail = scanner.feed(decoder(None, flush=True))
+                        await put(tail + scanner.flush())
+                        return (n_tokens,
+                                "stop" if scanner.stopped else finish)
+                    out = await stream.get()
+            finally:
+                self.async_engine.finish_stream(seq_id)
+
+        if not stream_mode:
+            pieces: List[str] = []
+
+            async def collect(t):
+                if t:
+                    pieces.append(t)
+
+            try:
+                n_tokens, finish = await produce(collect)
+            except BaseException:
+                self.async_engine.abort(seq_id)
+                raise
+            text = "".join(pieces)
+            if chat:
+                choice = {"index": 0,
+                          "message": {"role": "assistant",
+                                      "content": text},
+                          "finish_reason": finish}
+                obj = "chat.completion"
+            else:
+                choice = {"index": 0, "text": text,
+                          "finish_reason": finish}
+                obj = "text_completion"
+            return web.json_response({
+                "id": rid, "object": obj, "created": created,
+                "model": response_model, "choices": [choice],
+                "usage": _usage(prompt_len, n_tokens),
+            })
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+
+        def sse(payload: dict) -> bytes:
+            return f"data: {json.dumps(payload)}\n\n".encode()
+
+        def chunk(delta: Optional[str],
+                  finish: Optional[str]) -> dict:
+            # Shape-identical to the monolithic stream's chunk() (no
+            # role chunk — the dead engine already delivered it).
+            if chat:
+                d: Dict[str, Any] = {}
+                if delta:
+                    d["content"] = delta
+                choice = {"index": 0, "delta": d,
+                          "finish_reason": finish}
+                obj = "chat.completion.chunk"
+            else:
+                choice = {"index": 0, "text": delta or "",
+                          "finish_reason": finish}
+                obj = "text_completion"
+            return {"id": rid, "object": obj, "created": created,
+                    "model": response_model, "choices": [choice]}
+
+        def ckpt_frame(ckpt: dict) -> bytes:
+            # Keep checkpointing on the resumed leg too, so a second
+            # crash resumes again (the descriptor identity fields are
+            # carried forward from the original stream).
+            new_desc = {
+                "version": 1,
+                "request_id": desc.get("request_id"),
+                "response_id": rid,
+                "created": created,
+                "chat": chat,
+                "model": response_model,
+                "kv_dtype": my_dtype,
+                "sampling": _sampling_to_wire(sampling),
+            }
+            new_desc.update(ckpt)
+            return f": checkpoint {json.dumps(new_desc)}\n\n".encode()
+
+        async def emit(t):
+            if t:
+                await resp.write(sse(chunk(t, None)))
+            ckpt = self.engine.take_checkpoint(seq_id)
+            if ckpt is not None:
+                await resp.write(ckpt_frame(ckpt))
+
+        try:
+            _, finish = await produce(emit)
+            await resp.write(sse(chunk(None, finish)))
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except BaseException:
+            self.async_engine.abort(seq_id)
+            raise
+        return resp
+
     async def embeddings(self, request: web.Request):
         """OpenAI /v1/embeddings over the served model's hidden states."""
         from production_stack_tpu.engine.embeddings import (
@@ -1462,12 +1763,45 @@ class EngineServer:
         # health prober fail the endpoint out of routing while its
         # in-flight streams finish (docs/fleet.md); the fleet manager
         # polls ``active_requests`` to know when a SIGTERM is loss-free.
+        # getattr: older configs (and test stubs) predate the watchdog.
+        wd = getattr(self.engine.config, "step_watchdog_s", 0.0)
+        if wd > 0:
+            stuck = self.async_engine.current_step_s()
+            if stuck > wd:
+                # A wedged device step stalls every queued request; a
+                # 503 makes the router's prober rotate the replica out
+                # (docs/crash_recovery.md).
+                self._note_watchdog_trip(stuck)
+                return web.json_response({
+                    "status": "watchdog",
+                    "stuck_step_s": round(stuck, 3),
+                    "role": self.engine.config.engine_role,
+                    "draining": self.draining,
+                    "active_requests": self._active_generations,
+                }, status=503)
+            self._watchdog_tripped = False
         return web.json_response({
             "status": "ok",
             "role": self.engine.config.engine_role,
             "draining": self.draining,
             "active_requests": self._active_generations,
         })
+
+    def _note_watchdog_trip(self, stuck: float) -> None:
+        if self._watchdog_tripped:
+            return
+        self._watchdog_tripped = True
+        logger.error("Step watchdog tripped: step running for %.3fs "
+                     "(limit %.3fs); /health now 503",
+                     stuck, self.engine.config.step_watchdog_s)
+        tracer = self.engine.tracer
+        if tracer is not None:
+            # Synthetic span (profiler-capture pattern) so the trip is
+            # visible in traceview next to the requests it stalled.
+            sid = f"watchdog-{uuid.uuid4().hex[:12]}"
+            tracer.start(sid, prompt_tokens=0)
+            tracer.event(sid, "watchdog_trip", step_s=round(stuck, 3))
+            tracer.finish(sid, reason="watchdog")
 
     # -- zero-loss drain (docs/fleet.md) ------------------------------------
 
@@ -1785,6 +2119,7 @@ class EngineServer:
                             self._guarded(self.disagg_prefill))
         app.router.add_post("/v1/disagg/handoff",
                             self._guarded(self.disagg_handoff))
+        app.router.add_post("/v1/resume", self._guarded(self.resume))
         app.router.add_post("/drain", self.drain)
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/score", self.score)
@@ -1983,6 +2318,8 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
         engine_role=args.engine_role,
         handoff_timeout_s=args.handoff_timeout_s,
         device_peak_flops=args.device_peak_flops,
+        checkpoint_interval_tokens=args.checkpoint_interval_tokens,
+        step_watchdog_s=args.step_watchdog_s,
     )
     engine = LLMEngine(config, mesh=mesh, params=params,
                        tokenizer=tokenizer)
@@ -2206,6 +2543,20 @@ def parse_args(argv=None):
                              "requests before exiting anyway (0 = "
                              "wait forever; the fleet manager applies "
                              "its own drain deadline)")
+    parser.add_argument("--checkpoint-interval-tokens", type=int,
+                        default=0,
+                        help="Every N generated tokens, ship a "
+                             "streaming sequence's committed KV pages "
+                             "to the offload tier and attach a resume "
+                             "descriptor to the SSE stream so the "
+                             "router can resume it on another engine "
+                             "after a crash (0 disables; "
+                             "docs/crash_recovery.md)")
+    parser.add_argument("--step-watchdog-s", type=float, default=0.0,
+                        help="Seconds a single engine step may run "
+                             "before /health flips to 503 so the "
+                             "router's prober rotates the hung "
+                             "replica out (0 disables)")
     return parser.parse_args(argv)
 
 

@@ -59,6 +59,10 @@ SPAN_EVENTS = (
     "handoff_ship",
     "profiler_start",
     "profiler_stop",
+    "checkpoint_ship",
+    "resume_restore",
+    "watchdog_trip",
+    "crash_respawn",
     "finish",
 )
 

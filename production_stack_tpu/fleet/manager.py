@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import subprocess
 import sys
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -37,6 +39,7 @@ from production_stack_tpu.fleet.autoscaler import (
 )
 from production_stack_tpu.fleet.spec import FleetSpec, PoolSpec
 from production_stack_tpu.router.services.metrics_service import (
+    fleet_crash_respawns,
     fleet_desired_replicas,
     fleet_live_replicas,
     fleet_scale_events,
@@ -83,6 +86,19 @@ class FleetManager:
             p.name: PoolAutoscaler(p, clock) for p in spec.pools}
         self._session: Optional[aiohttp.ClientSession] = None
         self._stopping = False
+        # Crash-loop containment (docs/crash_recovery.md): recent
+        # non-drain exit times per pool (breaker window), consecutive
+        # crashes since the last healthy promotion (backoff exponent),
+        # the earliest clock a respawn is allowed, and a latch so the
+        # open breaker is logged once per trip, not every tick.
+        self._crash_times: Dict[str, deque] = {
+            p.name: deque() for p in spec.pools}
+        self._crash_streak: Dict[str, int] = {
+            p.name: 0 for p in spec.pools}
+        self._next_spawn_ok: Dict[str, float] = {
+            p.name: 0.0 for p in spec.pools}
+        self._breaker_logged: Dict[str, bool] = {
+            p.name: False for p in spec.pools}
 
     # ---- plumbing ---------------------------------------------------------
 
@@ -219,6 +235,43 @@ class FleetManager:
                          "killing", replica.pool, replica.url)
             replica.process.kill()
 
+    def _record_crash(self, pool: PoolSpec) -> None:
+        """A replica exited without a drain: advance the backoff and
+        the breaker window."""
+        now = self._clock()
+        self._crash_times[pool.name].append(now)
+        streak = self._crash_streak[pool.name] + 1
+        self._crash_streak[pool.name] = streak
+        backoff = min(
+            pool.respawn_backoff_base_s * (2 ** (streak - 1)),
+            pool.respawn_backoff_max_s)
+        # Jitter downward only: pools of replicas dying together must
+        # not respawn in lockstep, and the cap stays a true cap.
+        backoff *= random.uniform(0.5, 1.0)
+        self._next_spawn_ok[pool.name] = now + backoff
+
+    def _spawn_allowed(self, pool: PoolSpec) -> bool:
+        """Crash-loop gate: exponential backoff between respawns, and
+        a per-pool breaker that stops respawning entirely while the
+        pool has crashed ``crash_loop_threshold`` times inside
+        ``crash_loop_window_s`` (a broken image or poison traffic —
+        more copies of it will not help)."""
+        now = self._clock()
+        crashes = self._crash_times[pool.name]
+        while crashes and now - crashes[0] > pool.crash_loop_window_s:
+            crashes.popleft()
+        if (pool.crash_loop_threshold > 0
+                and len(crashes) >= pool.crash_loop_threshold):
+            if not self._breaker_logged[pool.name]:
+                logger.error(
+                    "pool %s: crash-loop breaker open (%d crashes in "
+                    "%.0fs); pausing respawns until the window cools",
+                    pool.name, len(crashes), pool.crash_loop_window_s)
+                self._breaker_logged[pool.name] = True
+            return False
+        self._breaker_logged[pool.name] = False
+        return now >= self._next_spawn_ok[pool.name]
+
     async def reconcile_once(self) -> None:
         """One convergence pass: reap, promote, drain, spawn."""
         changed = False
@@ -232,6 +285,7 @@ class FleetManager:
                     logger.warning(
                         "pool %s: replica %s exited unexpectedly (rc=%s)",
                         pool.name, replica.url, replica.process.returncode)
+                    self._record_crash(pool)
                 else:
                     logger.info("pool %s: drained replica %s exited",
                                 pool.name, replica.url)
@@ -244,6 +298,11 @@ class FleetManager:
                 payload = await self._probe_health(replica)
                 if payload is not None and not payload.get("draining"):
                     replica.state = LIVE
+                    # A healthy promotion proves the pool can boot:
+                    # reset the backoff exponent (the breaker window
+                    # drains on its own).
+                    self._crash_streak[pool.name] = 0
+                    self._next_spawn_ok[pool.name] = 0.0
                     changed = True
 
             for replica in replicas:
@@ -253,6 +312,16 @@ class FleetManager:
             want = self.desired[pool.name]
             active = [r for r in replicas if r.state != DRAINING]
             while len(active) < want:
+                if not self._spawn_allowed(pool):
+                    break
+                if self._crash_streak[pool.name] > 0:
+                    fleet_crash_respawns.labels(pool=pool.name).inc()
+                    logger.info(
+                        "pool %s: respawning after crash #%d (next "
+                        "backoff %.2fs)", pool.name,
+                        self._crash_streak[pool.name],
+                        max(0.0, self._next_spawn_ok[pool.name]
+                            - self._clock()))
                 active.append(self._spawn(pool))
             # Scale down newest-first; a replica still starting never
             # served traffic, so stop those before draining live ones.

@@ -93,6 +93,15 @@ class PoolSpec:
     # and {role}.  Tests use this to run pools of fake engines.
     command: List[str] = field(default_factory=list)
     autoscaler: AutoscalerSpec = field(default_factory=AutoscalerSpec)
+    # Crash-loop containment (docs/crash_recovery.md): replicas that
+    # exit without a drain are respawned with jittered exponential
+    # backoff, and a pool seeing ``crash_loop_threshold`` crashes
+    # within ``crash_loop_window_s`` stops respawning until the window
+    # cools — a broken image must not melt the host with a fork storm.
+    respawn_backoff_base_s: float = 1.0
+    respawn_backoff_max_s: float = 30.0
+    crash_loop_threshold: int = 5
+    crash_loop_window_s: float = 60.0
 
     def __post_init__(self) -> None:
         if not _NAME_RE.match(self.name or ""):
@@ -107,6 +116,20 @@ class PoolSpec:
             raise ValueError(
                 f"pool {self.name}: max_replicas must be >= "
                 "max(1, min_replicas)")
+        if self.respawn_backoff_base_s < 0:
+            raise ValueError(
+                f"pool {self.name}: respawn_backoff_base_s must be >= 0")
+        if self.respawn_backoff_max_s < self.respawn_backoff_base_s:
+            raise ValueError(
+                f"pool {self.name}: respawn_backoff_max_s must be >= "
+                "respawn_backoff_base_s")
+        if self.crash_loop_threshold < 0:
+            raise ValueError(
+                f"pool {self.name}: crash_loop_threshold must be >= 0 "
+                "(0 disables the breaker)")
+        if self.crash_loop_window_s <= 0:
+            raise ValueError(
+                f"pool {self.name}: crash_loop_window_s must be > 0")
 
     @classmethod
     def from_dict(cls, raw: Dict[str, Any]) -> "PoolSpec":
@@ -119,6 +142,13 @@ class PoolSpec:
             engine_flags=[str(f) for f in raw.get("engine_flags", [])],
             command=[str(c) for c in raw.get("command", [])],
             autoscaler=AutoscalerSpec.from_dict(raw.get("autoscaler", {})),
+            respawn_backoff_base_s=float(
+                raw.get("respawn_backoff_base_s", 1.0)),
+            respawn_backoff_max_s=float(
+                raw.get("respawn_backoff_max_s", 30.0)),
+            crash_loop_threshold=int(raw.get("crash_loop_threshold", 5)),
+            crash_loop_window_s=float(
+                raw.get("crash_loop_window_s", 60.0)),
         )
 
 

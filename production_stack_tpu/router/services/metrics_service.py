@@ -285,6 +285,21 @@ router_disagg_fallbacks = Gauge(
     "Requests that attempted the disagg path but were served "
     "monolithically instead (router-wide)", [])
 
+# -- crash recovery (docs/crash_recovery.md) --------------------------------
+stream_resumes = Gauge(
+    "vllm:stream_resumes_total",
+    "Mid-stream failover outcomes: streams resumed byte-exactly on a "
+    "replacement engine vs ended with a terminal error event "
+    "(router-wide)", ["outcome"])
+fleet_crash_respawns = Gauge(
+    "vllm:fleet_crash_respawns_total",
+    "Fleet-manager respawns of replicas that exited without a drain, "
+    "per pool", ["pool"])
+fleet_poison_quarantines = Gauge(
+    "vllm:fleet_poison_quarantines_total",
+    "Requests quarantined after crashing multiple engines "
+    "(router-wide)", [])
+
 
 def refresh_gauges() -> None:
     """Pull the latest snapshots into the gauge registry."""
@@ -439,6 +454,11 @@ def refresh_gauges() -> None:
     from production_stack_tpu.router.services import request_service
     router_disagg_handoffs.set(request_service.disagg_handoffs_total)
     router_disagg_fallbacks.set(request_service.disagg_fallbacks_total)
+    for outcome, value in \
+            request_service.stream_resumes_by_outcome.items():
+        stream_resumes.labels(outcome=outcome).set(value)
+    fleet_poison_quarantines.set(
+        request_service.poison_quarantines_total)
     from production_stack_tpu.router.resilience import get_resilience
     mgr = get_resilience()
     try:

@@ -87,6 +87,93 @@ _NETWORK_ERRORS = (
 disagg_handoffs_total = 0
 disagg_fallbacks_total = 0
 
+# Crash-recovery accounting (docs/crash_recovery.md), re-exported at
+# the router's /metrics by services/metrics_service.py:
+# mid-stream failover outcomes and poison-request quarantines.
+stream_resumes_by_outcome: dict = {}
+poison_quarantines_total = 0
+# Request ids observed in mid-stream backend crashes. A request whose
+# id has crashed >= POISON_CRASH_LIMIT engines is quarantined: no
+# further resume, terminal error — one request must not be able to
+# crash-loop the whole pool.
+POISON_CRASH_LIMIT = 2
+_poison_crashes: dict = {}
+
+
+def _note_crash(request_id: str) -> int:
+    # Bounded: the ledger only matters for requests crashing *now*; a
+    # hard reset at the cap beats unbounded growth on a long-lived
+    # router.
+    if len(_poison_crashes) > 4096:
+        _poison_crashes.clear()
+    count = _poison_crashes.get(request_id, 0) + 1
+    _poison_crashes[request_id] = count
+    return count
+
+
+def _bump_resume(outcome: str) -> None:
+    stream_resumes_by_outcome[outcome] = (
+        stream_resumes_by_outcome.get(outcome, 0) + 1)
+
+
+class _SseRelay:
+    """SSE-aware forwarding state for one proxied stream.
+
+    Buffers backend bytes and releases only whole ``\\n\\n``-delimited
+    events, so a mid-stream backend death never leaves a half-written
+    event on the client socket (a resumed stream can then continue
+    byte-exactly). Checkpoint comment frames (``: checkpoint {json}``)
+    are captured as the latest resume descriptor and stripped — they
+    are engine->router control traffic, not client payload. Forwarded
+    ``data:`` events have their content text measured so a resume can
+    tell the replacement engine exactly how much the client already
+    has (docs/crash_recovery.md)."""
+
+    _CKPT_PREFIX = b": checkpoint "
+
+    def __init__(self):
+        self.buf = bytearray()
+        self.descriptor: Optional[dict] = None
+        self.delivered_chars = 0
+
+    def feed(self, chunk: bytes) -> bytes:
+        self.buf.extend(chunk)
+        out = bytearray()
+        while True:
+            idx = self.buf.find(b"\n\n")
+            if idx < 0:
+                break
+            event = bytes(self.buf[:idx + 2])
+            del self.buf[:idx + 2]
+            if event.startswith(self._CKPT_PREFIX):
+                try:
+                    self.descriptor = json.loads(
+                        event[len(self._CKPT_PREFIX):].decode())
+                except (ValueError, UnicodeDecodeError):
+                    pass
+                continue
+            self._count(event)
+            out.extend(event)
+        return bytes(out)
+
+    def _count(self, event: bytes) -> None:
+        for line in event.split(b"\n"):
+            if not line.startswith(b"data:"):
+                continue
+            payload = line[5:].strip()
+            if not payload or payload == b"[DONE]":
+                continue
+            try:
+                obj = json.loads(payload)
+                choice = (obj.get("choices") or [{}])[0]
+                text = (choice.get("delta") or {}).get("content")
+                if text is None:
+                    text = choice.get("text")
+                if isinstance(text, str):
+                    self.delivered_chars += len(text)
+            except (ValueError, AttributeError, IndexError, TypeError):
+                pass
+
 
 class RetryableUpstreamError(Exception):
     """Backend failed — or, for 429, refused — before the first byte
@@ -107,11 +194,16 @@ class _BackendStreamError(Exception):
     """Backend died after bytes were already streamed downstream: the
     breaker hears about it, but the request must not be retried.
     Carries the prepared (partial) client response so the handler can
-    end the request without tripping aiohttp's unhandled-error path."""
+    end the request without tripping aiohttp's unhandled-error path,
+    plus the SSE relay (when the stream was SSE) whose captured
+    checkpoint descriptor lets ``_failover_stream`` resume the stream
+    on a healthy replacement (docs/crash_recovery.md)."""
 
-    def __init__(self, reason: str, response: web.StreamResponse):
+    def __init__(self, reason: str, response: web.StreamResponse,
+                 relay: "Optional[_SseRelay]" = None):
         super().__init__(reason)
         self.response = response
+        self.relay = relay
 
 
 class _ClientDisconnectedError(Exception):
@@ -398,14 +490,14 @@ async def route_general_request(request: web.Request,
                     else "retry budget exhausted")
                 continue
             except _BackendStreamError as e:
-                # Bytes already reached the client: no retry. Abort the
-                # connection so the client sees truncation rather than a
-                # falsely-complete body; aiohttp treats the resulting
-                # write failure as a premature disconnect (debug log),
-                # not an unhandled handler error.
-                if request.transport is not None:
-                    request.transport.close()
-                return e.response
+                # Bytes already reached the client: the attempt cannot
+                # be re-routed, but a checkpointed SSE stream can be
+                # RESUMED on a healthy replacement; otherwise the
+                # stream ends with a terminal in-band error event —
+                # never a silent truncation (docs/crash_recovery.md).
+                return await _failover_stream(
+                    request, e, request_id, healthy,
+                    tried | {server_url}, mgr)
             except _ClientDisconnectedError as e:
                 # Routine client disconnect: nothing to send and nobody
                 # to send it to — end quietly instead of surfacing a 500.
@@ -609,11 +701,13 @@ async def _route_disagg(request: web.Request, body: bytes, payload: dict,
                 else "decode retry budget exhausted")
             continue
         except _BackendStreamError as e:
-            # Bytes already reached the client: terminal, same as the
-            # monolithic path.
-            if request.transport is not None:
-                request.transport.close()
-            return e.response
+            # Bytes already reached the client: resume on another
+            # decode engine when a checkpoint was captured, else end
+            # with a terminal error event — same as the monolithic
+            # path.
+            return await _failover_stream(
+                request, e, request_id, decode_pool,
+                tried | {server_url}, mgr)
         except _ClientDisconnectedError as e:
             if e.response is not None:
                 return e.response
@@ -622,6 +716,188 @@ async def _route_disagg(request: web.Request, body: bytes, payload: dict,
         disagg_handoffs_total += 1
         return response
     return None
+
+
+async def _terminal_sse_error(request: web.Request,
+                              response: web.StreamResponse,
+                              relay: "Optional[_SseRelay]",
+                              message: str) -> web.StreamResponse:
+    """End an unrecoverable mid-stream failure honestly. For an SSE
+    stream: a terminal in-band ``error`` event plus ``[DONE]``, so the
+    client sees an explicit failure instead of a silently truncated
+    stream it could mistake for completion. For non-SSE bodies there
+    is no in-band channel — abort the connection so the truncation is
+    at least detectable."""
+    if relay is None:
+        if request.transport is not None:
+            request.transport.close()
+        return response
+    try:
+        payload = {"error": {"message": message,
+                             "type": "upstream_error"}}
+        await response.write(
+            f"data: {json.dumps(payload)}\n\n".encode())
+        await response.write(b"data: [DONE]\n\n")
+        await response.write_eof()
+    except Exception:
+        pass
+    return response
+
+
+async def _pipe_resume(request: web.Request, server_url: str,
+                       relay: "_SseRelay",
+                       response: web.StreamResponse,
+                       request_id: str, mgr) -> None:
+    """POST the captured checkpoint descriptor to ``server_url``'s
+    ``/v1/resume`` and pipe the replacement SSE stream into the
+    already-prepared client response. The relay keeps tracking
+    checkpoint frames and delivered chars, so a second crash on the
+    replacement resumes again. Raises ``RetryableUpstreamError`` when
+    the replacement refused the resume (try another candidate),
+    ``_BackendStreamError`` when it too died mid-stream, and
+    ``_ClientDisconnectedError`` when the downstream client went
+    away."""
+    session = _client_session(request.app)
+    body = json.dumps({
+        "descriptor": relay.descriptor,
+        "delivered_text_chars": relay.delivered_chars,
+        "stream": True,
+    }).encode()
+    # Any half-event from the dead backend is re-emitted whole by the
+    # replacement (delivered_chars only counts complete events).
+    relay.buf.clear()
+    blame: Optional[bool] = None
+    try:
+        async with session.post(
+            f"{server_url}/v1/resume", data=body,
+            headers={"content-type": "application/json",
+                     "x-request-id": request_id},
+            timeout=_request_timeout(mgr),
+        ) as backend:
+            if backend.status != 200:
+                blame = backend.status >= 500
+                raise RetryableUpstreamError(
+                    f"resume rejected with {backend.status}",
+                    status=backend.status,
+                )
+            stream = backend.content.iter_any()
+            while True:
+                try:
+                    chunk = await stream.__anext__()
+                except StopAsyncIteration:
+                    break
+                except _NETWORK_ERRORS as e:
+                    blame = True
+                    raise _BackendStreamError(
+                        f"{type(e).__name__}: {e}", response,
+                        relay=relay) from e
+                out = relay.feed(chunk)
+                if not out:
+                    continue
+                try:
+                    await response.write(out)
+                except _NETWORK_ERRORS as e:
+                    raise _ClientDisconnectedError(
+                        f"{type(e).__name__}: {e}", response) from e
+            try:
+                if relay.buf:
+                    await response.write(bytes(relay.buf))
+                    relay.buf.clear()
+                await response.write_eof()
+            except _NETWORK_ERRORS as e:
+                raise _ClientDisconnectedError(
+                    f"{type(e).__name__}: {e}", response) from e
+            blame = False
+    except (RetryableUpstreamError, _BackendStreamError,
+            _ClientDisconnectedError):
+        raise
+    except _NETWORK_ERRORS as e:
+        blame = True
+        raise RetryableUpstreamError(
+            f"{type(e).__name__}: {e}") from e
+    finally:
+        if mgr is not None:
+            if blame is True:
+                mgr.record_failure(server_url)
+            elif blame is False:
+                mgr.record_success(server_url)
+            else:
+                mgr.release_attempt(server_url)
+
+
+async def _failover_stream(request: web.Request,
+                           err: _BackendStreamError, request_id: str,
+                           pool, exclude: set,
+                           mgr) -> web.StreamResponse:
+    """Mid-stream failover (docs/crash_recovery.md): the backend died
+    after bytes reached the client. When the relay captured a
+    checkpoint descriptor, resume the stream byte-exactly on a healthy
+    replacement (repeating across crashes); a request id seen in
+    ``POISON_CRASH_LIMIT`` crashes is quarantined instead — one poison
+    request must not take down the whole pool. Every unrecoverable
+    path ends the stream with a terminal in-band error event."""
+    from production_stack_tpu.router.routing.logic import (
+        usable_endpoints,
+    )
+    global poison_quarantines_total
+    response, relay = err.response, err.relay
+    exclude = set(exclude)
+    try:
+        while True:
+            crashes = _note_crash(request_id)
+            if relay is None or relay.descriptor is None:
+                _bump_resume("no_checkpoint")
+                return await _terminal_sse_error(
+                    request, response, relay,
+                    "upstream engine died mid-stream and no resume "
+                    "checkpoint was available")
+            if crashes >= POISON_CRASH_LIMIT:
+                poison_quarantines_total += 1
+                _bump_resume("quarantined")
+                logger.error(
+                    "Quarantining poison request %s after %d engine "
+                    "crashes; not resuming again", request_id, crashes)
+                return await _terminal_sse_error(
+                    request, response, relay,
+                    f"request quarantined after {crashes} engine "
+                    f"crashes")
+            while True:
+                candidates = usable_endpoints(pool, exclude=exclude)
+                if not candidates:
+                    _bump_resume("exhausted")
+                    return await _terminal_sse_error(
+                        request, response, relay,
+                        "upstream engine died mid-stream and no "
+                        "healthy replacement accepted the resume")
+                server_url = candidates[0].url
+                if mgr is not None and not mgr.on_attempt(server_url):
+                    exclude.add(server_url)
+                    continue
+                try:
+                    await _pipe_resume(request, server_url, relay,
+                                       response, request_id, mgr)
+                except RetryableUpstreamError as e:
+                    logger.warning(
+                        "Resume of %s on %s refused (%s); trying "
+                        "next candidate", request_id, server_url, e)
+                    exclude.add(server_url)
+                    continue
+                except _BackendStreamError as e:
+                    logger.warning(
+                        "Resumed stream for %s died again on %s (%s)",
+                        request_id, server_url, e)
+                    exclude.add(server_url)
+                    err = e
+                    break  # outer loop: record the new crash
+                _bump_resume("resumed")
+                if mgr is not None:
+                    mgr.failovers_total += 1
+                logger.info("Resumed stream %s on %s (%d chars "
+                            "already delivered)", request_id,
+                            server_url, relay.delivered_chars)
+                return response
+    except _ClientDisconnectedError:
+        return response
 
 
 def _semantic_cache_store_callback(endpoint_path: str, payload: dict):
@@ -735,6 +1011,12 @@ async def _proxy_stream(request: web.Request, server_url: str,
             prepared = True
             first_chunk = True
             cache_buffer = bytearray() if store_callback else None
+            # SSE streams go through the relay: whole events only,
+            # checkpoint frames captured for mid-stream failover
+            # (docs/crash_recovery.md).
+            relay = (_SseRelay() if backend.headers.get(
+                "Content-Type", "").startswith("text/event-stream")
+                else None)
             stream = backend.content.iter_any()
             while True:
                 try:
@@ -742,10 +1024,14 @@ async def _proxy_stream(request: web.Request, server_url: str,
                 except StopAsyncIteration:
                     break
                 except _NETWORK_ERRORS as e:
-                    # Mid-stream death: bytes are already downstream, so
-                    # failover is impossible — blame the backend, abort.
+                    # Mid-stream death: bytes are already downstream,
+                    # so plain retry is impossible — blame the backend
+                    # and hand the relay up for a checkpoint resume.
                     raise _BackendStreamError(
-                        f"{type(e).__name__}: {e}", response) from e
+                        f"{type(e).__name__}: {e}", response,
+                        relay=relay) from e
+                if relay is not None:
+                    chunk = relay.feed(chunk)
                 if not chunk:
                     continue
                 monitor.on_request_response(
@@ -759,6 +1045,11 @@ async def _proxy_stream(request: web.Request, server_url: str,
                         and len(cache_buffer) < _CACHE_STORE_MAX_BYTES):
                     cache_buffer.extend(chunk)
                 await response.write(chunk)
+            if relay is not None and relay.buf:
+                # A backend that ended without the final blank line:
+                # flush the remainder so no bytes are lost.
+                await response.write(bytes(relay.buf))
+                relay.buf.clear()
             monitor.on_request_complete(server_url, request_id, time.time())
             completed = True
             await response.write_eof()

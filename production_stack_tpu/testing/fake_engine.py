@@ -13,6 +13,16 @@ Fault injection (for resilience tests): ``--fault MODE`` at startup or
 - ``hang``           accept the connection, never send a response
 - ``slow_first_token``  first token delayed by ``--fault-ttft`` seconds
 - ``abort_mid_stream``  stream a couple of chunks, then drop the socket
+- ``crash``          chaos (docs/crash_recovery.md): SIGKILL the whole
+                     process after ``--crash-after-tokens`` streamed
+                     tokens — the rawest mid-stream death, no FIN, no
+                     terminating chunk. Only sane for subprocess fakes
+                     (fleet pools, chaos tests); an in-process fake
+                     would kill the test runner.
+- ``hang_step``      a wedged device step: streams stall mid-response
+                     without closing, and /health answers 503
+                     ``{"status": "watchdog"}`` like the real server's
+                     ``--step-watchdog-s`` trip.
 - ``unhealthy``      API keeps working but /health answers 500
 - ``kv_missing``     disagg: a prefill-role fake emits descriptors whose
                      pages are unavailable; a decode-role fake answers
@@ -74,8 +84,8 @@ from production_stack_tpu.qos import (
 
 
 FAULT_MODES = (
-    "error500", "hang", "slow_first_token", "abort_mid_stream", "unhealthy",
-    "kv_missing", "overload",
+    "error500", "hang", "slow_first_token", "abort_mid_stream", "crash",
+    "hang_step", "unhealthy", "kv_missing", "overload",
 )
 
 ENGINE_ROLES = ("prefill", "decode", "both")
@@ -86,7 +96,9 @@ class FakeEngineState:
                  max_tokens_default: int = 32,
                  fault: Optional[str] = None, fault_ttft: float = 5.0,
                  role: str = "both", priority_aware: bool = False,
-                 max_concurrency: int = 0):
+                 max_concurrency: int = 0,
+                 checkpoint_interval: int = 0,
+                 crash_after_tokens: int = 4):
         self.model = model
         self.speed = speed  # tokens per second
         self.ttft = ttft  # seconds before first token
@@ -113,6 +125,14 @@ class FakeEngineState:
         # fake serves unlimited concurrency and overload is invisible.
         self.max_concurrency = max_concurrency
         self._slots: Optional[asyncio.Semaphore] = None
+        # Crash recovery (docs/crash_recovery.md): with a checkpoint
+        # interval set, streams carry ``: checkpoint {json}`` comment
+        # frames every N tokens and /v1/resume continues a broken
+        # stream from a descriptor; the crash fault SIGKILLs the
+        # process after this many streamed tokens.
+        self.checkpoint_interval = checkpoint_interval
+        self.crash_after_tokens = crash_after_tokens
+        self.stream_resumes = 0
         # Real EngineTracer (engine/tracing.py): fakes emit the same
         # engine-span lines and serve /debug/trace/{id} as the real
         # server. None disables tracing entirely.
@@ -220,6 +240,32 @@ def _chunk(request_id: str, model: str, text: Optional[str],
     }
 
 
+def _ckpt_frame(request_id: str, model: str, n_tokens: int,
+                done: int) -> bytes:
+    """SSE comment frame carrying the fake's resume descriptor — same
+    in-band relay channel the real engine uses; invisible to SSE
+    clients, captured (and stripped) by the router."""
+    desc = {
+        "version": 1,
+        "fake": True,
+        "response_id": request_id,
+        "chat": True,
+        "model": model,
+        "kv_dtype": "bf16",
+        "n_tokens": n_tokens,
+        "output_tokens": done,
+        "sampling": {"max_tokens": n_tokens},
+    }
+    return f": checkpoint {json.dumps(desc)}\n\n".encode()
+
+
+def _sigkill_self() -> None:
+    # The rawest mid-stream death: no FIN, no terminating chunk.
+    import os
+    import signal
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
 async def chat_completions(request: web.Request) -> web.StreamResponse:
     state: FakeEngineState = request.app["state"]
     state.requests_received += 1
@@ -303,8 +349,19 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                 if request.transport is not None:
                     request.transport.close()
                 return resp
+            if (state.fault == "crash"
+                    and i >= state.crash_after_tokens):
+                _sigkill_self()
+            if state.fault == "hang_step":
+                # A wedged device step: the stream stalls open while
+                # /health reports the watchdog trip.
+                await asyncio.sleep(3600)
             await asyncio.sleep(1.0 / state.speed)
             await resp.write(_sse(_chunk(request_id, model, word)))
+            if (state.checkpoint_interval > 0
+                    and (i + 1) % state.checkpoint_interval == 0):
+                await resp.write(_ckpt_frame(request_id, model,
+                                             n_tokens, i + 1))
         await resp.write(_sse(_chunk(request_id, model, None,
                                      finish="stop")))
         await resp.write(b"data: [DONE]\n\n")
@@ -528,6 +585,84 @@ async def disagg_handoff(request: web.Request) -> web.StreamResponse:
         state.running -= 1
 
 
+async def resume(request: web.Request) -> web.StreamResponse:
+    """POST /v1/resume stub (docs/crash_recovery.md): regenerate the
+    deterministic token text from the descriptor, skip what the router
+    already delivered, and stream the rest — no role chunk, same
+    response id — so the concatenated client stream matches an
+    uninterrupted run. Keeps the checkpoint cadence (and the crash
+    fault) active, so a resumed stream can crash and resume again."""
+    state: FakeEngineState = request.app["state"]
+    state.requests_received += 1
+    fault_resp = await _apply_api_fault(state, request)
+    if fault_resp is not None:
+        return fault_resp
+    body = await request.json()
+    desc = body.get("descriptor") or {}
+    if not desc.get("fake"):
+        return web.json_response(
+            {"error": {"message": "descriptor did not come from a "
+                                  "fake engine"}}, status=400)
+    delivered = int(body.get("delivered_text_chars") or 0)
+    n_tokens = int(desc.get("n_tokens") or state.max_tokens_default)
+    model = desc.get("model", state.model)
+    request_id = (desc.get("response_id")
+                  or f"chatcmpl-{uuid.uuid4().hex[:16]}")
+    words = [f"tok{i} " for i in range(n_tokens)]
+    state.stream_resumes += 1
+    state.running += 1
+    tracer, arrival = state.tracer, time.time()
+    if tracer is not None:
+        tracer.start(request_id,
+                     request_id=request.headers.get("x-request-id"),
+                     prompt_tokens=8)
+        tracer.event(request_id, "resume_restore",
+                     prior_tokens=int(desc.get("output_tokens") or 0))
+    try:
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            **_echo_headers(request),
+        })
+        await resp.prepare(request)
+        pos = 0
+        emitted = 0
+        for i, word in enumerate(words):
+            end = pos + len(word)
+            if end <= delivered:
+                pos = end
+                continue
+            text = word if pos >= delivered else word[delivered - pos:]
+            pos = end
+            if (state.fault == "crash"
+                    and emitted >= state.crash_after_tokens):
+                _sigkill_self()
+            if state.fault == "hang_step":
+                await asyncio.sleep(3600)
+            await asyncio.sleep(1.0 / state.speed)
+            await resp.write(_sse(_chunk(request_id, model, text)))
+            emitted += 1
+            if (state.checkpoint_interval > 0
+                    and (i + 1) % state.checkpoint_interval == 0):
+                await resp.write(_ckpt_frame(request_id, model,
+                                             n_tokens, i + 1))
+        await resp.write(_sse(_chunk(request_id, model, None,
+                                     finish="stop")))
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        state.total_served += 1
+        if tracer is not None:
+            tracer.finish(request_id, reason="stop",
+                          arrival_ts=arrival,
+                          first_scheduled_ts=arrival,
+                          first_token_ts=arrival,
+                          finish_ts=time.time(),
+                          prompt_tokens=8, output_tokens=n_tokens)
+        return resp
+    finally:
+        state.running -= 1
+
+
 async def models(request: web.Request) -> web.Response:
     state: FakeEngineState = request.app["state"]
     return web.json_response({
@@ -543,6 +678,16 @@ async def health(request: web.Request) -> web.Response:
     state: FakeEngineState = request.app["state"]
     if state.fault in ("error500", "unhealthy"):
         return web.json_response({"status": "injected fault"}, status=500)
+    if state.fault == "hang_step":
+        # Same contract as the real server's --step-watchdog-s trip:
+        # the prober rotates the wedged replica out on this 503.
+        return web.json_response({
+            "status": "watchdog",
+            "stuck_step_s": 3600.0,
+            "role": state.role,
+            "draining": state.draining,
+            "active_requests": state.running,
+        }, status=503)
     if state.fault == "hang":
         await asyncio.sleep(3600)
     return web.json_response({
@@ -731,11 +876,15 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
                       span_log: Optional[str] = None,
                       trace_ring: int = 256,
                       priority_aware: bool = False,
-                      max_concurrency: int = 0) -> web.Application:
+                      max_concurrency: int = 0,
+                      checkpoint_interval: int = 0,
+                      crash_after_tokens: int = 4) -> web.Application:
     state = FakeEngineState(model=model, speed=speed, ttft=ttft,
                             fault=fault, fault_ttft=fault_ttft,
                             role=role, priority_aware=priority_aware,
-                            max_concurrency=max_concurrency)
+                            max_concurrency=max_concurrency,
+                            checkpoint_interval=checkpoint_interval,
+                            crash_after_tokens=crash_after_tokens)
     if span_log or trace_ring > 0:
         # Same default as the real server: flight recorder on, span
         # log only when a path is given.
@@ -748,6 +897,7 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/disagg/prefill", disagg_prefill)
     app.router.add_post("/v1/disagg/handoff", disagg_handoff)
+    app.router.add_post("/v1/resume", resume)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
@@ -791,12 +941,23 @@ def main(argv=None) -> None:
                              "path ('-' = the process log), same "
                              "format as the real engine server's "
                              "--request-span-log")
+    parser.add_argument("--checkpoint-interval-tokens", type=int,
+                        default=0,
+                        help="Attach a resume descriptor to streams "
+                             "every N tokens, like the real engine's "
+                             "flag (docs/crash_recovery.md)")
+    parser.add_argument("--crash-after-tokens", type=int, default=4,
+                        help="With the crash fault: SIGKILL self after "
+                             "this many streamed tokens")
     args = parser.parse_args(argv)
     app = build_fake_engine(args.model, args.speed, args.ttft,
                             fault=args.fault, fault_ttft=args.fault_ttft,
                             role=args.role, span_log=args.span_log,
                             priority_aware=args.priority_aware,
-                            max_concurrency=args.max_concurrency)
+                            max_concurrency=args.max_concurrency,
+                            checkpoint_interval=(
+                                args.checkpoint_interval_tokens),
+                            crash_after_tokens=args.crash_after_tokens)
     web.run_app(app, host=args.host, port=args.port, print=None)
 
 

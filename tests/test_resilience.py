@@ -573,9 +573,13 @@ async def test_midstream_abort_never_retried():
             except Exception:
                 bodies.append("")  # truncated stream may error on read
         # Round-robin sent one request to each engine; the aborted one
-        # is truncated (no [DONE]), the other completed.
-        done_flags = sorted("data: [DONE]" in b for b in bodies)
-        assert done_flags == [False, True]
+        # ends with an honest in-band terminal error (no checkpoint was
+        # relayed, so mid-stream failover cannot resume it --
+        # docs/crash_recovery.md) followed by [DONE]; the other
+        # completed normally.
+        assert all(b.rstrip().endswith("data: [DONE]") for b in bodies)
+        error_flags = sorted('"type": "upstream_error"' in b for b in bodies)
+        assert error_flags == [False, True]
         # No retry happened: each engine saw exactly one request, and
         # the failover counters never moved.
         assert good.app["state"].requests_received == 1
