@@ -1698,6 +1698,205 @@ def run_chaos_worker(mode: str) -> None:
     }))
 
 
+def run_kvecon_worker(mode: str) -> None:
+    """KV-economy routing A/B (docs/kv_economy.md): a multi-tenant
+    prefix-heavy conversation mix against fake engines whose prefix
+    hot sets have real capacity (pinning too many tenants on one
+    replica thrashes its LRU), with the routing policy as the only
+    variable:
+
+      summary  -- kvstateaware on live /kv/summary scrapes
+      hashring -- session affinity keyed on the prompt's first chain
+                  block (blind consistent-hash pinning)
+      llq      -- least loaded (spreads tenants, no reuse anywhere)
+
+    Fake engines only (CPU, no JAX): TTFT shrinks 90% on a full
+    prefix hit, so the phase measures placement quality, not model
+    throughput. Reported: client TTFT percentiles and the aggregate
+    prefix hit rate read straight off the engine states.
+    """
+    import asyncio
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import aiohttp
+    from aiohttp import web
+
+    from production_stack_tpu.kvecon.summary import chain_text
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.resilience import (
+        ResilienceConfig,
+        initialize_resilience,
+    )
+    from production_stack_tpu.router.routing.logic import (
+        initialize_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        initialize_service_discovery,
+    )
+    from production_stack_tpu.router.services.rewriter import (
+        initialize_request_rewriter,
+    )
+    from production_stack_tpu.router.stats.engine_stats import (
+        initialize_engine_stats_scraper,
+    )
+    from production_stack_tpu.router.stats.request_stats import (
+        initialize_request_stats_monitor,
+    )
+    from production_stack_tpu.testing.fake_engine import build_fake_engine
+
+    # Heterogeneous KV capacity (the bf16-vs-int8 headroom spread the
+    # summaries exist to expose): one value per engine, hot-set cap ==
+    # advertised total pages.
+    capacities = [int(c) for c in os.environ.get(
+        "BENCH_KVECON_CAPACITY", "80,52,26").split(",")]
+    n_tenants = int(os.environ.get("BENCH_KVECON_TENANTS", "12"))
+    rounds = int(os.environ.get("BENCH_KVECON_ROUNDS", "6"))
+    ttft = float(os.environ.get("BENCH_KVECON_TTFT_S", "0.08"))
+    speed = float(os.environ.get("BENCH_KVECON_SPEED", "400"))
+    out_len = int(os.environ.get("BENCH_KVECON_OUT_LEN", "8"))
+    n_engines = len(capacities)
+
+    # Per-tenant shared prefix: ~6 chain blocks of distinct system
+    # prompt; each round appends ~1 block of conversation, so by the
+    # last round a tenant's chain is ~13 blocks. The 80/52/26 fleet
+    # fits exactly a 6/4/2 tenant split -- the split headroom-aware
+    # packing finds and blind hashing can't (a ring's ~even spread
+    # pins ~4 tenants on the 26-page replica, which thrashes).
+    def system_text(t):
+        seed = f"tenant-{t:03d} knowledge base. "
+        return (seed * (6 * 256 // len(seed) + 1))[:6 * 256]
+
+    def turn_text(t, r):
+        return (f"tenant-{t:03d} round-{r:02d} question: " * 8)[:220]
+
+    async def run():
+        runners = []
+        states = []
+        urls = []
+        for cap in capacities:
+            app = build_fake_engine(model="bench-fake", speed=speed,
+                                    ttft=ttft, kv_hot_capacity=cap,
+                                    kv_total_pages=cap)
+            states.append(app["state"])
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            urls.append("http://127.0.0.1:"
+                        f"{site._server.sockets[0].getsockname()[1]}")
+
+        initialize_service_discovery("static", urls=urls,
+                                     models=["bench-fake"] * n_engines)
+        initialize_request_stats_monitor(60.0)
+        scraper = initialize_engine_stats_scraper(3600.0)
+        if mode == "summary":
+            initialize_routing_logic("kvstateaware")
+        elif mode == "hashring":
+            initialize_routing_logic("session",
+                                     session_key="x-session-id")
+        else:
+            initialize_routing_logic("llq")
+        initialize_request_rewriter("noop")
+        initialize_resilience(ResilienceConfig(
+            max_retries=2, backend_connect_timeout=2.0,
+            backend_timeout=30.0, health_check_interval=0.0))
+        router = web.AppRunner(build_app())
+        await router.setup()
+        site = web.TCPSite(router, "127.0.0.1", 0)
+        await site.start()
+        router_url = ("http://127.0.0.1:"
+                      f"{site._server.sockets[0].getsockname()[1]}")
+
+        loop = asyncio.get_event_loop()
+        session = aiohttp.ClientSession()
+        results = []
+
+        async def one_request(tenant, rnd):
+            messages = [{"role": "system",
+                         "content": system_text(tenant)}]
+            for r in range(rnd + 1):
+                messages.append({"role": "user",
+                                 "content": turn_text(tenant, r)})
+            ring_key = str(chain_text(system_text(tenant))[0])
+            rec = {"ttft": None, "error": None}
+            t0 = time.time()
+            try:
+                async with session.post(
+                        router_url + "/v1/chat/completions",
+                        json={"model": "bench-fake",
+                              "messages": messages,
+                              "max_tokens": out_len, "stream": True},
+                        headers={"x-session-id": ring_key}) as resp:
+                    if resp.status != 200:
+                        rec["error"] = f"status {resp.status}"
+                    async for raw in resp.content:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if (not line.startswith("data: ")
+                                or line == "data: [DONE]"):
+                            continue
+                        delta = json.loads(
+                            line[len("data: "):])["choices"][0]["delta"]
+                        if delta.get("content") and rec["ttft"] is None:
+                            rec["ttft"] = time.time() - t0
+            except Exception as e:
+                rec["error"] = f"{type(e).__name__}: {e}"
+            results.append(rec)
+
+        # Sequential submission with a fresh scrape before each
+        # request: kvstateaware routes on what the engines advertise
+        # RIGHT NOW (headroom packs cold tenants, hits pin warm
+        # ones); the sync scraper runs in an executor so it doesn't
+        # deadlock the loop serving the in-process fakes.
+        for rnd in range(rounds):
+            for tenant in range(n_tenants):
+                await loop.run_in_executor(None, scraper.scrape_once)
+                await one_request(tenant, rnd)
+
+        scraper.close()
+        await session.close()
+        await router.cleanup()
+        for runner in runners:
+            await runner.cleanup()
+
+        hit = sum(s.prefix_hit_tokens for s in states)
+        query = sum(s.prefix_query_tokens for s in states)
+        return dict(
+            results=results,
+            hit_rate=(hit / query) if query else 0.0,
+            per_engine_hot=[len(s.kv_hot) for s in states],
+        )
+
+    out = asyncio.run(run())
+
+    def pctl(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    results = out["results"]
+    ttfts = [r["ttft"] for r in results if r["ttft"] is not None]
+    dropped = sum(1 for r in results if r["error"] is not None)
+    print(json.dumps({
+        "metric": f"kv-economy routing bench ({mode}): aggregate "
+                  "prefix hit rate across capped fake engines",
+        "value": round(out["hit_rate"], 4),
+        "unit": "fraction",
+        "vs_baseline": 0.0,
+        "extra": {
+            "policy": mode,
+            "requests_total": len(results),
+            "dropped": dropped,
+            "prefix_hit_rate": round(out["hit_rate"], 4),
+            "ttft_p50_s": round(pctl(ttfts, 0.5) or -1.0, 4),
+            "ttft_p99_s": round(pctl(ttfts, 0.99) or -1.0, 4),
+            "per_engine_hot_chains": out["per_engine_hot"],
+        },
+    }))
+
+
 def _spawn_worker(impl: str, tpu: bool, timeout: int, extra_env=None):
     """Run one benchmark worker; returns (result_dict | None, error)."""
     cmd = [sys.executable, os.path.abspath(__file__),
@@ -1748,6 +1947,9 @@ def main() -> None:
                 os.environ.get("BENCH_OVERLOAD_QOS", "off"))
         elif impl == "chaos":
             run_chaos_worker(os.environ.get("BENCH_CHAOS_CKPT", "on"))
+        elif impl == "kvecon":
+            run_kvecon_worker(
+                os.environ.get("BENCH_KVECON_POLICY", "summary"))
         else:
             run_worker(impl, tpu="--tpu" in sys.argv)
         return
@@ -1982,6 +2184,30 @@ def main() -> None:
                         "chaos_resume_gap_p50_s",
                         "chaos_resume_gap_p99_s"):
                 result["extra"][f"{tag}_{key}"] = ce.get(key)
+
+        # Cluster KV economy routing A/B (docs/kv_economy.md): the
+        # same multi-tenant prefix-heavy mix against capped-hot-set
+        # fake engines, with the routing policy as the only variable.
+        # Summary routing must beat both the blind hash ring and
+        # least-loaded on hit rate with TTFT p50 improved; numbers
+        # ride in extra under kvecon_{summary,hashring,llq}_*.
+        for tag, kmode in (("kvecon_summary", "summary"),
+                           ("kvecon_hashring", "hashring"),
+                           ("kvecon_llq", "llq")):
+            sys.stderr.write(f"[bench] running {tag} worker "
+                             f"(timeout {timeout}s)...\n")
+            ke_result, ke_err = _spawn_worker(
+                "kvecon", False, timeout,
+                extra_env={"BENCH_KVECON_POLICY": kmode,
+                           "JAX_PLATFORMS": "cpu"})
+            if ke_result is None:
+                errors[f"{tag}_error"] = ke_err
+                sys.stderr.write(f"[bench] WARNING: {ke_err}\n")
+                continue
+            ke = ke_result.get("extra", {})
+            for key in ("prefix_hit_rate", "ttft_p50_s",
+                        "ttft_p99_s", "requests_total", "dropped"):
+                result["extra"][f"{tag}_{key}"] = ke.get(key)
 
     if result is None:
         # Never hang the driver: report the failure as the metric line.

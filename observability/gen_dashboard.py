@@ -288,6 +288,35 @@ def build():
               [target('vllm:engine_attention_impl',
                       "{{phase}}={{impl}} {{server}}")],
               18, 100, w=6, kind="stat"),
+        # ---- Cluster KV economy (docs/kv_economy.md) -----------------------
+        row("KV Economy", 107),
+        panel("Hot Prefix Chains Advertised",
+              [target('vllm:engine_kv_summary_hot_chains')],
+              0, 108),
+        panel("KV Headroom Fraction",
+              [target('vllm:engine_kv_headroom_frac')],
+              8, 108, unit="percentunit"),
+        panel("KV Summary Staleness",
+              [target('vllm:engine_kv_summary_age_seconds')],
+              16, 108, unit="s"),
+        panel("Shared Cache Ops (rate)",
+              [target('sum(rate(vllm:engine_kv_cluster_hits[5m]))',
+                      "hits"),
+               target('sum(rate(vllm:engine_kv_cluster_misses[5m]))',
+                      "misses"),
+               target('sum(rate('
+                      'vllm:engine_kv_cluster_admissions[5m]))',
+                      "admissions"),
+               target('sum(rate('
+                      'vllm:engine_kv_cluster_rejections[5m]))',
+                      "rejections")],
+              0, 115),
+        panel("Free KV Pages",
+              [target('vllm:engine_kv_free_page_headroom')],
+              8, 115),
+        panel("Expected Prefix-Hit Tokens (last placement)",
+              [target('vllm:kv_route_expected_hit_tokens')],
+              16, 115),
     ]
     return {
         "title": "TPU Stack — Serving Overview",

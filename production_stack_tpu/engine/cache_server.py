@@ -4,8 +4,17 @@ The reference deploys ``lmcache_experimental_server`` as a standalone
 Deployment that multiple vLLM pods share KV through
 (helm/templates/deployment-cache-server.yaml:1-52, tutorial 06). This is
 our DCN-tier equivalent: a content-addressed page store over HTTP with
-msgpack framing, LRU-bounded, shared by every engine pod configured
-with ``--kv-remote-url``.
+msgpack framing, shared by every engine pod configured with
+``--kv-remote-url``.
+
+The store behind the routes is the MANAGED cluster prefix cache
+(kvecon/cluster_cache.py, docs/kv_economy.md): admission by
+distinct-requester demand promotion (PUT answers 200 with an
+``{"admitted": bool}`` verdict; probe and fetch misses record demand),
+TTL + LRU eviction of coldest chains whole under capacity watermarks,
+and per-chain metadata. ``build_cache_server``'s defaults
+(admit_hits=1, no TTL, watermarks 1.0) reproduce the legacy
+store-on-first-write LRU; the CLI defaults to the managed policy.
 
 Run: ``python -m production_stack_tpu.engine.cache_server --port 8100``
 """
@@ -13,59 +22,17 @@ Run: ``python -m production_stack_tpu.engine.cache_server --port 8100``
 from __future__ import annotations
 
 import argparse
-import threading
-from collections import OrderedDict
 
 from aiohttp import web
 
+from production_stack_tpu.kvecon.cluster_cache import (
+    CHAIN_HEADER,
+    REQUESTER_HEADER,
+    ManagedKVStore,
+)
 from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
-
-
-class BlobStore:
-    def __init__(self, max_bytes: int):
-        self.max_bytes = max_bytes
-        self._store: "OrderedDict[str, bytes]" = OrderedDict()
-        self._bytes = 0
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-
-    def put(self, key: str, blob: bytes) -> None:
-        with self._lock:
-            old = self._store.pop(key, None)
-            if old is not None:
-                self._bytes -= len(old)
-            while self._bytes + len(blob) > self.max_bytes and self._store:
-                _, evicted = self._store.popitem(last=False)
-                self._bytes -= len(evicted)
-            if len(blob) <= self.max_bytes:
-                self._store[key] = blob
-                self._bytes += len(blob)
-
-    def get(self, key: str):
-        with self._lock:
-            blob = self._store.get(key)
-            if blob is not None:
-                self._store.move_to_end(key)
-                self.hits += 1
-            else:
-                self.misses += 1
-            return blob
-
-    def contains(self, key: str) -> bool:
-        with self._lock:
-            return key in self._store
-
-    def stats(self) -> dict:
-        with self._lock:
-            return {
-                "entries": len(self._store),
-                "bytes": self._bytes,
-                "hits": self.hits,
-                "misses": self.misses,
-            }
 
 
 def _validate_payload(blob: bytes):
@@ -109,14 +76,42 @@ def _validate_payload(blob: bytes):
     return None
 
 
+def _wire_dtype(blob: bytes) -> str:
+    """First array's dtype, for the chain metadata (payload was
+    already validated)."""
+    import msgpack
+    try:
+        return str(msgpack.unpackb(blob)["arrays"][0]["dtype"])
+    except Exception:
+        return ""
+
+
 # Upper bound on keys per batched GET: bounds the response to
 # ~max page size x this many blobs and keeps one request from
 # monopolising the store lock.
 BATCH_GET_MAX_KEYS = 1024
 
 
-def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
-    store = BlobStore(max_bytes)
+def build_cache_server(max_bytes: int = 8 * 1024 ** 3,
+                       admit_hits: int = 1,
+                       ttl_s: float = 0.0,
+                       watermark_high: float = 1.0,
+                       watermark_low: float = 1.0,
+                       clock=None) -> web.Application:
+    store = ManagedKVStore(
+        max_bytes, admit_hits=admit_hits, ttl_s=ttl_s,
+        watermark_high=watermark_high, watermark_low=watermark_low,
+        **({"clock": clock} if clock is not None else {}))
+
+    def _requester(request: web.Request) -> str:
+        # Fall back to the peer address so legacy clients without the
+        # header still count as (coarse) distinct requesters.
+        rid = request.headers.get(REQUESTER_HEADER, "")
+        if rid:
+            return rid
+        peer = request.transport.get_extra_info("peername") \
+            if request.transport else None
+        return peer[0] if isinstance(peer, tuple) else "anon"
 
     async def put_kv(request: web.Request) -> web.Response:
         blob = await request.read()
@@ -124,11 +119,21 @@ def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
         if err is not None:
             return web.json_response(
                 {"error": {"message": err}}, status=400)
-        store.put(request.match_info["key"], blob)
-        return web.Response(status=200)
+        key = request.match_info["key"]
+        chain = request.headers.get(CHAIN_HEADER) or None
+        if chain:
+            # Demand recorded against the bare key (probe misses don't
+            # know the chain) merges into the chain before the verdict.
+            store.associate(key, chain)
+        admitted = store.put(
+            key, blob, chain_id=chain,
+            requester=_requester(request),
+            kv_dtype=_wire_dtype(blob))
+        return web.json_response({"admitted": admitted})
 
     async def get_kv(request: web.Request) -> web.Response:
-        blob = store.get(request.match_info["key"])
+        blob = store.get(request.match_info["key"],
+                         requester=_requester(request))
         if blob is None:
             return web.Response(status=404)
         return web.Response(
@@ -136,7 +141,8 @@ def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
         )
 
     async def head_kv(request: web.Request) -> web.Response:
-        if store.contains(request.match_info["key"]):
+        if store.contains(request.match_info["key"],
+                          requester=_requester(request)):
             return web.Response(status=200)
         return web.Response(status=404)
 
@@ -165,7 +171,8 @@ def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
                 {"error": {"message":
                            f"too many keys (max {BATCH_GET_MAX_KEYS})"}},
                 status=400)
-        blobs = [store.get(k) for k in keys]
+        rid = _requester(request)
+        blobs = [store.get(k, requester=rid) for k in keys]
         return web.Response(
             body=msgpack.packb({"blobs": blobs}),
             content_type="application/octet-stream")
@@ -178,14 +185,25 @@ def build_cache_server(max_bytes: int = 8 * 1024 ** 3) -> web.Application:
 
     async def metrics(request: web.Request) -> web.Response:
         s = store.stats()
-        total = s["hits"] + s["misses"]
         lines = [
             "# TYPE kvcache:entries gauge",
             f"kvcache:entries {s['entries']}",
             "# TYPE kvcache:bytes gauge",
             f"kvcache:bytes {s['bytes']}",
             "# TYPE kvcache:hit_rate gauge",
-            f"kvcache:hit_rate {(s['hits'] / total) if total else 0.0}",
+            f"kvcache:hit_rate {s['hit_rate']}",
+            "# TYPE kvcache:chains gauge",
+            f"kvcache:chains {s['chains']}",
+            "# TYPE kvcache:hits_total counter",
+            f"kvcache:hits_total {s['hits']}",
+            "# TYPE kvcache:misses_total counter",
+            f"kvcache:misses_total {s['misses']}",
+            "# TYPE kvcache:admissions_total counter",
+            f"kvcache:admissions_total {s['admissions']}",
+            "# TYPE kvcache:evictions_total counter",
+            f"kvcache:evictions_total {s['evictions']}",
+            "# TYPE kvcache:rejected_puts_total counter",
+            f"kvcache:rejected_puts_total {s['rejected_puts']}",
             "",
         ]
         return web.Response(text="\n".join(lines),
@@ -210,11 +228,39 @@ def main(argv=None) -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8100)
     parser.add_argument("--max-bytes", type=int, default=8 * 1024 ** 3)
+    # Managed-cache policy (docs/kv_economy.md). The CLI defaults are
+    # the managed economy; pass --kv-admit-hits 1 --kv-ttl-s 0
+    # --kv-watermark-high 1.0 --kv-watermark-low 1.0 for the legacy
+    # store-on-first-write LRU.
+    parser.add_argument(
+        "--kv-admit-hits", type=int, default=2,
+        help="Distinct requesters that must want a chain before its "
+             "pages are stored")
+    parser.add_argument(
+        "--kv-ttl-s", type=float, default=900.0,
+        help="Seconds an idle chain survives before TTL eviction "
+             "(0 disables)")
+    parser.add_argument(
+        "--kv-watermark-high", type=float, default=0.95,
+        help="Stored-bytes fraction of --max-bytes that triggers "
+             "coldest-chain eviction")
+    parser.add_argument(
+        "--kv-watermark-low", type=float, default=0.80,
+        help="Fraction eviction drains down to once triggered")
     args = parser.parse_args(argv)
-    logger.info("KV cache server on %s:%d (budget %d MiB)",
-                args.host, args.port, args.max_bytes // 2 ** 20)
-    web.run_app(build_cache_server(args.max_bytes), host=args.host,
-                port=args.port, print=None)
+    logger.info(
+        "KV cache server on %s:%d (budget %d MiB, admit_hits=%d, "
+        "ttl=%gs, watermarks %.2f/%.2f)",
+        args.host, args.port, args.max_bytes // 2 ** 20,
+        args.kv_admit_hits, args.kv_ttl_s,
+        args.kv_watermark_high, args.kv_watermark_low)
+    web.run_app(
+        build_cache_server(
+            args.max_bytes, admit_hits=args.kv_admit_hits,
+            ttl_s=args.kv_ttl_s,
+            watermark_high=args.kv_watermark_high,
+            watermark_low=args.kv_watermark_low),
+        host=args.host, port=args.port, print=None)
 
 
 if __name__ == "__main__":

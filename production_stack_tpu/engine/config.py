@@ -317,6 +317,45 @@ class OffloadConfig:
 
 
 @dataclasses.dataclass
+class KVEconConfig:
+    """Cluster KV economy knobs (docs/kv_economy.md).
+
+    Engine-side semantics: the summary tracker behind GET /kv/summary
+    and the host pool's eviction hysteresis. The cluster cache server
+    reuses the same flag spellings for its authoritative server-side
+    policy (admission by distinct-requester demand, TTL + watermark
+    chain eviction) with its own defaults — see
+    engine/cache_server.py.
+    """
+
+    # Hot chains advertised in the /kv/summary snapshot (and tracker
+    # sizing: up to 8x this many chains are tracked pre-admission).
+    summary_top_k: int = 64
+    # Decayed hit count a chain needs before it is advertised as hot.
+    admit_hits: int = 2
+    # Seconds an idle chain stays in the summary tracker (0 = no TTL).
+    ttl_s: float = 900.0
+    # Host offload pool fill fractions: above high, evict down to low
+    # (oldest-first, same order as the pool's LRU). 1.0/1.0 keeps the
+    # legacy evict-exactly-at-capacity behavior.
+    watermark_high: float = 1.0
+    watermark_low: float = 1.0
+
+    def __post_init__(self):
+        if self.summary_top_k < 1:
+            raise ValueError("kvecon.summary_top_k must be >= 1")
+        if self.admit_hits < 1:
+            raise ValueError("kvecon.admit_hits must be >= 1")
+        if self.ttl_s < 0:
+            raise ValueError("kvecon.ttl_s must be >= 0")
+        if not 0.0 < self.watermark_low <= self.watermark_high <= 1.0:
+            raise ValueError(
+                "kvecon watermarks must satisfy 0 < low <= high <= 1 "
+                f"(got low={self.watermark_low!r} "
+                f"high={self.watermark_high!r})")
+
+
+@dataclasses.dataclass
 class QoSConfig:
     """Overload quality-of-service (docs/qos.md): priority classes,
     preempt-to-offload, and engine-side shedding."""
@@ -358,6 +397,8 @@ class EngineConfig:
         default_factory=OffloadConfig)
     lora: LoRAConfig = dataclasses.field(default_factory=LoRAConfig)
     qos: QoSConfig = dataclasses.field(default_factory=QoSConfig)
+    kvecon: KVEconConfig = dataclasses.field(
+        default_factory=KVEconConfig)
     seed: int = 0
     # Disaggregated serving role (docs/disaggregation.md):
     #   both    -> monolithic engine (default; fully backward
@@ -503,6 +544,11 @@ CLI_FLAG_ALIASES = {
     "offload.enable": "--enable-kv-offload",
     "offload.host_pool_bytes": "--kv-host-pool-bytes",
     "offload.remote_url": "--kv-remote-url",
+    "kvecon.summary_top_k": "--kv-summary-top-k",
+    "kvecon.admit_hits": "--kv-admit-hits",
+    "kvecon.ttl_s": "--kv-ttl-s",
+    "kvecon.watermark_high": "--kv-watermark-high",
+    "kvecon.watermark_low": "--kv-watermark-low",
 }
 
 INTERNAL_FIELDS = {

@@ -147,7 +147,12 @@ class LLMEngine:
             KVOffloadManager,
             RemoteKVClient,
         )
-        remote = (RemoteKVClient(self.config.offload.remote_url)
+        # A per-process requester id: the managed cluster cache counts
+        # DISTINCT requesters demanding a chain for admission
+        # promotion (docs/kv_economy.md).
+        remote = (RemoteKVClient(
+                      self.config.offload.remote_url,
+                      requester=f"engine-{uuid.uuid4().hex[:12]}")
                   if self.config.offload.remote_url else None)
         # Tier keys are namespaced by the actual page storage format
         # (int8 vs the model dtype) so pods with different
@@ -155,7 +160,10 @@ class LLMEngine:
         kv_dtype = ("int8" if self.runner.kv_quantized
                     else str(np.dtype(self.config.model.jax_dtype)))
         self.offload = KVOffloadManager(
-            host_pool=HostKVPool(self.config.offload.host_pool_bytes),
+            host_pool=HostKVPool(
+                self.config.offload.host_pool_bytes,
+                watermark_high=self.config.kvecon.watermark_high,
+                watermark_low=self.config.kvecon.watermark_low),
             remote=remote,
             kv_dtype=kv_dtype,
         )
@@ -204,10 +212,11 @@ class LLMEngine:
             tokens, seq.pages, seq.num_hashed_pages, seq.cache_salt)
         hashes = PagedCacheManager.chain_hashes(
             tokens, self.cache_manager.page_size, seq.cache_salt)
+        chain = self.offload.chain_id(hashes[0]) if hashes else None
         shipped = 0
         for page_id, page_hash in zip(seq.pages, hashes):
             payload = self.runner.read_page(page_id)
-            self.offload.offload_page(page_hash, *payload)
+            self.offload.offload_page(page_hash, *payload, chain=chain)
             shipped += 1
         return shipped
 
@@ -317,6 +326,17 @@ class LLMEngine:
             spec_off=spec_off,
         )
         with self._lock:
+            if (not handoff_prefill
+                    and self._cold_start_target(seq) is not None):
+                # Shared cluster cache (docs/kv_economy.md): another
+                # engine may already hold this prompt's prefix KV.
+                # Park in AWAITING_KV so the step loop probes the
+                # shared tier (one HEAD) before prefill — hit means a
+                # batched restore instead of recompute, miss or tier
+                # down degrades straight to compute.
+                seq.state = SequenceState.AWAITING_KV
+                seq.cold_start_probe = True
+                seq.handoff_arrival_time = time.time()
             self.sequences[seq.seq_id] = seq
             try:
                 self.scheduler.add_sequence(seq)
@@ -327,7 +347,31 @@ class LLMEngine:
                 self._tracer.start(
                     seq.seq_id, request_id=request_id,
                     prompt_tokens=seq.num_prompt_tokens)
+                if seq.cold_start_probe:
+                    self._tracer.event(seq.seq_id, "awaiting_kv_park")
         return seq.seq_id
+
+    def _cold_start_target(self, seq: Sequence):
+        """First full usable prompt page neither in HBM nor hashed
+        locally — the page whose presence in the shared cluster cache
+        decides whether a cold prompt restores or computes. None when
+        there is no shared tier, prefix caching is off, or the local
+        cache already covers the prompt (then the normal first-touch
+        path handles everything). Caller holds self._lock."""
+        from production_stack_tpu.engine.kv_cache import (
+            PagedCacheManager,
+        )
+        if (self.offload is None or self.offload.remote is None
+                or not self.config.cache.enable_prefix_caching):
+            return None
+        usable = len(seq.prompt_token_ids) - 1
+        hashes = PagedCacheManager.chain_hashes(
+            seq.prompt_token_ids[:usable],
+            self.cache_manager.page_size, seq.cache_salt)
+        for page_hash in hashes:
+            if page_hash not in self.cache_manager._hash_to_page:
+                return page_hash
+        return None
 
     def add_handoff(self, prompt_token_ids: List[int],
                     first_token: int,
@@ -512,9 +556,12 @@ class LLMEngine:
                         seq.cache_salt)
                     done = self._ckpt_shipped_pages.get(seq.seq_id, 0)
                     pairs = list(zip(seq.pages, hashes))
+                    chain = (self.offload.chain_id(hashes[0])
+                             if hashes else None)
                     for page_id, page_hash in pairs[done:]:
                         payload = self.runner.read_page(page_id)
-                        self.offload.offload_page(page_hash, *payload)
+                        self.offload.offload_page(page_hash, *payload,
+                                                  chain=chain)
                         kv_bytes += sum(int(a.nbytes) for a in payload)
                         shipped += 1
                     self._ckpt_shipped_pages[seq.seq_id] = len(pairs)
@@ -560,9 +607,12 @@ class LLMEngine:
             hashes = PagedCacheManager.chain_hashes(
                 seq.prompt_token_ids, self.cache_manager.page_size,
                 seq.cache_salt)
+            chain = (self.offload.chain_id(hashes[0])
+                     if hashes else None)
             for page_id, page_hash in zip(seq.pages, hashes):
                 payload = self.runner.read_page(page_id)
-                self.offload.offload_page(page_hash, *payload)
+                self.offload.offload_page(page_hash, *payload,
+                                          chain=chain)
                 info["kv_bytes"] += sum(
                     int(a.nbytes) for a in payload)
                 info["page_keys"].append(
@@ -582,10 +632,24 @@ class LLMEngine:
         """Availability of a parked handoff's KV. Pages ship in chain
         order, so probing the LAST shipped page (one HEAD at most)
         answers for the whole chain. True/False is definitive; None =
-        tier unreachable (keep waiting until the handoff timeout)."""
+        tier unreachable (keep waiting until the handoff timeout).
+
+        A cold-start probe (docs/kv_economy.md) asks a different
+        question — "does the shared cache extend my local prefix?" —
+        so it probes the FIRST page the local cache is missing: any
+        hit there is a win (first-touch restore then pulls the longest
+        available chain), and probing the last page would miss
+        partially cached chains that are still worth restoring. The
+        HEAD also records this engine's demand server-side, which is
+        what promotes genuinely shared chains into the cache."""
         from production_stack_tpu.engine.kv_cache import (
             PagedCacheManager,
         )
+        if seq.cold_start_probe:
+            target = self._cold_start_target(seq)
+            if target is None:
+                return True  # local cache caught up meanwhile
+            return self.offload.handoff_ready(target)
         usable = len(seq.prompt_token_ids) - 1
         hashes = PagedCacheManager.chain_hashes(
             seq.prompt_token_ids[:usable],
@@ -605,27 +669,41 @@ class LLMEngine:
                 if seq.state != SequenceState.AWAITING_KV:
                     continue
                 ready = self._handoff_kv_ready(seq)
-                if ready is None:
+                if ready is None and seq.cold_start_probe:
+                    # Cold-start probes degrade immediately when the
+                    # shared tier is down: nothing was shipped for
+                    # this request, so waiting buys nothing — compute.
+                    logger.debug(
+                        "Cold-start probe %s: shared tier "
+                        "unreachable; computing", seq.seq_id)
+                elif ready is None:
                     if (now - seq.handoff_arrival_time
                             < self.config.handoff_timeout_s):
                         continue
                     logger.warning(
                         "Handoff %s timed out waiting for KV; "
                         "degrading to recompute", seq.seq_id)
-                elif ready is False:
+                elif ready is False and not seq.cold_start_probe:
                     logger.warning(
                         "Handoff %s KV not in any offload tier; "
                         "degrading to recompute", seq.seq_id)
                 seq.state = SequenceState.WAITING
-                self.metrics.on_handoff_admitted(
-                    now - seq.handoff_arrival_time)
+                if not seq.cold_start_probe:
+                    # Cold-start parks stay out of the disagg handoff
+                    # admission histogram — they are routine admission
+                    # probes, not handoff transfers.
+                    self.metrics.on_handoff_admitted(
+                        now - seq.handoff_arrival_time)
                 if self._tracer is not None:
                     self._tracer.event(
                         seq.seq_id, "awaiting_kv_restore",
                         waited_ms=round(
                             (now - seq.handoff_arrival_time) * 1e3, 2),
                         outcome=("ready" if ready
+                                 else "tier_down"
+                                 if ready is None and seq.cold_start_probe
                                  else "timeout" if ready is None
+                                 else "miss" if seq.cold_start_probe
                                  else "lost"))
 
     def register_lora(self, name_or_path: str,

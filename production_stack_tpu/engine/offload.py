@@ -82,15 +82,32 @@ def _stable_key(page_hash: PageHash, kv_dtype: str = "") -> str:
 
 
 class HostKVPool:
-    """LRU pool of KV pages in host RAM."""
+    """LRU pool of KV pages in host RAM.
 
-    def __init__(self, max_bytes: int = 2 * 1024 ** 3):
+    Eviction runs on watermark hysteresis (docs/kv_economy.md): a put
+    that would push usage past ``watermark_high * max_bytes`` evicts
+    oldest-first down to ``watermark_low * max_bytes``, so a full pool
+    sheds a batch of cold pages once instead of evicting one page on
+    every subsequent put. The defaults (1.0/1.0) preserve the legacy
+    evict-exactly-at-capacity behavior.
+    """
+
+    def __init__(self, max_bytes: int = 2 * 1024 ** 3,
+                 watermark_high: float = 1.0,
+                 watermark_low: float = 1.0):
+        if not 0.0 < watermark_low <= watermark_high <= 1.0:
+            raise ValueError(
+                "require 0 < watermark_low <= watermark_high <= 1, "
+                f"got low={watermark_low} high={watermark_high}")
         self.max_bytes = max_bytes
+        self.watermark_high = watermark_high
+        self.watermark_low = watermark_low
         self._pool: "OrderedDict[str, PagePayload]" = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def __len__(self) -> int:
         return len(self._pool)
@@ -105,10 +122,14 @@ class HostKVPool:
             if key in self._pool:
                 self._pool.move_to_end(key)
                 return
-            while self._bytes + size > self.max_bytes and self._pool:
-                _, evicted = self._pool.popitem(last=False)
-                self._bytes -= sum(a.nbytes for a in evicted)
-            if size <= self.max_bytes:
+            high = self.watermark_high * self.max_bytes
+            low = self.watermark_low * self.max_bytes
+            if self._bytes + size > high:
+                while self._bytes + size > low and self._pool:
+                    _, evicted = self._pool.popitem(last=False)
+                    self._bytes -= sum(a.nbytes for a in evicted)
+                    self.evictions += 1
+            if self._bytes + size <= self.max_bytes:
                 self._pool[key] = payload
                 self._bytes += size
 
@@ -134,13 +155,33 @@ class RemoteKVClient:
     HTTP — PUT /kv/<key>, GET /kv/<key>, HEAD /kv/<key>.
     """
 
-    def __init__(self, base_url: str, timeout_s: float = 5.0):
+    def __init__(self, base_url: str, timeout_s: float = 5.0,
+                 requester: str = ""):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        # Identity sent as X-KV-Requester so the managed cache can
+        # count DISTINCT engines demanding a chain (admission by
+        # demand promotion, kvecon/cluster_cache.py).
+        self.requester = requester
+        # Engine-side view of the shared tier, exported as the
+        # vllm:kv_cluster_* counters (engine/server.py /metrics).
+        self.hits = 0
+        self.misses = 0
+        self.admissions = 0
+        self.rejections = 0
         import requests
         self._session = requests.Session()
 
-    def put(self, key: str, payload: PagePayload) -> bool:
+    def _headers(self, chain: Optional[str] = None) -> Dict[str, str]:
+        headers = {}
+        if self.requester:
+            headers["X-KV-Requester"] = self.requester
+        if chain:
+            headers["X-KV-Chain"] = chain
+        return headers
+
+    def put(self, key: str, payload: PagePayload,
+            chain: Optional[str] = None) -> bool:
         import msgpack
         # Per-array framing: each page array carries its own
         # shape/dtype, so mixed-dtype payloads (int8 data + float32
@@ -157,8 +198,26 @@ class RemoteKVClient:
             resp = self._session.put(
                 f"{self.base_url}/kv/{key}", data=body,
                 timeout=self.timeout_s,
+                headers=self._headers(chain),
             )
-            return resp.status_code == 200
+            if resp.status_code != 200:
+                return False
+            # A managed cache answers 200 with an admission verdict;
+            # {"admitted": false} means "not promoted yet, don't
+            # bother retrying" and is SUCCESS for the write-through
+            # hot path — the page stays in the host tier and the
+            # server has recorded the demand. Legacy servers answer a
+            # bare 200 body; treat that as admitted.
+            try:
+                verdict = resp.json()
+            except ValueError:
+                verdict = {}
+            if isinstance(verdict, dict) and \
+                    verdict.get("admitted") is False:
+                self.rejections += 1
+            else:
+                self.admissions += 1
+            return True
         except Exception as e:
             logger.warning("Remote KV put failed: %s", e)
             return False
@@ -167,11 +226,14 @@ class RemoteKVClient:
         import msgpack
         try:
             resp = self._session.get(
-                f"{self.base_url}/kv/{key}", timeout=self.timeout_s
+                f"{self.base_url}/kv/{key}", timeout=self.timeout_s,
+                headers=self._headers(),
             )
             if resp.status_code != 200:
+                self.misses += 1
                 return None
             obj = msgpack.unpackb(resp.content)
+            self.hits += 1
             return tuple(
                 np.frombuffer(a["data"], _np_dtype(a["dtype"]))
                 .reshape(tuple(a["shape"]))
@@ -192,7 +254,8 @@ class RemoteKVClient:
         False but keeps waiting (until the handoff timeout) on None."""
         try:
             resp = self._session.head(
-                f"{self.base_url}/kv/{key}", timeout=self.timeout_s
+                f"{self.base_url}/kv/{key}", timeout=self.timeout_s,
+                headers=self._headers(),
             )
             return resp.status_code == 200
         except Exception:
@@ -215,6 +278,7 @@ class RemoteKVClient:
                 f"{self.base_url}/kv/batch_get",
                 data=msgpack.packb({"keys": list(keys)}),
                 timeout=self.timeout_s,
+                headers=self._headers(),
             )
             if resp.status_code in (404, 405):
                 out = {}
@@ -242,6 +306,8 @@ class RemoteKVClient:
                     .reshape(tuple(a["shape"]))
                     for a in arrays
                 )
+            self.hits += len(out)
+            self.misses += len(keys) - len(out)
             return out
         except Exception as e:
             logger.warning("Remote KV batch_get failed: %s", e)
@@ -294,13 +360,21 @@ class KVOffloadManager:
             return False
         return self.remote.probe(key)
 
+    def chain_id(self, root_hash: PageHash) -> str:
+        """Cluster-cache chain id for a page chain: the tier key of
+        its ROOT page hash. Sent as X-KV-Chain on write-through so the
+        managed cache groups a chain's pages for admission demand and
+        whole-chain eviction (kvecon/cluster_cache.py)."""
+        return self._key(root_hash)
+
     def offload_page(self, page_hash: PageHash,
-                     *payload: np.ndarray) -> None:
+                     *payload: np.ndarray,
+                     chain: Optional[str] = None) -> None:
         key = self._key(page_hash)
         self.host.put(key, payload)
         self.offloaded_pages += 1
         if self.remote is not None and self.write_through_remote:
-            self.remote.put(key, payload)
+            self.remote.put(key, payload, chain=chain)
 
     def lookup_chain(self, hashes: List[PageHash]) -> int:
         """How many leading pages of *hashes* can be restored."""
@@ -349,10 +423,18 @@ class KVOffloadManager:
 
     def stats(self) -> Dict[str, float]:
         total = self.host.hits + self.host.misses
-        return {
+        stats = {
             "host_pages": len(self.host),
             "host_bytes": self.host.used_bytes,
             "host_hit_rate": (self.host.hits / total) if total else 0.0,
             "offloaded_pages": self.offloaded_pages,
             "restored_pages": self.restored_pages,
         }
+        if self.remote is not None:
+            stats.update({
+                "cluster_hits": self.remote.hits,
+                "cluster_misses": self.remote.misses,
+                "cluster_admissions": self.remote.admissions,
+                "cluster_rejections": self.remote.rejections,
+            })
+        return stats

@@ -150,6 +150,12 @@ class Sequence:
     # requests spec-off; the scheduler then never spends speculative
     # draft/verify slack on them (docs/qos.md).
     spec_off: bool = False
+    # Cluster KV economy (docs/kv_economy.md): parked in AWAITING_KV
+    # at admission to probe the shared cache for this prompt's prefix
+    # before prefill. Unlike a disagg handoff, a cold-start probe
+    # degrades to compute IMMEDIATELY when the tier is unreachable —
+    # nothing was shipped for it, so there is nothing to wait for.
+    cold_start_probe: bool = False
 
     @property
     def num_generated(self) -> int:

@@ -33,6 +33,7 @@ from production_stack_tpu.engine.config import (
     bench_1b_model_config,
     CacheConfig,
     EngineConfig,
+    KVEconConfig,
     LoRAConfig,
     ModelConfig,
     OffloadConfig,
@@ -40,6 +41,10 @@ from production_stack_tpu.engine.config import (
     QoSConfig,
     SchedulerConfig,
     tiny_model_config,
+)
+from production_stack_tpu.kvecon.summary import (
+    PrefixSummaryTracker,
+    routable_text,
 )
 from production_stack_tpu.engine.engine import LLMEngine
 from production_stack_tpu.qos import (
@@ -509,6 +514,15 @@ class EngineServer:
         # Step watchdog (docs/crash_recovery.md): latched once per hung
         # step so the trip is logged/span-evented once, not per probe.
         self._watchdog_tripped = False
+        # Cluster KV economy (docs/kv_economy.md): decayed hot-prefix
+        # tracker behind GET /kv/summary. Observed from the request
+        # text at admission (O(prompt) hashing, no per-step cost); the
+        # router's KVStateAwarePolicy hashes the same text domain so
+        # the chain hashes line up.
+        kve = getattr(engine.config, "kvecon", None) or KVEconConfig()
+        self.kv_summary = PrefixSummaryTracker(
+            top_k=kve.summary_top_k, admit_hits=kve.admit_hits,
+            ttl_s=kve.ttl_s)
 
     # -- decoding helpers ---------------------------------------------------
 
@@ -566,6 +580,7 @@ class EngineServer:
             )
         prompt = render_chat_prompt(self.tokenizer, messages,
                                     chat_template=self.chat_template)
+        self.kv_summary.observe_text(routable_text(body))
         return await self._generate_response(
             request, body, prompt, chat=True
         )
@@ -590,6 +605,7 @@ class EngineServer:
         else:
             prompt_text = str(prompt_in)
             prompt = self.tokenizer.encode(prompt_text)
+        self.kv_summary.observe_text(routable_text(body))
         return await self._generate_response(
             request, body, prompt, chat=False, prompt_text=prompt_text
         )
@@ -1991,6 +2007,22 @@ class EngineServer:
     async def version(self, request: web.Request):
         return web.json_response({"version": __version__})
 
+    async def kv_summary_handler(self, request: web.Request):
+        """Cluster KV economy (docs/kv_economy.md): the engine's live
+        KV state for the router's KVStateAwarePolicy — hot prefix
+        chains (text-domain blake2b, decayed hit counts), free-page
+        headroom, and the KV storage dtype. Served from host-side
+        tracker/counter state only; never touches the device."""
+        cm = self.engine.cache_manager
+        return web.json_response({
+            "hot_chains": [[h, v]
+                           for h, v in self.kv_summary.snapshot()],
+            "free_pages": cm.num_free_pages,
+            "total_pages": cm.config.num_pages - 1,
+            "kv_dtype": self.engine.config.cache.resolved_kv_dtype(),
+            "top_k": self.kv_summary.top_k,
+        })
+
     async def metrics(self, request: web.Request):
         stats = self.engine.stats()
         lines = []
@@ -2042,6 +2074,34 @@ class EngineServer:
         lines.append("# TYPE vllm:disagg_awaiting_kv_requests gauge")
         lines.append("vllm:disagg_awaiting_kv_requests "
                      f"{float(stats['disagg_awaiting_kv_requests'])}")
+        # Cluster KV economy (docs/kv_economy.md): summary breadth and
+        # headroom mirror GET /kv/summary; the cluster counters come
+        # from the remote-tier client (0 until an offload remote is
+        # configured — the scrape surface stays stable either way).
+        cm = self.engine.cache_manager
+        lines.append("# TYPE vllm:kv_summary_hot_chains gauge")
+        lines.append("vllm:kv_summary_hot_chains "
+                     f"{float(self.kv_summary.hot_count())}")
+        lines.append("# TYPE vllm:kv_free_page_headroom gauge")
+        lines.append("vllm:kv_free_page_headroom "
+                     f"{float(cm.num_free_pages)}")
+        lines.append("# TYPE vllm:kv_total_pages gauge")
+        lines.append("vllm:kv_total_pages "
+                     f"{float(cm.config.num_pages - 1)}")
+        ostats = (self.engine.offload.stats()
+                  if self.engine.offload is not None else {})
+        lines.append("# TYPE vllm:kv_cluster_hits_total counter")
+        lines.append("vllm:kv_cluster_hits_total "
+                     f"{float(ostats.get('cluster_hits', 0.0))}")
+        lines.append("# TYPE vllm:kv_cluster_misses_total counter")
+        lines.append("vllm:kv_cluster_misses_total "
+                     f"{float(ostats.get('cluster_misses', 0.0))}")
+        lines.append("# TYPE vllm:kv_cluster_admissions_total counter")
+        lines.append("vllm:kv_cluster_admissions_total "
+                     f"{float(ostats.get('cluster_admissions', 0.0))}")
+        lines.append("# TYPE vllm:kv_cluster_rejections_total counter")
+        lines.append("vllm:kv_cluster_rejections_total "
+                     f"{float(ostats.get('cluster_rejections', 0.0))}")
         # Zero-loss drain (docs/fleet.md): 1 while new admissions are
         # rejected and in-flight sequences finish.
         lines.append("# TYPE vllm:engine_draining gauge")
@@ -2130,6 +2190,7 @@ class EngineServer:
         app.router.add_get("/health", self.health)
         app.router.add_get("/version", self.version)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/kv/summary", self.kv_summary_handler)
         app.router.add_post("/debug/profiler/start", self.profiler_start)
         app.router.add_post("/debug/profiler/stop", self.profiler_stop)
         app.router.add_get("/debug/trace/{request_id}", self.debug_trace)
@@ -2313,6 +2374,13 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             default_priority=args.default_priority,
             preempt_to_offload=args.preempt_to_offload == "on",
             shed_threshold=args.shed_threshold,
+        ),
+        kvecon=KVEconConfig(
+            summary_top_k=args.kv_summary_top_k,
+            admit_hits=args.kv_admit_hits,
+            ttl_s=args.kv_ttl_s,
+            watermark_high=args.kv_watermark_high,
+            watermark_low=args.kv_watermark_low,
         ),
         seed=args.seed,
         engine_role=args.engine_role,
@@ -2557,6 +2625,25 @@ def parse_args(argv=None):
                              "before /health flips to 503 so the "
                              "router's prober rotates the hung "
                              "replica out (0 disables)")
+    # Cluster KV economy (docs/kv_economy.md): the GET /kv/summary
+    # hot-chain tracker and the offload tier's watermark hysteresis.
+    parser.add_argument("--kv-summary-top-k", type=int, default=64,
+                        help="Hot prefix chains advertised at "
+                             "GET /kv/summary for KV-state-aware "
+                             "routing (docs/kv_economy.md)")
+    parser.add_argument("--kv-admit-hits", type=int, default=2,
+                        help="Decayed hit count a prefix chain needs "
+                             "before the summary advertises it")
+    parser.add_argument("--kv-ttl-s", type=float, default=900.0,
+                        help="Seconds an idle prefix chain stays in "
+                             "the summary tracker (0 disables TTL)")
+    parser.add_argument("--kv-watermark-high", type=float, default=1.0,
+                        help="Host KV pool fill fraction that triggers "
+                             "LRU eviction (1.0 = legacy exact-"
+                             "capacity behavior)")
+    parser.add_argument("--kv-watermark-low", type=float, default=1.0,
+                        help="Fill fraction the host KV pool drains "
+                             "down to once the high watermark trips")
     return parser.parse_args(argv)
 
 

@@ -42,7 +42,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--routing-logic",
         choices=["roundrobin", "session", "llq", "hra",
-                 "prefixaware", "custom"],
+                 "prefixaware", "kvstateaware", "custom"],
         default="roundrobin",
     )
     parser.add_argument(

@@ -13,15 +13,19 @@ from __future__ import annotations
 import abc
 import asyncio
 import enum
-import hashlib
 import heapq
 import itertools
 import random
 import time
 from dataclasses import dataclass, field as dataclass_field
 from math import ceil
-from typing import Dict, List, Mapping, Optional, Union
+from typing import Dict, List, Mapping, Optional, Tuple, Union
 
+from production_stack_tpu.kvecon.summary import (
+    TOKENS_PER_BLOCK,
+    chain_text,
+    expected_hit_blocks,
+)
 from production_stack_tpu.router.routing.hashring import ConsistentHashRing
 from production_stack_tpu.router.service_discovery import EndpointInfo
 from production_stack_tpu.router.stats.engine_stats import EngineStats
@@ -72,6 +76,7 @@ class RoutingLogic(str, enum.Enum):
     LEAST_LOADED = "llq"
     HRA = "hra"
     PREFIX_AWARE = "prefixaware"
+    KV_STATE_AWARE = "kvstateaware"
     CUSTOM_LOGIC = "custom"
 
 
@@ -372,21 +377,11 @@ class PrefixAwarePolicy(RoutingPolicy):
         self._initialized = True
 
     def _chain(self, text: str) -> List[int]:
-        # blake2b, not builtin hash(): str hashing is salted per
-        # process (PYTHONHASHSEED), so replicated routers — or one
-        # router across restarts — would score the same prefix with
-        # different chains and place it inconsistently. The chain must
-        # be a pure function of the text.
-        out: List[int] = []
-        h = b""
-        for i in range(0, len(text), self.BLOCK_CHARS):
-            block = text[i:i + self.BLOCK_CHARS]
-            h = hashlib.blake2b(
-                h + block.encode("utf-8", "surrogatepass"),
-                digest_size=8,
-            ).digest()
-            out.append(int.from_bytes(h, "big"))
-        return out
+        # Canonical implementation lives in kvecon.summary so the
+        # router's text chains stay byte-identical to the hot chains
+        # the engines advertise at GET /kv/summary (blake2b, not
+        # builtin hash(), because str hashing is salted per process).
+        return chain_text(text, self.BLOCK_CHARS)
 
     def _remember(self, url: str, chain: List[int]) -> None:
         from collections import OrderedDict
@@ -447,6 +442,116 @@ class PrefixAwarePolicy(RoutingPolicy):
         return _mark_routed(url, request_id, num_prefill_tokens)
 
 
+class KVStateAwarePolicy(RoutingPolicy):
+    """Route on the KV state engines actually HOLD, not on chains the
+    router remembers serving (docs/kv_economy.md).
+
+    Each engine exports a rolling summary of its KV economy at
+    ``GET /kv/summary`` — top-k hot chain hashes (hit-count-decayed),
+    free-page headroom, kv_dtype — which rides the engine-stats scrape
+    loop into ``EngineStats.kv_hot_chains`` / ``kv_free_page_headroom``.
+    A request's prompt is chain-hashed with the same blake2b scheme
+    and every candidate is scored:
+
+        score = W_HIT * expected_hit_frac          # prefix reuse
+              + W_HEADROOM * free_page_frac        # room to serve it
+              - W_LOAD * load_frac                 # queue depth
+
+    ``expected_hit_frac`` is the deepest chain hash of the prompt found
+    in the engine's advertised hot set, over the prompt's block count.
+    Unlike PrefixAwarePolicy's remembered-chain guess, this sees
+    chains the engine computed for OTHER routers' traffic, chains it
+    has evicted, and how much headroom is left — headroom varies
+    1.9-3.55x with ``--kv-cache-dtype``, which remembered chains can't
+    know.
+
+    Summaries are trusted only within ``SUMMARY_STALENESS_S`` of their
+    scrape; when NO candidate has a fresh summary (engines predate
+    /kv/summary, scraper down) the policy degrades to a private
+    PrefixAwarePolicy instance, which it keeps warm by recording every
+    routed chain — the fallback starts with full affinity state, not
+    cold.
+    """
+
+    SUMMARY_STALENESS_S = 30.0
+    W_HIT = 2.0
+    W_HEADROOM = 1.0
+    W_LOAD = 0.25
+    uses_prompt_text = True
+
+    def __init__(self):
+        if getattr(self, "_initialized", False):
+            return
+        # Private (non-singleton) fallback so configuring this policy
+        # never registers a PrefixAwarePolicy in SingletonMeta.
+        self._fallback = PrefixAwarePolicy.__new__(PrefixAwarePolicy)
+        self._fallback._index = {}
+        self._fallback._initialized = True
+        # url -> expected prefix-hit tokens of the last request routed
+        # there; exported as router gauge kv_route_expected_hit_tokens.
+        self.expected_hit_tokens_by_url: Dict[str, float] = {}
+        self._initialized = True
+
+    def _summary_fresh(self, stats: Optional[EngineStats],
+                       now: float) -> bool:
+        return (stats is not None
+                and stats.kv_summary_time > 0
+                and now - stats.kv_summary_time
+                <= self.SUMMARY_STALENESS_S)
+
+    def route_request(self, endpoints, engine_stats, request_stats, headers,
+                      request_id, num_prefill_tokens=0,
+                      prompt_text=None) -> str:
+        now = time.time()
+        fresh = {ep.url for ep in endpoints
+                 if self._summary_fresh(engine_stats.get(ep.url), now)}
+        chain = chain_text(prompt_text) if prompt_text else []
+        if not fresh:
+            return self._fallback.route_request(
+                endpoints, engine_stats, request_stats, headers,
+                request_id, num_prefill_tokens, prompt_text)
+
+        def load(url: str) -> int:
+            stat = request_stats.get(url)
+            if stat is None:
+                return 0
+            return stat.in_prefill_requests + stat.in_decoding_requests
+
+        loads = {ep.url: load(ep.url) for ep in endpoints}
+        max_load = max(loads.values()) or 1
+
+        def score(url: str) -> Tuple[float, float]:
+            es = engine_stats.get(url)
+            hit_frac = 0.0
+            headroom_frac = 0.5  # neutral when the engine is opaque
+            if url in fresh:
+                if chain:
+                    hit_frac = expected_hit_blocks(
+                        chain, es.kv_hot_chains) / len(chain)
+                total = es.kv_total_pages
+                if total > 0:
+                    headroom_frac = min(
+                        1.0, es.kv_free_page_headroom / total)
+            s = (self.W_HIT * hit_frac
+                 + self.W_HEADROOM * headroom_frac
+                 - self.W_LOAD * loads[url] / max_load)
+            return s, hit_frac
+
+        scored = {ep.url: score(ep.url) for ep in endpoints}
+        best = max(endpoints,
+                   key=lambda ep: (scored[ep.url][0],
+                                   -loads[ep.url], ep.url)).url
+        self.expected_hit_tokens_by_url[best] = (
+            scored[best][1] * len(chain) * TOKENS_PER_BLOCK)
+        for url in list(self.expected_hit_tokens_by_url):
+            if url not in loads:
+                del self.expected_hit_tokens_by_url[url]
+        if chain:
+            # Keep the fallback's affinity index warm for degradation.
+            self._fallback._remember(best, chain)
+        return _mark_routed(best, request_id, num_prefill_tokens)
+
+
 class WorkEstimatePolicy(RoutingPolicy):
     """'custom' policy: routes by estimated outstanding work per engine.
 
@@ -482,7 +587,8 @@ class WorkEstimatePolicy(RoutingPolicy):
 
 _POLICY_CLASSES = (
     RoundRobinPolicy, SessionPolicy, LeastLoadedPolicy,
-    HeadRoomAdmissionPolicy, PrefixAwarePolicy, WorkEstimatePolicy,
+    HeadRoomAdmissionPolicy, PrefixAwarePolicy, KVStateAwarePolicy,
+    WorkEstimatePolicy,
 )
 
 
@@ -500,6 +606,8 @@ def initialize_routing_logic(routing_logic: Union[str, RoutingLogic],
         return HeadRoomAdmissionPolicy()
     if logic == RoutingLogic.PREFIX_AWARE:
         return PrefixAwarePolicy()
+    if logic == RoutingLogic.KV_STATE_AWARE:
+        return KVStateAwarePolicy()
     if logic == RoutingLogic.CUSTOM_LOGIC:
         return WorkEstimatePolicy()
     raise ValueError(f"Unknown routing logic: {routing_logic}")

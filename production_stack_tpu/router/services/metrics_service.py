@@ -220,6 +220,46 @@ engine_attention_impl = Gauge(
     "Engine-reported resolved attention impl per phase as a one-hot "
     "labeled info gauge — alarms the silent XLA fallback (scraped)",
     ["server", "phase", "impl"])
+# KV economy (docs/kv_economy.md): each engine's KV-state summary and
+# its view of the shared cluster cache tier, re-exported per server,
+# plus the routing policy's expected-hit signal.
+engine_kv_summary_hot_chains = Gauge(
+    "vllm:engine_kv_summary_hot_chains",
+    "Engine-reported hot prefix chains advertised at GET /kv/summary "
+    "(scraped)", _LBL)
+engine_kv_free_page_headroom = Gauge(
+    "vllm:engine_kv_free_page_headroom",
+    "Engine-reported free KV pages available to new prefixes "
+    "(scraped)", _LBL)
+engine_kv_headroom_frac = Gauge(
+    "vllm:engine_kv_headroom_frac",
+    "Engine-reported free-page headroom over total pages — varies "
+    "1.9-3.55x with --kv-cache-dtype (scraped)", _LBL)
+engine_kv_summary_age = Gauge(
+    "vllm:engine_kv_summary_age_seconds",
+    "Age of the engine's last successful /kv/summary fetch; "
+    "KVStateAwarePolicy distrusts summaries older than its staleness "
+    "bound (scraped)", _LBL)
+engine_kv_cluster_hits = Gauge(
+    "vllm:engine_kv_cluster_hits",
+    "Engine-reported pages fetched from the shared cluster cache "
+    "(scraped)", _LBL)
+engine_kv_cluster_misses = Gauge(
+    "vllm:engine_kv_cluster_misses",
+    "Engine-reported shared cluster cache fetch/probe misses "
+    "(scraped)", _LBL)
+engine_kv_cluster_admissions = Gauge(
+    "vllm:engine_kv_cluster_admissions",
+    "Engine-reported write-throughs the shared cache admitted "
+    "(scraped)", _LBL)
+engine_kv_cluster_rejections = Gauge(
+    "vllm:engine_kv_cluster_rejections",
+    "Engine-reported write-throughs the shared cache declined pending "
+    "demand promotion (scraped)", _LBL)
+kv_route_expected_hit_tokens = Gauge(
+    "vllm:kv_route_expected_hit_tokens",
+    "Expected prefix-hit tokens of the last request KVStateAwarePolicy "
+    "routed to this engine", _LBL)
 
 # -- fleet manager (production_stack_tpu/fleet/, docs/fleet.md) -------------
 # Set by an in-process fleet manager (or its embedded exporter); the
@@ -451,6 +491,35 @@ def refresh_gauges() -> None:
         for phase, impl in es.attention_impl_by_phase.items():
             engine_attention_impl.labels(
                 server=server, phase=phase, impl=impl).set(1)
+        engine_kv_summary_hot_chains.labels(server=server).set(
+            es.kv_summary_hot_chains or len(es.kv_hot_chains))
+        engine_kv_free_page_headroom.labels(server=server).set(
+            es.kv_free_page_headroom)
+        if es.kv_total_pages > 0:
+            engine_kv_headroom_frac.labels(server=server).set(
+                es.kv_free_page_headroom / es.kv_total_pages)
+        if es.kv_summary_time > 0:
+            engine_kv_summary_age.labels(server=server).set(
+                max(0.0, time.time() - es.kv_summary_time))
+        engine_kv_cluster_hits.labels(server=server).set(
+            es.kv_cluster_hits)
+        engine_kv_cluster_misses.labels(server=server).set(
+            es.kv_cluster_misses)
+        engine_kv_cluster_admissions.labels(server=server).set(
+            es.kv_cluster_admissions)
+        engine_kv_cluster_rejections.labels(server=server).set(
+            es.kv_cluster_rejections)
+    from production_stack_tpu.router.routing.logic import (
+        KVStateAwarePolicy,
+        get_routing_logic,
+    )
+    try:
+        policy = get_routing_logic()
+    except ValueError:
+        policy = None
+    if isinstance(policy, KVStateAwarePolicy):
+        for server, toks in policy.expected_hit_tokens_by_url.items():
+            kv_route_expected_hit_tokens.labels(server=server).set(toks)
     from production_stack_tpu.router.services import request_service
     router_disagg_handoffs.set(request_service.disagg_handoffs_total)
     router_disagg_fallbacks.set(request_service.disagg_fallbacks_total)

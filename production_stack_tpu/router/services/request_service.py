@@ -32,6 +32,9 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.kvecon.summary import (
+    routable_text as kvecon_routable_text,
+)
 from production_stack_tpu.qos import (
     DEFAULT_PRIORITY,
     parse_priority,
@@ -241,23 +244,11 @@ def _estimate_prefill_tokens(request: web.Request, body: bytes) -> int:
 
 
 def _routable_prompt_text(payload: dict) -> "str | None":
-    """Stable text rendering of the request's prompt for prefix-aware
-    routing (chat history or completion prompt; None when the body
-    carries neither)."""
-    messages = payload.get("messages")
-    if isinstance(messages, list):
-        parts = []
-        for m in messages:
-            if isinstance(m, dict) and isinstance(m.get("content"), str):
-                parts.append(f"{m.get('role', '')}\x1f{m['content']}")
-        return "\x1e".join(parts) if parts else None
-    prompt = payload.get("prompt")
-    if isinstance(prompt, str):
-        return prompt
-    if isinstance(prompt, list) and prompt and \
-            all(isinstance(p, str) for p in prompt):
-        return "\x1e".join(prompt)
-    return None
+    """Stable text rendering of the request's prompt for prefix-aware /
+    KV-state-aware routing. Canonical implementation lives in kvecon so
+    the engine's summary tracker observes the exact same text the
+    router hashes (docs/kv_economy.md)."""
+    return kvecon_routable_text(payload)
 
 
 def _error(status: int, message: str,

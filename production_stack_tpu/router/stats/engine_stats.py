@@ -78,6 +78,17 @@ _METRIC_MAP = {
     # unlabeled MFU gauge; the labeled compile/HBM/step-time families
     # are handled in from_prometheus_text.
     "vllm:engine_mfu": "engine_mfu",
+    # KV economy (docs/kv_economy.md): summary gauges mirrored off
+    # GET /kv/summary (the scraper also fetches the summary body for
+    # the hot-chain hashes themselves) plus the engine-side cluster
+    # cache counters.
+    "vllm:kv_summary_hot_chains": "kv_summary_hot_chains",
+    "vllm:kv_free_page_headroom": "kv_free_page_headroom",
+    "vllm:kv_total_pages": "kv_total_pages",
+    "vllm:kv_cluster_hits_total": "kv_cluster_hits",
+    "vllm:kv_cluster_misses_total": "kv_cluster_misses",
+    "vllm:kv_cluster_admissions_total": "kv_cluster_admissions",
+    "vllm:kv_cluster_rejections_total": "kv_cluster_rejections",
 }
 
 # Engine latency histograms the scraper summarizes: it keeps each
@@ -205,6 +216,23 @@ class EngineStats:
     engine_mfu: float = 0.0
     attention_impl_by_phase: Dict[str, str] = field(
         default_factory=dict)
+    # KV economy (docs/kv_economy.md): the engine's rolling KV-state
+    # summary. Gauges mirror GET /kv/summary; kv_hot_chains carries
+    # the advertised chain hashes themselves (hash -> decayed hits),
+    # fetched alongside /metrics by the scraper, and kv_summary_time
+    # stamps when that fetch happened so KVStateAwarePolicy can bound
+    # staleness. The kv_cluster_* counters are the engine's view of
+    # the shared cache tier (hits/misses on fetch, admission verdicts
+    # on write-through).
+    kv_summary_hot_chains: float = 0.0
+    kv_free_page_headroom: float = 0.0
+    kv_total_pages: float = 0.0
+    kv_cluster_hits: float = 0.0
+    kv_cluster_misses: float = 0.0
+    kv_cluster_admissions: float = 0.0
+    kv_cluster_rejections: float = 0.0
+    kv_hot_chains: Dict[int, float] = field(default_factory=dict)
+    kv_summary_time: float = 0.0
 
     @classmethod
     def from_prometheus_text(cls, text: str) -> "EngineStats":
@@ -302,10 +330,42 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         try:
             resp = requests.get(f"{url}/metrics", timeout=_SCRAPE_TIMEOUT_S)
             resp.raise_for_status()
-            return EngineStats.from_prometheus_text(resp.text)
+            stats = EngineStats.from_prometheus_text(resp.text)
         except Exception as e:
             logger.warning("Failed to scrape %s/metrics: %s", url, e)
             return None
+        self._scrape_kv_summary(url, stats)
+        return stats
+
+    def _scrape_kv_summary(self, url: str, stats: EngineStats) -> None:
+        """Fetch GET /kv/summary for the hot-chain hashes themselves.
+
+        Best-effort: engines that predate the KV economy 404 here and
+        ``kv_summary_time`` stays 0, which KVStateAwarePolicy reads as
+        "no summary" and degrades to prefix-affinity."""
+        try:
+            resp = requests.get(f"{url}/kv/summary",
+                                timeout=_SCRAPE_TIMEOUT_S)
+            if resp.status_code != 200:
+                return
+            body = resp.json()
+        except Exception as e:
+            logger.debug("No /kv/summary from %s: %s", url, e)
+            return
+        try:
+            stats.kv_hot_chains = {
+                int(h): float(v) for h, v in body.get("hot_chains", [])
+            }
+            stats.kv_summary_hot_chains = float(len(stats.kv_hot_chains))
+            stats.kv_free_page_headroom = float(
+                body.get("free_pages", stats.kv_free_page_headroom))
+            stats.kv_total_pages = float(
+                body.get("total_pages", stats.kv_total_pages))
+            if body.get("kv_dtype"):
+                stats.engine_kv_cache_dtype = str(body["kv_dtype"])
+            stats.kv_summary_time = time.time()
+        except (TypeError, ValueError) as e:
+            logger.warning("Malformed /kv/summary from %s: %s", url, e)
 
     def scrape_once(self) -> None:
         """One synchronous scrape pass over the discovered engines.

@@ -64,6 +64,7 @@ _SECTION_CLASSES = {
     "lora": "LoRAConfig",
     "offload": "OffloadConfig",
     "qos": "QoSConfig",
+    "kvecon": "KVEconConfig",
 }
 
 # Fleet-spec classes whose dataclass fields are operator surface,

@@ -73,6 +73,12 @@ from aiohttp import web
 # reuses the real engine's tracer so router-side stitching tests see
 # genuine {"span": "engine_request"} lines without a TPU.
 from production_stack_tpu.engine.tracing import EngineTracer
+from production_stack_tpu.kvecon.summary import (
+    chain_text,
+    expected_hit_blocks,
+    routable_text,
+    TOKENS_PER_BLOCK,
+)
 from production_stack_tpu.qos import (
     DEFAULT_PRIORITY,
     parse_priority,
@@ -98,7 +104,9 @@ class FakeEngineState:
                  role: str = "both", priority_aware: bool = False,
                  max_concurrency: int = 0,
                  checkpoint_interval: int = 0,
-                 crash_after_tokens: int = 4):
+                 crash_after_tokens: int = 4,
+                 kv_hot_capacity: int = 128,
+                 kv_total_pages: int = 512):
         self.model = model
         self.speed = speed  # tokens per second
         self.ttft = ttft  # seconds before first token
@@ -137,6 +145,58 @@ class FakeEngineState:
         # engine-span lines and serve /debug/trace/{id} as the real
         # server. None disables tracing entirely.
         self.tracer: Optional[EngineTracer] = None
+        # Cluster KV economy (docs/kv_economy.md): capped LRU hot set
+        # of text-domain prefix chain hashes — the fake's stand-in for
+        # "which prefixes have live KV here". The CAP matters: a fake
+        # with unbounded memory would make every routing policy look
+        # prefix-perfect, so pinning too many distinct prefixes on one
+        # replica must thrash, exactly like a real page budget.
+        self.kv_hot_capacity = kv_hot_capacity
+        self.kv_total_pages = kv_total_pages
+        self.kv_hot: "dict[int, float]" = {}  # chain_hash -> hits
+        self.prefix_hit_tokens = 0
+        self.prefix_query_tokens = 0
+        # POST /kv/summary overrides (None = derived from kv_hot).
+        self.kv_summary_override: Optional[dict] = None
+
+    def observe_prefix(self, body: dict) -> float:
+        """Score the request against the hot set (fraction of prompt
+        blocks with 'live KV'), then fold its chains in with LRU
+        eviction at the capacity cap. Returns the hit fraction."""
+        text = routable_text(body)
+        if not text:
+            return 0.0
+        chains = chain_text(text)
+        if not chains:
+            return 0.0
+        hit = expected_hit_blocks(chains, self.kv_hot)
+        self.prefix_hit_tokens += hit * TOKENS_PER_BLOCK
+        self.prefix_query_tokens += len(chains) * TOKENS_PER_BLOCK
+        now = time.monotonic()
+        for h in chains:
+            self.kv_hot.pop(h, None)  # re-insert = move to MRU end
+            self.kv_hot[h] = now
+        while len(self.kv_hot) > self.kv_hot_capacity:
+            self.kv_hot.pop(next(iter(self.kv_hot)))
+        return hit / len(chains)
+
+    def prefix_hit_rate(self) -> float:
+        if self.prefix_query_tokens == 0:
+            return 0.0
+        return self.prefix_hit_tokens / self.prefix_query_tokens
+
+    def kv_summary_payload(self) -> dict:
+        if self.kv_summary_override is not None:
+            return self.kv_summary_override
+        hot = sorted(self.kv_hot.items(), key=lambda kv: -kv[1])
+        return {
+            "hot_chains": [[h, 1.0] for h, _ in hot],
+            "free_pages": max(
+                0, self.kv_total_pages - len(self.kv_hot)
+                - self.running),
+            "total_pages": self.kv_total_pages,
+            "kv_dtype": "bf16",
+        }
 
     def slot_sem(self) -> Optional[asyncio.Semaphore]:
         # Lazily created so the semaphore binds to the serving loop.
@@ -281,6 +341,12 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     stream = bool(body.get("stream", False))
     request_id = f"chatcmpl-{uuid.uuid4().hex[:16]}"
     model = body.get("model", state.model)
+    # KV economy TTFT model (docs/kv_economy.md): prefill time scales
+    # with the cold fraction of the prompt — a prefix already hot on
+    # this replica skips its share of --ttft, so routing policies that
+    # land repeat prefixes on the same pod measurably win.
+    hit_frac = state.observe_prefix(body)
+    ttft_eff = state.ttft * (1.0 - 0.9 * hit_frac)
     words = [f"tok{i} " for i in range(n_tokens)]
     tracer, arrival = state.tracer, time.time()
     if tracer is not None:
@@ -297,7 +363,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             state.waiting -= 1
     state.running += 1
     try:
-        await asyncio.sleep(state.ttft)
+        await asyncio.sleep(ttft_eff)
         first_ts = time.time()
         if tracer is not None:
             tracer.event(request_id, "prefill_chunk",
@@ -389,6 +455,7 @@ async def completions(request: web.Request) -> web.Response:
         return fault_resp
     body = await request.json()
     n_tokens = int(body.get("max_tokens") or state.max_tokens_default)
+    hit_frac = state.observe_prefix(body)
     sem = state.slot_sem()
     if sem is not None:
         state.waiting += 1
@@ -398,7 +465,8 @@ async def completions(request: web.Request) -> web.Response:
             state.waiting -= 1
     state.running += 1
     try:
-        await asyncio.sleep(state.ttft + n_tokens / state.speed)
+        await asyncio.sleep(state.ttft * (1.0 - 0.9 * hit_frac)
+                            + n_tokens / state.speed)
         state.total_served += 1
         return web.json_response({
             "id": f"cmpl-{uuid.uuid4().hex[:16]}",
@@ -775,10 +843,30 @@ async def debug_trace(request: web.Request) -> web.Response:
     return web.json_response(found)
 
 
+async def kv_summary(request: web.Request) -> web.Response:
+    """GET /kv/summary: same schema as the real engine server
+    (docs/kv_economy.md), derived from the fake's capped hot set —
+    or from a POST /kv/summary override."""
+    state: FakeEngineState = request.app["state"]
+    return web.json_response(state.kv_summary_payload())
+
+
+async def set_kv_summary(request: web.Request) -> web.Response:
+    """POST /kv/summary: pin the summary payload for router tests
+    ({"hot_chains": [[hash, hits], ...], "free_pages": N,
+    "total_pages": N, "kv_dtype": "bf16"}); null body/empty object
+    clears the override back to derived state."""
+    state: FakeEngineState = request.app["state"]
+    body = await request.json()
+    state.kv_summary_override = body or None
+    return web.json_response(state.kv_summary_payload())
+
+
 async def metrics(request: web.Request) -> web.Response:
     state: FakeEngineState = request.app["state"]
     cache_usage = (state.cache_usage if state.cache_usage is not None
                    else min(1.0, state.running / 16))
+    kvs = state.kv_summary_payload()
     text = "\n".join([
         "# TYPE vllm:num_requests_running gauge",
         f"vllm:num_requests_running {float(state.running)}",
@@ -787,9 +875,27 @@ async def metrics(request: web.Request) -> web.Response:
         "# TYPE vllm:num_requests_total counter",
         f"vllm:num_requests_total {float(state.total_served)}",
         "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
-        "vllm:gpu_prefix_cache_hit_rate 0.0",
+        "vllm:gpu_prefix_cache_hit_rate "
+        f"{float(state.prefix_hit_rate())}",
         "# TYPE vllm:gpu_cache_usage_perc gauge",
         f"vllm:gpu_cache_usage_perc {float(cache_usage)}",
+        # Cluster KV economy (docs/kv_economy.md): mirrors the real
+        # server's summary gauges; the cluster counters stay 0 (the
+        # fake has no offload tier) to keep the scrape surface stable.
+        "# TYPE vllm:kv_summary_hot_chains gauge",
+        f"vllm:kv_summary_hot_chains {float(len(kvs['hot_chains']))}",
+        "# TYPE vllm:kv_free_page_headroom gauge",
+        f"vllm:kv_free_page_headroom {float(kvs['free_pages'])}",
+        "# TYPE vllm:kv_total_pages gauge",
+        f"vllm:kv_total_pages {float(kvs['total_pages'])}",
+        "# TYPE vllm:kv_cluster_hits_total counter",
+        "vllm:kv_cluster_hits_total 0.0",
+        "# TYPE vllm:kv_cluster_misses_total counter",
+        "vllm:kv_cluster_misses_total 0.0",
+        "# TYPE vllm:kv_cluster_admissions_total counter",
+        "vllm:kv_cluster_admissions_total 0.0",
+        "# TYPE vllm:kv_cluster_rejections_total counter",
+        "vllm:kv_cluster_rejections_total 0.0",
         "# TYPE vllm:engine_draining gauge",
         f"vllm:engine_draining {float(state.draining)}",
         "# TYPE vllm:qos_shed_total counter",
@@ -878,13 +984,17 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
                       priority_aware: bool = False,
                       max_concurrency: int = 0,
                       checkpoint_interval: int = 0,
-                      crash_after_tokens: int = 4) -> web.Application:
+                      crash_after_tokens: int = 4,
+                      kv_hot_capacity: int = 128,
+                      kv_total_pages: int = 512) -> web.Application:
     state = FakeEngineState(model=model, speed=speed, ttft=ttft,
                             fault=fault, fault_ttft=fault_ttft,
                             role=role, priority_aware=priority_aware,
                             max_concurrency=max_concurrency,
                             checkpoint_interval=checkpoint_interval,
-                            crash_after_tokens=crash_after_tokens)
+                            crash_after_tokens=crash_after_tokens,
+                            kv_hot_capacity=kv_hot_capacity,
+                            kv_total_pages=kv_total_pages)
     if span_log or trace_ring > 0:
         # Same default as the real server: flight recorder on, span
         # log only when a path is given.
@@ -901,6 +1011,8 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/kv/summary", kv_summary)
+    app.router.add_post("/kv/summary", set_kv_summary)
     app.router.add_get("/debug/trace/{request_id}", debug_trace)
     app.router.add_get("/debug/compiles", debug_compiles)
     app.router.add_get("/debug/memory", debug_memory)
@@ -949,6 +1061,14 @@ def main(argv=None) -> None:
     parser.add_argument("--crash-after-tokens", type=int, default=4,
                         help="With the crash fault: SIGKILL self after "
                              "this many streamed tokens")
+    parser.add_argument("--kv-hot-capacity", type=int, default=128,
+                        help="Capped LRU hot-prefix set size behind "
+                             "GET /kv/summary (docs/kv_economy.md) — "
+                             "pinning more distinct prefixes than this "
+                             "on one fake thrashes, like a real page "
+                             "budget")
+    parser.add_argument("--kv-total-pages", type=int, default=512,
+                        help="total_pages reported by GET /kv/summary")
     args = parser.parse_args(argv)
     app = build_fake_engine(args.model, args.speed, args.ttft,
                             fault=args.fault, fault_ttft=args.fault_ttft,
@@ -957,7 +1077,9 @@ def main(argv=None) -> None:
                             max_concurrency=args.max_concurrency,
                             checkpoint_interval=(
                                 args.checkpoint_interval_tokens),
-                            crash_after_tokens=args.crash_after_tokens)
+                            crash_after_tokens=args.crash_after_tokens,
+                            kv_hot_capacity=args.kv_hot_capacity,
+                            kv_total_pages=args.kv_total_pages)
     web.run_app(app, host=args.host, port=args.port, print=None)
 
 
