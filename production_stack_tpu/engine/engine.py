@@ -334,7 +334,7 @@ class LLMEngine:
                 # shared tier (one HEAD) before prefill — hit means a
                 # batched restore instead of recompute, miss or tier
                 # down degrades straight to compute.
-                seq.state = SequenceState.AWAITING_KV
+                seq.transition(SequenceState.AWAITING_KV)
                 seq.cold_start_probe = True
                 seq.handoff_arrival_time = time.time()
             self.sequences[seq.seq_id] = seq
@@ -434,7 +434,7 @@ class LLMEngine:
             self.disagg_decode_requests += 1
             if self.offload is None:
                 # No tier to restore from: degrade to recompute now.
-                seq.state = SequenceState.WAITING
+                seq.transition(SequenceState.WAITING)
                 self.metrics.on_handoff_admitted(0.0)
                 if self._tracer is not None:
                     self._tracer.event(
@@ -503,7 +503,7 @@ class LLMEngine:
             self.stream_resumes += 1
             if self.offload is None:
                 # No tier to restore from: recompute from the journal.
-                seq.state = SequenceState.WAITING
+                seq.transition(SequenceState.WAITING)
                 self.metrics.on_handoff_admitted(0.0)
                 if self._tracer is not None:
                     self._tracer.event(
@@ -687,7 +687,7 @@ class LLMEngine:
                     logger.warning(
                         "Handoff %s KV not in any offload tier; "
                         "degrading to recompute", seq.seq_id)
-                seq.state = SequenceState.WAITING
+                seq.transition(SequenceState.WAITING)
                 if not seq.cold_start_probe:
                     # Cold-start parks stay out of the disagg handoff
                     # admission histogram — they are routine admission

@@ -148,11 +148,11 @@ class Scheduler:
 
     def add_sequence(self, seq: Sequence) -> None:
         if len(self.waiting) >= self.config.max_queue_len:
-            seq.state = SequenceState.ABORTED
+            seq.transition(SequenceState.ABORTED)
             seq.finish_reason = FinishReason.ABORT
             raise RuntimeError("Scheduler queue full")
         if seq.num_prompt_tokens >= self.config.max_model_len:
-            seq.state = SequenceState.ABORTED
+            seq.transition(SequenceState.ABORTED)
             seq.finish_reason = FinishReason.ABORT
             raise ValueError(
                 f"Prompt is {seq.num_prompt_tokens} tokens but "
@@ -163,7 +163,7 @@ class Scheduler:
         if seq.num_prompt_tokens >= min(
                 max_prompt_pages,
                 (self.cache.config.num_pages - 1) * self.page_size):
-            seq.state = SequenceState.ABORTED
+            seq.transition(SequenceState.ABORTED)
             seq.finish_reason = FinishReason.ABORT
             raise ValueError(
                 f"Prompt of {seq.num_prompt_tokens} tokens cannot fit "
@@ -676,13 +676,13 @@ class Scheduler:
             # the ordinary first-touch restore path pulls them back —
             # miss/unreachable degrades to recompute via the same
             # tri-state the handoff path already handles.
-            seq.state = SequenceState.AWAITING_KV
+            seq.transition(SequenceState.AWAITING_KV)
             seq.handoff_arrival_time = time.time()
             if self.tracer is not None:
                 self.tracer.event(seq.seq_id, "awaiting_kv_park",
                                   pages=evicted)
         else:
-            seq.state = SequenceState.WAITING
+            seq.transition(SequenceState.WAITING)
         self.waiting.appendleft(seq)
 
     def _log_preemption(self, seq: Sequence) -> None:
@@ -730,7 +730,7 @@ class Scheduler:
                 self.waiting.remove(seq)
             except ValueError:
                 return  # raced with an abort that already dequeued it
-            seq.state = SequenceState.RUNNING
+            seq.transition(SequenceState.RUNNING)
             seq.first_token_time = time.time()
             if self.tracer is not None:
                 self.tracer.event(seq.seq_id, "first_token",
@@ -790,8 +790,8 @@ class Scheduler:
     def _finish(self, seq: Sequence, reason: FinishReason) -> None:
         if seq.state in (SequenceState.FINISHED, SequenceState.ABORTED):
             return
-        seq.state = (SequenceState.ABORTED if reason == FinishReason.ABORT
-                     else SequenceState.FINISHED)
+        seq.transition(SequenceState.ABORTED if reason == FinishReason.ABORT
+                       else SequenceState.FINISHED)
         seq.finish_reason = reason
         seq.finish_time = time.time()
         if self.proposer is not None:

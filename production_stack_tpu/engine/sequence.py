@@ -76,6 +76,51 @@ class SequenceState(enum.Enum):
     ABORTED = "aborted"
 
 
+# The sequence lifecycle, as data. Single source of truth for every
+# ``seq.state`` change in the stack: ``Sequence.transition`` validates
+# against it at runtime, the ``state-machine`` staticcheck rule flags
+# direct ``.state =`` writes and untabled transitions at lint time,
+# and docs/sequence_states.md renders it (kept in sync both
+# directions by the same rule). ``"new"`` is a pseudo-state meaning
+# "constructed with this initial state".
+SEQUENCE_TRANSITIONS = (
+    ("new", "waiting",
+     "ordinary admission: request queued for prefill"),
+    ("new", "awaiting_kv",
+     "disagg handoff / crash resume arrives parked until its shipped "
+     "KV is reachable in an offload tier"),
+    ("waiting", "running",
+     "last prefill chunk executed and the first token sampled"),
+    ("waiting", "awaiting_kv",
+     "cold-start probe: park a fresh request to ask the shared KV "
+     "tier for its prefix before computing"),
+    ("waiting", "aborted",
+     "admission rejected (queue full, oversized prompt) or client "
+     "abort while queued"),
+    ("awaiting_kv", "waiting",
+     "parked KV became reachable (admit for restore) or the wait "
+     "degraded to recompute (timeout / miss / no tier)"),
+    ("awaiting_kv", "aborted",
+     "client abort or engine shutdown while parked"),
+    ("running", "waiting",
+     "preempted for KV-cache pressure; generated tokens folded into "
+     "the prompt for recompute"),
+    ("running", "awaiting_kv",
+     "preempt-to-offload: pages shipped to the offload tier, parked "
+     "for re-admission"),
+    ("running", "finished",
+     "stop token / length budget / disagg handoff retirement"),
+    ("running", "aborted",
+     "client abort or crash containment mid-decode"),
+)
+
+_ALLOWED_TRANSITIONS = frozenset(
+    (src, dst) for src, dst, _ in SEQUENCE_TRANSITIONS)
+
+SEQUENCE_INITIAL_STATES = frozenset(
+    dst for src, dst, _ in SEQUENCE_TRANSITIONS if src == "new")
+
+
 class FinishReason(str, enum.Enum):
     STOP = "stop"
     LENGTH = "length"
@@ -156,6 +201,24 @@ class Sequence:
     # degrades to compute IMMEDIATELY when the tier is unreachable —
     # nothing was shipped for it, so there is nothing to wait for.
     cold_start_probe: bool = False
+
+    def transition(self, new_state: SequenceState) -> None:
+        """The one sanctioned way to change ``state``. Validates the
+        move against SEQUENCE_TRANSITIONS (same-state is a no-op, so
+        idempotent callers like abort-on-already-aborted stay simple);
+        an untabled pair raises instead of silently corrupting the
+        lifecycle. The ``state-machine`` staticcheck rule flags any
+        direct ``.state =`` write outside this method."""
+        old = self.state
+        if old == new_state:
+            return
+        if (old.value, new_state.value) not in _ALLOWED_TRANSITIONS:
+            raise ValueError(
+                f"untabled sequence transition {old.value} -> "
+                f"{new_state.value} for {self.seq_id}; if this move is "
+                "legitimate, add a row to SEQUENCE_TRANSITIONS (and "
+                "docs/sequence_states.md)")
+        self.state = new_state
 
     @property
     def num_generated(self) -> int:

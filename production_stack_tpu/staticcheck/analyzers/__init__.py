@@ -8,9 +8,13 @@ from production_stack_tpu.staticcheck.analyzers import (  # noqa: F401
     async_blocking,
     config_contract,
     dispatch_path,
+    endpoint_contract,
     kv_parity,
+    lock_discipline,
     metrics_contract,
     network_timeout,
+    page_lifecycle,
     span_contract,
+    state_machine,
     tracer_hygiene,
 )

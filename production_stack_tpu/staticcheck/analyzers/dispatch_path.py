@@ -10,16 +10,29 @@ that path silently re-serializes the pipeline — the step "works" but
 the overlap is gone, which no functional test notices. Inside the
 DISPATCH_PATH functions of engine/model_runner.py this flags:
 
-- ``np.asarray(...)`` / ``np.array(...)`` (device->host copy when fed
-  a device array),
+- ``np.asarray(...)`` / ``np.array(...)`` — *unless* the argument is
+  provably host-origin (see below): converting a Python list is a
+  plain host op, not a device sync,
 - ``jax.device_get(...)`` / ``device_get(...)``,
 - ``<anything>.block_until_ready()`` and ``<array>.item()``.
 
+Host-origin is decided flow-sensitively over the CFG
+(staticcheck/cfg.py) with a must-analysis (staticcheck/dataflow.py,
+intersection join): an argument is host-origin when it is a literal,
+a known host-list attribute of a sequence (``seq.output_token_ids``,
+``seq.prompt_token_ids``, ...), a ``list()``/``range()``/``sorted()``
+result, or a local name assigned only such values on **every** path
+reaching the call. Anything a device value could flow into stays
+flagged. This is what used to require ``# lint: allow-host-read``
+waivers on the penalty-payload asarray calls — the dataflow now
+proves those reads safe instead.
+
 ``int(...)`` / ``float(...)`` of host scalars are fine and not
-flagged. A deliberate host read carries ``# lint: allow-host-read``
-on the call line. The DISPATCH_PATH set must track reality: a listed
-name missing from model_runner.py is itself a finding, so a renamed
-function cannot silently fall out of coverage.
+flagged. A deliberate device read still carries
+``# lint: allow-host-read`` on the call line. The DISPATCH_PATH set
+must track reality: a listed name missing from model_runner.py is
+itself a finding, so a renamed function cannot silently fall out of
+coverage.
 
 Migrated from tests/test_dispatch_path_lint.py (PR 3), now a thin
 wrapper over this rule.
@@ -28,8 +41,9 @@ wrapper over this rule.
 from __future__ import annotations
 
 import ast
-from typing import List
+from typing import FrozenSet, List
 
+from production_stack_tpu.staticcheck.cfg import CFG
 from production_stack_tpu.staticcheck.core import (
     Finding,
     Project,
@@ -37,6 +51,7 @@ from production_stack_tpu.staticcheck.core import (
     rule,
     tail_name,
 )
+from production_stack_tpu.staticcheck import dataflow
 
 RUNNER = "production_stack_tpu/engine/model_runner.py"
 
@@ -58,6 +73,63 @@ DISPATCH_PATH = {
     "_as_device",
 }
 
+# Attributes that are host Python lists/scalars by construction
+# (engine/sequence.py): reading them never touches the device.
+HOST_ATTRS = {
+    "output_token_ids", "prompt_token_ids", "all_token_ids",
+    "stop_token_ids", "pages", "num_computed_tokens",
+    "num_prior_output_tokens", "seq_id", "sampling",
+}
+
+# Builtins whose result is host data when their inputs are.
+_HOST_CALLS = {"list", "tuple", "range", "sorted", "len", "int",
+               "float", "min", "max", "sum", "enumerate", "zip"}
+
+
+def _is_host_expr(node: ast.AST, host_names: FrozenSet[str]) -> bool:
+    """Conservative proof that ``node`` is host data (never a device
+    array)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set, ast.ListComp,
+                         ast.SetComp, ast.GeneratorExp)):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in host_names
+    if isinstance(node, ast.Attribute):
+        return node.attr in HOST_ATTRS
+    if isinstance(node, ast.Subscript):
+        return _is_host_expr(node.value, host_names)
+    if isinstance(node, ast.BinOp):
+        return (_is_host_expr(node.left, host_names)
+                and _is_host_expr(node.right, host_names))
+    if isinstance(node, ast.Call):
+        return (isinstance(node.func, ast.Name)
+                and node.func.id in _HOST_CALLS
+                and all(_is_host_expr(a, host_names)
+                        for a in node.args))
+    return False
+
+
+def _host_transfer(state: FrozenSet[str], el, _kind) -> FrozenSet[str]:
+    if isinstance(el, ast.Assign):
+        names = [t.id for t in el.targets if isinstance(t, ast.Name)]
+        if names:
+            if _is_host_expr(el.value, state):
+                return state | frozenset(names)
+            return state - frozenset(names)
+    elif isinstance(el, ast.AugAssign) and isinstance(
+            el.target, ast.Name):
+        if not _is_host_expr(el.value, state):
+            return state - {el.target.id}
+    elif isinstance(el, (ast.For, ast.AsyncFor)):
+        targets = frozenset(n.id for n in ast.walk(el.target)
+                            if isinstance(n, ast.Name))
+        if _is_host_expr(el.iter, state):
+            return state | targets
+        return state - targets
+    return state
+
 
 def is_blocking_call(call: ast.Call) -> bool:
     func = call.func
@@ -71,6 +143,15 @@ def is_blocking_call(call: ast.Call) -> bool:
             "block_until_ready", "item"):
         return True
     return False
+
+
+def _host_exempt(call: ast.Call, host_names: FrozenSet[str]) -> bool:
+    """np.asarray/np.array of provably-host data is a plain host op."""
+    if recv_name(call.func) != "np":
+        return False
+    if tail_name(call.func) not in ("asarray", "array"):
+        return False
+    return bool(call.args) and _is_host_expr(call.args[0], host_names)
 
 
 def dispatch_path_functions(tree: ast.AST):
@@ -90,13 +171,27 @@ def check(project: Project) -> List[Finding]:
     seen = set()
     for fn in dispatch_path_functions(sf.tree):
         seen.add(fn.name)
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call) and is_blocking_call(node):
-                findings.append(sf.finding(
-                    "host-read", node,
-                    f"blocking host read in {fn.name} re-serializes "
-                    "the async pipeline — move it to result()/"
-                    "completion (docs/async_pipeline.md)"))
+        cfg = CFG(fn, raises=lambda _s, _t: False)
+        block_in, _ = dataflow.solve(
+            cfg, frozenset(), _host_transfer, join="intersection")
+        for block in cfg.reachable():
+            if block.id not in block_in:
+                continue
+            state = block_in[block.id]
+            for el in block.elements:
+                if isinstance(el, ast.AST) and not isinstance(
+                        el, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for node in ast.walk(el):
+                        if (isinstance(node, ast.Call)
+                                and is_blocking_call(node)
+                                and not _host_exempt(node, state)):
+                            findings.append(sf.finding(
+                                "host-read", node,
+                                f"blocking host read in {fn.name} "
+                                "re-serializes the async pipeline — "
+                                "move it to result()/completion "
+                                "(docs/async_pipeline.md)"))
+                state = _host_transfer(state, el, None)
     missing = DISPATCH_PATH - seen
     if missing:
         findings.append(Finding(

@@ -1,0 +1,163 @@
+"""Rule ``endpoint-contract``: the fake engine mirrors the real HTTP
+surface — or says, explicitly, why not.
+
+Router tests run against testing/fake_engine.py; every route the real
+servers (engine/server.py, engine/cache_server.py) grow that the fake
+does not is a slice of the system the router test suite silently
+stopped exercising (this drifted every PR: /version, /debug/steps,
+the profiler endpoints, and the pooling endpoints were all missing
+when this rule landed). The contract, both directions:
+
+- every ``app.router.add_<method>("<path>", ...)`` in the real server
+  files is either registered in fake_engine.py too, or carried in
+  fake_engine's ``FAKE_ENGINE_EXEMPT`` dict
+  (``{"METHOD /path": "why the fake cannot/need not mirror it"}``);
+- an exemption for a route the fake DOES implement is redundant and
+  flagged (stale exemptions cannot accumulate);
+- an exemption for a route no real server registers is stale and
+  flagged;
+- routes only the fake registers (fault injection hooks etc.) must be
+  declared in fake_engine's ``FAKE_ONLY_ROUTES`` dict, same shape —
+  an undeclared fake-only route is flagged (it usually means a real
+  route was renamed and the fake kept the old one).
+
+Routes are recognized as ``<...>.add_get/add_post/add_put/add_head/
+add_delete("<literal>", handler)``; dynamic paths are invisible to
+this rule by design (none exist today).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    rule,
+    tail_name,
+)
+
+REAL_FILES = (
+    "production_stack_tpu/engine/server.py",
+    "production_stack_tpu/engine/cache_server.py",
+)
+FAKE_FILE = "production_stack_tpu/testing/fake_engine.py"
+
+_ADD_METHODS = {
+    "add_get": "GET",
+    "add_post": "POST",
+    "add_put": "PUT",
+    "add_delete": "DELETE",
+    "add_head": "HEAD",
+}
+
+
+def _routes(tree: ast.AST) -> Dict[str, int]:
+    """{"METHOD /path": first line} for add_* calls with a literal
+    path."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and tail_name(node.func) in _ADD_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            key = (f"{_ADD_METHODS[tail_name(node.func)]} "
+                   f"{node.args[0].value}")
+            out.setdefault(key, node.lineno)
+    return out
+
+
+def _marker_dict(tree: ast.AST, name: str) -> Dict[str, Tuple[int, str]]:
+    """{route: (line, rationale)} from a module-level dict literal."""
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        for target in stmt.targets:
+            if (isinstance(target, ast.Name) and target.id == name
+                    and isinstance(stmt.value, ast.Dict)):
+                out = {}
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        rationale = (v.value if isinstance(v, ast.Constant)
+                                     and isinstance(v.value, str) else "")
+                        out[k.value] = (k.lineno, rationale)
+                return out
+    return {}
+
+
+@rule("endpoint-contract",
+      "every real server route is mirrored in testing/fake_engine.py "
+      "or explicitly exempted (FAKE_ENGINE_EXEMPT), both directions")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    fake = project.source(FAKE_FILE)
+    if fake is None or fake.tree is None:
+        return [Finding(
+            rule="endpoint-contract", path=FAKE_FILE, line=0,
+            message="endpoint-contract surface file missing — if the "
+                    "fake engine moved, update "
+                    "staticcheck/analyzers/endpoint_contract.py")]
+    fake_routes = _routes(fake.tree)
+    exempt = _marker_dict(fake.tree, "FAKE_ENGINE_EXEMPT")
+    fake_only = _marker_dict(fake.tree, "FAKE_ONLY_ROUTES")
+
+    real_routes: Dict[str, Tuple[str, int]] = {}
+    for relpath in REAL_FILES:
+        sf = project.source(relpath)
+        if sf is None or sf.tree is None:
+            findings.append(Finding(
+                rule="endpoint-contract", path=relpath, line=0,
+                message="endpoint-contract surface file missing — if "
+                        "the server moved, update "
+                        "staticcheck/analyzers/endpoint_contract.py"))
+            continue
+        for route, line in _routes(sf.tree).items():
+            real_routes.setdefault(route, (relpath, line))
+
+    for route, (relpath, line) in sorted(real_routes.items()):
+        if route in fake_routes or route in exempt:
+            continue
+        sf = project.source(relpath)
+        findings.append(sf.finding(
+            "endpoint-contract", line,
+            f"route '{route}' has no mirror in testing/fake_engine.py "
+            "— router tests silently stopped covering it; add a fake "
+            "handler or a FAKE_ENGINE_EXEMPT entry with a rationale"))
+
+    for route, (line, rationale) in sorted(exempt.items()):
+        if route in fake_routes:
+            findings.append(fake.finding(
+                "endpoint-contract", line,
+                f"FAKE_ENGINE_EXEMPT lists '{route}' but the fake "
+                "implements it — drop the redundant exemption"))
+        elif route not in real_routes:
+            findings.append(fake.finding(
+                "endpoint-contract", line,
+                f"FAKE_ENGINE_EXEMPT lists '{route}' which no real "
+                "server registers — stale exemption"))
+        elif not rationale.strip():
+            findings.append(fake.finding(
+                "endpoint-contract", line,
+                f"FAKE_ENGINE_EXEMPT entry for '{route}' has an empty "
+                "rationale — say why the fake cannot mirror it"))
+
+    for route, line in sorted(fake_routes.items()):
+        if route in real_routes or route in fake_only:
+            continue
+        findings.append(fake.finding(
+            "endpoint-contract", line,
+            f"fake-only route '{route}' is not declared in "
+            "FAKE_ONLY_ROUTES — if the real route was renamed, rename "
+            "the fake's too; if it is a test hook, declare it"))
+
+    for route, (line, _rationale) in sorted(fake_only.items()):
+        if route in real_routes:
+            findings.append(fake.finding(
+                "endpoint-contract", line,
+                f"FAKE_ONLY_ROUTES lists '{route}' but a real server "
+                "registers it — it is a mirrored route, drop the "
+                "declaration"))
+    return findings

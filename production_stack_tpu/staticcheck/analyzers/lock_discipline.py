@@ -1,0 +1,208 @@
+"""Rule ``lock-discipline``: shared mutable state touched from async
+paths is actually guarded — and guards don't block the loop.
+
+The engine server and the router are single-process asyncio programs
+whose handlers interleave at every ``await``. Two hazard classes,
+both found over the CFG (staticcheck/cfg.py) with a lock-held
+lattice (facts = names of locks currently held, gen on ``with``-entry
+/ ``.acquire()``, kill on ``with``-exit / ``.release()``):
+
+- **await under a sync lock**: an ``await`` while a *synchronous*
+  lock (``with self._lock:``, ``threading.Lock``) is held parks the
+  entire event loop on whatever the awaited task needs — classic
+  asyncio deadlock/latency bomb. ``async with`` locks are fine and
+  not flagged.
+
+- **unguarded cross-handler read-modify-write**: an instance
+  attribute that ≥2 ``async def`` methods of the same class
+  read-modify-write (``self.x += ...`` or ``self.x = f(self.x)``)
+  without one lock held in common at every such site. Plain
+  assignments and single-method mutations are not flagged —
+  ``self.x = val`` is atomic under asyncio; it is the
+  read-then-write-back pattern that loses updates when the methods
+  interleave.
+
+A lock is recognized lexically: the guarded expression's dotted tail
+contains ``lock`` (``self._lock``, ``write_lock``, ``self.mu.lock``).
+Waive a reviewed site with ``# lint: allow-lock-discipline``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Set, Tuple
+
+from production_stack_tpu.staticcheck.cfg import (
+    CFG,
+    WithEnter,
+    WithExit,
+    contains_await,
+)
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    rule,
+    tail_name,
+)
+from production_stack_tpu.staticcheck import dataflow
+
+SCOPE = (
+    "production_stack_tpu/engine/server.py",
+    "production_stack_tpu/router/*.py",
+    "production_stack_tpu/router/**/*.py",
+)
+
+
+def _lock_name(expr: ast.AST) -> str:
+    """Dotted name of a lock expression ('' if not lock-like). The
+    with-item may be a call (``self._lock.acquire_timeout(...)``) —
+    the receiver chain is what names the lock."""
+    node = expr
+    if isinstance(node, ast.Call):
+        node = node.func
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    dotted = ".".join(reversed(parts))
+    return dotted if "lock" in dotted.lower() else ""
+
+
+# Lock fact: (dotted lock name, "sync"|"async")
+Fact = Tuple[str, str]
+
+
+def _transfer(state: FrozenSet[Fact], el, _kind) -> FrozenSet[Fact]:
+    if isinstance(el, WithEnter):
+        name = _lock_name(el.node)
+        if name:
+            return state | {(name, "async" if el.is_async else "sync")}
+        return state
+    if isinstance(el, WithExit):
+        name = _lock_name(el.node)
+        if name:
+            return frozenset(f for f in state if f[0] != name)
+        return state
+    if isinstance(el, ast.AST):
+        for node in ast.walk(el):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                if node.func.attr == "acquire":
+                    name = _lock_name(node.func.value)
+                    if name:
+                        return state | {(name, "sync")}
+                elif node.func.attr == "release":
+                    name = _lock_name(node.func.value)
+                    if name:
+                        return frozenset(
+                            f for f in state if f[0] != name)
+    return state
+
+
+def _no_raises(_stmt, _in_try) -> bool:
+    # Lock findings are per-statement (not at exits), so exception
+    # edges add blocks without adding signal; with/try routing still
+    # releases locks on every path.
+    return False
+
+
+def _rmw_attrs(el) -> Set[str]:
+    """self-attributes this element read-modify-writes."""
+    out: Set[str] = set()
+    if not isinstance(el, ast.AST):
+        return out
+    for node in ast.walk(el):
+        if isinstance(node, ast.AugAssign):
+            target = node.target
+            if isinstance(target, ast.Subscript):
+                target = target.value
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                out.add(target.attr)
+        elif isinstance(node, ast.Assign):
+            written = {t.attr for t in node.targets
+                       if isinstance(t, ast.Attribute)
+                       and isinstance(t.value, ast.Name)
+                       and t.value.id == "self"}
+            if written:
+                read = {n.attr for n in ast.walk(node.value)
+                        if isinstance(n, ast.Attribute)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"}
+                out |= written & read
+    return out
+
+
+def _walk_blocks(cfg: CFG, block_in):
+    """(element, state-before-element) pairs over reachable blocks."""
+    for block in cfg.reachable():
+        if block.id not in block_in:
+            continue
+        state = block_in[block.id]
+        for el in block.elements:
+            yield el, state
+            state = _transfer(state, el, None)
+
+
+@rule("lock-discipline",
+      "no await under a held sync lock; shared attributes "
+      "read-modify-written from several async handlers share a lock")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files(*SCOPE):
+        if sf.tree is None:
+            continue  # parse-error rule reports it
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            # attr -> [(method, line, locks-held-at-site)]
+            mutations: Dict[str, List[Tuple[str, int,
+                                            FrozenSet[str]]]] = {}
+            for fn in cls.body:
+                if not isinstance(fn, ast.AsyncFunctionDef):
+                    continue
+                cfg = CFG(fn, raises=_no_raises)
+                block_in, _ = dataflow.solve(
+                    cfg, frozenset(), _transfer, join="intersection")
+                for el, state in _walk_blocks(cfg, block_in):
+                    held_sync = sorted(
+                        n for n, k in state if k == "sync")
+                    if (held_sync and isinstance(el, ast.AST)
+                            and not isinstance(
+                                el, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+                            and contains_await(el)):
+                        findings.append(sf.finding(
+                            "lock-discipline", el,
+                            f"await in {cls.name}.{fn.name} while "
+                            f"sync lock {held_sync[0]} is held — "
+                            "parks the event loop; use asyncio.Lock "
+                            "with 'async with', or release first"))
+                    for attr in _rmw_attrs(el):
+                        if "lock" in attr.lower():
+                            continue
+                        mutations.setdefault(attr, []).append(
+                            (fn.name, getattr(el, "lineno", 0),
+                             frozenset(n for n, _k in state)))
+            for attr, sites in sorted(mutations.items()):
+                methods = {m for m, _l, _h in sites}
+                if len(methods) < 2:
+                    continue
+                common = frozenset.intersection(
+                    *[h for _m, _l, h in sites])
+                if common:
+                    continue
+                for method, line, held in sorted(sites):
+                    if held:
+                        continue  # this site is guarded; flag the bare ones
+                    findings.append(sf.finding(
+                        "lock-discipline", line,
+                        f"self.{attr} is read-modify-written from "
+                        f"async handlers {sorted(methods)} of "
+                        f"{cls.name} with no common lock — "
+                        "interleaved handlers lose updates; guard "
+                        "every site with one asyncio.Lock"))
+    return findings

@@ -1,0 +1,267 @@
+"""Rule ``page-lifecycle``: KV page allocations and AWAITING_KV parks
+pair with their release on EVERY path, including exception edges.
+
+This is the hazard class PRs 6, 7, 10, 12 and 13 each re-pinned with
+a bespoke runtime ``test_*_leak`` regression: a scheduler/engine path
+allocates KV pages (or parks a sequence in ``AWAITING_KV``) and an
+early ``return``/``raise`` leaks the pages or strands the sequence.
+Runtime tests only cover the paths someone thought to exercise; this
+rule walks all of them over the CFG (staticcheck/cfg.py) with a
+forward may-analysis (staticcheck/dataflow.py).
+
+Two fact families, per function in engine/scheduler.py and
+engine/engine.py:
+
+- **orphan allocation**: ``x = <...>.allocate_pages(...)`` (or
+  ``x = list(<...>.allocate_pages(...))``) binds fresh pages to a
+  local. Direct attribute transfer (``seq.pages = ...``,
+  ``seq.pages.extend(...)``) is immediately owned and never tracked.
+  The fact dies at the first statement that *uses* the local — by
+  then the pages are visible to whatever cleanup that code path owns
+  (this deliberately checks "alloc reaches SOME consumer on every
+  path", the pattern every historical leak violated, not full
+  ownership transfer). A fact alive at the normal or exceptional exit
+  is a leak finding at the allocation line.
+
+- **orphan park**: a sequence enters ``AWAITING_KV`` (``.state =`` /
+  ``.transition(...)`` / ``Sequence(state=...)``) and must reach a
+  queue or terminal sink — ``add_sequence``, ``appendleft``/
+  ``append``, ``abort_sequence``/``_finish``/``finish_handoff``,
+  registration in an engine container, or ``pop``/``remove`` on the
+  failure path — before every exit. Unlike allocations, only those
+  sinks kill the fact: a tracer event reading ``seq.seq_id`` is not
+  custody.
+
+Exception edges use a narrow raises-predicate: ``raise``/``assert``,
+any call inside a ``try`` body, and calls to the APIs that actually
+throw on these paths (``allocate_pages``, ``add_sequence``) — so a
+``logger.warning`` cannot manufacture a phantom leak path, and
+``try/except OutOfPagesError`` cleanup is modeled exactly.
+
+Waive a deliberate orphan with ``# lint: allow-page-lifecycle`` on
+the allocation/park line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet, List, Set, Tuple
+
+from production_stack_tpu.staticcheck.cfg import (
+    CFG,
+    WithEnter,
+    WithExit,
+    contains_call,
+    function_defs,
+)
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    recv_name,
+    rule,
+    tail_name,
+)
+from production_stack_tpu.staticcheck import dataflow
+
+SCOPE = (
+    "production_stack_tpu/engine/scheduler.py",
+    "production_stack_tpu/engine/engine.py",
+)
+
+# Calls that genuinely raise on the allocation/admission paths; plus
+# raise/assert and anything already under a try, these are the only
+# sources of exception edges for this rule.
+RAISING_CALLS = {"allocate_pages", "add_sequence"}
+
+# Custody sinks for a parked sequence (see module docstring).
+PARK_SINKS = {"add_sequence", "append", "appendleft", "pop", "remove",
+              "_finish", "abort_sequence", "finish_handoff"}
+
+Fact = Tuple[str, str, int]  # ("alloc"|"park", var, lineno)
+
+
+def _raises(stmt: ast.AST, in_try: bool) -> bool:
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if not contains_call(stmt):
+        return False
+    if in_try:
+        return True
+    return any(isinstance(n, ast.Call)
+               and tail_name(n.func) in RAISING_CALLS
+               for n in ast.walk(stmt))
+
+
+def _alloc_target(stmt: ast.AST) -> str:
+    """Name bound to a fresh allocation by this statement, or ''.
+    Matches ``x = <...>.allocate_pages(...)`` and
+    ``x = list/tuple(<...>.allocate_pages(...))``."""
+    if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+        return ""
+    target = stmt.targets[0]
+    if not isinstance(target, ast.Name):
+        return ""
+    value = stmt.value
+    if (isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in ("list", "tuple") and value.args):
+        value = value.args[0]
+    if (isinstance(value, ast.Call)
+            and tail_name(value.func) == "allocate_pages"):
+        return target.id
+    return ""
+
+
+def _is_awaiting_kv(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and node.attr == "AWAITING_KV"
+            and tail_name(node.value) == "SequenceState")
+
+
+def _park_target(stmt: ast.AST) -> str:
+    """Variable whose sequence this statement parks in AWAITING_KV,
+    or ''."""
+    # x.state = SequenceState.AWAITING_KV  /  x.transition(AWAITING_KV)
+    if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Attribute)
+            and stmt.targets[0].attr == "state"
+            and isinstance(stmt.targets[0].value, ast.Name)
+            and _is_awaiting_kv(stmt.value)):
+        return stmt.targets[0].value.id
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+        if (tail_name(call.func) == "transition"
+                and isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.args and _is_awaiting_kv(call.args[0])):
+            return call.func.value.id
+    # x = Sequence(..., state=SequenceState.AWAITING_KV, ...)
+    if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Call)
+            and tail_name(stmt.value.func) == "Sequence"):
+        for kw in stmt.value.keywords:
+            if kw.arg == "state" and _is_awaiting_kv(kw.value):
+                return stmt.targets[0].id
+    return ""
+
+
+def _root_name(node: ast.AST) -> str:
+    """Leftmost Name of an attribute/subscript chain ('' otherwise)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _names_read(el) -> Set[str]:
+    """Names this CFG element *uses* — the allocation-consumption net.
+    Loop heads are restricted to their iterable (a ``while x:`` test
+    alone doesn't take custody of x)."""
+    if isinstance(el, (WithEnter, WithExit)):
+        return {n.id for n in ast.walk(el.node)
+                if isinstance(n, ast.Name)}
+    if isinstance(el, ast.While):
+        return set()
+    if isinstance(el, (ast.For, ast.AsyncFor)):
+        return {n.id for n in ast.walk(el.iter)
+                if isinstance(n, ast.Name)}
+    if isinstance(el, ast.AST):
+        return {n.id for n in ast.walk(el) if isinstance(n, ast.Name)}
+    return set()
+
+
+def _park_sunk_vars(el) -> Set[str]:
+    """Variables a custody sink consumes in this element."""
+    out: Set[str] = set()
+    if not isinstance(el, ast.AST):
+        return out
+    for node in ast.walk(el):
+        if (isinstance(node, ast.Call)
+                and tail_name(node.func) in PARK_SINKS):
+            for arg in node.args:
+                root = _root_name(arg)
+                if root:
+                    out.add(root)
+        # self.sequences[x.seq_id] = x : container registration.
+        elif (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Name)
+                and any(isinstance(t, (ast.Subscript, ast.Attribute))
+                        for t in node.targets)):
+            out.add(node.value.id)
+    return out
+
+
+def _transfer(state: FrozenSet[Fact], el, _kind) -> FrozenSet[Fact]:
+    reads = _names_read(el)
+    sunk = _park_sunk_vars(el)
+    alloc_var = _alloc_target(el) if isinstance(el, ast.AST) else ""
+    park_var = _park_target(el) if isinstance(el, ast.AST) else ""
+    out = set()
+    for fact in state:
+        kind, var, _line = fact
+        if kind == "alloc":
+            if var in reads:
+                continue  # consumed (or rebound) here
+        else:  # park
+            if var in sunk:
+                continue
+            if _rebinds(el, var) and park_var != var:
+                continue  # rebound to something else
+        out.add(fact)
+    if alloc_var:
+        out.add(("alloc", alloc_var, el.lineno))
+    if park_var:
+        out.add(("park", park_var, el.lineno))
+    return frozenset(out)
+
+
+def _rebinds(el, var: str) -> bool:
+    if not isinstance(el, ast.Assign):
+        return False
+    return any(isinstance(t, ast.Name) and t.id == var
+               for t in el.targets)
+
+
+@rule("page-lifecycle",
+      "KV page allocations / AWAITING_KV parks reach their paired "
+      "release or queue sink on every path (incl. exception edges)")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files(*SCOPE):
+        if sf.tree is None:
+            continue  # parse-error rule reports it
+        for fn in function_defs(sf.tree):
+            # Cheap prefilter: only functions that allocate or park.
+            if not any(_alloc_target(s) or _park_target(s)
+                       for s in ast.walk(fn)
+                       if isinstance(s, ast.stmt)):
+                continue
+            cfg = CFG(fn, raises=_raises)
+            exits = dataflow.facts_at_exit(
+                cfg, frozenset(), _transfer, join="union")
+            leaked: Set[Tuple[Fact, str]] = set()
+            for exit_name, facts in exits.items():
+                for fact in facts:
+                    leaked.add((fact, exit_name))
+            reported = set()
+            for (kind, var, line), exit_name in sorted(leaked):
+                if (kind, var, line) in reported:
+                    continue  # one finding per site, not per exit
+                reported.add((kind, var, line))
+                how = ("function exit" if exit_name == "exit"
+                       else "exception path")
+                if kind == "alloc":
+                    findings.append(sf.finding(
+                        "page-lifecycle", line,
+                        f"KV pages allocated into '{var}' in {fn.name} "
+                        f"can leak: a {how} is reachable before "
+                        "anything consumes them — free_sequence them "
+                        "or transfer ownership on that path"))
+                else:
+                    findings.append(sf.finding(
+                        "page-lifecycle", line,
+                        f"sequence '{var}' parked in AWAITING_KV in "
+                        f"{fn.name} can be stranded: a {how} is "
+                        "reachable before any queue/abort sink takes "
+                        "custody — the request would never complete"))
+    return findings

@@ -1,0 +1,233 @@
+"""Rule ``state-machine``: the SequenceState lifecycle is a declared
+table, not folklore.
+
+``SEQUENCE_TRANSITIONS`` in engine/sequence.py is the single source
+of truth for how a sequence may move between states; the runtime
+guard is ``Sequence.transition`` (raises on an untabled pair). This
+rule makes the table enforceable at lint time and keeps the docs
+honest, both directions, like ``span-contract``:
+
+- engine/sequence.py must define ``SEQUENCE_TRANSITIONS`` as a
+  module-level literal of ``(from, to, rationale)`` rows (``"new"``
+  rows declare sanctioned constructor states) and the
+  ``Sequence.transition`` method;
+- any direct ``<x>.state = SequenceState.<S>`` write anywhere in the
+  package outside ``Sequence.transition`` itself bypasses the runtime
+  validation and is a finding;
+- ``Sequence(..., state=SequenceState.<S>)`` constructor calls must
+  use a state with a ``("new", <S>)`` row;
+- ``.transition(SequenceState.<S>)`` calls must target a state that
+  appears as a destination in the table (the exact edge is checked at
+  runtime; lint catches states that are never a legal destination);
+- every table row is rendered (backticked ``| `from` | `to` |``) in
+  the ``<!-- sequence-states:begin/end -->`` block of
+  docs/sequence_states.md, and every documented row is in the table.
+
+Waiver: ``# lint: allow-state-machine`` on the flagged line (e.g. a
+test helper that deliberately corrupts state).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set, Tuple
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    tail_name,
+    rule,
+)
+
+SEQUENCE_FILE = "production_stack_tpu/engine/sequence.py"
+DOCS_FILE = "docs/sequence_states.md"
+
+_BLOCK_RE = re.compile(
+    r"<!--\s*sequence-states:begin\s*-->(.*?)"
+    r"<!--\s*sequence-states:end\s*-->",
+    re.DOTALL)
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`([a-z_]+)`\s*\|\s*`([a-z_]+)`", re.MULTILINE)
+
+
+def _transition_table(tree: ast.AST) -> Set[Tuple[str, str]]:
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        for target in stmt.targets:
+            if (isinstance(target, ast.Name)
+                    and target.id == "SEQUENCE_TRANSITIONS"
+                    and isinstance(stmt.value, (ast.Tuple, ast.List))):
+                rows = set()
+                for el in stmt.value.elts:
+                    if (isinstance(el, (ast.Tuple, ast.List))
+                            and len(el.elts) >= 2
+                            and all(isinstance(e, ast.Constant)
+                                    for e in el.elts[:2])):
+                        rows.add((el.elts[0].value, el.elts[1].value))
+                return rows
+    return set()
+
+
+def _enum_values(tree: ast.AST) -> dict:
+    """{member name: value} of the SequenceState enum."""
+    out = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef) and stmt.name == "SequenceState":
+            for sub in stmt.body:
+                if (isinstance(sub, ast.Assign)
+                        and isinstance(sub.targets[0], ast.Name)
+                        and isinstance(sub.value, ast.Constant)):
+                    out[sub.targets[0].id] = sub.value.value
+    return out
+
+
+def _in_transition_method(tree: ast.AST, lineno: int) -> bool:
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.FunctionDef)
+                and node.name == "transition"):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                return True
+    return False
+
+
+def _state_member(node: ast.AST) -> str:
+    """'X' for a ``SequenceState.X`` reference, else ''."""
+    if (isinstance(node, ast.Attribute)
+            and tail_name(node.value) == "SequenceState"):
+        return node.attr
+    return ""
+
+
+@rule("state-machine",
+      "SequenceState changes go through Sequence.transition and match "
+      "the declared SEQUENCE_TRANSITIONS table (docs in sync)")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    seq_sf = project.source(SEQUENCE_FILE)
+    docs = project.source(DOCS_FILE)
+    if seq_sf is None or seq_sf.tree is None:
+        return [Finding(
+            rule="state-machine", path=SEQUENCE_FILE, line=0,
+            message="state-machine surface file missing — if the "
+                    "sequence module moved, update "
+                    "staticcheck/analyzers/state_machine.py")]
+    table = _transition_table(seq_sf.tree)
+    if not table:
+        return [Finding(
+            rule="state-machine", path=SEQUENCE_FILE, line=0,
+            message="SEQUENCE_TRANSITIONS table not found (or empty) "
+                    "— the lifecycle must be a module-level literal "
+                    "of (from, to, rationale) rows")]
+    enum_values = _enum_values(seq_sf.tree)
+    value_of = {name: val for name, val in enum_values.items()}
+    initial = {dst for src, dst in table if src == "new"}
+    destinations = {dst for _src, dst in table}
+
+    # Rows must name real states (typo in the table itself).
+    known = set(enum_values.values()) | {"new"}
+    for src, dst in sorted(table):
+        for name in (src, dst):
+            if name not in known:
+                findings.append(Finding(
+                    rule="state-machine", path=SEQUENCE_FILE, line=0,
+                    message=f"SEQUENCE_TRANSITIONS row ('{src}', "
+                            f"'{dst}') names '{name}', which is not a "
+                            "SequenceState value"))
+
+    if not any(isinstance(n, ast.FunctionDef) and n.name == "transition"
+               for n in ast.walk(seq_sf.tree)):
+        findings.append(Finding(
+            rule="state-machine", path=SEQUENCE_FILE, line=0,
+            message="Sequence.transition method not found — the "
+                    "runtime half of the state-machine contract is "
+                    "missing"))
+
+    for sf in project.files("production_stack_tpu/**/*.py"):
+        if sf.tree is None:
+            continue  # parse-error rule reports it
+        for node in ast.walk(sf.tree):
+            # Direct .state = SequenceState.X writes.
+            if isinstance(node, ast.Assign):
+                member = ""
+                if _state_member(node.value):
+                    member = _state_member(node.value)
+                elif isinstance(node.value, ast.IfExp):
+                    # x.state = A if cond else B
+                    if (_state_member(node.value.body)
+                            or _state_member(node.value.orelse)):
+                        member = (_state_member(node.value.body)
+                                  or _state_member(node.value.orelse))
+                if member:
+                    for target in node.targets:
+                        if (isinstance(target, ast.Attribute)
+                                and target.attr == "state"):
+                            if (sf.relpath == SEQUENCE_FILE
+                                    and _in_transition_method(
+                                        sf.tree, node.lineno)):
+                                continue
+                            findings.append(sf.finding(
+                                "state-machine", node,
+                                "direct .state write bypasses "
+                                "Sequence.transition() and its "
+                                "SEQUENCE_TRANSITIONS validation — "
+                                "call transition() instead"))
+            # Sequence(state=...) constructor states.
+            elif (isinstance(node, ast.Call)
+                    and tail_name(node.func) == "Sequence"):
+                for kw in node.keywords:
+                    member = _state_member(kw.value) if kw.arg == "state" \
+                        else ""
+                    if member and value_of.get(member) not in initial:
+                        findings.append(sf.finding(
+                            "state-machine", node,
+                            f"Sequence constructed in state "
+                            f"'{value_of.get(member, member)}' which "
+                            "has no ('new', ...) row in "
+                            "SEQUENCE_TRANSITIONS — not a sanctioned "
+                            "initial state"))
+            # transition(SequenceState.X) destinations.
+            elif (isinstance(node, ast.Call)
+                    and tail_name(node.func) == "transition"
+                    and node.args):
+                member = _state_member(node.args[0])
+                if member and value_of.get(member) not in destinations:
+                    findings.append(sf.finding(
+                        "state-machine", node,
+                        f"transition to '{value_of.get(member, member)}'"
+                        " which is never a destination in "
+                        "SEQUENCE_TRANSITIONS — untabled move (would "
+                        "raise at runtime)"))
+
+    # Docs contract, both directions.
+    if docs is None:
+        findings.append(Finding(
+            rule="state-machine", path=DOCS_FILE, line=0,
+            message="docs/sequence_states.md missing — the transition "
+                    "table must be rendered for humans too"))
+        return findings
+    block = _BLOCK_RE.search(docs.text)
+    if block is None:
+        findings.append(Finding(
+            rule="state-machine", path=DOCS_FILE, line=0,
+            message="docs/sequence_states.md is missing the "
+                    "<!-- sequence-states:begin/end --> marker block "
+                    "the transition table lives in"))
+        return findings
+    documented = set(_DOC_ROW_RE.findall(block.group(1)))
+    for src, dst in sorted(table - documented):
+        findings.append(Finding(
+            rule="state-machine", path=DOCS_FILE, line=0,
+            message=f"transition ('{src}' -> '{dst}') is in "
+                    "SEQUENCE_TRANSITIONS but undocumented — add a "
+                    "row to the table in docs/sequence_states.md"))
+    for src, dst in sorted(documented - table):
+        findings.append(Finding(
+            rule="state-machine", path=DOCS_FILE, line=0,
+            message=f"docs/sequence_states.md documents transition "
+                    f"('{src}' -> '{dst}') which is not in "
+                    "SEQUENCE_TRANSITIONS — stale row or missing "
+                    "table entry"))
+    return findings

@@ -15,9 +15,14 @@ the argument happens to be weakly typed. Flags:
    - ``if``/``while`` tests on ``.shape``/``.ndim`` — trace-time
      specialization; legitimate bucketing must carry a waiver so
      every retrace trigger is deliberate and reviewed,
-   - any ``while`` loop whose test is not a compile-time constant —
+   - any ``while`` loop whose test can read a *traced* value —
      Python loops on traced state either fail to trace or unroll
-     unboundedly (use ``lax.while_loop``/``fori_loop``);
+     unboundedly (use ``lax.while_loop``/``fori_loop``). Tracedness
+     is decided by a taint dataflow over the CFG (staticcheck/cfg.py):
+     function parameters and everything derived from them are
+     tainted; a loop whose test reads only host-bounded locals (e.g.
+     ``size = 8`` then ``while size < 4096: size *= 2`` — padding
+     computation on constants) is fine and no longer needs a waiver;
 2. at module scope of every file in scope: eager ``jnp.*`` calls —
    module import must not allocate on or talk to the accelerator
    (``jnp.dtype`` is exempt: it is host metadata).
@@ -37,6 +42,7 @@ from __future__ import annotations
 import ast
 from typing import List, Set
 
+from production_stack_tpu.staticcheck.cfg import CFG
 from production_stack_tpu.staticcheck.core import (
     Finding,
     Project,
@@ -44,6 +50,7 @@ from production_stack_tpu.staticcheck.core import (
     rule,
     tail_name,
 )
+from production_stack_tpu.staticcheck import dataflow
 
 SCOPE = (
     "production_stack_tpu/ops/*.py",
@@ -106,6 +113,69 @@ def traced_functions(tree: ast.AST):
     yield from visit(tree, False)
 
 
+def _param_names(fn) -> Set[str]:
+    args = fn.args
+    names = {a.arg for a in (args.posonlyargs + args.args
+                             + args.kwonlyargs)}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def _taint_transfer(state, el, _kind):
+    """Union-taint over locals: parameters (and anything computed
+    from them) are traced values; literals and host arithmetic on
+    untainted locals are not."""
+    if not isinstance(el, ast.AST):
+        return state
+
+    def expr_tainted(expr) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in state
+                   for n in ast.walk(expr))
+
+    if isinstance(el, ast.Assign):
+        targets = frozenset(n.id for t in el.targets
+                            for n in ast.walk(t)
+                            if isinstance(n, ast.Name))
+        if expr_tainted(el.value):
+            return state | targets
+        return state - targets
+    if isinstance(el, ast.AugAssign) and isinstance(el.target, ast.Name):
+        if expr_tainted(el.value):
+            return state | {el.target.id}
+        return state  # x (op)= host-const keeps x's current status
+    if isinstance(el, (ast.For, ast.AsyncFor)):
+        targets = frozenset(n.id for n in ast.walk(el.target)
+                            if isinstance(n, ast.Name))
+        if expr_tainted(el.iter):
+            return state | targets
+        return state - targets
+    return state
+
+
+def _while_reads_traced(fn) -> dict:
+    """{While node: bool(test can read a traced value)} for every
+    while-loop in ``fn``, via the taint dataflow."""
+    cfg = CFG(fn, raises=lambda _s, _t: False)
+    block_in, _ = dataflow.solve(
+        cfg, frozenset(_param_names(fn)), _taint_transfer,
+        join="union")
+    out = {}
+    for block in cfg.reachable():
+        if block.id not in block_in:
+            continue
+        state = block_in[block.id]
+        for el in block.elements:
+            if isinstance(el, ast.While):
+                out[el] = any(
+                    isinstance(n, ast.Name) and n.id in state
+                    for n in ast.walk(el.test))
+            state = _taint_transfer(state, el, None)
+    return out
+
+
 def _test_findings(sf, fn, test, kind: str) -> List[Finding]:
     out: List[Finding] = []
     for sub in ast.walk(test):
@@ -158,17 +228,22 @@ def check_tree(sf) -> List[Finding]:
 
     # (1) hazards inside traced functions.
     for fn in traced_functions(tree):
+        traced_whiles = _while_reads_traced(fn)
         for node in ast.walk(fn):
             if isinstance(node, (ast.If, ast.While)):
                 kind = ("while-loop test"
                         if isinstance(node, ast.While) else "branch")
                 findings.extend(_test_findings(sf, fn, node.test, kind))
+                # Whiles inside nested defs are judged in their own
+                # function's taint context (traced_functions yields
+                # nested defs separately).
                 if (isinstance(node, ast.While)
-                        and not isinstance(node.test, ast.Constant)):
+                        and traced_whiles.get(node, False)):
                     findings.append(sf.finding(
                         "tracer-hygiene", node,
                         f"Python while-loop in traced function "
-                        f"{fn.name}: traces unboundedly or fails — "
+                        f"{fn.name}: its test can read a traced "
+                        "value, so it traces unboundedly or fails — "
                         "use lax.while_loop/fori_loop"))
             elif isinstance(node, ast.IfExp):
                 findings.extend(

@@ -10,8 +10,11 @@ only findings *outside* the baseline fail the CLI. The workflow
 - ``python -m production_stack_tpu.staticcheck --update-baseline``
   rewrites the file from the current tree (review the diff: a grown
   baseline is a regression you are choosing to accept);
-- an entry whose finding disappears is pruned on the next
-  ``--update-baseline`` and never hides anything meanwhile.
+- ``--prune-baseline`` drops entries whose finding no longer fires
+  without accepting any new debt — the shrink-only counterpart;
+- CI runs with ``--fail-stale-baseline``: a stale entry (fingerprint
+  that no longer fires) fails the job, so paid-down debt is removed
+  from the ledger in the same PR that paid it.
 """
 
 from __future__ import annotations
@@ -29,12 +32,37 @@ def baseline_path(root) -> pathlib.Path:
     return pathlib.Path(root) / BASELINE_RELPATH
 
 
-def load_fingerprints(root) -> Set[str]:
+def load_entries(root) -> List[dict]:
     path = baseline_path(root)
     if not path.exists():
-        return set()
+        return []
     data = json.loads(path.read_text())
-    return {entry["fingerprint"] for entry in data.get("findings", [])}
+    return list(data.get("findings", []))
+
+
+def load_fingerprints(root) -> Set[str]:
+    return {entry["fingerprint"] for entry in load_entries(root)}
+
+
+def stale_entries(root, findings: Iterable[Finding]) -> List[dict]:
+    """Baseline entries whose fingerprint no longer fires anywhere in
+    the tree — paid-down debt that should leave the ledger."""
+    live = {f.fingerprint() for f in findings}
+    return [e for e in load_entries(root)
+            if e["fingerprint"] not in live]
+
+
+def prune(root, findings: Iterable[Finding]) -> List[dict]:
+    """Drop stale entries, rewrite the file, return what was dropped.
+    Shrink-only: never records new findings."""
+    live = {f.fingerprint() for f in findings}
+    entries = load_entries(root)
+    kept = [e for e in entries if e["fingerprint"] in live]
+    dropped = [e for e in entries if e["fingerprint"] not in live]
+    if dropped:
+        baseline_path(root).write_text(json.dumps(
+            {"version": 1, "findings": kept}, indent=2) + "\n")
+    return dropped
 
 
 def split_new(findings: Iterable[Finding],

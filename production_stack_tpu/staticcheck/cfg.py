@@ -1,0 +1,458 @@
+"""Intraprocedural control-flow graphs over stdlib ``ast``.
+
+The per-node AST matching the suite started with (PR 5) cannot state
+the invariants the engine keeps re-pinning with runtime regression
+tests: "every path that allocates KV pages reaches the paired free",
+"no ``await`` while a sync lock is held", "this branch only runs with
+host data". Those are *path* properties. This module turns one
+``FunctionDef``/``AsyncFunctionDef`` into a small CFG that the
+worklist solver in ``dataflow.py`` runs lattices over.
+
+Shape of the graph:
+
+- ``Block``: a straight-line run of elements. Elements are either
+  plain ``ast.stmt`` nodes or the synthetic ``WithEnter``/``WithExit``
+  markers a ``with``/``async with`` desugars into (so a lock-held
+  lattice sees acquisition and release as ordinary effects).
+- Edges carry a kind: ``NORMAL`` (fallthrough/branch), ``BACK`` (loop
+  back-edge — same semantics as NORMAL, labelled so tests and widening
+  heuristics can see loops), and ``EXC`` (the statement raised).
+- Two synthetic sinks: ``cfg.exit`` (return / fall-off-the-end) and
+  ``cfg.raise_exit`` (an exception escaped the function).
+
+Exception edges are the precision/noise dial. A statement gets EXC
+edges when the caller-supplied ``raises(stmt, in_try)`` predicate says
+so; the default is "contains a call, raise or assert". Analyzers pass
+narrower predicates (e.g. page-lifecycle only treats ``raise``,
+statements inside a ``try`` body, and calls to known-raising cache
+APIs as throwing) so a ``logger.warning`` does not manufacture a
+phantom leak path. An EXC edge means "the statement's effects did NOT
+happen": the solver propagates the state from *before* the raising
+statement, which the builder guarantees by placing every raising
+statement in its own single-element block.
+
+``try``/``finally`` is handled the way CPython compiles it: ``break``,
+``continue`` and ``return`` that cross a ``finally`` re-emit (clone)
+the finally body on that exit path, and exceptional paths route
+through a once-built exceptional copy of the finally before escaping
+outward. ``with`` bodies reuse the same machinery with a synthetic
+``WithExit`` as their finally, so a lock held in a ``with`` is
+provably released on every exit — including the exception edges.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+NORMAL = "normal"
+BACK = "back"
+EXC = "exc"
+
+
+@dataclasses.dataclass
+class WithEnter:
+    """Synthetic element: control entered ``with <expr>`` (one marker
+    per with-item). ``node`` is the ``withitem.context_expr``."""
+    node: ast.expr
+    is_async: bool
+    lineno: int
+
+
+@dataclasses.dataclass
+class WithExit:
+    """Synthetic element: the matching context manager exited (normal
+    or exceptional path — __exit__ runs on both)."""
+    node: ast.expr
+    is_async: bool
+    lineno: int
+
+
+Element = object  # ast.stmt | WithEnter | WithExit
+
+
+class Block:
+    __slots__ = ("id", "elements", "succs")
+
+    def __init__(self, block_id: int):
+        self.id = block_id
+        self.elements: List[Element] = []
+        self.succs: List[Tuple["Block", str]] = []
+
+    def edge(self, dst: "Block", kind: str = NORMAL) -> None:
+        if (dst, kind) not in self.succs:
+            self.succs.append((dst, kind))
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<Block {self.id} n={len(self.elements)}>"
+
+
+def contains_call(stmt: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) for n in ast.walk(stmt))
+
+
+def contains_await(stmt: ast.AST) -> bool:
+    return any(isinstance(n, ast.Await) for n in ast.walk(stmt))
+
+
+def default_raises(stmt: ast.AST, in_try: bool) -> bool:
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    return contains_call(stmt)
+
+
+_CATCH_ALL_NAMES = {"Exception", "BaseException"}
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _CATCH_ALL_NAMES
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _CATCH_ALL_NAMES
+                   for e in t.elts)
+    return False
+
+
+@dataclasses.dataclass
+class _Frame:
+    """One enclosing construct the builder must route exits through."""
+    kind: str  # "loop" | "try" | "with"
+    # loop targets
+    head: Optional[Block] = None
+    after: Optional[Block] = None
+    # try: where a raise inside the body lands
+    handler_entries: List[Block] = dataclasses.field(
+        default_factory=list)
+    # try: some handler is a catch-all (bare / Exception /
+    # BaseException), so body exceptions cannot bypass the handlers.
+    catches_all: bool = False
+    # statements to re-emit when control leaves this frame early
+    # (finally body, or the WithExit marker for a with).
+    cleanup: List[Element] = dataclasses.field(default_factory=list)
+    # exceptional continuation: block chain that runs the cleanup and
+    # escapes outward. Built lazily, once per frame.
+    exc_chain: Optional[Block] = None
+
+
+class CFG:
+    """Control-flow graph of one function body.
+
+    ``raises(stmt, in_try)`` decides which statements get EXC edges.
+    The builder guarantees every statement with EXC successors sits in
+    a single-element block, so exception edges always observe the
+    state *before* the statement (its effects did not happen).
+    """
+
+    def __init__(self, fn, raises: Callable[[ast.AST, bool], bool]
+                 = default_raises):
+        assert isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        self.fn = fn
+        self._raises = raises
+        self.blocks: List[Block] = []
+        self.entry = self._new_block()
+        self.exit = self._new_block()
+        self.raise_exit = self._new_block()
+        self._frames: List[_Frame] = []
+        end = self._build_stmts(fn.body, self.entry)
+        if end is not None:
+            end.edge(self.exit)
+
+    # ---- construction ---------------------------------------------------
+
+    def _new_block(self) -> Block:
+        b = Block(len(self.blocks))
+        self.blocks.append(b)
+        return b
+
+    def _in_try(self) -> bool:
+        return any(f.kind == "try" for f in self._frames)
+
+    def _exc_targets(self) -> List[Block]:
+        """Where an exception raised *here* can land: every handler of
+        the innermost try, plus the cleanup chain that escapes the
+        innermost frame outward (unless a catch-all handler makes
+        bypass impossible)."""
+        for frame in reversed(self._frames):
+            if frame.kind == "try" and frame.handler_entries:
+                targets = list(frame.handler_entries)
+                if not frame.catches_all:
+                    targets.append(self._escape_chain(frame))
+                return targets
+            if frame.cleanup:
+                return [self._escape_chain(frame)]
+        return [self.raise_exit]
+
+    def _escape_chain(self, frame: _Frame) -> Block:
+        """Lazily build ``frame``'s exceptional continuation: run its
+        cleanup, then keep escaping through the enclosing frames.
+
+        Out-edges are NORMAL, not EXC: the raise already happened at
+        the statement that routed here, and the cleanup elements in
+        this block must take effect before the state reaches the next
+        handler or the exceptional exit.
+        """
+        if frame.exc_chain is None:
+            b = self._new_block()
+            frame.exc_chain = b
+            b.elements.extend(frame.cleanup)
+            idx = self._frames.index(frame)
+            outer = self._frames[:idx]
+            target = self.raise_exit
+            for out in reversed(outer):
+                if out.kind == "try" and out.handler_entries:
+                    # Escaping exception may be caught one level up.
+                    for h in out.handler_entries:
+                        b.edge(h)
+                    if out.catches_all:
+                        return frame.exc_chain
+                if out.cleanup:
+                    target = self._escape_chain(out)
+                    break
+            b.edge(target)
+        return frame.exc_chain
+
+    def _route_cleanups(self, src: Block, upto: Optional[_Frame],
+                        target: Block, kind: str = NORMAL) -> None:
+        """Early exit (break/continue/return): clone the cleanup
+        elements of every frame between the current one and ``upto``
+        (exclusive; None = all frames) onto the path ``src ->
+        target``."""
+        cleanups: List[Element] = []
+        for frame in reversed(self._frames):
+            if frame is upto:
+                break
+            cleanups.extend(frame.cleanup)
+        if cleanups:
+            chain = self._new_block()
+            chain.elements.extend(cleanups)
+            src.edge(chain)
+            chain.edge(target, kind)
+        else:
+            src.edge(target, kind)
+
+    def _emit(self, stmt: ast.stmt, cur: Block) -> Block:
+        """Append a simple statement, isolating raisers in their own
+        block so EXC edges see pre-statement state."""
+        if self._raises(stmt, self._in_try()):
+            box = self._new_block()
+            cur.edge(box)
+            box.elements.append(stmt)
+            for t in self._exc_targets():
+                box.edge(t, EXC)
+            nxt = self._new_block()
+            box.edge(nxt)
+            return nxt
+        cur.elements.append(stmt)
+        return cur
+
+    def _build_stmts(self, stmts: List[ast.stmt],
+                     cur: Optional[Block]) -> Optional[Block]:
+        """Returns the open fallthrough block, or None if control
+        cannot reach past ``stmts``."""
+        for stmt in stmts:
+            if cur is None:
+                return None  # unreachable code: stop building
+            cur = self._build_stmt(stmt, cur)
+        return cur
+
+    def _build_stmt(self, stmt: ast.stmt,
+                    cur: Block) -> Optional[Block]:
+        if isinstance(stmt, (ast.If,)):
+            body = self._new_block()
+            cur.edge(body)
+            body_end = self._build_stmts(stmt.body, body)
+            after = self._new_block()
+            if stmt.orelse:
+                orelse = self._new_block()
+                cur.edge(orelse)
+                orelse_end = self._build_stmts(stmt.orelse, orelse)
+                if orelse_end is not None:
+                    orelse_end.edge(after)
+            else:
+                cur.edge(after)
+            if body_end is not None:
+                body_end.edge(after)
+            return after
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = self._new_block()
+            # Loop heads carry the test/iter statement itself so
+            # analyzers can see the names it reads.
+            head.elements.append(stmt)
+            cur.edge(head)
+            after = self._new_block()
+            frame = _Frame(kind="loop", head=head, after=after)
+            self._frames.append(frame)
+            body = self._new_block()
+            head.edge(body)
+            body_end = self._build_stmts(stmt.body, body)
+            self._frames.pop()
+            if body_end is not None:
+                body_end.edge(head, BACK)
+            if stmt.orelse:
+                orelse = self._new_block()
+                head.edge(orelse)
+                orelse_end = self._build_stmts(stmt.orelse, orelse)
+                if orelse_end is not None:
+                    orelse_end.edge(after)
+            else:
+                head.edge(after)
+            return after
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            is_async = isinstance(stmt, ast.AsyncWith)
+            enters = [WithEnter(item.context_expr, is_async,
+                                stmt.lineno)
+                      for item in stmt.items]
+            exits = [WithExit(item.context_expr, is_async, stmt.lineno)
+                     for item in reversed(stmt.items)]
+            # Entering the context can raise (context_expr is a call).
+            cur = self._emit(stmt_expr_of(stmt), cur)
+            cur.elements.extend(enters)
+            frame = _Frame(kind="with", cleanup=list(exits))
+            self._frames.append(frame)
+            body_end = self._build_stmts(stmt.body, cur)
+            self._frames.pop()
+            if body_end is None:
+                return None
+            body_end.elements.extend(exits)
+            nxt = self._new_block()
+            body_end.edge(nxt)
+            return nxt
+
+        if isinstance(stmt, ast.Try):
+            handler_entries = [self._new_block()
+                               for _ in stmt.handlers]
+            frame = _Frame(kind="try", handler_entries=handler_entries,
+                           catches_all=any(_is_catch_all(h)
+                                           for h in stmt.handlers),
+                           cleanup=list(stmt.finalbody))
+            after = self._new_block()
+            self._frames.append(frame)
+            body = self._new_block()
+            cur.edge(body)
+            body_end = self._build_stmts(stmt.body, body)
+            if body_end is not None and stmt.orelse:
+                body_end = self._build_stmts(stmt.orelse, body_end)
+            self._frames.pop()
+            # Handlers run OUTSIDE the protected region (an exception
+            # inside a handler escapes this try) but inside the
+            # finally frame.
+            fin_frame = None
+            if stmt.finalbody:
+                fin_frame = _Frame(kind="with",
+                                   cleanup=list(stmt.finalbody))
+                self._frames.append(fin_frame)
+            handler_ends = []
+            for h, entry in zip(stmt.handlers, handler_entries):
+                handler_ends.append(
+                    self._build_stmts(h.body, entry))
+            if fin_frame is not None:
+                self._frames.pop()
+            # Normal completion and handler completion both run the
+            # finally once, then continue to ``after``.
+            tails = [e for e in ([body_end] + handler_ends)
+                     if e is not None]
+            if not tails and not stmt.finalbody:
+                return None
+            if stmt.finalbody:
+                fin = self._new_block()
+                for t in tails:
+                    t.edge(fin)
+                fin_end = self._build_stmts(stmt.finalbody, fin)
+                if fin_end is None or not tails:
+                    return None
+                fin_end.edge(after)
+            else:
+                for t in tails:
+                    t.edge(after)
+            return after
+
+        if isinstance(stmt, ast.Return):
+            box = self._new_block()
+            cur.edge(box)
+            box.elements.append(stmt)
+            self._route_cleanups(box, None, self.exit)
+            return None
+
+        if isinstance(stmt, ast.Break):
+            frame = self._innermost_loop()
+            if frame is not None:
+                self._route_cleanups(cur, frame, frame.after)
+            return None
+
+        if isinstance(stmt, ast.Continue):
+            frame = self._innermost_loop()
+            if frame is not None:
+                self._route_cleanups(cur, frame, frame.head, BACK)
+            return None
+
+        if isinstance(stmt, ast.Raise):
+            box = self._new_block()
+            cur.edge(box)
+            box.elements.append(stmt)
+            for t in self._exc_targets():
+                box.edge(t, EXC)
+            return None
+
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # Nested definitions are opaque single statements here;
+            # analyze them with their own CFG if needed.
+            cur.elements.append(stmt)
+            return cur
+
+        return self._emit(stmt, cur)
+
+    def _innermost_loop(self) -> Optional[_Frame]:
+        for frame in reversed(self._frames):
+            if frame.kind == "loop":
+                return frame
+        return None
+
+    # ---- queries --------------------------------------------------------
+
+    def reachable(self) -> List[Block]:
+        seen = {self.entry.id}
+        order = [self.entry]
+        stack = [self.entry]
+        while stack:
+            b = stack.pop()
+            for dst, _ in b.succs:
+                if dst.id not in seen:
+                    seen.add(dst.id)
+                    order.append(dst)
+                    stack.append(dst)
+        return order
+
+    def back_edges(self) -> List[Tuple[Block, Block]]:
+        return [(b, dst) for b in self.blocks
+                for dst, kind in b.succs if kind == BACK]
+
+
+class _WithHead(ast.stmt):
+    pass
+
+
+def stmt_expr_of(with_stmt) -> ast.stmt:
+    """A synthetic statement holding a with-statement's context
+    expressions, so entering the with can carry EXC edges without
+    re-walking its whole body."""
+    expr = ast.Expr(value=ast.Tuple(
+        elts=[item.context_expr for item in with_stmt.items],
+        ctx=ast.Load()))
+    ast.copy_location(expr, with_stmt)
+    ast.fix_missing_locations(expr)
+    return expr
+
+
+def function_defs(tree: ast.AST):
+    """Every (async) function definition in ``tree``, including
+    nested ones."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

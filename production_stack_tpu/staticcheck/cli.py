@@ -9,7 +9,17 @@ pre-commit hook):
 - 2: usage or internal error (unknown rule, unreadable root, ...).
 
 ``--update-baseline`` rewrites baseline.json from the current tree
-and exits 0; review that diff like code.
+and exits 0; review that diff like code. ``--prune-baseline`` is the
+shrink-only counterpart (drops stale entries, never adds), and
+``--fail-stale-baseline`` turns a stale entry into exit 1 — CI runs
+with it so paid-down debt leaves the ledger in the paying PR.
+
+``--diff <git-ref>`` reports only findings whose file/line is touched
+vs the ref (git diff -U0; exit semantics unchanged) so pre-commit
+stays fast as the rule count grows. ``--sarif out.sarif`` writes a
+SARIF 2.1.0 report for PR annotation alongside the normal output;
+the ``--json`` payload is byte-stable and unaffected by either flag's
+absence.
 """
 
 from __future__ import annotations
@@ -20,6 +30,8 @@ import pathlib
 import sys
 
 from production_stack_tpu.staticcheck import baseline as baseline_mod
+from production_stack_tpu.staticcheck import diff as diff_mod
+from production_stack_tpu.staticcheck import sarif as sarif_mod
 from production_stack_tpu.staticcheck.core import (
     REGISTRY,
     Project,
@@ -49,6 +61,19 @@ def main(argv=None) -> int:
     parser.add_argument("--update-baseline", action="store_true",
                         help="rewrite baseline.json from the current "
                              "tree (then exit 0)")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop baseline entries that no longer "
+                             "fire (shrink-only; then exit 0)")
+    parser.add_argument("--fail-stale-baseline", action="store_true",
+                        help="exit 1 if any baseline entry no longer "
+                             "fires (CI ledger hygiene)")
+    parser.add_argument("--diff", default=None, metavar="GIT_REF",
+                        help="report only findings on lines changed "
+                             "vs this git ref (analysis still runs "
+                             "on the whole tree)")
+    parser.add_argument("--sarif", default=None, metavar="PATH",
+                        help="also write a SARIF 2.1.0 report of the "
+                             "new findings to PATH")
     args = parser.parse_args(argv)
 
     # Side-effect import: registers every analyzer.
@@ -77,8 +102,30 @@ def main(argv=None) -> int:
         print(f"wrote {len(findings)} finding(s) to {path}")
         return 0
 
+    if args.prune_baseline:
+        dropped = baseline_mod.prune(root, findings)
+        print(f"pruned {len(dropped)} stale baseline entr"
+              f"{'y' if len(dropped) == 1 else 'ies'}")
+        return 0
+
     fingerprints = baseline_mod.load_fingerprints(root)
     new, baselined = baseline_mod.split_new(findings, fingerprints)
+
+    if args.diff is not None:
+        try:
+            changed = diff_mod.changed_lines(root, args.diff)
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        new = diff_mod.filter_findings(new, changed)
+
+    if args.sarif:
+        payload = sarif_mod.render(new, REGISTRY)
+        pathlib.Path(args.sarif).write_text(
+            json.dumps(payload, indent=2) + "\n")
+
+    stale = (baseline_mod.stale_entries(root, findings)
+             if args.fail_stale_baseline else [])
 
     if args.json:
         print(json.dumps({
@@ -93,6 +140,13 @@ def main(argv=None) -> int:
             print(f.render())
         print(f"{len(new)} new finding(s), {len(baselined)} "
               "baselined")
+    if stale:
+        for entry in stale:
+            print(f"stale baseline entry: {entry['fingerprint']} "
+                  f"({entry['rule']}, {entry['path']}) no longer "
+                  "fires — run --prune-baseline",
+                  file=sys.stderr)
+        return 1
     return 1 if new else 0
 
 

@@ -1,0 +1,106 @@
+"""Generic forward dataflow over ``cfg.CFG``.
+
+One solver, many small lattices. An analysis supplies:
+
+- ``initial``: the state at function entry (a frozenset of facts).
+- ``transfer(state, element, incoming_kind) -> state``: the effect of
+  one block element (an ``ast.stmt`` or a ``WithEnter``/``WithExit``
+  marker). Pure; must return a frozenset.
+- ``join``: ``"union"`` for may-analyses (a fact holds on SOME path —
+  leak detection wants this: a page allocation live on any path to the
+  exit is a leak) or ``"intersection"`` for must-analyses (a fact
+  holds on ALL paths — "this value is definitely host-origin").
+
+The solver iterates to a fixpoint with a worklist. States are
+frozensets over a finite universe of per-function facts, so
+termination is immediate (each block's in-state grows/shrinks
+monotonically toward a bound).
+
+Exception edges: the CFG builder isolates every potentially-raising
+statement in its own block, so the EXC successor receives the state
+*before* that statement's transfer — "the effects did not happen".
+Concretely ``block_out`` maps each block to a dict ``{kind: state}``:
+the NORMAL/BACK out-state has all transfers applied, the EXC
+out-state is the block's in-state untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, FrozenSet, List, Tuple
+
+from .cfg import BACK, CFG, EXC, NORMAL, Block
+
+State = FrozenSet
+Transfer = Callable[[State, object, str], State]
+
+
+def solve(cfg: CFG, initial: State, transfer: Transfer,
+          join: str = "union") -> Tuple[Dict[int, State],
+                                        Dict[int, Dict[str, State]]]:
+    """Run ``transfer`` over ``cfg`` to fixpoint.
+
+    Returns ``(block_in, block_out)`` keyed by block id. For
+    intersection join, blocks never reached keep the universe-absent
+    sentinel ``None`` internally and are excluded from the result.
+    """
+    assert join in ("union", "intersection")
+    blocks = cfg.reachable()
+    block_in: Dict[int, State] = {}
+    block_out: Dict[int, Dict[str, State]] = {}
+
+    # Predecessor map with edge kinds.
+    preds: Dict[int, List[Tuple[Block, str]]] = {b.id: [] for b in blocks}
+    ids = set(preds)
+    for b in blocks:
+        for dst, kind in b.succs:
+            if dst.id in ids:
+                preds[dst.id].append((b, kind))
+
+    block_in[cfg.entry.id] = initial
+
+    def apply_block(b: Block, state: State) -> Dict[str, State]:
+        exc_state = state  # pre-statement state escapes on EXC edges
+        for el in b.elements:
+            state = transfer(state, el, NORMAL)
+        return {NORMAL: state, BACK: state, EXC: exc_state}
+
+    worklist = [b for b in blocks]
+    in_list = {b.id for b in blocks}
+    while worklist:
+        b = worklist.pop(0)
+        in_list.discard(b.id)
+        if b.id == cfg.entry.id:
+            new_in = initial
+        else:
+            incoming = [block_out[p.id][kind]
+                        for p, kind in preds[b.id]
+                        if p.id in block_out]
+            if not incoming:
+                continue  # no predecessor solved yet
+            if join == "union":
+                new_in = frozenset().union(*incoming)
+            else:
+                new_in = frozenset.intersection(*incoming)
+        if b.id in block_in and block_in[b.id] == new_in \
+                and b.id in block_out:
+            continue
+        block_in[b.id] = new_in
+        block_out[b.id] = apply_block(b, new_in)
+        for dst, _kind in b.succs:
+            if dst.id in ids and dst.id not in in_list:
+                in_list.add(dst.id)
+                worklist.append(dst)
+    return block_in, block_out
+
+
+def facts_at_exit(cfg: CFG, initial: State, transfer: Transfer,
+                  join: str = "union") -> Dict[str, State]:
+    """Convenience: the joined state reaching the normal exit and the
+    exceptional exit. Missing key means that exit is unreachable."""
+    block_in, _ = solve(cfg, initial, transfer, join)
+    out = {}
+    if cfg.exit.id in block_in:
+        out["exit"] = block_in[cfg.exit.id]
+    if cfg.raise_exit.id in block_in:
+        out["raise_exit"] = block_in[cfg.raise_exit.id]
+    return out

@@ -1,0 +1,78 @@
+"""``--diff <ref>`` support: restrict findings to touched lines.
+
+Pre-commit latency must not grow with the rule count, so the CLI can
+filter findings to those on lines changed vs a base ref. The full
+analysis still runs (rules are cross-file contracts — a route added
+in server.py fires a finding anchored in fake_engine.py), only the
+*reporting* is filtered:
+
+- a finding with a line number survives if its file is in the diff
+  and its line is inside a changed hunk;
+- a line-0 (file/project-contract) finding survives if its file is in
+  the diff at all — contract findings have no better anchor, and
+  hiding them on a touched file would let a PR break a contract
+  invisibly.
+
+Parsing is ``git diff -U0 <ref>`` hunk headers only (``+++ b/path``,
+``@@ -a,b +c,d @@``): zero context means changed-line ranges are
+exact.
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+from typing import Dict, Iterable, List, Set
+
+from production_stack_tpu.staticcheck.core import Finding
+
+_FILE_RE = re.compile(r"^\+\+\+ b/(.+)$")
+_HUNK_RE = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+
+
+def parse_unified_diff(text: str) -> Dict[str, Set[int]]:
+    """{path: changed line numbers (new side)} from ``-U0`` output.
+    A file that only lost lines maps to an empty set — it is still
+    'touched'."""
+    changed: Dict[str, Set[int]] = {}
+    current: str = ""
+    for line in text.splitlines():
+        m = _FILE_RE.match(line)
+        if m:
+            current = m.group(1)
+            changed.setdefault(current, set())
+            continue
+        m = _HUNK_RE.match(line)
+        if m and current:
+            start = int(m.group(1))
+            # "+N" means one line; "+N,0" is a pure deletion — the
+            # file is touched but no new-side lines exist.
+            count = int(m.group(2)) if m.group(2) is not None else 1
+            changed[current].update(range(start, start + count))
+    return changed
+
+
+def changed_lines(root, ref: str) -> Dict[str, Set[int]]:
+    """Run ``git diff -U0 <ref>`` in ``root`` and parse it. Raises
+    RuntimeError (for the CLI's usage-error exit) when git fails —
+    e.g. an unknown ref."""
+    proc = subprocess.run(
+        ["git", "diff", "-U0", ref, "--"],
+        cwd=str(root), capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"git diff -U0 {ref} failed: "
+            f"{proc.stderr.strip() or proc.stdout.strip()}")
+    return parse_unified_diff(proc.stdout)
+
+
+def filter_findings(findings: Iterable[Finding],
+                    changed: Dict[str, Set[int]]) -> List[Finding]:
+    out: List[Finding] = []
+    for f in findings:
+        lines = changed.get(f.path)
+        if lines is None:
+            continue
+        if f.line == 0 or f.line in lines:
+            out.append(f)
+    return out

@@ -1,0 +1,68 @@
+"""SARIF 2.1.0 rendering for ``--sarif out.sarif``.
+
+The point is PR annotation: CI uploads the file via
+``github/codeql-action/upload-sarif`` and findings appear inline on
+the diff. Minimal valid subset — one run, the registered rules as the
+driver's rule catalog, one result per finding with a physical
+location and the staticcheck fingerprint carried in
+``partialFingerprints`` so GitHub's alert dedup tracks ours.
+
+``--json`` stays the machine-readable contract (byte-stable); SARIF
+is a second emitter over the same findings, never a replacement.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from production_stack_tpu.staticcheck.core import Finding, Rule
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render(findings: Iterable[Finding],
+           rules: Dict[str, Rule]) -> dict:
+    rule_ids = sorted(rules)
+    index = {name: i for i, name in enumerate(rule_ids)}
+    results: List[dict] = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "%SRCROOT%",
+                    },
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "partialFingerprints": {
+                "staticcheckFingerprint/v1": f.fingerprint(),
+            },
+        }
+        if f.rule in index:
+            result["ruleIndex"] = index[f.rule]
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "production-stack-tpu-staticcheck",
+                    "informationUri":
+                        "docs/static_analysis.md",
+                    "rules": [{
+                        "id": name,
+                        "shortDescription": {
+                            "text": rules[name].description},
+                    } for name in rule_ids],
+                },
+            },
+            "results": results,
+        }],
+    }

@@ -73,6 +73,7 @@ from aiohttp import web
 # reuses the real engine's tracer so router-side stitching tests see
 # genuine {"span": "engine_request"} lines without a TPU.
 from production_stack_tpu.engine.tracing import EngineTracer
+from production_stack_tpu.version import __version__
 from production_stack_tpu.kvecon.summary import (
     chain_text,
     expected_hit_blocks,
@@ -95,6 +96,56 @@ FAULT_MODES = (
 )
 
 ENGINE_ROLES = ("prefill", "decode", "both")
+
+# endpoint-contract markers (staticcheck/analyzers/endpoint_contract.py):
+# every real-server route is mirrored here or listed below with the
+# reason the fake cannot (or need not) fake it. Both directions are
+# linted — a stale or redundant entry is itself a finding.
+FAKE_ENGINE_EXEMPT = {
+    "POST /v1/embeddings":
+        "pooling endpoints run a real model forward (hidden-state "
+        "pooling); router tests exercise generation routing, and a "
+        "fabricated embedding vector would only test the fabrication",
+    "POST /v1/score":
+        "cross-encoder scoring needs a real forward pass — see "
+        "POST /v1/embeddings",
+    "POST /score":
+        "alias of /v1/score — same real-forward dependency",
+    "POST /v1/rerank":
+        "rerank is score over N candidates — same real-forward "
+        "dependency",
+    "POST /rerank":
+        "alias of /v1/rerank — same real-forward dependency",
+    "POST /debug/profiler/start":
+        "drives the live JAX profiler on the device; meaningless "
+        "without a TPU and never routed through the router",
+    "POST /debug/profiler/stop":
+        "paired with /debug/profiler/start — same device dependency",
+    "POST /kv/batch_get":
+        "cache-server route: tests run the real CacheServer app "
+        "in-process (it has no device dependency) instead of faking it",
+    "PUT /kv/{key}":
+        "cache-server route — real CacheServer runs in-process for "
+        "tests",
+    "HEAD /kv/{key}":
+        "cache-server route — real CacheServer runs in-process for "
+        "tests",
+    "GET /kv/{key}":
+        "cache-server route — real CacheServer runs in-process for "
+        "tests",
+    "GET /stats":
+        "cache-server route — real CacheServer runs in-process for "
+        "tests",
+}
+
+# Routes only the fake serves: test hooks with no real-server twin.
+FAKE_ONLY_ROUTES = {
+    "POST /fault": "fault-injection hook for resilience tests",
+    "POST /gauges": "injects deterministic load-gauge values so "
+                    "autoscaler tests can drive SLO signals",
+    "POST /kv/summary": "lets KV-economy tests plant the hot-chain "
+                        "snapshot the GET serves",
+}
 
 
 class FakeEngineState:
@@ -961,6 +1012,30 @@ async def debug_compiles(request: web.Request) -> web.Response:
     })
 
 
+async def version(request: web.Request) -> web.Response:
+    """GET /version: same shape as the real server (the package
+    version — the fake IS this package)."""
+    return web.json_response({"version": __version__})
+
+
+async def debug_steps(request: web.Request) -> web.Response:
+    """GET /debug/steps[?limit=N]: the fake's flight recorder (same
+    EngineTracer class as the real engine), same 404/400 contract as
+    engine/server.py debug_steps."""
+    state: FakeEngineState = request.app["state"]
+    if state.tracer is None:
+        return web.json_response(
+            {"error": {"message": "tracing disabled"}}, status=404)
+    try:
+        limit = int(request.query.get("limit", "100"))
+    except ValueError:
+        return web.json_response(
+            {"error": {"message": "limit must be an integer"}},
+            status=400)
+    return web.json_response(
+        {"steps": state.tracer.recent_steps(limit=limit)})
+
+
 async def debug_memory(request: web.Request) -> web.Response:
     """GET /debug/memory: deterministic HBM-ledger payload matching
     the real server's shape (engine/server.py debug_memory)."""
@@ -1010,10 +1085,12 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
     app.router.add_post("/v1/resume", resume)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/health", health)
+    app.router.add_get("/version", version)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/kv/summary", kv_summary)
     app.router.add_post("/kv/summary", set_kv_summary)
     app.router.add_get("/debug/trace/{request_id}", debug_trace)
+    app.router.add_get("/debug/steps", debug_steps)
     app.router.add_get("/debug/compiles", debug_compiles)
     app.router.add_get("/debug/memory", debug_memory)
     app.router.add_post("/fault", set_fault)

@@ -42,7 +42,8 @@ def test_all_advertised_rules_are_registered():
     import production_stack_tpu.staticcheck.analyzers  # noqa: F401
     expected = {"tracer-hygiene", "async-blocking", "metrics-contract",
                 "config-contract", "no-timeout", "host-read",
-                "kv-parity", "span-contract"}
+                "kv-parity", "span-contract", "page-lifecycle",
+                "state-machine", "lock-discipline", "endpoint-contract"}
     assert expected <= set(REGISTRY)
 
 
