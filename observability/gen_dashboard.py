@@ -317,6 +317,31 @@ def build():
         panel("Expected Prefix-Hit Tokens (last placement)",
               [target('vllm:kv_route_expected_hit_tokens')],
               16, 115),
+        # ---- SLO ledger & goodput (docs/observability.md) -------------------
+        row("SLO & Goodput", 122),
+        panel("SLO Attainment by Class",
+              [target('vllm:slo_attainment',
+                      "{{class}} {{model}}")],
+              0, 123, unit="percentunit"),
+        panel("SLO Burn Rate (multi-window)",
+              [target('vllm:slo_burn_rate', "{{window}}")],
+              8, 123),
+        panel("Good vs Bad Requests (rate)",
+              [target('sum(rate(vllm:slo_good_requests_total[5m]))',
+                      "good"),
+               target('sum(rate(vllm:slo_bad_requests_total[5m]))',
+                      "bad")],
+              16, 123),
+        panel("Slow-Request Archive Depth",
+              [target('vllm:slow_archive_depth', "exemplars")],
+              0, 130, w=4, kind="stat"),
+        panel("Perf Drift Flags by Phase",
+              [target('vllm:perf_drift', "{{phase}}")],
+              4, 130, w=8, kind="stat"),
+        panel("Engine Step-Time Median by Kind",
+              [target('vllm:engine_step_time_median_seconds',
+                      "{{kind}} {{server}}")],
+              12, 130, w=12, unit="s"),
     ]
     return {
         "title": "TPU Stack — Serving Overview",

@@ -33,6 +33,7 @@ greedy parity tests can pin zero overhead.
 from __future__ import annotations
 
 import collections
+import statistics
 import time
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
@@ -100,6 +101,11 @@ class PerfObservatory:
         self._tokens: Dict[str, int] = {}
         self.device_seconds_total = 0.0
         self.tokens_total = 0
+        # Bounded per-kind ring of recent step durations; its medians
+        # feed vllm:engine_step_time_median_seconds{kind} and the
+        # router-side drift sentinel (obs/drift.py).
+        self._step_durations: Dict[str, Deque[float]] = {}
+        self._step_ring_size = 512
 
         # ---- dispatch-timing fold-in (PSTPU_TIMING walls) ------------
         self._dispatch_count: Dict[str, int] = {}
@@ -252,9 +258,24 @@ class PerfObservatory:
         self._tokens[kind] = self._tokens.get(kind, 0) + int(tokens)
         self.device_seconds_total += float(device_s)
         self.tokens_total += int(tokens)
+        ring = self._step_durations.get(kind)
+        if ring is None:
+            ring = self._step_durations[kind] = collections.deque(
+                maxlen=self._step_ring_size)
+        ring.append(float(device_s))
 
     def device_seconds_by_kind(self) -> Dict[str, float]:
         return dict(self._device_seconds)
+
+    def step_time_medians(self) -> Dict[str, float]:
+        """Median recent step duration per kind (seconds). Computed
+        over the bounded ring, so it tracks the *current* regime
+        rather than the lifetime mean the cumulative counters give."""
+        out: Dict[str, float] = {}
+        for kind, ring in self._step_durations.items():
+            if ring:
+                out[kind] = statistics.median(ring)
+        return out
 
     def tokens_by_kind(self) -> Dict[str, int]:
         return dict(self._tokens)

@@ -2157,6 +2157,12 @@ class EngineServer:
                 lines.append(
                     "vllm:engine_step_device_seconds_total{kind=\""
                     f"{kind}\"}} {float(secs)}")
+            lines.append(
+                "# TYPE vllm:engine_step_time_median_seconds gauge")
+            for kind, med in sorted(obs.step_time_medians().items()):
+                lines.append(
+                    "vllm:engine_step_time_median_seconds{kind=\""
+                    f"{kind}\"}} {float(med)}")
             lines.append("# TYPE vllm:engine_mfu gauge")
             lines.append(f"vllm:engine_mfu {float(obs.mfu())}")
             lines.append("# TYPE vllm:engine_attention_impl gauge")

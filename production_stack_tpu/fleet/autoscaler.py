@@ -61,6 +61,9 @@ class PoolSignals:
     waiting: float = -1.0      # summed across replicas
     cache_usage: float = -1.0  # worst replica
     awaiting_kv: float = -1.0  # summed across replicas
+    # Fleet-wide: the router's vllm:slo_burn_rate{window="5m"} gauge
+    # has no server label, so every pool sees the same value.
+    slo_burn_rate: float = -1.0
 
     def _max(self, attr: str, value: float) -> None:
         setattr(self, attr, max(getattr(self, attr), value))
@@ -91,6 +94,15 @@ def signals_from_router_metrics(
     out: Dict[str, PoolSignals] = {
         pool: PoolSignals() for pool in set(url_to_pool.values())}
     for name, labels, value in parse_prometheus_text(text):
+        if name == "vllm:slo_burn_rate":
+            # SLO-ledger burn (docs/observability.md): no server label
+            # — a fleet-wide signal mirrored into every pool. Only the
+            # fast 5m window drives scaling; the 1h window is for
+            # paging, not capacity.
+            if labels.get("window") == "5m" and value >= 0:
+                for signals in out.values():
+                    signals._max("slo_burn_rate", value)
+            continue
         target = _SIGNAL_METRICS.get(name)
         if target is None:
             continue
@@ -135,6 +147,10 @@ class PoolAutoscaler:
             per_replica = signals.awaiting_kv / max(1, current)
             out.append(("awaiting_kv",
                         per_replica / spec.target_awaiting_kv))
+        if spec.target_slo_burn_rate > 0 and signals.slo_burn_rate >= 0:
+            out.append(("slo_burn_rate",
+                        signals.slo_burn_rate
+                        / spec.target_slo_burn_rate))
         return out
 
     def desired(self, current: int,

@@ -47,6 +47,12 @@ class AutoscalerSpec:
     target_waiting_per_replica: float = 0.0
     target_cache_usage: float = 0.0
     target_awaiting_kv: float = 0.0
+    # SLO-ledger burn rate (docs/observability.md): the router's
+    # fleet-wide vllm:slo_burn_rate{window="5m"} gauge as a scaling
+    # hint — burn above target means the error budget is draining
+    # faster than replicas can absorb. Fleet-wide, so it nudges every
+    # pool that enables it.
+    target_slo_burn_rate: float = 0.0
     tolerance: float = 0.1
     scale_up_cooldown_s: float = 15.0
     scale_down_cooldown_s: float = 60.0
@@ -54,8 +60,8 @@ class AutoscalerSpec:
     def __post_init__(self) -> None:
         for knob in ("target_ttft_p99_s", "target_itl_p99_s",
                      "target_waiting_per_replica", "target_cache_usage",
-                     "target_awaiting_kv", "scale_up_cooldown_s",
-                     "scale_down_cooldown_s"):
+                     "target_awaiting_kv", "target_slo_burn_rate",
+                     "scale_up_cooldown_s", "scale_down_cooldown_s"):
             if getattr(self, knob) < 0:
                 raise ValueError(f"autoscaler.{knob} must be >= 0")
         if not 0.0 <= self.tolerance < 1.0:
@@ -71,6 +77,8 @@ class AutoscalerSpec:
                 raw.get("target_waiting_per_replica", 0.0)),
             target_cache_usage=float(raw.get("target_cache_usage", 0.0)),
             target_awaiting_kv=float(raw.get("target_awaiting_kv", 0.0)),
+            target_slo_burn_rate=float(
+                raw.get("target_slo_burn_rate", 0.0)),
             tolerance=float(raw.get("tolerance", 0.1)),
             scale_up_cooldown_s=float(raw.get("scale_up_cooldown_s", 15.0)),
             scale_down_cooldown_s=float(

@@ -1,0 +1,60 @@
+"""Cluster SLO ledger, slow-request exemplars, fleet rollup, drift.
+
+The observability layer's answer to "are we meeting SLO, for whom,
+and which requests are blowing it?" (docs/observability.md):
+
+- :mod:`obs.slo` — declarative :class:`SLOSpec` (``--slo-spec``) and
+  the windowed good/bad :class:`SLOLedger` with SRE multi-window
+  burn rates.
+- :mod:`obs.slow_archive` — bounded ring of SLO-breach exemplars,
+  each holding the stitched router+engine waterfall
+  (``GET /debug/slow``).
+- :mod:`obs.cluster_status` — the ``GET /cluster/status`` fleet
+  rollup that ``python -m production_stack_tpu.stacktop`` renders.
+- :mod:`obs.drift` — the perf-drift sentinel over step-time medians
+  vs a committed baseline (``vllm:perf_drift{phase}``).
+
+The router installs live instances here at startup; the metrics
+service and route handlers read them back. ``None`` means the
+feature is off (no ``--slo-spec`` / ``--perf-baseline``), and every
+consumer guards on it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from production_stack_tpu.obs.drift import DriftSentinel
+from production_stack_tpu.obs.slo import (  # noqa: F401
+    BURN_WINDOWS,
+    SLOLedger,
+    SLOSpec,
+    SLOTarget,
+)
+from production_stack_tpu.obs.slow_archive import SlowArchive
+
+_slo_ledger: Optional[SLOLedger] = None
+_slow_archive: Optional[SlowArchive] = None
+_drift_sentinel: Optional[DriftSentinel] = None
+
+
+def install(ledger: Optional[SLOLedger] = None,
+            archive: Optional[SlowArchive] = None,
+            sentinel: Optional[DriftSentinel] = None) -> None:
+    """Install (or clear, with None) the process-wide instances."""
+    global _slo_ledger, _slow_archive, _drift_sentinel
+    _slo_ledger = ledger
+    _slow_archive = archive
+    _drift_sentinel = sentinel
+
+
+def get_slo_ledger() -> Optional[SLOLedger]:
+    return _slo_ledger
+
+
+def get_slow_archive() -> Optional[SlowArchive]:
+    return _slow_archive
+
+
+def get_drift_sentinel() -> Optional[DriftSentinel]:
+    return _drift_sentinel

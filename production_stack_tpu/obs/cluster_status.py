@@ -1,0 +1,90 @@
+"""Fleet rollup behind the router's ``GET /cluster/status``.
+
+One JSON snapshot of the whole fleet, fed entirely from state the
+router already keeps — the engine-stats scrape loop, service
+discovery, the SLO ledger, the slow archive, and the drift sentinel.
+No new polling: the handler is a pure fold over live singletons, and
+``python -m production_stack_tpu.stacktop`` renders the result.
+
+Inputs are passed in (not imported) so this module stays free of
+router imports — the router imports ``obs``, never the reverse.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Optional
+
+
+def _server_entry(stats, now: float) -> dict:
+    """One per-server block from an EngineStats snapshot (accessed
+    with getattr so older/partial snapshots degrade to defaults)."""
+    g = lambda name, default=0.0: getattr(stats, name, default)  # noqa: E731
+    summary_time = float(g("kv_summary_time"))
+    return {
+        "running": int(g("num_running_requests", 0)),
+        "waiting": int(g("num_queuing_requests", 0)),
+        "cache_usage": round(float(g("kv_usage_perc")), 4),
+        "prefix_hit_rate": round(float(g("kv_cache_hit_rate")), 4),
+        "draining": bool(g("engine_draining")),
+        "kv": {
+            "hot_chains": int(g("kv_summary_hot_chains")),
+            "free_pages": int(g("kv_free_page_headroom")),
+            "total_pages": int(g("kv_total_pages")),
+            "summary_age_s": (round(now - summary_time, 3)
+                              if summary_time > 0 else None),
+        },
+        "qos_shed": {k: int(v) for k, v in
+                     sorted(g("qos_shed_by_class", {}).items())},
+        "compile_events": {k: int(v) for k, v in
+                           sorted(g("compile_events_by_kind",
+                                    {}).items())},
+        "mfu": round(float(g("engine_mfu")), 4),
+        "hbm_bytes": {k: int(v) for k, v in
+                      sorted(g("hbm_bytes_by_category", {}).items())},
+        "step_time_median_s": {
+            k: round(float(v), 6) for k, v in
+            sorted(g("step_time_median_by_kind", {}).items())},
+    }
+
+
+def build_snapshot(engine_stats: Dict[str, object],
+                   endpoints: Iterable[object] = (),
+                   healthy: Optional[Dict[str, bool]] = None,
+                   ledger=None, archive=None, sentinel=None,
+                   now: Optional[float] = None) -> dict:
+    """The ``/cluster/status`` payload.
+
+    ``engine_stats`` maps server URL -> EngineStats; ``endpoints`` are
+    service-discovery EndpointInfo objects (for model/role metadata);
+    ``healthy`` maps URL -> availability from the resilience layer.
+    """
+    now = time.time() if now is None else now
+    meta: Dict[str, dict] = {}
+    for ep in endpoints:
+        names = getattr(ep, "model_names", None) or ()
+        meta[getattr(ep, "url", "")] = {
+            "model": names[0] if names else None,
+            "role": getattr(ep, "role", None),
+        }
+    servers: Dict[str, dict] = {}
+    for url in sorted(set(engine_stats) | set(meta)):
+        entry = _server_entry(
+            engine_stats.get(url), now) if url in engine_stats else {}
+        entry.update(meta.get(url, {}))
+        if healthy is not None:
+            entry["healthy"] = bool(healthy.get(url, True))
+        servers[url] = entry
+    snap: dict = {"ts": now, "servers": servers}
+    if ledger is not None:
+        snap["slo"] = ledger.snapshot()
+    if sentinel is not None:
+        medians = {url: getattr(stats, "step_time_median_by_kind", {})
+                   for url, stats in engine_stats.items()}
+        snap["perf_drift"] = sentinel.evaluate(medians)
+    if archive is not None:
+        snap["slow_archive"] = {"depth": archive.depth(),
+                                "capacity": archive.capacity,
+                                "archived_total":
+                                    archive.archived_total}
+    return snap

@@ -1,0 +1,260 @@
+"""Cluster SLO ledger: declarative targets, attainment, burn rates.
+
+The reference stack's observability stops at raw gauges; this module
+answers the operator's actual question — "are we meeting SLO, for
+whom?" — as first-class state. An :class:`SLOSpec` declares per
+priority-class and per-model latency targets plus an objective
+fraction; the router-side :class:`SLOLedger` classifies every
+completed request as *good* or *bad* against its resolved target and
+keeps a bounded event window from which it derives
+
+- **attainment** per ``(class, model)`` — the good fraction over the
+  trailing hour, exported as ``vllm:slo_attainment{class,model}``;
+- **SRE multi-window burn rates** (5 m / 1 h) — how fast the error
+  budget ``1 - objective`` is being consumed, exported as
+  ``vllm:slo_burn_rate{window}``. A burn rate above 1.0 means the
+  budget empties before the window does; the classic page-worthy
+  signal is both windows burning hot at once.
+
+All window arithmetic takes an injectable ``clock`` (the
+``TokenBucket`` / ``PoolAutoscaler`` idiom) so tests drive it with a
+fake clock. The `slo-contract` staticcheck rule keeps every spec
+field below documented in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+# Burn-rate windows, label value -> seconds (SRE multi-window pattern:
+# the short window catches fast burns, the long one filters blips).
+BURN_WINDOWS: Dict[str, float] = {"5m": 300.0, "1h": 3600.0}
+
+# Attainment is computed over the longest burn window.
+ATTAINMENT_WINDOW_S = 3600.0
+
+
+@dataclasses.dataclass
+class SLOTarget:
+    """Latency targets for one priority class or model; a target of 0
+    disables that metric's check (same convention as the autoscaler
+    knobs)."""
+
+    ttft_s: float = 0.0
+    itl_s: float = 0.0
+    e2e_s: float = 0.0
+    # Objective fraction override for this class/model; 0 inherits the
+    # spec-level objective.
+    objective: float = 0.0
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "SLOTarget":
+        # An *explicit* objective must be a real fraction; only an
+        # absent key means "inherit the spec-level objective".
+        if "objective" in raw and not 0.0 < float(raw["objective"]) < 1.0:
+            raise ValueError(
+                "per-target objective must be in (0, 1), got "
+                f"{raw['objective']}")
+        return cls(
+            ttft_s=float(raw.get("ttft_s", 0.0)),
+            itl_s=float(raw.get("itl_s", 0.0)),
+            e2e_s=float(raw.get("e2e_s", 0.0)),
+            objective=float(raw.get("objective", 0.0)),
+        )
+
+    def merged_over(self, base: "SLOTarget") -> "SLOTarget":
+        """Field-wise override: nonzero fields of ``self`` win over
+        ``base`` (model overrides layered on the class target)."""
+        return SLOTarget(
+            ttft_s=self.ttft_s or base.ttft_s,
+            itl_s=self.itl_s or base.itl_s,
+            e2e_s=self.e2e_s or base.e2e_s,
+            objective=self.objective or base.objective,
+        )
+
+
+@dataclasses.dataclass
+class SLOSpec:
+    """Declarative SLO spec, loaded from JSON via the router's
+    ``--slo-spec`` flag. ``classes`` maps priority-class names
+    (docs/qos.md) to targets; ``models`` maps model names to
+    field-wise overrides layered on top of the class target."""
+
+    objective: float = 0.99
+    classes: Dict[str, SLOTarget] = dataclasses.field(
+        default_factory=dict)
+    models: Dict[str, SLOTarget] = dataclasses.field(
+        default_factory=dict)
+
+    def __post_init__(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"objective must be in (0, 1), got {self.objective}")
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "SLOSpec":
+        return cls(
+            objective=float(raw.get("objective", 0.99)),
+            classes={str(k): SLOTarget.from_dict(v or {})
+                     for k, v in (raw.get("classes") or {}).items()},
+            models={str(k): SLOTarget.from_dict(v or {})
+                    for k, v in (raw.get("models") or {}).items()},
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "SLOSpec":
+        with open(path) as fh:
+            return cls.from_dict(json.load(fh))
+
+    def resolve(self, priority_class: str,
+                model: str) -> Tuple[SLOTarget, float]:
+        """Effective (target, objective) for one request: the class
+        target with any model override layered on, objective falling
+        back to the spec default."""
+        target = self.classes.get(priority_class, SLOTarget())
+        override = self.models.get(model)
+        if override is not None:
+            target = override.merged_over(target)
+        objective = target.objective or self.objective
+        return target, objective
+
+
+# One classified completion: (ts, class, model, server, good, budget)
+# where budget is the request's allowed bad fraction (1 - objective).
+_Event = Tuple[float, str, str, str, bool, float]
+
+
+class SLOLedger:
+    """Windowed good/bad classification per (class, model, server).
+
+    Bounded: events older than the longest burn window are pruned on
+    every observe, and the deque itself is capped as a backstop.
+    """
+
+    def __init__(self, spec: SLOSpec,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_events: int = 65536):
+        self.spec = spec
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._events: Deque[_Event] = collections.deque(
+            maxlen=max_events)
+        self.good_total: Dict[Tuple[str, str], int] = {}
+        self.bad_total: Dict[Tuple[str, str], int] = {}
+
+    # ---- classification --------------------------------------------------
+
+    def observe(self, priority_class: str, model: str, server: str,
+                ttft_s: Optional[float] = None,
+                itl_s: Optional[float] = None,
+                e2e_s: Optional[float] = None,
+                now: Optional[float] = None) -> List[dict]:
+        """Classify one completed request. Returns the breach list —
+        empty when the request met its SLO — of
+        ``{"metric", "value_s", "target_s"}`` dicts, which the caller
+        uses to trigger slow-archive exemplar capture."""
+        target, objective = self.spec.resolve(priority_class, model)
+        breaches: List[dict] = []
+        for metric, value, limit in (
+                ("ttft", ttft_s, target.ttft_s),
+                ("itl", itl_s, target.itl_s),
+                ("e2e", e2e_s, target.e2e_s)):
+            if limit > 0 and value is not None and value > limit:
+                breaches.append({"metric": metric,
+                                 "value_s": value,
+                                 "target_s": limit})
+        good = not breaches
+        ts = self._clock() if now is None else now
+        key = (priority_class, model)
+        with self._lock:
+            self._events.append(
+                (ts, priority_class, model, server, good,
+                 1.0 - objective))
+            counts = self.good_total if good else self.bad_total
+            counts[key] = counts.get(key, 0) + 1
+            self._prune(ts)
+        return breaches
+
+    def _prune(self, now: float) -> None:
+        horizon = now - max(BURN_WINDOWS.values())
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    # ---- windowed views --------------------------------------------------
+
+    def attainments(self, now: Optional[float] = None,
+                    server: Optional[str] = None,
+                    ) -> Dict[Tuple[str, str], float]:
+        """Good fraction per (class, model) over the attainment
+        window, optionally filtered to one server."""
+        now = self._clock() if now is None else now
+        horizon = now - ATTAINMENT_WINDOW_S
+        good: Dict[Tuple[str, str], int] = {}
+        total: Dict[Tuple[str, str], int] = {}
+        with self._lock:
+            for ts, cls, model, srv, ok, _budget in self._events:
+                if ts < horizon:
+                    continue
+                if server is not None and srv != server:
+                    continue
+                key = (cls, model)
+                total[key] = total.get(key, 0) + 1
+                if ok:
+                    good[key] = good.get(key, 0) + 1
+        return {key: good.get(key, 0) / n
+                for key, n in total.items() if n}
+
+    def burn_rates(self, now: Optional[float] = None,
+                   ) -> Dict[str, float]:
+        """Error-budget burn per window: bad fraction divided by the
+        traffic-weighted budget (mean per-request ``1 - objective``).
+        0.0 with no traffic in the window; 1.0 means the budget
+        empties exactly when the window does."""
+        now = self._clock() if now is None else now
+        out: Dict[str, float] = {}
+        with self._lock:
+            events = list(self._events)
+        for label, width in BURN_WINDOWS.items():
+            horizon = now - width
+            n = bad = 0
+            budget_sum = 0.0
+            for ts, _cls, _model, _srv, ok, budget in events:
+                if ts < horizon:
+                    continue
+                n += 1
+                budget_sum += budget
+                if not ok:
+                    bad += 1
+            if n == 0 or budget_sum <= 0:
+                out[label] = 0.0
+            else:
+                out[label] = (bad / n) / (budget_sum / n)
+        return out
+
+    # ---- snapshots -------------------------------------------------------
+
+    def totals(self) -> Dict[str, Dict[Tuple[str, str], int]]:
+        with self._lock:
+            return {"good": dict(self.good_total),
+                    "bad": dict(self.bad_total)}
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """JSON-ready rollup for ``GET /cluster/status``."""
+        now = self._clock() if now is None else now
+        totals = self.totals()
+        return {
+            "objective": self.spec.objective,
+            "attainment": {
+                f"{cls}|{model}": round(frac, 6)
+                for (cls, model), frac
+                in sorted(self.attainments(now).items())},
+            "burn_rate": {k: round(v, 6)
+                          for k, v in self.burn_rates(now).items()},
+            "good_requests": sum(totals["good"].values()),
+            "bad_requests": sum(totals["bad"].values()),
+        }

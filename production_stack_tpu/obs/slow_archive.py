@@ -1,0 +1,61 @@
+"""Slow-request exemplar archive: metric -> trace with zero effort.
+
+When a request breaches its SLO (obs/slo.py), the router pulls that
+request's engine flight-recorder timeline (``/debug/trace/{id}``,
+docs/observability.md) and archives the stitched router+engine
+waterfall here. ``GET /debug/slow?class=&model=&limit=`` serves the
+ring, newest first, so every p99 outlier on the dashboard links
+straight to its per-request timeline; ``traceview
+--from-slow-archive`` renders the same payload offline.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, List, Optional
+
+
+class SlowArchive:
+    """Bounded ring of breach exemplars.
+
+    An entry is a plain dict:
+    ``{"request_id", "class", "model", "server", "ts", "breach":
+    [{"metric", "value_s", "target_s"}], "spans": [router span dict,
+    *engine span dicts], "waterfall": str}`` — ``spans`` is the
+    stitched timeline, ``waterfall`` its rendered text.
+    """
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._ring: Deque[dict] = collections.deque(
+            maxlen=self.capacity)
+        self.archived_total = 0
+
+    def add(self, entry: dict) -> None:
+        entry.setdefault("ts", time.time())
+        with self._lock:
+            self._ring.append(entry)
+            self.archived_total += 1
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def snapshot(self, priority_class: Optional[str] = None,
+                 model: Optional[str] = None,
+                 limit: int = 50) -> List[dict]:
+        """Newest-first view, optionally filtered by class/model."""
+        with self._lock:
+            entries = list(self._ring)
+        entries.reverse()
+        if priority_class:
+            entries = [e for e in entries
+                       if e.get("class") == priority_class]
+        if model:
+            entries = [e for e in entries if e.get("model") == model]
+        if limit >= 0:
+            entries = entries[:limit]
+        return entries

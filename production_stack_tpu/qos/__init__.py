@@ -149,6 +149,23 @@ def priority_name(priority: "Priority | int") -> str:
     return Priority(int(priority)).name.lower()
 
 
+def classify_request(headers, remote: Optional[str] = None
+                     ) -> "tuple[str, str]":
+    """(priority-class name, tenant) for one request, never raising:
+    a malformed ``x-priority`` falls back to the deployment default
+    and the tenant falls back to the client address. This is the
+    labeling helper the router uses even when its QoS fairness layer
+    is off, so spans, request stats, and the SLO ledger always carry
+    class/tenant attribution (docs/observability.md)."""
+    raw = headers.get(PRIORITY_HEADER)
+    try:
+        pri = parse_priority(raw) if raw else DEFAULT_PRIORITY
+    except ValueError:
+        pri = DEFAULT_PRIORITY
+    tenant = headers.get(TENANT_HEADER) or remote or "unknown"
+    return priority_name(pri), str(tenant)
+
+
 def shed_counter_dict() -> Dict[str, int]:
     """Zeroed per-class shed counter (stable label set for metrics)."""
     return {name: 0 for name in PRIORITY_NAMES}

@@ -175,6 +175,58 @@ async def metrics(request: web.Request) -> web.Response:
     return web.Response(body=payload, content_type=content_type.split(";")[0])
 
 
+async def cluster_status(request: web.Request) -> web.Response:
+    """Single-JSON fleet rollup (docs/observability.md): the engine
+    stats scrape loop, SLO ledger, drift sentinel and slow-archive
+    counters folded into one snapshot. ``python -m
+    production_stack_tpu.stacktop`` renders this."""
+    from production_stack_tpu import obs
+    from production_stack_tpu.obs.cluster_status import build_snapshot
+    try:
+        endpoints = get_service_discovery().get_endpoint_info(
+            include_unhealthy=True)
+    except ValueError:
+        endpoints = []
+    try:
+        engine_stats = get_engine_stats_scraper().get_engine_stats()
+    except ValueError:
+        engine_stats = {}
+    mgr = get_resilience()
+    healthy = {ep.url: (mgr is None or mgr.endpoint_available(ep.url))
+               for ep in endpoints}
+    return web.json_response(build_snapshot(
+        engine_stats, endpoints=endpoints, healthy=healthy,
+        ledger=obs.get_slo_ledger(), archive=obs.get_slow_archive(),
+        sentinel=obs.get_drift_sentinel()))
+
+
+async def debug_slow(request: web.Request) -> web.Response:
+    """Slow-request exemplar ring:
+    ``GET /debug/slow?class=&model=&limit=`` (docs/observability.md)."""
+    from production_stack_tpu import obs
+    archive = obs.get_slow_archive()
+    if archive is None:
+        return web.json_response(
+            {"error": {"message": "slow archive not initialized"}},
+            status=503)
+    try:
+        limit = int(request.query.get("limit", 50))
+    except ValueError:
+        return web.json_response(
+            {"error": {"message": "limit must be an integer"}},
+            status=400)
+    entries = archive.snapshot(
+        priority_class=request.query.get("class") or None,
+        model=request.query.get("model") or None,
+        limit=limit)
+    return web.json_response({
+        "entries": entries,
+        "depth": archive.depth(),
+        "capacity": archive.capacity,
+        "archived_total": archive.archived_total,
+    })
+
+
 # ---- files API -------------------------------------------------------------
 
 def _user_id(request: web.Request) -> str:
@@ -357,6 +409,24 @@ def initialize_all(app: web.Application, args) -> None:
         initialize_span_logger,
     )
     initialize_span_logger(getattr(args, "request_span_log", None))
+    # SLO ledger + slow-request archive + drift sentinel (obs/,
+    # docs/observability.md). install() overwrites any previous
+    # instances, so repeated initialize_all calls in test rigs reset
+    # cleanly.
+    from production_stack_tpu import obs
+    # The slow archive only fills on SLO breaches, so it rides the
+    # ledger: without --slo-spec, GET /debug/slow honestly 503s
+    # instead of serving a forever-empty ring.
+    has_slo = bool(getattr(args, "slo_spec", None))
+    obs.install(
+        ledger=(obs.SLOLedger(obs.SLOSpec.load(args.slo_spec))
+                if has_slo else None),
+        archive=(obs.SlowArchive(
+            getattr(args, "slow_archive_size", 64) or 64)
+            if has_slo else None),
+        sentinel=(obs.DriftSentinel.load(args.perf_baseline)
+                  if getattr(args, "perf_baseline", None) else None),
+    )
 
     app["file_storage"] = initialize_storage(
         args.file_storage_class, args.file_storage_path
@@ -413,6 +483,8 @@ def build_app(args=None) -> web.Application:
     app.router.add_get("/health", health)
     app.router.add_get("/version", version)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/cluster/status", cluster_status)
+    app.router.add_get("/debug/slow", debug_slow)
 
     app.router.add_post("/v1/files", upload_file)
     app.router.add_get("/v1/files", list_files)

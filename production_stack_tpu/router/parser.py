@@ -164,6 +164,27 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="Emit one JSON span per request to this file "
              "('-' = router log); disabled when unset",
     )
+
+    # Cluster SLO ledger + slow-request archive + drift sentinel
+    # (production_stack_tpu/obs/; docs/observability.md).
+    parser.add_argument(
+        "--slo-spec", default=None,
+        help="Path to an SLO spec JSON (per-class / per-model TTFT, "
+             "ITL and e2e targets plus objective fraction); enables "
+             "the SLO ledger, burn-rate gauges and slow-request "
+             "exemplar capture",
+    )
+    parser.add_argument(
+        "--perf-baseline", default=None,
+        help="Path to a committed per-phase step-time baseline JSON "
+             "(observability/perf_baseline.json); enables the drift "
+             "sentinel and the vllm:perf_drift gauge",
+    )
+    parser.add_argument(
+        "--slow-archive-size", type=int, default=64,
+        help="Ring capacity of the slow-request exemplar archive "
+             "served at GET /debug/slow",
+    )
     parser.add_argument(
         "--log-level", default="info",
         choices=["debug", "info", "warning", "error", "critical"],
@@ -221,3 +242,5 @@ def validate_args(args: argparse.Namespace) -> None:
             raise ValueError("--qos-shed-deficit must be > 0")
     if args.qos_max_concurrency < 0:
         raise ValueError("--qos-max-concurrency must be >= 0")
+    if args.slow_archive_size < 1:
+        raise ValueError("--slow-archive-size must be >= 1")

@@ -340,6 +340,51 @@ fleet_poison_quarantines = Gauge(
     "Requests quarantined after crashing multiple engines "
     "(router-wide)", [])
 
+# -- cluster SLO ledger + drift sentinel (production_stack_tpu/obs/) --------
+slo_attainment = Gauge(
+    "vllm:slo_attainment",
+    "Good fraction per (class, model) over the attainment window "
+    "against the --slo-spec targets (docs/observability.md)",
+    ["class", "model"])
+slo_burn_rate = Gauge(
+    "vllm:slo_burn_rate",
+    "Error-budget burn rate per SRE window; above 1.0 the budget "
+    "empties before the window does", ["window"])
+slo_good_requests = Gauge(
+    "vllm:slo_good_requests_total",
+    "Requests that met their resolved SLO target, per (class, model)",
+    ["class", "model"])
+slo_bad_requests = Gauge(
+    "vllm:slo_bad_requests_total",
+    "Requests that breached their resolved SLO target, per "
+    "(class, model)", ["class", "model"])
+slow_archive_depth = Gauge(
+    "vllm:slow_archive_depth",
+    "Slow-request exemplars currently held in the GET /debug/slow "
+    "ring", [])
+perf_drift = Gauge(
+    "vllm:perf_drift",
+    "1 while any server's step-time median sits outside the "
+    "--perf-baseline band for this phase", ["phase"])
+engine_step_time_median = Gauge(
+    "vllm:engine_step_time_median_seconds",
+    "Engine-reported median device step time per kind over the "
+    "observatory's recent-step ring (scraped)", ["server", "kind"])
+
+
+def _set_or_clear(gauge, server: str, value: float) -> None:
+    """-1 is RequestStats' "no observation yet" sentinel. Rendering it
+    would leak an impossible negative latency into Prometheus on idle
+    servers (poisoning p99 alert rules), so the stale label child is
+    removed from the exposition instead."""
+    if value >= 0:
+        gauge.labels(server=server).set(value)
+        return
+    try:
+        gauge.remove(server)
+    except KeyError:
+        pass
+
 
 def refresh_gauges() -> None:
     """Pull the latest snapshots into the gauge registry."""
@@ -362,8 +407,8 @@ def refresh_gauges() -> None:
             stat.in_prefill_requests + stat.in_decoding_requests)
         avg_latency.labels(server=server).set(stat.avg_latency)
         avg_itl.labels(server=server).set(stat.avg_itl)
-        ttft_p99.labels(server=server).set(stat.ttft_p99)
-        itl_p99.labels(server=server).set(stat.itl_p99)
+        _set_or_clear(ttft_p99, server, stat.ttft_p99)
+        _set_or_clear(itl_p99, server, stat.itl_p99)
         num_requests_swapped.labels(server=server).set(
             stat.num_swapped_requests)
         allocated_blocks.labels(server=server).set(stat.allocated_blocks)
@@ -487,6 +532,9 @@ def refresh_gauges() -> None:
         for kind, value in es.step_device_seconds_by_kind.items():
             engine_step_device_seconds.labels(
                 server=server, kind=kind).set(value)
+        for kind, value in es.step_time_median_by_kind.items():
+            engine_step_time_median.labels(
+                server=server, kind=kind).set(value)
         engine_mfu.labels(server=server).set(es.engine_mfu)
         for phase, impl in es.attention_impl_by_phase.items():
             engine_attention_impl.labels(
@@ -558,6 +606,30 @@ def refresh_gauges() -> None:
         tenant_throttled.set(rqos.tenant_throttled_total)
         for cls, value in rqos.shed_by_class.items():
             router_qos_shed.labels(**{"class": cls}).set(value)
+    from production_stack_tpu import obs
+    ledger = obs.get_slo_ledger()
+    if ledger is not None:
+        for (cls, mdl), frac in ledger.attainments().items():
+            slo_attainment.labels(
+                **{"class": cls, "model": mdl}).set(frac)
+        for window, rate in ledger.burn_rates().items():
+            slo_burn_rate.labels(window=window).set(rate)
+        totals = ledger.totals()
+        for (cls, mdl), n in totals["good"].items():
+            slo_good_requests.labels(
+                **{"class": cls, "model": mdl}).set(n)
+        for (cls, mdl), n in totals["bad"].items():
+            slo_bad_requests.labels(
+                **{"class": cls, "model": mdl}).set(n)
+    archive = obs.get_slow_archive()
+    if archive is not None:
+        slow_archive_depth.set(archive.depth())
+    sentinel = obs.get_drift_sentinel()
+    if sentinel is not None:
+        medians = {server: es.step_time_median_by_kind
+                   for server, es in engine_stats.items()}
+        for phase, flag in sentinel.flags(medians).items():
+            perf_drift.labels(phase=phase).set(flag)
 
 
 def render_exposition() -> tuple[bytes, str]:

@@ -36,6 +36,7 @@ from production_stack_tpu.kvecon.summary import (
     routable_text as kvecon_routable_text,
 )
 from production_stack_tpu.qos import (
+    classify_request,
     DEFAULT_PRIORITY,
     parse_priority,
     PRIORITY_HEADER,
@@ -270,6 +271,88 @@ def _finish_span(span, status: str) -> None:
         sink.emit(span)
 
 
+def _observe_slo(app: web.Application, slo_ctx: Optional[dict],
+                 server_url: str, request_id: str, span,
+                 first_chunk_ts: Optional[float], n_chunks: int,
+                 end_ts: float) -> None:
+    """Classify one completed request against the SLO ledger
+    (docs/observability.md). A breach schedules exemplar capture: the
+    engine's flight-recorder timeline is pulled and the stitched
+    router+engine waterfall archived, so the request that moved the
+    burn-rate gauge is retrievable at GET /debug/slow."""
+    from production_stack_tpu import obs
+    ledger = obs.get_slo_ledger()
+    if ledger is None or slo_ctx is None:
+        return
+    arrival = slo_ctx["arrival"]
+    ttft = (first_chunk_ts - arrival
+            if first_chunk_ts is not None else None)
+    itl = ((end_ts - first_chunk_ts) / (n_chunks - 1)
+           if first_chunk_ts is not None and n_chunks > 1 else None)
+    breaches = ledger.observe(
+        slo_ctx["class"], slo_ctx["model"], server_url,
+        ttft_s=ttft, itl_s=itl, e2e_s=end_ts - arrival)
+    if not breaches or obs.get_slow_archive() is None:
+        return
+    if span is not None:
+        router_span = json.loads(span.to_json())
+    else:
+        # Span logging off: synthesize the router span from the
+        # timings at hand so the archived waterfall still renders.
+        def ms(t):
+            return (None if t is None
+                    else round((t - arrival) * 1e3, 2))
+        router_span = {
+            "span": "request", "request_id": request_id,
+            "model": slo_ctx["model"],
+            "path": None,
+            "priority_class": slo_ctx["class"],
+            "tenant": slo_ctx["tenant"],
+            "backend": server_url,
+            "arrival_ts": round(arrival, 6),
+            "queue_delay_ms": None,
+            "ttft_ms": ms(first_chunk_ts),
+            "latency_ms": ms(end_ts),
+            "chunks": n_chunks, "status": "ok",
+        }
+    entry = {"request_id": request_id, "class": slo_ctx["class"],
+             "model": slo_ctx["model"], "server": server_url,
+             "breach": breaches}
+    asyncio.create_task(_capture_slow_exemplar(
+        app, server_url, request_id, router_span, entry))
+
+
+async def _capture_slow_exemplar(app: web.Application, server_url: str,
+                                 request_id: str, router_span: dict,
+                                 entry: dict) -> None:
+    """Best-effort: fetch the engine flight-recorder timeline for one
+    breaching request and archive the stitched waterfall. Never raises
+    — the ledger already counted the breach; the exemplar is gravy."""
+    from production_stack_tpu import obs
+    from production_stack_tpu.traceview import render_waterfall
+    archive = obs.get_slow_archive()
+    if archive is None:
+        return
+    engine_spans: list = []
+    try:
+        session = _client_session(app)
+        async with session.get(
+            f"{server_url}/debug/trace/{request_id}",
+            timeout=aiohttp.ClientTimeout(total=5),
+        ) as resp:
+            if resp.status == 200:
+                payload = await resp.json()
+                engine_spans = [s for s in payload.get("spans", [])
+                                if isinstance(s, dict)]
+    except Exception as e:
+        logger.debug("Slow-exemplar trace fetch from %s for %s "
+                     "failed: %s", server_url, request_id, e)
+    spans = [router_span] + engine_spans
+    entry["spans"] = spans
+    entry["waterfall"] = render_waterfall(spans, request_id)
+    archive.add(entry)
+
+
 def _disagg_eligible(payload: dict) -> bool:
     """Conservative gate for the two-hop disagg path: only plain
     single-choice generate requests. Anything exotic (multi-choice,
@@ -307,6 +390,14 @@ async def route_general_request(request: web.Request,
     model = payload.get("model")
     if not model:
         return _error(400, "Request body must contain a 'model' field")
+
+    # Observability attribution (docs/observability.md): priority class
+    # and tenant are stamped on every request — span, request stats and
+    # SLO ledger — whether or not the QoS fairness layer is on.
+    priority_class, tenant = classify_request(request.headers,
+                                              request.remote)
+    slo_ctx = {"class": priority_class, "tenant": tenant,
+               "model": model, "arrival": in_router_time}
 
     # Router QoS (docs/qos.md): tenant identification, per-tenant rate
     # limiting, and the degradation ladder — applied before any backend
@@ -376,10 +467,13 @@ async def route_general_request(request: web.Request,
 
     mgr = get_resilience()
     monitor = get_request_stats_monitor()
-    monitor.on_request_arrival(request_id, in_router_time)
+    monitor.on_request_arrival(request_id, in_router_time,
+                               priority_class=priority_class,
+                               tenant=tenant)
 
     from production_stack_tpu.router.tracing import start_span
-    span = start_span(request_id, model, endpoint_path)
+    span = start_span(request_id, model, endpoint_path,
+                      priority_class=priority_class, tenant=tenant)
 
     num_prefill_tokens = _estimate_prefill_tokens(request, body)
     policy = get_routing_logic()
@@ -399,7 +493,7 @@ async def route_general_request(request: web.Request,
             response = await _route_disagg(
                 request, body, payload, request_id,
                 prefill_pool, decode_pool, num_prefill_tokens,
-                span=span, mgr=mgr,
+                span=span, mgr=mgr, slo_ctx=slo_ctx,
             )
             if response is not None:
                 return response
@@ -463,7 +557,7 @@ async def route_general_request(request: web.Request,
                 response = await _proxy_stream(
                     request, server_url, endpoint_path, body, request_id,
                     policy, store_callback, span=span, mgr=mgr,
-                    extra_headers=qos_headers,
+                    extra_headers=qos_headers, slo_ctx=slo_ctx,
                 )
             except RetryableUpstreamError as e:
                 last_error = e
@@ -556,7 +650,8 @@ async def route_general_request(request: web.Request,
 async def _route_disagg(request: web.Request, body: bytes, payload: dict,
                         request_id: str, prefill_pool, decode_pool,
                         num_prefill_tokens: int, span=None,
-                        mgr=None) -> Optional[web.StreamResponse]:
+                        mgr=None,
+                        slo_ctx=None) -> Optional[web.StreamResponse]:
     """Two-hop disaggregated dispatch (docs/disaggregation.md).
 
     Hop 1 POSTs the original body to a prefill-role engine's
@@ -674,7 +769,7 @@ async def _route_disagg(request: web.Request, body: bytes, payload: dict,
             response = await _proxy_stream(
                 request, server_url, "/v1/disagg/handoff", handoff_body,
                 request_id, policy, span=span, mgr=mgr,
-                reject_statuses=(409,),
+                reject_statuses=(409,), slo_ctx=slo_ctx,
             )
         except RetryableUpstreamError as e:
             tried.add(server_url)
@@ -924,7 +1019,8 @@ async def _proxy_stream(request: web.Request, server_url: str,
                         policy, store_callback=None,
                         span=None, mgr=None,
                         reject_statuses: tuple = (),
-                        extra_headers: Optional[dict] = None
+                        extra_headers: Optional[dict] = None,
+                        slo_ctx: Optional[dict] = None
                         ) -> web.StreamResponse:
     """One proxy attempt. Raises ``RetryableUpstreamError`` when the
     backend failed before anything was streamed to the client; once the
@@ -1001,6 +1097,8 @@ async def _proxy_stream(request: web.Request, server_url: str,
                     f"{type(e).__name__}: {e}") from e
             prepared = True
             first_chunk = True
+            first_chunk_ts: Optional[float] = None
+            n_chunks = 0
             cache_buffer = bytearray() if store_callback else None
             # SSE streams go through the relay: whole events only,
             # checkpoint frames captured for mid-stream failover
@@ -1025,11 +1123,15 @@ async def _proxy_stream(request: web.Request, server_url: str,
                     chunk = relay.feed(chunk)
                 if not chunk:
                     continue
+                chunk_ts = time.time()
                 monitor.on_request_response(
-                    server_url, request_id, time.time(),
+                    server_url, request_id, chunk_ts,
                     is_first_token=first_chunk,
                 )
+                if first_chunk:
+                    first_chunk_ts = chunk_ts
                 first_chunk = False
+                n_chunks += 1
                 if span is not None:
                     span.on_chunk()
                 if (cache_buffer is not None
@@ -1041,7 +1143,8 @@ async def _proxy_stream(request: web.Request, server_url: str,
                 # flush the remainder so no bytes are lost.
                 await response.write(bytes(relay.buf))
                 relay.buf.clear()
-            monitor.on_request_complete(server_url, request_id, time.time())
+            end_ts = time.time()
+            monitor.on_request_complete(server_url, request_id, end_ts)
             completed = True
             await response.write_eof()
             blame = False
@@ -1049,6 +1152,8 @@ async def _proxy_stream(request: web.Request, server_url: str,
                     and len(cache_buffer) < _CACHE_STORE_MAX_BYTES):
                 store_callback(bytes(cache_buffer))
             _finish_span(span, "ok")
+            _observe_slo(request.app, slo_ctx, server_url, request_id,
+                         span, first_chunk_ts, n_chunks, end_ts)
             return response
     except RetryableUpstreamError as e:
         # A 429 is a healthy engine answering fast that it is full —

@@ -213,6 +213,11 @@ class EngineStats:
         default_factory=dict)
     step_device_seconds_by_kind: Dict[str, float] = field(
         default_factory=dict)
+    # Median recent step duration per kind
+    # (vllm:engine_step_time_median_seconds{kind}) — the drift
+    # sentinel's input (obs/drift.py, docs/observability.md).
+    step_time_median_by_kind: Dict[str, float] = field(
+        default_factory=dict)
     engine_mfu: float = 0.0
     attention_impl_by_phase: Dict[str, str] = field(
         default_factory=dict)
@@ -274,6 +279,11 @@ class EngineStats:
                 if (sample.name
                         == "vllm:engine_step_device_seconds_total"):
                     stats.step_device_seconds_by_kind[
+                        sample.labels.get("kind", "")] = sample.value
+                    continue
+                if (sample.name
+                        == "vllm:engine_step_time_median_seconds"):
+                    stats.step_time_median_by_kind[
                         sample.labels.get("kind", "")] = sample.value
                     continue
                 if (sample.name == "vllm:engine_attention_impl"

@@ -132,6 +132,12 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
         self._itl: Dict[str, SlidingWindow] = {}
 
         self._arrival_time: Dict[str, float] = {}
+        # QoS attribution (docs/observability.md): priority class and
+        # tenant per in-flight request, plus running per-class arrival
+        # counts — the labels the SLO ledger and spans carry.
+        self._req_class: Dict[str, str] = {}
+        self._req_tenant: Dict[str, str] = {}
+        self.arrivals_by_class: Dict[str, int] = {}
         self._first_token_time: Dict[Tuple[str, str], float] = {}
         self._in_prefill: Dict[str, Set[str]] = {}
         self._in_decode: Dict[str, Set[str]] = {}
@@ -146,11 +152,27 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
 
     # ---- lifecycle events -------------------------------------------------
 
-    def on_request_arrival(self, request_id: str, timestamp: float) -> None:
+    def on_request_arrival(self, request_id: str, timestamp: float,
+                           priority_class: Optional[str] = None,
+                           tenant: Optional[str] = None) -> None:
         with self._lock:
             self._arrival_time[request_id] = timestamp
+            if priority_class is not None:
+                self._req_class[request_id] = priority_class
+                self.arrivals_by_class[priority_class] = (
+                    self.arrivals_by_class.get(priority_class, 0) + 1)
+            if tenant is not None:
+                self._req_tenant[request_id] = tenant
             if self._first_query_time is None:
                 self._first_query_time = timestamp
+
+    def request_attribution(self, request_id: str
+                            ) -> Tuple[Optional[str], Optional[str]]:
+        """(priority class, tenant) recorded at arrival, while the
+        request is still in flight."""
+        with self._lock:
+            return (self._req_class.get(request_id),
+                    self._req_tenant.get(request_id))
 
     def on_request_routed(self, engine_url: str, request_id: str,
                           prefill_tokens: int,
@@ -237,6 +259,8 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
 
     def _cleanup_locked(self, engine_url: str, request_id: str) -> None:
         self._arrival_time.pop(request_id, None)
+        self._req_class.pop(request_id, None)
+        self._req_tenant.pop(request_id, None)
         self._first_token_time.pop((engine_url, request_id), None)
         if engine_url in self._in_prefill:
             self._in_prefill[engine_url].discard(request_id)

@@ -35,6 +35,12 @@ class RequestSpan:
     request_id: str
     model: str
     path: str
+    # QoS attribution (docs/qos.md): the request's priority class and
+    # tenant identity, so SLO attainment per class is derivable from
+    # span logs alone. Always set by the router (class defaults to
+    # the deployment default when the x-priority header is absent).
+    priority_class: Optional[str] = None
+    tenant: Optional[str] = None
     arrival_ts: float = field(default_factory=time.time)
     backend: Optional[str] = None
     routed_ts: Optional[float] = None
@@ -95,6 +101,8 @@ class RequestSpan:
             "request_id": self.request_id,
             "model": self.model,
             "path": self.path,
+            "priority_class": self.priority_class,
+            "tenant": self.tenant,
             "backend": self.backend,
             "arrival_ts": round(self.arrival_ts, 6),
             "queue_delay_ms": ms(self.arrival_ts, self.routed_ts),
@@ -146,9 +154,11 @@ def get_span_logger() -> Optional[SpanLogger]:
     return _span_logger
 
 
-def start_span(request_id: str, model: str,
-               path: str) -> Optional[RequestSpan]:
+def start_span(request_id: str, model: str, path: str,
+               priority_class: Optional[str] = None,
+               tenant: Optional[str] = None) -> Optional[RequestSpan]:
     """None when span logging is disabled — the hot path stays free."""
     if _span_logger is None:
         return None
-    return RequestSpan(request_id=request_id, model=model, path=path)
+    return RequestSpan(request_id=request_id, model=model, path=path,
+                       priority_class=priority_class, tenant=tenant)

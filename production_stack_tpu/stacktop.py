@@ -1,0 +1,168 @@
+"""stacktop: a live terminal console over ``GET /cluster/status``.
+
+``top`` for the serving stack: one screen answering "how is the
+fleet, are we meeting SLO, and is anything drifting" — per-server
+health/load/KV/QoS/compile columns, the SLO attainment and burn-rate
+block, the perf-drift sentinel verdicts, and the slow-archive depth.
+
+Run::
+
+    python -m production_stack_tpu.stacktop --url http://router:8080
+
+Polls the router and redraws on an interval, marking rows whose load
+changed since the previous poll. ``--once`` renders a single
+snapshot and exits; ``--plain`` suppresses ANSI control sequences
+(the mode tests golden-match against). Rendering is a pure function
+of the snapshot, so the same code path serves both the live console
+and the tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+import requests
+
+
+def _fmt(value, width: int) -> str:
+    return f"{value:>{width}}" if value is not None else " " * width
+
+
+def render_snapshot(snap: dict, changed: Optional[set] = None) -> str:
+    """Plain-text render of one /cluster/status payload. ``changed``
+    marks server URLs whose load moved since the previous poll."""
+    changed = changed or set()
+    lines: List[str] = []
+    ts = snap.get("ts")
+    stamp = (time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(ts))
+             if isinstance(ts, (int, float)) else "-")
+    lines.append(f"tpu-stack cluster status @ {stamp}")
+
+    slo = snap.get("slo")
+    if slo:
+        burn = slo.get("burn_rate", {})
+        lines.append(
+            f"SLO objective={slo.get('objective')} "
+            f"burn 5m={burn.get('5m', 0.0):.2f} "
+            f"1h={burn.get('1h', 0.0):.2f} "
+            f"good={slo.get('good_requests', 0)} "
+            f"bad={slo.get('bad_requests', 0)}")
+        for key, frac in sorted(
+                (slo.get("attainment") or {}).items()):
+            lines.append(f"  attainment {key} = {frac:.4f}")
+
+    drift = snap.get("perf_drift")
+    if drift:
+        parts = []
+        for phase, info in sorted(drift.items()):
+            verdict = "TRIPPED" if info.get("tripped") else "ok"
+            observed = info.get("observed_s")
+            obs_txt = (f"{observed:.4f}s"
+                       if isinstance(observed, (int, float)) else "-")
+            parts.append(f"{phase}: {verdict} "
+                         f"({obs_txt} vs {info.get('baseline_s')}s)")
+        lines.append("drift " + "  ".join(parts))
+
+    arch = snap.get("slow_archive")
+    if arch:
+        lines.append(
+            f"slow archive: {arch.get('depth', 0)}"
+            f"/{arch.get('capacity', 0)} "
+            f"({arch.get('archived_total', 0)} archived)")
+
+    servers = snap.get("servers") or {}
+    if servers:
+        lines.append("")
+        lines.append(
+            f"{'SERVER':<42} {'HEALTH':<7} {'ROLE':<7} "
+            f"{'RUN':>4} {'WAIT':>4} {'CACHE':>6} {'HIT':>6} "
+            f"{'MFU':>6} {'SHED':>5} {'COMPILES':>8}")
+        for url in sorted(servers):
+            s = servers[url]
+            health = "drain" if s.get("draining") else (
+                "ok" if s.get("healthy", True) else "DOWN")
+            shed = sum((s.get("qos_shed") or {}).values())
+            compiles = sum((s.get("compile_events") or {}).values())
+            mark = "*" if url in changed else " "
+            lines.append(
+                f"{url:<41}{mark} {health:<7} "
+                f"{str(s.get('role') or '-'):<7} "
+                f"{_fmt(s.get('running'), 4)} "
+                f"{_fmt(s.get('waiting'), 4)} "
+                f"{s.get('cache_usage', 0.0):>6.2f} "
+                f"{s.get('prefix_hit_rate', 0.0):>6.2f} "
+                f"{s.get('mfu', 0.0):>6.2f} "
+                f"{shed:>5} {compiles:>8}")
+    return "\n".join(lines)
+
+
+def _load_changes(prev: Optional[dict], snap: dict) -> set:
+    """Server URLs whose load gauges moved between two snapshots."""
+    if not prev:
+        return set()
+    watched = ("running", "waiting", "cache_usage")
+    out = set()
+    prev_servers = prev.get("servers") or {}
+    for url, s in (snap.get("servers") or {}).items():
+        before = prev_servers.get(url)
+        if before is None or any(
+                s.get(k) != before.get(k) for k in watched):
+            out.add(url)
+    return out
+
+
+def fetch_snapshot(url: str, timeout: float = 5.0) -> dict:
+    resp = requests.get(f"{url.rstrip('/')}/cluster/status",
+                        timeout=timeout)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m production_stack_tpu.stacktop",
+        description="Live fleet console over the router's "
+                    "/cluster/status rollup.")
+    parser.add_argument("--url", default="http://localhost:8080",
+                        help="router base URL")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="seconds between polls")
+    parser.add_argument("--once", action="store_true",
+                        help="render one snapshot and exit")
+    parser.add_argument("--plain", action="store_true",
+                        help="no ANSI control sequences (tests, "
+                             "pipes)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw snapshot JSON instead of "
+                             "the rendered console")
+    args = parser.parse_args(argv)
+
+    prev: Optional[dict] = None
+    while True:
+        try:
+            snap = fetch_snapshot(args.url)
+        except Exception as e:
+            print(f"stacktop: {args.url}: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if args.json:
+            out = json.dumps(snap, indent=2, sort_keys=True)
+        else:
+            out = render_snapshot(snap, _load_changes(prev, snap))
+        if not (args.plain or args.once):
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(out)
+        if args.once:
+            return 0
+        prev = snap
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

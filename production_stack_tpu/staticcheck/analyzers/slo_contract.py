@@ -1,0 +1,78 @@
+"""Rule ``slo-contract``: every SLO spec field is documented.
+
+The SLO ledger's spec (``--slo-spec``, obs/slo.py) is the operator's
+declarative surface for "what counts as good": per-class and
+per-model latency targets plus objective fractions. Like the
+config-contract rule for engine/fleet knobs, a spec field an
+operator cannot find in the docs is a knob that effectively does not
+exist — and a doc row for a removed field is a trap. Checks that
+every dataclass field of ``SLOTarget`` and ``SLOSpec`` appears
+backticked somewhere in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    rule,
+)
+
+SLO_FILE = "production_stack_tpu/obs/slo.py"
+DOCS_FILE = "docs/observability.md"
+SPEC_CLASSES = ("SLOTarget", "SLOSpec")
+
+
+def _dataclass_fields(tree: ast.AST, class_name: str) -> Set[str]:
+    """Annotated field names of one dataclass."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {stmt.target.id for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)}
+    return set()
+
+
+@rule("slo-contract",
+      "every SLOSpec / SLOTarget field is documented in "
+      "docs/observability.md")
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def missing(path):
+        return Finding(
+            rule="slo-contract", path=path, line=0,
+            message="slo-contract surface file missing — if the layer "
+                    "moved, update "
+                    "staticcheck/analyzers/slo_contract.py")
+
+    slo = project.source(SLO_FILE)
+    docs = project.source(DOCS_FILE)
+    if slo is None or slo.tree is None:
+        findings.append(missing(SLO_FILE))
+    if docs is None:
+        findings.append(missing(DOCS_FILE))
+    if findings:
+        return findings
+
+    for cls in SPEC_CLASSES:
+        fields = _dataclass_fields(slo.tree, cls)
+        if not fields:
+            findings.append(Finding(
+                rule="slo-contract", path=SLO_FILE, line=0,
+                message=f"dataclass {cls} not found (or has no "
+                        "annotated fields) — the SLO spec surface "
+                        "must stay in obs/slo.py"))
+            continue
+        for name in sorted(fields):
+            if f"`{name}`" not in docs.text:
+                findings.append(Finding(
+                    rule="slo-contract", path=DOCS_FILE, line=0,
+                    message=f"SLO spec field {cls}.{name} is not "
+                            "documented in docs/observability.md — "
+                            "every --slo-spec field must appear "
+                            "backticked in the SLO ledger section"))
+    return findings

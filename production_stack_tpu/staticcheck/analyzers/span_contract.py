@@ -15,7 +15,12 @@ just becomes an event no tool recognizes. Checks:
   ``<!-- span-events:begin -->`` / ``<!-- span-events:end -->`` block
   of docs/observability.md, and every documented name is in
   ``SPAN_EVENTS`` — the docs table and the vocabulary cannot drift
-  apart in either direction.
+  apart in either direction;
+- the router span's JSON field set (the dict-literal keys in
+  ``RequestSpan.to_json``, router/tracing.py) matches the
+  ``<!-- router-span-fields:begin/end -->`` table in the same doc,
+  both directions — span-log consumers (traceview, the slow archive,
+  jq pipelines) key on those names.
 
 Event-name call sites are recognized positionally: ``EngineSpan.event``
 takes the name first, ``EngineTracer.event`` takes it second (after
@@ -38,10 +43,15 @@ from production_stack_tpu.staticcheck.core import (
 )
 
 TRACING_FILE = "production_stack_tpu/engine/tracing.py"
+ROUTER_TRACING_FILE = "production_stack_tpu/router/tracing.py"
 DOCS_FILE = "docs/observability.md"
 
 _BLOCK_RE = re.compile(
     r"<!--\s*span-events:begin\s*-->(.*?)<!--\s*span-events:end\s*-->",
+    re.DOTALL)
+_ROUTER_FIELDS_BLOCK_RE = re.compile(
+    r"<!--\s*router-span-fields:begin\s*-->(.*?)"
+    r"<!--\s*router-span-fields:end\s*-->",
     re.DOTALL)
 _DOC_NAME_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`", re.MULTILINE)
 
@@ -62,6 +72,26 @@ def _event_name_sites(tree: ast.AST) -> List[Tuple[int, str]]:
                 sites.append((node.lineno, arg.value))
                 break
     return sites
+
+
+def _router_span_fields(tree: ast.AST) -> Set[str]:
+    """Dict-literal keys emitted by ``RequestSpan.to_json``."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == "RequestSpan"):
+            continue
+        for fn in node.body:
+            if not (isinstance(fn, ast.FunctionDef)
+                    and fn.name == "to_json"):
+                continue
+            keys: Set[str] = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Dict):
+                    keys |= {k.value for k in sub.keys
+                             if isinstance(k, ast.Constant)
+                             and isinstance(k.value, str)}
+            return keys
+    return set()
 
 
 def _span_events(tree: ast.AST) -> Set[str]:
@@ -117,6 +147,43 @@ def check(project: Project) -> List[Finding]:
                     "(engine/tracing.py) — add it to the vocabulary "
                     "and the docs/observability.md event table, or "
                     "fix the typo"))
+
+    router_tracing = project.source(ROUTER_TRACING_FILE)
+    if router_tracing is None or router_tracing.tree is None:
+        findings.append(missing(ROUTER_TRACING_FILE))
+    else:
+        fields = _router_span_fields(router_tracing.tree)
+        if not fields:
+            findings.append(Finding(
+                rule="span-contract", path=ROUTER_TRACING_FILE, line=0,
+                message="RequestSpan.to_json dict literal not found — "
+                        "the router span field set must be a literal "
+                        "dict for the contract to see it"))
+        else:
+            fblock = _ROUTER_FIELDS_BLOCK_RE.search(docs.text)
+            if fblock is None:
+                findings.append(Finding(
+                    rule="span-contract", path=DOCS_FILE, line=0,
+                    message="docs/observability.md is missing the "
+                            "<!-- router-span-fields:begin/end --> "
+                            "marker block the router span field table "
+                            "lives in"))
+            else:
+                doc_fields = set(_DOC_NAME_RE.findall(fblock.group(1)))
+                for name in sorted(fields - doc_fields):
+                    findings.append(Finding(
+                        rule="span-contract", path=DOCS_FILE, line=0,
+                        message=f"router span field '{name}' is "
+                                "emitted by RequestSpan.to_json but "
+                                "undocumented — add a row to the "
+                                "router-span-fields table"))
+                for name in sorted(doc_fields - fields):
+                    findings.append(Finding(
+                        rule="span-contract", path=DOCS_FILE, line=0,
+                        message="docs/observability.md documents "
+                                f"router span field '{name}' which "
+                                "RequestSpan.to_json does not emit — "
+                                "stale row or renamed field"))
 
     block = _BLOCK_RE.search(docs.text)
     if block is None:

@@ -36,6 +36,14 @@ Fault injection (for resilience tests): ``--fault MODE`` at startup or
                      request's ``x-priority`` header; without it every
                      request is treated as the deployment default
                      (batch), i.e. everything is shed.
+- ``slow_ttft``      SLO-breach timing fault (docs/observability.md):
+                     first token delayed by an extra ``--slow-ttft-s``
+                     seconds — the stream still completes cleanly, so
+                     router-side SLO ledger / slow-archive tests see a
+                     breaching-but-successful request
+- ``slow_itl``       SLO-breach timing fault: every streamed token
+                     takes ``--slow-itl-s`` seconds instead of
+                     ``1/speed``
 - ``null``/absent    healthy (clears a previously set fault)
 
 Disaggregation (docs/disaggregation.md): ``--role prefill|decode|both``
@@ -93,6 +101,7 @@ from production_stack_tpu.qos import (
 FAULT_MODES = (
     "error500", "hang", "slow_first_token", "abort_mid_stream", "crash",
     "hang_step", "unhealthy", "kv_missing", "overload",
+    "slow_ttft", "slow_itl",
 )
 
 ENGINE_ROLES = ("prefill", "decode", "both")
@@ -145,6 +154,10 @@ FAKE_ONLY_ROUTES = {
                     "autoscaler tests can drive SLO signals",
     "POST /kv/summary": "lets KV-economy tests plant the hot-chain "
                         "snapshot the GET serves",
+    "GET /cluster/status": "single-fake stand-in for the ROUTER's "
+                           "fleet rollup (router/app.py serves the "
+                           "real one) so stacktop render tests run "
+                           "without a router",
 }
 
 
@@ -167,6 +180,11 @@ class FakeEngineState:
         self.total_served = 0
         self.fault = fault  # one of FAULT_MODES or None
         self.fault_ttft = fault_ttft  # slow_first_token delay
+        # SLO-breach timing faults (docs/observability.md): extra
+        # first-token delay / per-token cadence under the slow_ttft /
+        # slow_itl fault modes.
+        self.slow_ttft_s = 0.75
+        self.slow_itl_s = 0.2
         self.requests_received = 0  # API hits incl. faulted ones
         self.role = role  # reported in /health for role discovery
         self.disagg_prefills = 0  # descriptors emitted
@@ -398,6 +416,13 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     # land repeat prefixes on the same pod measurably win.
     hit_frac = state.observe_prefix(body)
     ttft_eff = state.ttft * (1.0 - 0.9 * hit_frac)
+    # SLO-breach timing faults: breach-but-succeed, so the router's
+    # SLO ledger classifies a completed request as bad and captures
+    # its exemplar (docs/observability.md).
+    if state.fault == "slow_ttft":
+        ttft_eff += state.slow_ttft_s
+    tok_delay = (state.slow_itl_s if state.fault == "slow_itl"
+                 else 1.0 / state.speed)
     words = [f"tok{i} " for i in range(n_tokens)]
     tracer, arrival = state.tracer, time.time()
     if tracer is not None:
@@ -421,7 +446,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                          start=0, tokens=8, last=True)
             tracer.event(request_id, "first_token", token=0)
         if not stream:
-            await asyncio.sleep(n_tokens / state.speed)
+            await asyncio.sleep(n_tokens * tok_delay)
             state.total_served += 1
             if tracer is not None:
                 tracer.finish(request_id, reason="stop",
@@ -473,7 +498,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                 # A wedged device step: the stream stalls open while
                 # /health reports the watchdog trip.
                 await asyncio.sleep(3600)
-            await asyncio.sleep(1.0 / state.speed)
+            await asyncio.sleep(tok_delay)
             await resp.write(_sse(_chunk(request_id, model, word)))
             if (state.checkpoint_interval > 0
                     and (i + 1) % state.checkpoint_interval == 0):
@@ -516,8 +541,14 @@ async def completions(request: web.Request) -> web.Response:
             state.waiting -= 1
     state.running += 1
     try:
-        await asyncio.sleep(state.ttft * (1.0 - 0.9 * hit_frac)
-                            + n_tokens / state.speed)
+        # Same SLO-breach timing faults as chat_completions: the whole
+        # body is delayed by the faulted ttft + per-token cadence.
+        ttft_eff = state.ttft * (1.0 - 0.9 * hit_frac)
+        if state.fault == "slow_ttft":
+            ttft_eff += state.slow_ttft_s
+        tok_delay = (state.slow_itl_s if state.fault == "slow_itl"
+                     else 1.0 / state.speed)
+        await asyncio.sleep(ttft_eff + n_tokens * tok_delay)
         state.total_served += 1
         return web.json_response({
             "id": f"cmpl-{uuid.uuid4().hex[:16]}",
@@ -877,6 +908,10 @@ async def set_fault(request: web.Request) -> web.Response:
     state.fault = mode
     if "fault_ttft" in body:
         state.fault_ttft = float(body["fault_ttft"])
+    if "slow_ttft_s" in body:
+        state.slow_ttft_s = float(body["slow_ttft_s"])
+    if "slow_itl_s" in body:
+        state.slow_itl_s = float(body["slow_itl_s"])
     return web.json_response({"fault": state.fault})
 
 
@@ -973,6 +1008,12 @@ async def metrics(request: web.Request) -> web.Response:
         'vllm:engine_hbm_bytes{category="step_buffers"} 65536.0',
         "# TYPE vllm:engine_step_device_seconds_total counter",
         'vllm:engine_step_device_seconds_total{kind="decode"} 2.5',
+        # Step-time medians (drift sentinel, obs/drift.py): static
+        # values matching observability/perf_baseline.json, so an
+        # unmodified fake reads as "no drift".
+        "# TYPE vllm:engine_step_time_median_seconds gauge",
+        'vllm:engine_step_time_median_seconds{kind="decode"} 0.025',
+        'vllm:engine_step_time_median_seconds{kind="prefill"} 0.5',
         "# TYPE vllm:engine_mfu gauge",
         "vllm:engine_mfu 0.37",
         "# TYPE vllm:engine_attention_impl gauge",
@@ -981,6 +1022,47 @@ async def metrics(request: web.Request) -> web.Response:
         "",
     ])
     return web.Response(text=text, content_type="text/plain")
+
+
+async def cluster_status(request: web.Request) -> web.Response:
+    """GET /cluster/status: a /cluster/status-shaped snapshot with
+    this fake as the only server — built through the same
+    obs.cluster_status rollup the router uses, so stacktop render
+    tests exercise the real payload shape without a router."""
+    from types import SimpleNamespace
+
+    from production_stack_tpu.obs.cluster_status import build_snapshot
+
+    state: FakeEngineState = request.app["state"]
+    kvs = state.kv_summary_payload()
+    cache_usage = (state.cache_usage if state.cache_usage is not None
+                   else min(1.0, state.running / 16))
+    stats = SimpleNamespace(
+        num_running_requests=state.running,
+        num_queuing_requests=state.waiting,
+        kv_usage_perc=float(cache_usage),
+        kv_cache_hit_rate=state.prefix_hit_rate(),
+        engine_draining=float(state.draining),
+        kv_summary_hot_chains=float(len(kvs["hot_chains"])),
+        kv_free_page_headroom=float(kvs["free_pages"]),
+        kv_total_pages=float(kvs["total_pages"]),
+        kv_summary_time=time.time(),
+        qos_shed_by_class=dict(state.qos_shed_counts),
+        compile_events_by_kind={"step": 3.0, "unified": 1.0},
+        engine_mfu=0.37,
+        hbm_bytes_by_category={"weights": 1048576.0,
+                               "kv_pages": 524288.0,
+                               "kv_scales": 0.0,
+                               "step_buffers": 65536.0},
+        step_time_median_by_kind={"decode": 0.025, "prefill": 0.5},
+    )
+    url = f"http://{request.host}"
+    ep = SimpleNamespace(url=url, model_name=state.model,
+                         role=state.role)
+    return web.json_response(
+        build_snapshot({url: stats}, endpoints=[ep],
+                       healthy={url: state.fault not in
+                                ("error500", "unhealthy")}))
 
 
 async def debug_compiles(request: web.Request) -> web.Response:
@@ -1089,6 +1171,7 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/kv/summary", kv_summary)
     app.router.add_post("/kv/summary", set_kv_summary)
+    app.router.add_get("/cluster/status", cluster_status)
     app.router.add_get("/debug/trace/{request_id}", debug_trace)
     app.router.add_get("/debug/steps", debug_steps)
     app.router.add_get("/debug/compiles", debug_compiles)
@@ -1112,6 +1195,12 @@ def main(argv=None) -> None:
                         help="start with this fault mode active")
     parser.add_argument("--fault-ttft", type=float, default=5.0,
                         help="slow_first_token injected delay (seconds)")
+    parser.add_argument("--slow-ttft-s", type=float, default=0.75,
+                        help="slow_ttft fault: extra first-token "
+                             "delay (seconds)")
+    parser.add_argument("--slow-itl-s", type=float, default=0.2,
+                        help="slow_itl fault: per-token cadence "
+                             "(seconds) replacing 1/speed")
     parser.add_argument("--role", default="both", choices=ENGINE_ROLES,
                         help="engine role reported in /health "
                              "(disaggregated-serving discovery)")
@@ -1157,6 +1246,8 @@ def main(argv=None) -> None:
                             crash_after_tokens=args.crash_after_tokens,
                             kv_hot_capacity=args.kv_hot_capacity,
                             kv_total_pages=args.kv_total_pages)
+    app["state"].slow_ttft_s = args.slow_ttft_s
+    app["state"].slow_itl_s = args.slow_itl_s
     web.run_app(app, host=args.host, port=args.port, print=None)
 
 

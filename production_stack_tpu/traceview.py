@@ -121,6 +121,22 @@ def render_waterfall(spans: List[dict], request_id: str) -> str:
     return "\n".join(out) + "\n"
 
 
+def load_slow_archive(path: str) -> List[dict]:
+    """Spans from a saved ``GET /debug/slow`` payload (or a bare entry
+    list): every archived exemplar already carries its stitched
+    ``spans``, so the file renders without any span-log files."""
+    with open(path) as f:
+        payload = json.load(f)
+    entries = (payload.get("entries", [])
+               if isinstance(payload, dict) else payload)
+    spans: List[dict] = []
+    for entry in entries:
+        if isinstance(entry, dict):
+            spans.extend(s for s in entry.get("spans", [])
+                         if isinstance(s, dict))
+    return spans
+
+
 def _request_ids(spans: List[dict]) -> List[str]:
     seen: Dict[str, None] = {}
     for s in spans:
@@ -135,14 +151,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m production_stack_tpu.traceview",
         description="Merge router + engine span logs into per-request "
                     "waterfalls (docs/observability.md)")
-    parser.add_argument("logs", nargs="+",
+    parser.add_argument("logs", nargs="*",
                         help="Span JSON-line files (router and/or "
                              "engine --request-span-log outputs)")
     parser.add_argument("--request-id", default=None,
                         help="Render only this request (default: every "
                              "request id found, in first-seen order)")
+    parser.add_argument("--from-slow-archive", default=None,
+                        help="Render spans from a saved GET /debug/slow "
+                             "JSON payload instead of (or merged with) "
+                             "span-log files")
     args = parser.parse_args(argv)
+    if not args.logs and not args.from_slow_archive:
+        parser.error("need span-log files and/or --from-slow-archive")
     spans = load_spans(args.logs)
+    if args.from_slow_archive:
+        spans.extend(load_slow_archive(args.from_slow_archive))
     ids = ([args.request_id] if args.request_id
            else _request_ids(spans))
     if not ids:

@@ -1,0 +1,335 @@
+"""Live wiring of the cluster SLO ledger (docs/observability.md):
+fake engines under a breaching timing fault drive the burn-rate
+gauges, slow-request exemplar capture with a stitched waterfall at
+GET /debug/slow, the /cluster/status rollup and the stacktop console;
+plus the scrape-side regression test for the -1 "no data" p99
+sentinel and the fake engine's SLO fault modes.
+"""
+
+import asyncio
+import json
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.testing.fake_engine import build_fake_engine
+
+SLO_SPEC = {
+    "objective": 0.9,
+    "classes": {
+        # Interactive gets a generous TTFT budget the slow fault stays
+        # inside; batch gets one it always breaches.
+        "interactive": {"ttft_s": 5.0},
+        "batch": {"ttft_s": 0.05},
+    },
+}
+
+
+def _write_spec(tmp_path, spec=SLO_SPEC, name="slo.json"):
+    path = tmp_path / name
+    path.write_text(json.dumps(spec))
+    return str(path)
+
+
+async def _rig(fake, router_args, fn):
+    """One fake engine + a router built from CLI args."""
+    fake_server = TestServer(fake)
+    await fake_server.start_server()
+    url = f"http://127.0.0.1:{fake_server.port}"
+    try:
+        args = parse_args([
+            "--service-discovery", "static",
+            "--static-backends", url,
+            "--static-models", "m1",
+            "--routing-logic", "roundrobin",
+        ] + router_args)
+        client = TestClient(TestServer(build_app(args)))
+        await client.start_server()
+        try:
+            await fn(client, url)
+        finally:
+            await client.close()
+    finally:
+        await fake_server.close()
+        from production_stack_tpu.router.tracing import (
+            initialize_span_logger,
+        )
+        initialize_span_logger(None)
+
+
+def _sample(text, name, **labels):
+    """Value of one Prometheus sample from exposition text, or None."""
+    frag = ",".join(f'{k}="{v}"' for k, v in labels.items())
+    for line in text.splitlines():
+        if line.startswith(f"{name}{{") and frag in line:
+            return float(line.rsplit(" ", 1)[1])
+        if not labels and line.startswith(f"{name} "):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def test_overload_breach_burns_budget_and_archives_exemplar(tmp_path):
+    fake = build_fake_engine(model="m1", speed=1000, ttft=0.0,
+                             fault="slow_ttft")
+    fake["state"].slow_ttft_s = 0.2
+
+    async def run(client, url):
+        async def one(priority):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={"model": "m1",
+                      "messages": [{"role": "user", "content": "x"}],
+                      "max_tokens": 4, "stream": True},
+                headers={"x-priority": priority})
+            assert resp.status == 200
+            await resp.read()
+
+        # ~2x overload: all six requests in flight at once against one
+        # engine; four batch (breaching), two interactive (within).
+        await asyncio.gather(*[one("batch") for _ in range(4)],
+                             *[one("interactive") for _ in range(2)])
+        # Exemplar capture is fire-and-forget; let the tasks finish.
+        await asyncio.sleep(0.5)
+
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        burn = _sample(text, "vllm:slo_burn_rate", window="5m")
+        assert burn is not None and burn > 1.0
+        att_int = _sample(text, "vllm:slo_attainment",
+                          **{"class": "interactive", "model": "m1"})
+        att_batch = _sample(text, "vllm:slo_attainment",
+                            **{"class": "batch", "model": "m1"})
+        assert att_int == 1.0
+        assert att_batch == 0.0
+        assert _sample(text, "vllm:slo_bad_requests_total",
+                       **{"class": "batch", "model": "m1"}) == 4.0
+        assert _sample(text, "vllm:slow_archive_depth") == 4.0
+
+        resp = await client.get("/debug/slow")
+        body = await resp.json()
+        assert body["depth"] == 4 and body["archived_total"] == 4
+        entry = body["entries"][0]
+        assert entry["class"] == "batch" and entry["model"] == "m1"
+        assert entry["breach"][0]["metric"] == "ttft"
+        assert entry["server"] == url
+        # The stitched waterfall carries both the router span and the
+        # engine flight-recorder timeline for the same request id.
+        rid = entry["request_id"]
+        spans = entry["spans"]
+        assert {s["span"] for s in spans} == {"request",
+                                              "engine_request"}
+        assert all(s["request_id"] == rid for s in spans)
+        assert entry["waterfall"].startswith(
+            f"request {rid}  ({len(spans)} spans)")
+        assert "first_token" in entry["waterfall"]
+
+        # Class/model filters and the limit contract.
+        resp = await client.get("/debug/slow?class=interactive")
+        assert (await resp.json())["entries"] == []
+        resp = await client.get("/debug/slow?limit=bogus")
+        assert resp.status == 400
+
+        # Replayable offline through traceview --from-slow-archive.
+        from production_stack_tpu.traceview import main as traceview
+        path = tmp_path / "slow.json"
+        path.write_text(json.dumps(body))
+        assert traceview(["--from-slow-archive", str(path),
+                          "--request-id", rid]) == 0
+
+    asyncio.run(_rig(fake, [
+        "--slo-spec", _write_spec(tmp_path),
+        "--slow-archive-size", "16",
+    ], run))
+
+
+def test_debug_slow_is_503_without_spec():
+    fake = build_fake_engine(model="m1", speed=1000, ttft=0.0)
+
+    async def run(client, url):
+        resp = await client.get("/debug/slow")
+        assert resp.status == 503
+
+    asyncio.run(_rig(fake, [], run))
+
+
+def test_cluster_status_and_stacktop_console(tmp_path):
+    fake = build_fake_engine(model="m1", speed=1000, ttft=0.0)
+    baseline = tmp_path / "perf_baseline.json"
+    baseline.write_text(json.dumps(
+        {"band": 0.25, "phases": {"decode": 0.025, "prefill": 0.5}}))
+
+    async def run(client, url):
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"model": "m1",
+                  "messages": [{"role": "user", "content": "x"}],
+                  "max_tokens": 2},
+            headers={"x-priority": "interactive"})
+        assert resp.status == 200
+        await resp.read()
+
+        resp = await client.get("/cluster/status")
+        snap = await resp.json()
+        assert url in snap["servers"]
+        server = snap["servers"][url]
+        assert server["model"] == "m1" and server["healthy"] is True
+        assert snap["slo"]["good_requests"] == 1
+        assert snap["slow_archive"]["depth"] == 0
+        # Sentinel enabled: verdict block present (engine medians only
+        # arrive with the stats scrape, so no trip is asserted here).
+        assert set(snap["perf_drift"]) == {"decode", "prefill"}
+
+        # The console renders that snapshot; --once --plain is the
+        # scriptable mode, exercised against the live router from a
+        # worker thread (stacktop polls with sync requests).
+        from production_stack_tpu import stacktop
+        base = f"http://127.0.0.1:{client.port}"
+        loop = asyncio.get_running_loop()
+        rc = await loop.run_in_executor(
+            None, stacktop.main, ["--url", base, "--once", "--plain"])
+        assert rc == 0
+        snap2 = await loop.run_in_executor(
+            None, stacktop.fetch_snapshot, base)
+        out = stacktop.render_snapshot(snap2)
+        assert "tpu-stack cluster status" in out
+        assert url in out and "SLO objective=0.9" in out
+
+    asyncio.run(_rig(fake, [
+        "--slo-spec", _write_spec(tmp_path),
+        "--perf-baseline", str(baseline),
+    ], run))
+
+
+def test_spans_and_stats_carry_class_and_tenant(tmp_path):
+    """Satellite: every router span and request-stats observation is
+    attributed with priority class and tenant."""
+    fake = build_fake_engine(model="m1", speed=1000, ttft=0.0)
+    span_log = str(tmp_path / "spans.jsonl")
+
+    async def run(client, url):
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"model": "m1",
+                  "messages": [{"role": "user", "content": "x"}],
+                  "max_tokens": 2},
+            headers={"x-priority": "interactive",
+                     "x-api-key": "tenant-a"})
+        assert resp.status == 200
+        await resp.read()
+
+        from production_stack_tpu.router.stats.request_stats import (
+            get_request_stats_monitor,
+        )
+        monitor = get_request_stats_monitor()
+        assert monitor.arrivals_by_class.get("interactive") == 1
+
+    asyncio.run(_rig(fake, ["--request-span-log", span_log], run))
+    line = json.loads(open(span_log).read().splitlines()[0])
+    assert line["priority_class"] == "interactive"
+    assert line["tenant"] == "tenant-a"
+
+
+def test_idle_p99_sentinel_not_exported(tmp_path):
+    """Satellite regression: RequestStats' -1 "no observation" p99
+    sentinel must never reach the Prometheus exposition — an idle
+    server renders no sample, and a stale sample is removed once its
+    window empties."""
+    from prometheus_client import REGISTRY, generate_latest
+
+    from production_stack_tpu.router.services import metrics_service
+    from production_stack_tpu.router.stats.request_stats import (
+        initialize_request_stats_monitor,
+    )
+
+    monitor = initialize_request_stats_monitor(0.2)
+    url = "http://idle-p99-regression:1"
+    now = time.time()
+    monitor.on_request_arrival("rid-1", now)
+    monitor.on_request_routed(url, "rid-1", now)
+
+    def exposition():
+        metrics_service.refresh_gauges()
+        return generate_latest(REGISTRY).decode()
+
+    # Routed but no first token yet: the p99 windows are empty (-1
+    # internally) and the exposition must carry NO sample — not -1.
+    text = exposition()
+    assert f'vllm:ttft_p99_seconds{{server="{url}"}}' not in text
+    assert f'vllm:itl_p99_seconds{{server="{url}"}}' not in text
+
+    # First token observed: a real sample appears.
+    monitor.on_request_response(url, "rid-1", time.time(),
+                                is_first_token=True)
+    text = exposition()
+    value = None
+    for line in text.splitlines():
+        if line.startswith(f'vllm:ttft_p99_seconds{{server="{url}"}}'):
+            value = float(line.rsplit(" ", 1)[1])
+    assert value is not None and value >= 0
+
+    # Window expires: the stale child is removed again, not left at
+    # its last value and not reset to -1.
+    time.sleep(0.3)
+    text = exposition()
+    assert f'vllm:ttft_p99_seconds{{server="{url}"}}' not in text
+
+
+def test_fake_engine_slow_faults_and_cluster_status():
+    """Satellite: the fake engine honors the slow_ttft / slow_itl
+    timing faults (breach-but-succeed) and serves /cluster/status-
+    shaped stats."""
+
+    async def run():
+        fake = build_fake_engine(model="m1", speed=1000, ttft=0.0,
+                                 fault="slow_ttft")
+        fake["state"].slow_ttft_s = 0.25
+        server = TestServer(fake)
+        await server.start_server()
+        try:
+            client = TestClient(server)
+            t0 = time.monotonic()
+            resp = await client.post("/v1/chat/completions", json={
+                "model": "m1",
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2, "stream": True})
+            assert resp.status == 200
+            await resp.content.readany()
+            assert time.monotonic() - t0 >= 0.25
+            await resp.read()
+            # The non-streaming completions path honors the fault too.
+            t0 = time.monotonic()
+            resp = await client.post("/v1/completions", json={
+                "model": "m1", "prompt": "x", "max_tokens": 2})
+            assert resp.status == 200
+            await resp.read()
+            assert time.monotonic() - t0 >= 0.25
+
+            status = await (await client.get("/cluster/status")).json()
+            assert "ts" in status and "servers" in status
+            (entry,) = status["servers"].values()
+            assert "running" in entry and "cache_usage" in entry
+        finally:
+            await server.close()
+
+        fake = build_fake_engine(model="m1", speed=1000, ttft=0.0,
+                                 fault="slow_itl")
+        fake["state"].slow_itl_s = 0.1
+        server = TestServer(fake)
+        await server.start_server()
+        try:
+            client = TestClient(server)
+            t0 = time.monotonic()
+            resp = await client.post("/v1/chat/completions", json={
+                "model": "m1",
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 4, "stream": True})
+            assert resp.status == 200
+            await resp.read()
+            # 4 tokens at a forced >= 0.1s cadence.
+            assert time.monotonic() - t0 >= 0.3
+        finally:
+            await server.close()
+
+    asyncio.run(run())

@@ -257,6 +257,29 @@ def test_signals_from_router_metrics_grouping():
     assert out["prefill"].waiting == -1.0          # unowned server ignored
 
 
+def test_slo_burn_rate_is_a_fleet_wide_signal():
+    text = "\n".join([
+        'vllm:slo_burn_rate{window="5m"} 2.5',
+        'vllm:slo_burn_rate{window="1h"} 9.0',
+        'vllm:num_requests_waiting{server="http://a:1"} 6.0',
+    ])
+    out = signals_from_router_metrics(text, {
+        "http://a:1": "decode", "http://b:2": "prefill"})
+    # No server label: every pool sees the 5m value; the 1h window is
+    # for paging, never capacity.
+    assert out["decode"].slo_burn_rate == 2.5
+    assert out["prefill"].slo_burn_rate == 2.5
+
+    # Burn over target scales the pool up like any other signal.
+    asc = PoolAutoscaler(_pool(target_slo_burn_rate=1.0,
+                               scale_up_cooldown_s=0.0))
+    assert asc.desired(2, out["decode"]) == 5          # ratio 2.5
+    # Disabled (0) target ignores the signal entirely.
+    off = PoolAutoscaler(_pool(target_waiting_per_replica=4.0))
+    assert off.desired(2, PoolSignals(slo_burn_rate=50.0,
+                                      waiting=8.0)) == 2
+
+
 # ---- engine server drain surface (stub engine; no LLMEngine build) --------
 
 class _StubEngine:

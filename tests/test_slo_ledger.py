@@ -1,0 +1,298 @@
+"""Cluster SLO ledger, drift sentinel, slow archive and stacktop
+(docs/observability.md): burn-rate window arithmetic under a fake
+clock, spec resolution, archive ring semantics, the /cluster/status
+fold, the stacktop plain render, and traceview's slow-archive replay.
+All pure-unit — the live wiring is tested in test_e2e_slo.py.
+"""
+
+import json
+
+import pytest
+
+from production_stack_tpu import obs
+from production_stack_tpu.obs.cluster_status import build_snapshot
+from production_stack_tpu.stacktop import (
+    _load_changes,
+    render_snapshot,
+)
+from production_stack_tpu.traceview import (
+    load_slow_archive,
+    render_waterfall,
+)
+
+SPEC = {
+    "objective": 0.9,
+    "classes": {
+        "interactive": {"ttft_s": 0.5, "itl_s": 0.1},
+        "batch": {"ttft_s": 5.0, "objective": 0.8},
+    },
+    "models": {"m-slow": {"ttft_s": 2.0}},
+}
+
+
+def _ledger(clock):
+    return obs.SLOLedger(obs.SLOSpec.from_dict(SPEC), clock=clock)
+
+
+# ---- spec resolution ---------------------------------------------------
+
+
+def test_spec_rejects_bad_objective():
+    with pytest.raises(ValueError):
+        obs.SLOSpec.from_dict({"objective": 1.5})
+    with pytest.raises(ValueError):
+        obs.SLOSpec.from_dict(
+            {"classes": {"batch": {"objective": 0.0}}})
+
+
+def test_spec_model_targets_override_class_targets():
+    spec = obs.SLOSpec.from_dict(SPEC)
+    target, objective = spec.resolve("interactive", "m-slow")
+    # Model-specific ttft wins; class itl survives the merge.
+    assert target.ttft_s == 2.0
+    assert target.itl_s == 0.1
+    assert objective == 0.9
+    target, objective = spec.resolve("batch", "other-model")
+    assert target.ttft_s == 5.0
+    assert objective == 0.8
+
+
+def test_spec_load_roundtrip(tmp_path):
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(SPEC))
+    spec = obs.SLOSpec.load(str(path))
+    assert spec.objective == 0.9
+    assert set(spec.classes) == {"interactive", "batch"}
+
+
+# ---- ledger scoring + burn windows -------------------------------------
+
+
+def test_observe_returns_breach_verdicts():
+    t = [0.0]
+    ledger = _ledger(lambda: t[0])
+    assert ledger.observe("interactive", "m", "http://e1",
+                          ttft_s=0.2, itl_s=0.05) == []
+    breaches = ledger.observe("interactive", "m", "http://e1",
+                              ttft_s=0.9, itl_s=0.3)
+    assert {b["metric"] for b in breaches} == {"ttft", "itl"}
+    assert breaches[0]["target_s"] in (0.5, 0.1)
+
+
+def test_burn_rate_window_arithmetic_under_fake_clock():
+    t = [0.0]
+    ledger = _ledger(lambda: t[0])
+    # 1 bad of 10 at t=0: bad_frac 0.1 vs budget 0.1 -> burn 1.0 in
+    # both windows.
+    for i in range(9):
+        ledger.observe("interactive", "m", "e", ttft_s=0.1)
+    ledger.observe("interactive", "m", "e", ttft_s=9.0)
+    burn = ledger.burn_rates()
+    assert burn["5m"] == pytest.approx(1.0)
+    assert burn["1h"] == pytest.approx(1.0)
+
+    # 10 minutes later the bad event has aged out of the 5m window
+    # but still burns the 1h budget; 10 fresh good events dilute it.
+    t[0] = 600.0
+    for i in range(10):
+        ledger.observe("interactive", "m", "e", ttft_s=0.1)
+    burn = ledger.burn_rates()
+    assert burn["5m"] == 0.0
+    assert burn["1h"] == pytest.approx(0.5)
+
+    # Past the hour everything ages out.
+    t[0] = 4300.0
+    assert ledger.burn_rates() == {"5m": 0.0, "1h": 0.0}
+
+
+def test_attainment_is_windowed_and_keyed_by_class_model():
+    t = [0.0]
+    ledger = _ledger(lambda: t[0])
+    ledger.observe("interactive", "m", "e1", ttft_s=0.1)
+    ledger.observe("interactive", "m", "e1", ttft_s=3.0)
+    ledger.observe("batch", "m", "e2", ttft_s=3.0)  # within batch 5s
+    att = ledger.attainments()
+    assert att[("interactive", "m")] == pytest.approx(0.5)
+    assert att[("batch", "m")] == pytest.approx(1.0)
+    totals = ledger.totals()
+    assert totals["bad"][("interactive", "m")] == 1
+    # Attainment forgets events older than the hour window.
+    t[0] = 3700.0
+    ledger.observe("interactive", "m", "e1", ttft_s=0.1)
+    assert ledger.attainments()[("interactive", "m")] == 1.0
+
+
+def test_unconstrained_phase_never_breaches():
+    t = [0.0]
+    ledger = _ledger(lambda: t[0])
+    # batch has no itl/e2e target: any value is good.
+    assert ledger.observe("batch", "m", "e",
+                          itl_s=99.0, e2e_s=1e6) == []
+
+
+# ---- drift sentinel ----------------------------------------------------
+
+
+def test_drift_sentinel_band(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(
+        {"band": 0.25, "phases": {"decode": 0.02, "prefill": 0.5}}))
+    sentinel = obs.DriftSentinel.load(str(path))
+    # decode 0.04 is +100% vs baseline -> tripped; prefill in band.
+    verdicts = sentinel.evaluate(
+        {"e1": {"decode": 0.04, "prefill": 0.55}})
+    assert verdicts["decode"]["tripped"] is True
+    assert verdicts["prefill"]["tripped"] is False
+    flags = sentinel.flags({"e1": {"decode": 0.04, "prefill": 0.55}})
+    assert flags == {"decode": 1.0, "prefill": 0.0}
+    # No observation for a phase -> not tripped (absence is not drift).
+    assert sentinel.evaluate({})["decode"]["tripped"] is False
+
+
+def test_drift_sentinel_rejects_degenerate_baseline():
+    with pytest.raises(ValueError):
+        obs.DriftSentinel({"decode": 0.02}, band=0.0)
+
+
+# ---- slow archive ------------------------------------------------------
+
+
+def test_slow_archive_ring_and_filters():
+    archive = obs.SlowArchive(2)
+    for i, cls in enumerate(["batch", "interactive", "batch"]):
+        archive.add({"request_id": f"r{i}", "class": cls, "model": "m"})
+    assert archive.depth() == 2
+    assert archive.archived_total == 3
+    # Newest first; oldest entry evicted by the ring.
+    assert [e["request_id"] for e in archive.snapshot()] == ["r2", "r1"]
+    assert [e["request_id"]
+            for e in archive.snapshot(priority_class="batch")] == ["r2"]
+    assert archive.snapshot(model="other") == []
+    assert len(archive.snapshot(limit=1)) == 1
+
+
+# ---- cluster snapshot + stacktop render --------------------------------
+
+
+class _Stats:
+    num_running_requests = 3
+    num_queuing_requests = 1
+    kv_usage_perc = 0.5
+    kv_cache_hit_rate = 0.25
+    engine_mfu = 0.12
+    step_time_median_by_kind = {"decode": 0.02}
+
+
+def test_build_snapshot_folds_all_layers():
+    t = [0.0]
+    ledger = _ledger(lambda: t[0])
+    ledger.observe("interactive", "m", "e", ttft_s=9.0)
+    archive = obs.SlowArchive(4)
+    archive.add({"request_id": "r0", "class": "interactive",
+                 "model": "m"})
+    sentinel = obs.DriftSentinel({"decode": 0.02}, band=0.25)
+
+    class _Ep:
+        url = "http://e1"
+        model_names = ["m"]
+        role = "decode"
+
+    snap = build_snapshot({"http://e1": _Stats()}, endpoints=[_Ep()],
+                          healthy={"http://e1": True}, ledger=ledger,
+                          archive=archive, sentinel=sentinel,
+                          now=1000.0)
+    server = snap["servers"]["http://e1"]
+    assert server["running"] == 3
+    assert server["role"] == "decode"
+    assert server["healthy"] is True
+    assert snap["slo"]["bad_requests"] == 1
+    assert snap["slow_archive"]["depth"] == 1
+    assert snap["perf_drift"]["decode"]["tripped"] is False
+    # Optional layers disabled -> keys absent, not null.
+    bare = build_snapshot({"http://e1": _Stats()}, now=1000.0)
+    assert set(bare) == {"ts", "servers"}
+
+
+def test_stacktop_plain_render_golden():
+    snap = {
+        "ts": 0.0,
+        "slo": {"objective": 0.9,
+                "attainment": {"interactive|m": 0.5},
+                "burn_rate": {"5m": 2.0, "1h": 0.25},
+                "good_requests": 1, "bad_requests": 1},
+        "perf_drift": {"decode": {"baseline_s": 0.02,
+                                  "observed_s": 0.04,
+                                  "drift": 1.0, "tripped": True}},
+        "slow_archive": {"depth": 1, "capacity": 64,
+                         "archived_total": 5},
+        "servers": {"http://e1": {
+            "healthy": True, "role": "decode", "running": 3,
+            "waiting": 1, "cache_usage": 0.5, "prefix_hit_rate": 0.25,
+            "mfu": 0.12, "qos_shed": {"batch": 2},
+            "compile_events": {"decode": 7},
+        }},
+    }
+    out = render_snapshot(snap)
+    expected = "\n".join([
+        "tpu-stack cluster status @ 1970-01-01 00:00:00",
+        "SLO objective=0.9 burn 5m=2.00 1h=0.25 good=1 bad=1",
+        "  attainment interactive|m = 0.5000",
+        "drift decode: TRIPPED (0.0400s vs 0.02s)",
+        "slow archive: 1/64 (5 archived)",
+        "",
+        "SERVER                                     HEALTH  ROLE    "
+        " RUN WAIT  CACHE    HIT    MFU  SHED COMPILES",
+        "http://e1                                  ok      decode  "
+        "   3    1   0.50   0.25   0.12     2        7",
+    ])
+    assert out == expected
+    # A changed server gets its marker; an unhealthy one renders DOWN.
+    marked = render_snapshot(snap, changed={"http://e1"})
+    assert "http://e1                                * ok" in marked
+    snap["servers"]["http://e1"]["healthy"] = False
+    assert "DOWN" in render_snapshot(snap)
+
+
+def test_stacktop_load_change_detection():
+    prev = {"servers": {"e1": {"running": 1, "waiting": 0,
+                               "cache_usage": 0.1}}}
+    same = {"servers": {"e1": {"running": 1, "waiting": 0,
+                               "cache_usage": 0.1}}}
+    moved = {"servers": {"e1": {"running": 2, "waiting": 0,
+                                "cache_usage": 0.1},
+                         "e2": {"running": 0}}}
+    assert _load_changes(prev, same) == set()
+    assert _load_changes(prev, moved) == {"e1", "e2"}
+    assert _load_changes(None, moved) == set()
+
+
+# ---- traceview --from-slow-archive -------------------------------------
+
+
+def test_traceview_renders_from_slow_archive(tmp_path):
+    router_span = {
+        "span": "request", "request_id": "rid-1", "model": "m",
+        "path": "/v1/chat/completions", "arrival_ts": 100.0,
+        "queue_delay_ms": 1.0, "ttft_ms": 900.0, "latency_ms": 950.0,
+        "chunks": 4, "status": "ok", "backend": "http://e1",
+    }
+    engine_span = {
+        "span": "engine_request", "request_id": "rid-1",
+        "seq_id": "seq-1", "role": "both",
+        "events": [{"event": "enqueue", "ts": 100.01},
+                   {"event": "first_token", "ts": 100.9}],
+    }
+    payload = {"entries": [{"request_id": "rid-1",
+                            "class": "interactive", "model": "m",
+                            "spans": [router_span, engine_span]}]}
+    path = tmp_path / "slow.json"
+    path.write_text(json.dumps(payload))
+    spans = load_slow_archive(str(path))
+    assert len(spans) == 2
+    text = render_waterfall(spans, "rid-1")
+    assert text.startswith("request rid-1  (2 spans)")
+    assert "first_chunk" in text and "first_token" in text
+
+    # CLI end-to-end: --from-slow-archive with no span-log files.
+    from production_stack_tpu.traceview import main
+    assert main(["--from-slow-archive", str(path)]) == 0

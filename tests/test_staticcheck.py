@@ -42,8 +42,9 @@ def test_all_advertised_rules_are_registered():
     import production_stack_tpu.staticcheck.analyzers  # noqa: F401
     expected = {"tracer-hygiene", "async-blocking", "metrics-contract",
                 "config-contract", "no-timeout", "host-read",
-                "kv-parity", "span-contract", "page-lifecycle",
-                "state-machine", "lock-discipline", "endpoint-contract"}
+                "kv-parity", "span-contract", "slo-contract",
+                "page-lifecycle", "state-machine", "lock-discipline",
+                "endpoint-contract"}
     assert expected <= set(REGISTRY)
 
 
@@ -276,6 +277,27 @@ def test_metrics_contract_accepts_explicit_drop_marker():
 
 # ---- span-contract -----------------------------------------------------
 
+# An agreeing router-span surface rides along in every span fixture so
+# the event-vocabulary tests exercise only the drift they plant.
+_ROUTER_TRACING_SRC = """\
+    import json
+
+    class RequestSpan:
+        def to_json(self):
+            return json.dumps({
+                "span": "request",
+                "request_id": self.request_id,
+            })
+    """
+_ROUTER_FIELDS_DOC = """\
+    <!-- router-span-fields:begin -->
+    | Field | Meaning |
+    |---|---|
+    | `span` | record marker |
+    | `request_id` | stitch key |
+    <!-- router-span-fields:end -->
+    """
+
 _SPAN_FIXTURE = {
     "production_stack_tpu/engine/tracing.py": """\
         SPAN_EVENTS = (
@@ -288,14 +310,15 @@ _SPAN_FIXTURE = {
             tracer.event(seq_id, "enqueue")
             tracer.event(seq_id, "fist_token")
         """,
-    "docs/observability.md": """\
+    "production_stack_tpu/router/tracing.py": _ROUTER_TRACING_SRC,
+    "docs/observability.md": textwrap.dedent("""\
         <!-- span-events:begin -->
         | Event | When |
         |---|---|
         | `enqueue` | admitted |
         | `ghost_event` | never |
         <!-- span-events:end -->
-        """,
+        """) + textwrap.dedent(_ROUTER_FIELDS_DOC),
 }
 
 
@@ -317,14 +340,14 @@ def test_span_contract_accepts_agreeing_surfaces():
             tracer.event(seq_id, "enqueue")
             tracer.event(seq_id, "finish")
         """
-    fixture["docs/observability.md"] = """\
+    fixture["docs/observability.md"] = textwrap.dedent("""\
         <!-- span-events:begin -->
         | Event | When |
         |---|---|
         | `enqueue` | admitted |
         | `finish` | closed |
         <!-- span-events:end -->
-        """
+        """) + textwrap.dedent(_ROUTER_FIELDS_DOC)
     assert _run(fixture, "span-contract") == []
 
 
@@ -333,6 +356,85 @@ def test_span_contract_requires_marker_block():
     fixture["docs/observability.md"] = "no markers here\n"
     findings = _run(fixture, "span-contract")
     assert any("marker block" in f.message for f in findings)
+
+
+def test_span_contract_router_fields_two_way_drift():
+    """An emitted-but-undocumented router span field and a
+    documented-but-gone field are both findings."""
+    fixture = dict(_SPAN_FIXTURE)
+    fixture["production_stack_tpu/router/tracing.py"] = """\
+        import json
+
+        class RequestSpan:
+            def to_json(self):
+                return json.dumps({
+                    "span": "request",
+                    "request_id": self.request_id,
+                    "tenant": self.tenant,
+                })
+        """
+    findings = _run(fixture, "span-contract")
+    messages = "\n".join(f.message for f in findings)
+    assert ("router span field 'tenant' is emitted" in messages)
+    # Now plant the reverse: docs advertise a field to_json dropped.
+    fixture["production_stack_tpu/router/tracing.py"] = """\
+        import json
+
+        class RequestSpan:
+            def to_json(self):
+                return json.dumps({"span": "request"})
+        """
+    findings = _run(fixture, "span-contract")
+    messages = "\n".join(f.message for f in findings)
+    assert ("router span field 'request_id'" in messages
+            and "does not emit" in messages)
+
+
+# ---- slo-contract ------------------------------------------------------
+
+_SLO_FIXTURE = {
+    "production_stack_tpu/obs/slo.py": """\
+        from dataclasses import dataclass, field
+
+        @dataclass
+        class SLOTarget:
+            ttft_s: float = None
+            objective: float = None
+
+        @dataclass
+        class SLOSpec:
+            objective: float = 0.99
+            classes: dict = field(default_factory=dict)
+        """,
+    "docs/observability.md": """\
+        ## SLO ledger
+
+        Fields: `objective`, `classes` and per-target `ttft_s`.
+        """,
+}
+
+
+def test_slo_contract_catches_undocumented_field():
+    findings = _run(_SLO_FIXTURE, "slo-contract")
+    assert findings == []
+    fixture = dict(_SLO_FIXTURE)
+    fixture["production_stack_tpu/obs/slo.py"] = (
+        _SLO_FIXTURE["production_stack_tpu/obs/slo.py"]
+        .replace("objective: float = 0.99",
+                 "objective: float = 0.99\n"
+                 "            ghost_knob: int = 0"))
+    findings = _run(fixture, "slo-contract")
+    assert any("SLOSpec.ghost_knob is not documented" in f.message
+               for f in findings)
+
+
+def test_slo_contract_requires_spec_classes():
+    fixture = dict(_SLO_FIXTURE)
+    fixture["production_stack_tpu/obs/slo.py"] = "X = 1\n"
+    findings = _run(fixture, "slo-contract")
+    messages = "\n".join(f.message for f in findings)
+    assert ("SLOTarget not found" in messages
+            or "dataclass SLOTarget not found" in messages)
 
 
 # ---- config-contract ---------------------------------------------------
