@@ -1262,6 +1262,309 @@ def run_autoscale_worker() -> None:
     }))
 
 
+def run_rollout_worker() -> None:
+    """Safe-rollout bench (docs/fleet.md): router + fleet manager + a
+    two-replica fake-engine pool driven through two full revision
+    rollouts. Scenario A (good canary): a behavior-identical new
+    build must promote fleet-wide with zero 5xx while a long
+    checkpointed stream started before the rollout ends byte-exact,
+    carried across revisions by migrate-mode drains (resume outcome
+    ``migrated``). Scenario B (bad canary): a ``degrade_new_revision``
+    fault bundle must be caught by the latency judge and
+    automatically rolled back with the alarm gauge latched while the
+    stable set keeps serving to SLO.
+
+    Fake engines only (CPU, no JAX): the phase measures the rollout
+    controller and the migration protocol, not model throughput.
+    """
+    import asyncio
+    import socket
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import aiohttp
+    from aiohttp import web
+
+    from production_stack_tpu.fleet.autoscaler import (
+        parse_prometheus_text,
+    )
+    from production_stack_tpu.fleet.manager import LIVE, FleetManager
+    from production_stack_tpu.fleet.spec import (
+        AutoscalerSpec,
+        FleetSpec,
+        PoolSpec,
+        RevisionSpec,
+        RolloutSpec,
+    )
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.dynamic_config import (
+        initialize_dynamic_config_watcher,
+    )
+    from production_stack_tpu.router.resilience import (
+        ResilienceConfig,
+        initialize_resilience,
+    )
+    from production_stack_tpu.router.routing.logic import (
+        initialize_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        initialize_service_discovery,
+    )
+    from production_stack_tpu.router.services import request_service
+    from production_stack_tpu.router.services.rewriter import (
+        initialize_request_rewriter,
+    )
+    from production_stack_tpu.router.stats.engine_stats import (
+        initialize_engine_stats_scraper,
+    )
+    from production_stack_tpu.router.stats.request_stats import (
+        initialize_request_stats_monitor,
+    )
+
+    speed = float(os.environ.get("BENCH_ROLLOUT_SPEED", "200"))
+    out_len = int(os.environ.get("BENCH_ROLLOUT_OUT_LEN", "24"))
+    stream_s = float(os.environ.get("BENCH_ROLLOUT_STREAM_S", "8"))
+    slo_ttft = float(os.environ.get("BENCH_ROLLOUT_SLO_TTFT_S", "0.5"))
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    async def run():
+        request_service.stream_resumes_by_outcome.clear()
+        request_service._poison_crashes.clear()
+        initialize_service_discovery("static", urls=[], models=[],
+                                     roles=[])
+        initialize_request_stats_monitor(60.0)
+        initialize_engine_stats_scraper(3600.0)
+        initialize_routing_logic("roundrobin")
+        initialize_request_rewriter("noop")
+        initialize_resilience(ResilienceConfig(
+            max_retries=2, backend_connect_timeout=2.0,
+            backend_timeout=60.0, health_check_interval=0.0))
+        runner = web.AppRunner(build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        router_url = ("http://127.0.0.1:"
+                      f"{site._server.sockets[0].getsockname()[1]}")
+
+        config_path = os.path.join(tempfile.mkdtemp(), "dyn.json")
+        base = free_port()
+        pool = PoolSpec(
+            name="decode", role="decode", min_replicas=2,
+            max_replicas=4, model="bench-fake",
+            command=[sys.executable, "-m",
+                     "production_stack_tpu.testing.fake_engine",
+                     "--host", "127.0.0.1", "--port", "{port}",
+                     "--model", "{model}", "--role", "{role}",
+                     "--speed", str(speed), "--ttft", "0.0",
+                     "--checkpoint-interval-tokens", "2"],
+            autoscaler=AutoscalerSpec(enable=False),
+            revision=RevisionSpec(build_id="v1"),
+            # No SLO ledger or drift sentinel in this rig: judge on
+            # crash streak + canary-vs-stable p99 latency ratio.
+            rollout=RolloutSpec(
+                enable=True, canary_weight=0.5, bake_s=2.0,
+                max_slo_burn_rate_5m=0.0, fail_on_perf_drift=False,
+                max_crash_streak=1, max_latency_ratio=3.0,
+                drain_mode="migrate"))
+        spec = FleetSpec(
+            pools=[pool], port_start=base, port_end=base + 9,
+            router_url=router_url, router_config_path=config_path,
+            drain_timeout_s=30.0)
+        mgr = FleetManager(spec)
+        session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=120.0))
+        watcher = initialize_dynamic_config_watcher(config_path, 3600.0)
+
+        async def one_request(n_tokens, sink=None):
+            rec = {"status": None, "ttft": None, "error": None,
+                   "text": ""}
+            body = {"model": "bench-fake",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": n_tokens, "stream": True}
+            t0 = time.time()
+            parts = []
+            try:
+                async with session.post(
+                        router_url + "/v1/chat/completions",
+                        json=body) as resp:
+                    rec["status"] = resp.status
+                    async for raw in resp.content:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if (not line.startswith("data: ")
+                                or line == "data: [DONE]"):
+                            continue
+                        event = json.loads(line[len("data: "):])
+                        if "choices" not in event:
+                            rec["error"] = "terminal SSE error"
+                            continue
+                        delta = (event["choices"][0].get("delta")
+                                 or {})
+                        if not delta.get("content"):
+                            continue
+                        if rec["ttft"] is None:
+                            rec["ttft"] = time.time() - t0
+                        parts.append(delta["content"])
+            except Exception as e:
+                rec["error"] = f"{type(e).__name__}: {e}"
+            rec["text"] = "".join(parts)
+            if sink is not None:
+                sink.append(rec)
+            return rec
+
+        async def drive_until(pred, sink, deadline_s, desc):
+            """Reconcile + hot-reload + background traffic until the
+            predicate holds; the traffic is what feeds the canary
+            judge its per-server latency samples."""
+            deadline = time.time() + deadline_s
+            i = 0
+            while time.time() < deadline:
+                await mgr.reconcile_once()
+                watcher.check_and_apply()
+                if pred():
+                    return
+                if i % 3 == 0:
+                    await asyncio.gather(
+                        *(one_request(out_len, sink=sink)
+                          for _ in range(4)))
+                i += 1
+                await asyncio.sleep(0.05)
+            raise RuntimeError(f"rollout bench never reached: {desc}")
+
+        def all_on(build):
+            reps = mgr.replicas["decode"]
+            return (mgr.current_revision["decode"].build_id == build
+                    and len(reps) == 2
+                    and all(r.build_id == build and r.state == LIVE
+                            for r in reps))
+
+        def phase():
+            return (mgr.rollout.status().get("decode") or {})
+
+        async def metric(name, label_key, label_val):
+            async with session.get(router_url + "/metrics") as resp:
+                text = await resp.text()
+            for mname, labels, value in parse_prometheus_text(text):
+                if mname == name and labels.get(label_key) == label_val:
+                    return value
+            return -1.0
+
+        out = {}
+        good_results, bad_results = [], []
+        try:
+            await drive_until(lambda: all_on("v1"), good_results,
+                              30.0, "2x v1 live")
+
+            # ---- scenario A: good canary, long stream migrates ----
+            n_stream = int(stream_s * speed)
+            long_task = asyncio.ensure_future(one_request(n_stream))
+            await asyncio.sleep(0.3)  # stream in flight before roll
+            pool.revision = RevisionSpec(build_id="v2")
+            t0 = time.time()
+            await drive_until(lambda: all_on("v2"), good_results,
+                              90.0, "fleet rolled to v2")
+            out["good_roll_s"] = time.time() - t0
+            long_rec = await long_task
+            out["long_rec"] = long_rec
+            out["n_stream"] = n_stream
+            out["migrated"] = dict(
+                request_service.stream_resumes_by_outcome
+            ).get("migrated", 0)
+
+            # ---- scenario B: bad canary, judge rolls it back ------
+            pool.rollout.bake_s = 4.0
+            pool.revision = RevisionSpec(
+                build_id="v3",
+                engine_flags=["--fault", "degrade_new_revision",
+                              "--slow-ttft-s", "1.0",
+                              "--slow-itl-s", "0.05"])
+            t1 = time.time()
+            await drive_until(
+                lambda: phase().get("phase") == "rolled_back",
+                bad_results, 90.0, "bad canary rolled back")
+            out["bad_detect_s"] = time.time() - t1
+            out["bad_verdict"] = phase().get("verdict", "")
+            # The v3 canary must drain away; the stable set stays v2.
+            await drive_until(lambda: all_on("v2"), bad_results,
+                              60.0, "stable set restored on v2")
+            out["alarm"] = await metric("vllm:rollout_alarm", "pool",
+                                        "decode")
+            out["rollbacks"] = await metric(
+                "vllm:rollout_rollbacks_total", "pool", "decode")
+            # Post-rollback traffic must be back to full SLO.
+            recovery = []
+            await asyncio.gather(*(one_request(out_len, sink=recovery)
+                                   for _ in range(8)))
+            out["recovery"] = recovery
+        finally:
+            await mgr.drain_all()
+            await mgr.close()
+            await session.close()
+            await runner.cleanup()
+        out["good_results"] = good_results
+        out["bad_results"] = bad_results
+        return out
+
+    out = asyncio.run(run())
+
+    def fails(recs):
+        n_5xx = sum(1 for r in recs
+                    if r["status"] is not None and r["status"] >= 500)
+        dropped = sum(1 for r in recs if r["error"] is not None)
+        return n_5xx, dropped
+
+    expected = "".join(f"tok{i} " for i in range(out["n_stream"]))
+    long_rec = out["long_rec"]
+    byte_exact = long_rec["text"] == expected
+    good_5xx, good_dropped = fails(out["good_results"])
+    bad_5xx, bad_dropped = fails(out["bad_results"])
+    recovery = out["recovery"]
+    attainment = (sum(
+        1 for r in recovery
+        if r["status"] == 200 and r["error"] is None
+        and r["ttft"] is not None and r["ttft"] <= slo_ttft)
+        / len(recovery)) if recovery else 0.0
+    invariants = [
+        byte_exact, out["migrated"] >= 1, good_5xx == 0,
+        good_dropped == 0, out["alarm"] == 1.0,
+        out["rollbacks"] >= 1, bad_5xx == 0, bad_dropped == 0,
+        attainment >= 0.99,
+    ]
+    score = sum(invariants) / len(invariants)
+    print(json.dumps({
+        "metric": "safe-rollout bench: good canary promotes with a "
+                  "byte-exact migrated stream; bad canary auto-rolls "
+                  "back behind a latched alarm",
+        "value": round(score, 4),
+        "unit": "fraction",
+        "vs_baseline": 0.0,
+        "extra": {
+            "rollout_good_roll_s": round(out["good_roll_s"], 2),
+            "rollout_good_5xx": good_5xx,
+            "rollout_good_dropped": good_dropped,
+            "rollout_migrated_streams": out["migrated"],
+            "rollout_migrated_stream_tokens": len(
+                long_rec["text"].split()),
+            "rollout_migrated_stream_expected": out["n_stream"],
+            "rollout_migrated_byte_exact": byte_exact,
+            "rollout_detected_bad_canary": out["rollbacks"] >= 1,
+            "rollout_bad_detect_s": round(out["bad_detect_s"], 2),
+            "rollout_bad_verdict": out["bad_verdict"],
+            "rollout_alarm_latched": out["alarm"] == 1.0,
+            "rollout_rollbacks_total": out["rollbacks"],
+            "rollout_bad_5xx": bad_5xx,
+            "rollout_bad_dropped": bad_dropped,
+            "rollout_attainment_after_rollback": round(attainment, 4),
+        },
+    }))
+
+
 def run_overload_worker(mode: str) -> None:
     """QoS overload bench (docs/qos.md): router + two finite-capacity
     fake engines driven at ~2x capacity by three well-behaved
@@ -1942,6 +2245,8 @@ def main() -> None:
                 os.environ.get("BENCH_UNIFIED_MODE", "off"))
         elif impl == "autoscale":
             run_autoscale_worker()
+        elif impl == "rollout":
+            run_rollout_worker()
         elif impl == "overload":
             run_overload_worker(
                 os.environ.get("BENCH_OVERLOAD_QOS", "off"))
@@ -2131,6 +2436,24 @@ def main() -> None:
         else:
             for key, value in as_result.get("extra", {}).items():
                 if key.startswith("autoscale_"):
+                    result["extra"][key] = value
+
+        # Safe-rollout phase (docs/fleet.md): canary-scored rolling
+        # upgrade A/B over fake-engine subprocesses — a good canary
+        # promotes fleet-wide with a byte-exact migrated stream and
+        # zero 5xx, a fault-injected bad canary auto-rolls-back
+        # behind a latched alarm. Rides in extra under rollout_*.
+        sys.stderr.write(f"[bench] running rollout worker "
+                         f"(timeout {timeout}s)...\n")
+        ro_result, ro_err = _spawn_worker(
+            "rollout", False, timeout,
+            extra_env={"JAX_PLATFORMS": "cpu"})
+        if ro_result is None:
+            errors["rollout_error"] = ro_err
+            sys.stderr.write(f"[bench] WARNING: {ro_err}\n")
+        else:
+            for key, value in ro_result.get("extra", {}).items():
+                if key.startswith("rollout_"):
                     result["extra"][key] = value
 
         # QoS overload A/B (docs/qos.md): the same ~2x-capacity mixed-

@@ -342,6 +342,32 @@ def build():
               [target('vllm:engine_step_time_median_seconds',
                       "{{kind}} {{server}}")],
               12, 130, w=12, unit="s"),
+        # ---- Rolling upgrades (docs/fleet.md) -------------------------------
+        row("Rollouts", 137),
+        panel("Rollout Phase by Pool",
+              [target('vllm:rollout_phase', "{{pool}} {{phase}}")],
+              0, 138),
+        panel("Replicas by Revision",
+              [target('vllm:rollout_replicas',
+                      "{{pool}} {{revision}}")],
+              8, 138),
+        panel("Rollbacks / Alarm",
+              [target('vllm:rollout_rollbacks_total',
+                      "rollbacks {{pool}}"),
+               target('vllm:rollout_alarm', "ALARM {{pool}}")],
+              16, 138),
+        panel("Server Revision Labels",
+              [target('vllm:server_revision',
+                      "{{server}} {{revision}}")],
+              0, 145),
+        panel("Stream Resumes by Outcome (rate)",
+              [target('sum by(outcome) '
+                      '(rate(vllm:stream_resumes_total[5m]))',
+                      "{{outcome}}")],
+              8, 145),
+        panel("Server Errors (rate)",
+              [target('rate(vllm:server_errors_total[5m])')],
+              16, 145),
     ]
     return {
         "title": "TPU Stack — Serving Overview",

@@ -34,11 +34,18 @@ _HIGHER_BETTER = (
     "tok_s", "tokens_per_s", "tokens/s", "per_s", "req_per_s", "rate",
     "goodput", "mfu", "jain", "acceptance", "hit", "overlap",
     "capacity", "throughput",
+    # --worker rollout: streams carried across revisions intact, and
+    # a bad canary actually caught by the judge (docs/fleet.md).
+    "rollout_migrated", "rollout_detected", "rollout_attainment",
 )
 _LOWER_BETTER = (
     "p50", "p90", "p99", "latency", "itl", "ttft", "seconds", "_ms",
     "_s", "pad_ratio", "compile_events", "queueing", "hbm_bytes",
     "shed", "preempt",
+    # --worker rollout failure counters: client-visible errors and
+    # streams broken mid-rollout should be zero.
+    "rollout_5xx", "rollout_broken", "rollout_rollback",
+    "rollout_alarm",
 )
 
 

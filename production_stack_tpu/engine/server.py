@@ -482,7 +482,8 @@ class EngineServer:
                  pooling: str = "last",
                  profile_dir: Optional[str] = None,
                  chat_template: Optional[str] = None,
-                 drain_exit_timeout_s: float = 0.0):
+                 drain_exit_timeout_s: float = 0.0,
+                 build_id: str = ""):
         self.async_engine = AsyncEngine(engine)
         self.engine = engine
         self.model_name = served_model_name
@@ -505,6 +506,15 @@ class EngineServer:
         # requests run to completion untouched.
         self.draining = False
         self.drain_exit_timeout_s = drain_exit_timeout_s
+        # Rolling upgrades (docs/fleet.md): --build-id labels the
+        # running revision in /health and /version so the rollout
+        # controller can verify which build a replica actually runs.
+        # A migrate-mode drain flips migrate_drain: checkpointed
+        # streams are cut right after a checkpoint frame so the router
+        # resumes them on a new-revision replica instead of waiting
+        # for multi-minute streams to finish here.
+        self.build_id = build_id
+        self.migrate_drain = False
         self._active_generations = 0
         self._drain_exit_task: Optional[asyncio.Task] = None
         # QoS graceful shedding (docs/qos.md): per-priority-class count
@@ -1043,6 +1053,23 @@ class EngineServer:
                         ckpt = self.engine.take_checkpoint(seq_id)
                         if ckpt is not None:
                             await resp.write(ckpt_frame(ckpt))
+                            if self.migrate_drain:
+                                # Migrate-mode drain (docs/fleet.md):
+                                # the frame just written is the full
+                                # resume state, so cut the connection
+                                # abruptly — a clean EOF would read as
+                                # a finished stream, while an abrupt
+                                # close makes the router resume it on
+                                # another replica byte-exactly.
+                                tracer = self.engine.tracer
+                                if tracer is not None:
+                                    tracer.event(seq_id, "migrate_ship")
+                                # In-band marker: the router's config
+                                # watcher polls too slowly to classify
+                                # this cut as a migration on its own.
+                                await resp.write(b": migrating\n\n")
+                                if request.transport is not None:
+                                    request.transport.close()
 
             _, n_toks, finish_reason, _ = await consume_choice(
                 seq_id, stream, on_delta=on_delta)
@@ -1588,6 +1615,18 @@ class EngineServer:
             ckpt = self.engine.take_checkpoint(seq_id)
             if ckpt is not None:
                 await resp.write(ckpt_frame(ckpt))
+                if self.migrate_drain:
+                    # Migrate-mode drain cuts resumed legs too — a
+                    # stream can hop replicas more than once during a
+                    # rolling upgrade (docs/fleet.md).
+                    tracer = self.engine.tracer
+                    if tracer is not None:
+                        tracer.event(seq_id, "migrate_ship")
+                    # Same in-band migration marker as the original
+                    # stream leg.
+                    await resp.write(b": migrating\n\n")
+                    if request.transport is not None:
+                        request.transport.close()
 
         try:
             _, finish = await produce(emit)
@@ -1794,6 +1833,7 @@ class EngineServer:
                     "role": self.engine.config.engine_role,
                     "draining": self.draining,
                     "active_requests": self._active_generations,
+                    "build_id": self.build_id,
                 }, status=503)
             self._watchdog_tripped = False
         return web.json_response({
@@ -1801,6 +1841,7 @@ class EngineServer:
             "role": self.engine.config.engine_role,
             "draining": self.draining,
             "active_requests": self._active_generations,
+            "build_id": self.build_id,
         })
 
     def _note_watchdog_trip(self, stuck: float) -> None:
@@ -1861,6 +1902,11 @@ class EngineServer:
                 body = {}
         already = self.draining
         self.draining = True
+        if body.get("migrate"):
+            # Migrate-mode drain (docs/fleet.md): cut checkpointed
+            # streams at their next checkpoint frame so the router
+            # resumes them elsewhere instead of waiting them out.
+            self.migrate_drain = True
         if not already:
             logger.info("Drain requested: rejecting new admissions, "
                         "%d generation request(s) in flight",
@@ -2005,7 +2051,8 @@ class EngineServer:
         return web.json_response(obs.memory_report())
 
     async def version(self, request: web.Request):
-        return web.json_response({"version": __version__})
+        return web.json_response({"version": __version__,
+                                  "build_id": self.build_id})
 
     async def kv_summary_handler(self, request: web.Request):
         """Cluster KV economy (docs/kv_economy.md): the engine's live
@@ -2617,6 +2664,11 @@ def parse_args(argv=None):
                              "requests before exiting anyway (0 = "
                              "wait forever; the fleet manager applies "
                              "its own drain deadline)")
+    parser.add_argument("--build-id", type=str, default="",
+                        help="Opaque build/revision label reported in "
+                             "/health and /version; the fleet rollout "
+                             "controller uses it to verify which "
+                             "revision a replica runs (docs/fleet.md)")
     parser.add_argument("--checkpoint-interval-tokens", type=int,
                         default=0,
                         help="Every N generated tokens, ship a "
@@ -2745,7 +2797,8 @@ def main(argv=None) -> None:
         server = EngineServer(engine, served_name, pooling=args.pooling,
                           profile_dir=args.profile_dir,
                           chat_template=_load_chat_template(args),
-                          drain_exit_timeout_s=args.drain_exit_timeout_s)
+                          drain_exit_timeout_s=args.drain_exit_timeout_s,
+                          build_id=args.build_id)
         if embedder is not None:
             embedder.bridge = bridge
             server._embedder = embedder
@@ -2762,7 +2815,8 @@ def main(argv=None) -> None:
     server = EngineServer(engine, served_name, pooling=args.pooling,
                           profile_dir=args.profile_dir,
                           chat_template=_load_chat_template(args),
-                          drain_exit_timeout_s=args.drain_exit_timeout_s)
+                          drain_exit_timeout_s=args.drain_exit_timeout_s,
+                          build_id=args.build_id)
     logger.info("tpu-engine %s serving %s on %s:%d",
                 __version__, served_name, args.host, args.port)
     web.run_app(server.build_app(), host=args.host, port=args.port,

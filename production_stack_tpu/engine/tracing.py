@@ -61,6 +61,7 @@ SPAN_EVENTS = (
     "profiler_stop",
     "checkpoint_ship",
     "resume_restore",
+    "migrate_ship",
     "watchdog_trip",
     "crash_respawn",
     "finish",

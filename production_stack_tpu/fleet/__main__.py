@@ -10,7 +10,10 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import signal
+import sys
+import time
 
 from production_stack_tpu.fleet.manager import FleetManager
 from production_stack_tpu.fleet.spec import load_fleet_spec
@@ -37,7 +40,36 @@ def parse_args(argv=None) -> argparse.Namespace:
                         help="Override the spec's autoscale_interval_s")
     parser.add_argument("--drain-timeout-s", type=float, default=None,
                         help="Override the spec's drain_timeout_s")
+    parser.add_argument("--rollout-cmd", default=None,
+                        choices=("pause", "resume", "abort"),
+                        help="Instead of running the manager, write a "
+                             "rollout control command to the spec's "
+                             "rollout_control_path and exit — the "
+                             "running manager's rollout controller "
+                             "picks it up on its next reconcile tick "
+                             "(docs/fleet.md)")
+    parser.add_argument("--rollout-pool", default=None,
+                        help="Restrict --rollout-cmd to one pool "
+                             "(default: every pool with an active "
+                             "rollout)")
     return parser.parse_args(argv)
+
+
+def send_rollout_command(spec, cmd: str, pool=None) -> str:
+    """Writes the operator command file the RolloutController polls.
+    A strictly increasing ``ts`` dedupes: the controller only applies
+    commands newer than the last one it saw."""
+    path = spec.rollout_control_path
+    if not path:
+        raise SystemExit(
+            "spec has no rollout_control_path; set it to use "
+            "--rollout-cmd (docs/fleet.md)")
+    payload = {"ts": time.time(), "cmd": cmd}
+    if pool:
+        payload["pool"] = pool
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
 
 
 async def _amain(args: argparse.Namespace) -> None:
@@ -63,7 +95,14 @@ async def _amain(args: argparse.Namespace) -> None:
 
 
 def main(argv=None) -> None:
-    asyncio.run(_amain(parse_args(argv)))
+    args = parse_args(argv)
+    if args.rollout_cmd is not None:
+        spec = load_fleet_spec(args.spec)
+        path = send_rollout_command(spec, args.rollout_cmd,
+                                    pool=args.rollout_pool)
+        print(f"rollout {args.rollout_cmd} -> {path}", file=sys.stderr)
+        return
+    asyncio.run(_amain(args))
 
 
 if __name__ == "__main__":

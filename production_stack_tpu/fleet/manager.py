@@ -37,7 +37,11 @@ from production_stack_tpu.fleet.autoscaler import (
     PoolSignals,
     signals_from_router_metrics,
 )
-from production_stack_tpu.fleet.spec import FleetSpec, PoolSpec
+from production_stack_tpu.fleet.spec import (
+    FleetSpec,
+    PoolSpec,
+    RevisionSpec,
+)
 from production_stack_tpu.router.services.metrics_service import (
     fleet_crash_respawns,
     fleet_desired_replicas,
@@ -68,6 +72,12 @@ class Replica:
     state: str = STARTING
     drain_started: float = -1.0
     sigterm_sent: float = -1.0
+    # The revision this replica was spawned at (docs/fleet.md) and
+    # whether its drain runs in migrate mode (checkpointed streams
+    # proactively resumed elsewhere instead of waited out).
+    build_id: str = ""
+    rev_key: tuple = ()
+    migrate: bool = False
 
 
 class FleetManager:
@@ -99,6 +109,14 @@ class FleetManager:
             p.name: 0.0 for p in spec.pools}
         self._breaker_logged: Dict[str, bool] = {
             p.name: False for p in spec.pools}
+        # Revision each pool is currently rolled out at.  Spawns use
+        # this (so a crash respawn never jumps revisions mid-bake);
+        # the rollout controller moves it to ``pool.revision`` only
+        # once a roll completes (docs/fleet.md).
+        self.current_revision: Dict[str, RevisionSpec] = {
+            p.name: p.revision for p in spec.pools}
+        from production_stack_tpu.fleet.rollout import RolloutController
+        self.rollout = RolloutController(self)
 
     # ---- plumbing ---------------------------------------------------------
 
@@ -121,24 +139,44 @@ class FleetManager:
             f"fleet port range [{self.spec.port_start}, "
             f"{self.spec.port_end}] exhausted")
 
-    def _command(self, pool: PoolSpec, port: int) -> List[str]:
+    def _command(self, pool: PoolSpec, port: int,
+                 revision: RevisionSpec) -> List[str]:
         if pool.command:
-            return [c.format(port=port, model=pool.model, role=pool.role)
+            argv = [c.format(port=port, model=pool.model, role=pool.role)
                     for c in pool.command]
-        argv = [sys.executable, "-m", "production_stack_tpu.engine.server",
-                "--model", pool.model, "--host", "127.0.0.1",
-                "--port", str(port), "--engine-role", pool.role]
-        return argv + list(pool.engine_flags)
+        else:
+            argv = [sys.executable, "-m",
+                    "production_stack_tpu.engine.server",
+                    "--model", pool.model, "--host", "127.0.0.1",
+                    "--port", str(port), "--engine-role", pool.role]
+            argv += list(pool.engine_flags)
+        # Revision surface rides last so a revision can override the
+        # pool's base flags; --build-id makes membership verifiable at
+        # /health and /version on both engine variants.
+        argv += list(revision.engine_flags)
+        if revision.build_id:
+            argv += ["--build-id", revision.build_id]
+        return argv
 
     async def _probe_health(self, replica: Replica) -> Optional[dict]:
+        status, payload = await self._probe_health_raw(replica)
+        return payload if status == 200 else None
+
+    async def _probe_health_raw(self, replica: Replica):
+        """(HTTP status, payload) of ``GET /health`` — the payload is
+        returned even for a 503, so drain escalation can tell a
+        watchdog-wedged engine from a merely busy one.  (None, None)
+        when the replica is unreachable."""
         try:
             session = await self._http()
             async with session.get(replica.url + "/health") as resp:
-                if resp.status != 200:
-                    return None
-                return await resp.json()
+                try:
+                    payload = await resp.json()
+                except Exception:
+                    payload = None
+                return resp.status, payload
         except Exception:
-            return None
+            return None, None
 
     # ---- registration -----------------------------------------------------
 
@@ -155,18 +193,30 @@ class FleetManager:
         backends: List[str] = []
         models: List[str] = []
         roles: List[str] = []
+        revisions: List[str] = []
+        migrating: List[str] = []
         for pool in self.spec.pools:
             for replica in self.replicas[pool.name]:
                 if replica.state == LIVE:
                     backends.append(replica.url)
                     models.append(pool.model)
                     roles.append(pool.role)
+                    revisions.append(replica.build_id)
+                elif replica.state == DRAINING and replica.migrate:
+                    # Migrate-mode drains: the router classifies these
+                    # engines' mid-stream deaths as planned migrations
+                    # (resume outcome "migrated", no poison blame).
+                    migrating.append(replica.url)
         payload = {
             "service_discovery": "static",
             "routing_logic": self.spec.routing_logic,
             "static_backends": backends,
             "static_models": models,
             "static_roles": roles,
+            "static_revisions": revisions,
+            "canary_weights": self.rollout.canary_weights(),
+            "migrating": migrating,
+            "rollout_status": self.rollout.status(),
         }
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
@@ -183,47 +233,68 @@ class FleetManager:
 
     # ---- reconcile --------------------------------------------------------
 
-    def _spawn(self, pool: PoolSpec) -> Replica:
+    def _spawn(self, pool: PoolSpec,
+               revision: Optional[RevisionSpec] = None) -> Replica:
+        if revision is None:
+            revision = self.rollout.revision_for_spawn(pool)
         port = self._alloc_port()
-        argv = self._command(pool, port)
+        argv = self._command(pool, port, revision)
         process = subprocess.Popen(argv, stdout=subprocess.DEVNULL)
         replica = Replica(pool=pool.name, port=port,
-                          url=f"http://127.0.0.1:{port}", process=process)
+                          url=f"http://127.0.0.1:{port}", process=process,
+                          build_id=revision.build_id,
+                          rev_key=revision.key())
         self.replicas[pool.name].append(replica)
-        logger.info("pool %s: spawned replica %s (pid %d)",
-                    pool.name, replica.url, process.pid)
+        logger.info("pool %s: spawned replica %s (pid %d, build %r)",
+                    pool.name, replica.url, process.pid,
+                    revision.build_id)
         return replica
 
-    async def _start_drain(self, replica: Replica) -> None:
+    async def _start_drain(self, replica: Replica,
+                           migrate: bool = False) -> None:
         replica.state = DRAINING
         replica.drain_started = self._clock()
+        replica.migrate = migrate
         # Deregister before asking the engine to drain: the router must
         # stop choosing this replica before it starts 503ing admissions.
         self._write_router_config()
         try:
             session = await self._http()
-            async with session.post(replica.url + "/drain",
-                                    json={"exit": True}) as resp:
+            async with session.post(
+                    replica.url + "/drain",
+                    json={"exit": True, "migrate": migrate}) as resp:
                 await resp.read()
         except Exception as e:
             logger.warning("pool %s: drain request to %s failed: %s",
                            replica.pool, replica.url, e)
 
     async def _escalate_drain(self, replica: Replica) -> None:
-        """Post-timeout escalation. Never kills a busy engine."""
+        """Post-timeout escalation. Never kills a busy engine — unless
+        its watchdog has tripped: a wedged device step will never
+        reach idle, and waiting on it would wedge the whole rollout
+        behind one stuck replica."""
         timeout = self.spec.drain_timeout_s
         if timeout <= 0:
             return
         if self._clock() - replica.drain_started < timeout:
             return
-        payload = await self._probe_health(replica)
-        if payload is not None and payload.get("active_requests"):
+        _, payload = await self._probe_health_raw(replica)
+        wedged = (payload or {}).get("status") == "watchdog"
+        if (payload is not None and payload.get("active_requests")
+                and not wedged):
             logger.warning(
                 "pool %s: %s still has %s in-flight past the %.0fs drain "
                 "timeout; waiting (never killing a busy engine)",
                 replica.pool, replica.url,
                 payload.get("active_requests"), timeout)
             return
+        if wedged:
+            logger.warning(
+                "pool %s: %s is watchdog-wedged while draining "
+                "(stuck %.1fs); escalating despite %s in-flight",
+                replica.pool, replica.url,
+                (payload or {}).get("stuck_step_s", 0.0),
+                (payload or {}).get("active_requests", 0))
         if replica.sigterm_sent < 0:
             logger.warning("pool %s: %s idle but did not exit after "
                            "drain; sending SIGTERM",
@@ -275,6 +346,10 @@ class FleetManager:
     async def reconcile_once(self) -> None:
         """One convergence pass: reap, promote, drain, spawn."""
         changed = False
+        # The rollout controller moves first: it reads last pass's
+        # replica states, sets per-pool surge counts and the revision
+        # new spawns should run, and starts migrate-drains.
+        changed |= await self.rollout.tick()
         for pool in self.spec.pools:
             replicas = self.replicas[pool.name]
 
@@ -309,7 +384,11 @@ class FleetManager:
                 if replica.state == DRAINING:
                     await self._escalate_drain(replica)
 
-            want = self.desired[pool.name]
+            # The rollout surge rides on top of the autoscaler's
+            # desired count: the canary (and each roll step's
+            # replacement) is an extra replica, so stable capacity
+            # never dips mid-rollout.
+            want = self.desired[pool.name] + self.rollout.surge(pool.name)
             active = [r for r in replicas if r.state != DRAINING]
             while len(active) < want:
                 if not self._spawn_allowed(pool):
@@ -325,9 +404,15 @@ class FleetManager:
                 active.append(self._spawn(pool))
             # Scale down newest-first; a replica still starting never
             # served traffic, so stop those before draining live ones.
+            # During a rollout, old-revision replicas are preferred
+            # victims so a scale-down never eats the canary.
             excess = len(active) - want
-            for victim in sorted(active, key=lambda r: r.port,
-                                 reverse=True)[:max(0, excess)]:
+            target_key = self.rollout.target_key(pool.name)
+            for victim in sorted(
+                    active,
+                    key=lambda r: (target_key is not None
+                                   and r.rev_key != target_key, r.port),
+                    reverse=True)[:max(0, excess)]:
                 if victim.state == STARTING:
                     victim.process.terminate()
                     victim.state = DRAINING  # reaped next pass

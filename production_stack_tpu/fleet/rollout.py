@@ -1,0 +1,480 @@
+"""Canary-scored rolling upgrades with automatic rollback.
+
+Changing a pool's ``revision`` in the fleet spec does not restart
+anything in place.  The :class:`RolloutController` (owned by the
+:class:`~production_stack_tpu.fleet.manager.FleetManager`, ticked at
+the top of every reconcile pass) walks the pool through a surge
+rolling update:
+
+1. **canary** — one extra replica is spawned at the target revision
+   (the surge, so stable capacity never dips) and promoted LIVE;
+2. **bake** — the canary takes ``rollout.canary_weight`` of the
+   pool's dispatch traffic while the stable set serves the rest;
+3. **judge** — at the end of the bake window the canary is scored
+   against the router's own sensors: the 5m SLO burn rate, the
+   perf-drift sentinel, the canary's crash streak, its breaker
+   failure count, and its p99 latency vs the worst stable replica;
+4. **roll** — a passing canary continues the roll one replica at a
+   time (spawn-new, then drain-old in ``migrate`` mode: the old
+   replica's checkpointed streams are proactively resumed on a
+   new-revision replica via ``POST /v1/resume`` — byte-exact
+   zero-loss even for multi-minute streams);
+5. **rollback** — a failing canary is migrate-drained, the old
+   revision is respawned, and the rollout freezes behind a latched
+   alarm gauge until an operator intervenes
+   (``--rollout-cmd pause|resume|abort``, docs/fleet.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from production_stack_tpu.fleet.autoscaler import parse_prometheus_text
+from production_stack_tpu.fleet.spec import PoolSpec, RevisionSpec
+from production_stack_tpu.router.services.metrics_service import (
+    rollout_alarm,
+    rollout_phase,
+    rollout_replicas,
+    rollout_rollbacks,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+# Lifecycle of one pool's rollout; "paused" and "rolled_back" hold
+# whatever surge the underlying phase had so capacity stays stable.
+ROLLOUT_PHASES = ("idle", "canary", "bake", "roll", "paused",
+                  "rolled_back")
+
+
+@dataclass
+class _PoolRollout:
+    """Controller state for one pool."""
+
+    phase: str = "idle"
+    target: Optional[RevisionSpec] = None
+    paused_from: str = "idle"
+    bake_start: float = -1.0
+    baseline_errors: float = 0.0
+    crashes: int = 0
+    rollbacks: int = 0
+    alarm: bool = False
+    verdict: str = ""
+    # Revision keys an operator aborted; never retried until the spec
+    # names a different target.
+    abandoned: set = field(default_factory=set)
+
+
+class RolloutController:
+    """Drives every pool's revision rollout from the reconcile loop."""
+
+    def __init__(self, manager):
+        self._mgr = manager
+        self._state: Dict[str, _PoolRollout] = {
+            p.name: _PoolRollout() for p in manager.spec.pools}
+        self._last_cmd_ts = 0.0
+
+    # ---- hooks the manager reads every reconcile pass ---------------------
+
+    def surge(self, pool_name: str) -> int:
+        """Extra replicas (over the autoscaler's desired count) this
+        pool should run right now so the rollout never eats stable
+        capacity."""
+        st = self._state[pool_name]
+        phase = st.paused_from if st.phase == "paused" else st.phase
+        if phase in ("canary", "bake"):
+            return 1
+        if phase == "roll":
+            olds = [r for r in self._mgr.replicas[pool_name]
+                    if st.target is not None
+                    and r.rev_key != st.target.key()
+                    and r.state != "draining"]
+            return 1 if olds else 0
+        return 0
+
+    def revision_for_spawn(self, pool: PoolSpec) -> RevisionSpec:
+        """Which revision a new replica of *pool* should run: the
+        rollout target while rolling (and for the single canary while
+        baking — a crashed canary respawns at the target, a crashed
+        stable replica at the current revision)."""
+        st = self._state[pool.name]
+        phase = st.paused_from if st.phase == "paused" else st.phase
+        if st.target is not None:
+            if phase == "roll":
+                return st.target
+            if phase in ("canary", "bake"):
+                key = st.target.key()
+                n_target = sum(
+                    1 for r in self._mgr.replicas[pool.name]
+                    if r.rev_key == key and r.state != "draining")
+                if n_target == 0:
+                    return st.target
+        return self._mgr.current_revision[pool.name]
+
+    def target_key(self, pool_name: str) -> Optional[tuple]:
+        st = self._state[pool_name]
+        return st.target.key() if st.target is not None else None
+
+    def canary_weights(self) -> Dict[str, float]:
+        """url -> dispatch traffic share, for the router's dynamic
+        config.  Only baking canaries are weighted; once the roll is
+        on, new-revision replicas are ordinary pool members."""
+        out: Dict[str, float] = {}
+        for pool in self._mgr.spec.pools:
+            st = self._state[pool.name]
+            phase = st.paused_from if st.phase == "paused" else st.phase
+            if phase != "bake":
+                continue
+            canary = self._canary(pool.name)
+            if canary is not None and canary.state == "live":
+                out[canary.url] = pool.rollout.canary_weight
+        return out
+
+    def status(self) -> Dict[str, dict]:
+        """Per-pool rollout snapshot shipped to the router via the
+        dynamic config (stacktop renders it; docs/fleet.md)."""
+        out: Dict[str, dict] = {}
+        for pool in self._mgr.spec.pools:
+            st = self._state[pool.name]
+            if (st.phase == "idle" and not st.alarm
+                    and st.rollbacks == 0):
+                continue
+            out[pool.name] = {
+                "phase": st.phase,
+                "current_build":
+                    self._mgr.current_revision[pool.name].build_id,
+                "target_build":
+                    st.target.build_id if st.target else "",
+                "alarm": st.alarm,
+                "rollbacks": st.rollbacks,
+                "verdict": st.verdict,
+            }
+        return out
+
+    # ---- internals --------------------------------------------------------
+
+    def _canary(self, pool_name: str):
+        st = self._state[pool_name]
+        if st.target is None:
+            return None
+        key = st.target.key()
+        for replica in self._mgr.replicas[pool_name]:
+            if replica.rev_key == key and replica.state != "draining":
+                return replica
+        return None
+
+    async def _fetch_metrics(self) -> str:
+        url = self._mgr.spec.router_url
+        if not url:
+            return ""
+        try:
+            session = await self._mgr._http()
+            async with session.get(
+                    url.rstrip("/") + "/metrics") as resp:
+                return await resp.text()
+        except Exception as e:
+            logger.warning("rollout judge cannot scrape router "
+                           "metrics: %s", e)
+            return ""
+
+    async def _server_errors(self, server_url: str) -> float:
+        for name, labels, value in parse_prometheus_text(
+                await self._fetch_metrics()):
+            if (name == "vllm:server_errors_total"
+                    and labels.get("server") == server_url):
+                return value
+        return 0.0
+
+    async def _judge(self, pool: PoolSpec, st: _PoolRollout,
+                     canary) -> Optional[str]:
+        """Score the canary at the end of its bake window.  Returns a
+        failure reason, or None when every enabled signal passes."""
+        spec = pool.rollout
+        if (spec.max_crash_streak > 0
+                and st.crashes >= spec.max_crash_streak):
+            return (f"canary crashed {st.crashes}x "
+                    f"(limit {spec.max_crash_streak})")
+        text = await self._fetch_metrics()
+        burn_5m = -1.0
+        drift_tripped = []
+        errors = -1.0
+        ttft = {}
+        itl = {}
+        for name, labels, value in parse_prometheus_text(text):
+            if (name == "vllm:slo_burn_rate"
+                    and labels.get("window") == "5m"):
+                burn_5m = value
+            elif name == "vllm:perf_drift" and value > 0:
+                drift_tripped.append(labels.get("phase", "?"))
+            elif (name == "vllm:server_errors_total"
+                  and labels.get("server") == canary.url):
+                errors = value
+            elif name == "vllm:ttft_p99_seconds":
+                ttft[labels.get("server", "")] = value
+            elif name == "vllm:itl_p99_seconds":
+                itl[labels.get("server", "")] = value
+        if (spec.max_slo_burn_rate_5m > 0
+                and burn_5m > spec.max_slo_burn_rate_5m):
+            return (f"5m SLO burn rate {burn_5m:.2f} > "
+                    f"{spec.max_slo_burn_rate_5m:.2f}")
+        if spec.fail_on_perf_drift and drift_tripped:
+            return f"perf drift tripped: {sorted(drift_tripped)}"
+        if spec.max_server_errors > 0 and errors >= 0:
+            delta = errors - st.baseline_errors
+            if delta > spec.max_server_errors:
+                return (f"router charged canary with {delta:.0f} "
+                        f"failures (limit {spec.max_server_errors:.0f})")
+        if spec.max_latency_ratio > 0:
+            stable_urls = {
+                r.url for r in self._mgr.replicas[pool.name]
+                if r is not canary and r.state == "live"}
+            for label, series in (("ttft", ttft), ("itl", itl)):
+                canary_p99 = series.get(canary.url, -1.0)
+                stable_p99 = max(
+                    [series[u] for u in stable_urls
+                     if series.get(u, -1.0) > 0] or [-1.0])
+                if canary_p99 > 0 and stable_p99 > 0:
+                    ratio = canary_p99 / stable_p99
+                    if ratio > spec.max_latency_ratio:
+                        return (f"canary {label} p99 {ratio:.2f}x the "
+                                f"worst stable replica (limit "
+                                f"{spec.max_latency_ratio:.2f}x)")
+        return None
+
+    async def _rollback(self, pool: PoolSpec, st: _PoolRollout,
+                        reason: str) -> None:
+        st.verdict = reason
+        st.rollbacks += 1
+        st.alarm = True
+        st.phase = "rolled_back"
+        logger.error(
+            "pool %s: rolling back revision %r: %s (alarm latched; "
+            "--rollout-cmd resume to retry, abort to abandon)",
+            pool.name, st.target.build_id if st.target else "", reason)
+        migrate = pool.rollout.drain_mode == "migrate"
+        key = st.target.key() if st.target is not None else None
+        for replica in list(self._mgr.replicas[pool.name]):
+            if (key is not None and replica.rev_key == key
+                    and replica.state != "draining"):
+                await self._mgr._start_drain(replica, migrate=migrate)
+
+    def _poll_control(self) -> Optional[dict]:
+        path = self._mgr.spec.rollout_control_path
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except Exception:
+            return None
+        ts = float(raw.get("ts", 0.0))
+        if ts <= self._last_cmd_ts:
+            return None
+        self._last_cmd_ts = ts
+        return raw
+
+    async def _apply_command(self, cmd: dict) -> bool:
+        """pause/resume/abort from the fleet CLI (docs/fleet.md)."""
+        action = cmd.get("cmd")
+        only = cmd.get("pool")
+        changed = False
+        for pool in self._mgr.spec.pools:
+            if only and pool.name != only:
+                continue
+            st = self._state[pool.name]
+            applied = False
+            if action == "pause":
+                if st.phase in ("canary", "bake", "roll"):
+                    st.paused_from = st.phase
+                    st.phase = "paused"
+                    applied = True
+            elif action == "resume":
+                if st.phase == "paused":
+                    st.phase = st.paused_from
+                    applied = True
+                elif st.phase == "rolled_back":
+                    # Unlatch and retry the rollout from the top.
+                    st.alarm = False
+                    st.phase = "idle"
+                    st.target = None
+                    applied = True
+            elif action == "abort":
+                if st.target is not None:
+                    st.abandoned.add(st.target.key())
+                if st.phase in ("canary", "bake", "roll", "paused"):
+                    # Walk back any new-revision surplus.
+                    key = (st.target.key()
+                           if st.target is not None else None)
+                    migrate = pool.rollout.drain_mode == "migrate"
+                    for replica in list(self._mgr.replicas[pool.name]):
+                        if (key is not None and replica.rev_key == key
+                                and replica.state != "draining"):
+                            await self._mgr._start_drain(
+                                replica, migrate=migrate)
+                st.alarm = False
+                st.phase = "idle"
+                st.target = None
+                applied = True
+            if applied:
+                changed = True
+                logger.warning("pool %s: rollout command %r applied "
+                               "(phase now %s)", pool.name, action,
+                               st.phase)
+        return changed
+
+    def _refresh_gauges(self) -> None:
+        for pool in self._mgr.spec.pools:
+            st = self._state[pool.name]
+            for phase in ROLLOUT_PHASES:
+                rollout_phase.labels(
+                    pool=pool.name, phase=phase).set(
+                        1.0 if phase == st.phase else 0.0)
+            by_rev: Dict[str, int] = {}
+            for replica in self._mgr.replicas[pool.name]:
+                rev = replica.build_id or "unversioned"
+                by_rev[rev] = by_rev.get(rev, 0) + 1
+            for rev, count in by_rev.items():
+                rollout_replicas.labels(
+                    pool=pool.name, revision=rev).set(count)
+            rollout_rollbacks.labels(pool=pool.name).set(st.rollbacks)
+            rollout_alarm.labels(pool=pool.name).set(
+                1.0 if st.alarm else 0.0)
+
+    # ---- the tick ----------------------------------------------------------
+
+    async def tick(self) -> bool:
+        """One controller pass; returns True when the router's
+        dynamic config must be rewritten (membership metadata, canary
+        weights or rollout status changed)."""
+        changed = False
+        cmd = self._poll_control()
+        if cmd is not None:
+            changed |= await self._apply_command(cmd)
+        for pool in self._mgr.spec.pools:
+            changed |= await self._tick_pool(pool)
+        self._refresh_gauges()
+        return changed
+
+    async def _tick_pool(self, pool: PoolSpec) -> bool:
+        st = self._state[pool.name]
+        if st.phase in ("paused", "rolled_back"):
+            return False
+        changed = False
+        mgr = self._mgr
+        target = pool.revision
+        current = mgr.current_revision[pool.name]
+
+        if st.phase == "idle":
+            if (pool.rollout.enable
+                    and target.key() != current.key()
+                    and target.key() not in st.abandoned):
+                st.phase = "canary"
+                st.target = target
+                st.crashes = 0
+                st.verdict = ""
+                logger.info(
+                    "pool %s: rollout %r -> %r starting (canary "
+                    "surge)", pool.name, current.build_id,
+                    target.build_id)
+                changed = True
+            return changed
+
+        # The spec's target moved mid-rollout: restart from the top.
+        if st.target is not None and target.key() != st.target.key():
+            logger.warning(
+                "pool %s: rollout target changed mid-flight; "
+                "restarting rollout", pool.name)
+            st.phase = "idle"
+            st.target = None
+            return True
+
+        if st.phase == "canary":
+            canary = self._canary(pool.name)
+            if canary is not None and canary.state == "live":
+                payload = await mgr._probe_health(canary) or {}
+                reported = payload.get("build_id", "")
+                if (st.target.build_id and reported
+                        and reported != st.target.build_id):
+                    await self._rollback(
+                        pool, st,
+                        f"canary reports build {reported!r}, wanted "
+                        f"{st.target.build_id!r}")
+                    return True
+                st.phase = "bake"
+                st.bake_start = mgr._clock()
+                st.baseline_errors = await self._server_errors(
+                    canary.url)
+                logger.info(
+                    "pool %s: canary %s live at build %r; baking "
+                    "%.0fs at weight %.2f", pool.name, canary.url,
+                    st.target.build_id, pool.rollout.bake_s,
+                    pool.rollout.canary_weight)
+                changed = True
+            return changed
+
+        if st.phase == "bake":
+            canary = self._canary(pool.name)
+            if canary is None or canary.process.poll() is not None:
+                st.crashes += 1
+                if (pool.rollout.max_crash_streak > 0
+                        and st.crashes
+                        >= pool.rollout.max_crash_streak):
+                    await self._rollback(
+                        pool, st,
+                        f"canary crashed {st.crashes}x (limit "
+                        f"{pool.rollout.max_crash_streak})")
+                else:
+                    # Reconcile respawns the canary at the target
+                    # revision; re-enter bake once it is LIVE again.
+                    st.phase = "canary"
+                return True
+            if mgr._clock() - st.bake_start >= pool.rollout.bake_s:
+                reason = await self._judge(pool, st, canary)
+                if reason is None:
+                    st.phase = "roll"
+                    st.verdict = "passed"
+                    logger.info(
+                        "pool %s: canary passed; rolling revision %r "
+                        "across the pool", pool.name,
+                        st.target.build_id)
+                else:
+                    await self._rollback(pool, st, reason)
+                return True
+            return False
+
+        if st.phase == "roll":
+            key = st.target.key()
+            replicas = mgr.replicas[pool.name]
+            olds = [r for r in replicas if r.rev_key != key]
+            if not olds:
+                mgr.current_revision[pool.name] = st.target
+                st.phase = "idle"
+                st.target = None
+                logger.info(
+                    "pool %s: rollout complete; every replica on "
+                    "build %r",
+                    pool.name,
+                    mgr.current_revision[pool.name].build_id)
+                return True
+            draining_olds = [r for r in olds if r.state == "draining"]
+            live_total = sum(1 for r in replicas if r.state == "live")
+            if (not draining_olds
+                    and live_total > mgr.desired[pool.name]):
+                # One at a time: drain the oldest old-revision replica
+                # only while spare LIVE capacity covers it.
+                victim = min(
+                    (r for r in olds if r.state == "live"),
+                    key=lambda r: r.port, default=None)
+                if victim is not None:
+                    migrate = pool.rollout.drain_mode == "migrate"
+                    logger.info(
+                        "pool %s: rolling %s off build %r (%s drain)",
+                        pool.name, victim.url, victim.build_id,
+                        "migrate" if migrate else "wait")
+                    await mgr._start_drain(victim, migrate=migrate)
+                    return True
+            return False
+        return False

@@ -87,6 +87,106 @@ class AutoscalerSpec:
 
 
 @dataclass
+class RevisionSpec:
+    """What version of the engine a pool's replicas should run.
+
+    Changing a pool's revision in the spec is the rollout trigger
+    (docs/fleet.md): the reconciler does not restart anything in
+    place; the :class:`~production_stack_tpu.fleet.rollout.RolloutController`
+    walks the pool from the old revision to this one behind a scored
+    canary.  Two revisions are the same iff both ``build_id`` and
+    ``engine_flags`` match.
+    """
+
+    # Opaque build identifier (image tag, git sha).  Passed to the
+    # engine as ``--build-id`` and surfaced in its /version and
+    # /health payloads so revision membership is verifiable.
+    build_id: str = ""
+    # Extra engine flags for this revision, appended after the pool's
+    # own engine_flags (so a revision can override them).
+    engine_flags: List[str] = field(default_factory=list)
+
+    def key(self) -> tuple:
+        return (self.build_id, tuple(self.engine_flags))
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "RevisionSpec":
+        return cls(
+            build_id=str(raw.get("build_id", "")),
+            engine_flags=[str(f) for f in raw.get("engine_flags", [])],
+        )
+
+
+ROLLOUT_DRAIN_MODES = ("migrate", "wait")
+
+
+@dataclass
+class RolloutSpec:
+    """Canary judge + rollout pacing knobs for one pool.
+
+    A threshold of 0 disables that signal.  The judge scores the
+    canary once at the end of the bake window; any failing signal
+    triggers automatic rollback (docs/fleet.md).
+    """
+
+    enable: bool = True
+    # Fraction of the pool's dispatch traffic steered at the canary
+    # while it bakes (the rest goes to the stable set).
+    canary_weight: float = 0.1
+    # How long the canary takes weighted traffic before it is judged.
+    bake_s: float = 300.0
+    # Judge: fail when the fleet 5m SLO burn rate exceeds this.
+    max_slo_burn_rate_5m: float = 1.0
+    # Judge: fail when any perf-drift sentinel phase is tripped.
+    fail_on_perf_drift: bool = True
+    # Judge: fail when the canary crashed at least this many times
+    # during the bake (it is respawned at the same revision meanwhile).
+    max_crash_streak: int = 1
+    # Judge: fail when the router charged the canary with more than
+    # this many breaker failures (vllm:server_errors_total delta).
+    max_server_errors: float = 0.0
+    # Judge: fail when the canary's p99 TTFT or ITL exceeds the worst
+    # stable replica's by more than this factor.
+    max_latency_ratio: float = 0.0
+    # How old replicas are drained during the roll: "migrate"
+    # proactively resumes their checkpointed streams on new-revision
+    # replicas via POST /v1/resume (zero-loss even for multi-minute
+    # streams, docs/crash_recovery.md); "wait" lets in-flight work
+    # finish naturally before the replica exits.
+    drain_mode: str = "migrate"
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.canary_weight <= 1.0:
+            raise ValueError(
+                "rollout.canary_weight must be in (0, 1]")
+        for knob in ("bake_s", "max_slo_burn_rate_5m",
+                     "max_server_errors", "max_latency_ratio"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"rollout.{knob} must be >= 0")
+        if self.max_crash_streak < 0:
+            raise ValueError("rollout.max_crash_streak must be >= 0")
+        if self.drain_mode not in ROLLOUT_DRAIN_MODES:
+            raise ValueError(
+                f"rollout.drain_mode {self.drain_mode!r} not in "
+                f"{ROLLOUT_DRAIN_MODES}")
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "RolloutSpec":
+        return cls(
+            enable=bool(raw.get("enable", True)),
+            canary_weight=float(raw.get("canary_weight", 0.1)),
+            bake_s=float(raw.get("bake_s", 300.0)),
+            max_slo_burn_rate_5m=float(
+                raw.get("max_slo_burn_rate_5m", 1.0)),
+            fail_on_perf_drift=bool(raw.get("fail_on_perf_drift", True)),
+            max_crash_streak=int(raw.get("max_crash_streak", 1)),
+            max_server_errors=float(raw.get("max_server_errors", 0.0)),
+            max_latency_ratio=float(raw.get("max_latency_ratio", 0.0)),
+            drain_mode=str(raw.get("drain_mode", "migrate")),
+        )
+
+
+@dataclass
 class PoolSpec:
     """One named pool of interchangeable engine replicas."""
 
@@ -101,6 +201,10 @@ class PoolSpec:
     # and {role}.  Tests use this to run pools of fake engines.
     command: List[str] = field(default_factory=list)
     autoscaler: AutoscalerSpec = field(default_factory=AutoscalerSpec)
+    # Target engine revision; changing it in the spec drives a
+    # canary-scored surge rolling update (docs/fleet.md).
+    revision: RevisionSpec = field(default_factory=RevisionSpec)
+    rollout: RolloutSpec = field(default_factory=RolloutSpec)
     # Crash-loop containment (docs/crash_recovery.md): replicas that
     # exit without a drain are respawned with jittered exponential
     # backoff, and a pool seeing ``crash_loop_threshold`` crashes
@@ -150,6 +254,8 @@ class PoolSpec:
             engine_flags=[str(f) for f in raw.get("engine_flags", [])],
             command=[str(c) for c in raw.get("command", [])],
             autoscaler=AutoscalerSpec.from_dict(raw.get("autoscaler", {})),
+            revision=RevisionSpec.from_dict(raw.get("revision", {})),
+            rollout=RolloutSpec.from_dict(raw.get("rollout", {})),
             respawn_backoff_base_s=float(
                 raw.get("respawn_backoff_base_s", 1.0)),
             respawn_backoff_max_s=float(
@@ -181,6 +287,11 @@ class FleetSpec:
     drain_timeout_s: float = 120.0
     reconcile_interval_s: float = 1.0
     autoscale_interval_s: float = 5.0
+    # JSON control file the rollout controller polls for operator
+    # commands; ``python -m production_stack_tpu.fleet --rollout-cmd
+    # pause|resume|abort`` writes it (docs/fleet.md).  Empty disables
+    # the operator channel.
+    rollout_control_path: str = ""
 
     def __post_init__(self) -> None:
         if not self.pools:
@@ -214,6 +325,7 @@ class FleetSpec:
             drain_timeout_s=float(raw.get("drain_timeout_s", 120.0)),
             reconcile_interval_s=float(raw.get("reconcile_interval_s", 1.0)),
             autoscale_interval_s=float(raw.get("autoscale_interval_s", 5.0)),
+            rollout_control_path=raw.get("rollout_control_path", ""),
         )
 
     @classmethod

@@ -52,12 +52,15 @@ def build_snapshot(engine_stats: Dict[str, object],
                    endpoints: Iterable[object] = (),
                    healthy: Optional[Dict[str, bool]] = None,
                    ledger=None, archive=None, sentinel=None,
+                   rollout: Optional[dict] = None,
                    now: Optional[float] = None) -> dict:
     """The ``/cluster/status`` payload.
 
     ``engine_stats`` maps server URL -> EngineStats; ``endpoints`` are
     service-discovery EndpointInfo objects (for model/role metadata);
-    ``healthy`` maps URL -> availability from the resilience layer.
+    ``healthy`` maps URL -> availability from the resilience layer;
+    ``rollout`` is the fleet's per-pool rollout status relayed through
+    the dynamic-config file (docs/fleet.md).
     """
     now = time.time() if now is None else now
     meta: Dict[str, dict] = {}
@@ -67,6 +70,9 @@ def build_snapshot(engine_stats: Dict[str, object],
             "model": names[0] if names else None,
             "role": getattr(ep, "role", None),
         }
+        revision = getattr(ep, "revision", "")
+        if revision:
+            meta[getattr(ep, "url", "")]["revision"] = revision
     servers: Dict[str, dict] = {}
     for url in sorted(set(engine_stats) | set(meta)):
         entry = _server_entry(
@@ -87,4 +93,6 @@ def build_snapshot(engine_stats: Dict[str, object],
                                 "capacity": archive.capacity,
                                 "archived_total":
                                     archive.archived_total}
+    if rollout:
+        snap["rollout"] = rollout
     return snap

@@ -194,10 +194,16 @@ async def cluster_status(request: web.Request) -> web.Response:
     mgr = get_resilience()
     healthy = {ep.url: (mgr is None or mgr.endpoint_available(ep.url))
                for ep in endpoints}
+    from production_stack_tpu.router.dynamic_config import (
+        get_dynamic_config_watcher,
+    )
+    watcher = get_dynamic_config_watcher()
+    config = watcher.get_current_config() if watcher else None
+    rollout = config.rollout_status if config else None
     return web.json_response(build_snapshot(
         engine_stats, endpoints=endpoints, healthy=healthy,
         ledger=obs.get_slo_ledger(), archive=obs.get_slow_archive(),
-        sentinel=obs.get_drift_sentinel()))
+        sentinel=obs.get_drift_sentinel(), rollout=rollout))
 
 
 async def debug_slow(request: web.Request) -> web.Response:

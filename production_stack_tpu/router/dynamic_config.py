@@ -37,6 +37,17 @@ class DynamicRouterConfig:
     # with static_backends — the fleet manager registers disagg pools
     # through this file, so roles must survive the hot-reload path.
     static_roles: List[str] = field(default_factory=list)
+    # Build revision per backend, aligned with static_backends — the
+    # fleet rollout controller labels members so per-server gauges and
+    # stacktop can tell the canary revision from the stable one.
+    static_revisions: List[str] = field(default_factory=list)
+    # url -> dispatch traffic share for baking canaries (docs/fleet.md).
+    canary_weights: dict = field(default_factory=dict)
+    # Backends in a migrate-mode drain: their mid-stream deaths are
+    # planned migrations, not crashes (resume outcome "migrated").
+    migrating: List[str] = field(default_factory=list)
+    # Per-pool rollout snapshot for /cluster/status and stacktop.
+    rollout_status: dict = field(default_factory=dict)
     session_key: Optional[str] = None
     k8s_namespace: str = "default"
     k8s_port: int = 8000
@@ -48,6 +59,7 @@ class DynamicRouterConfig:
         backends = raw.get("static_backends", "")
         models = raw.get("static_models", "")
         roles = raw.get("static_roles", "")
+        revisions = raw.get("static_revisions", "")
         if isinstance(backends, list):
             backends = ",".join(backends)
         # Same validation/normalization as the --static-backends CLI path.
@@ -56,12 +68,21 @@ class DynamicRouterConfig:
             models = [m.strip() for m in models.split(",") if m.strip()]
         if isinstance(roles, str):
             roles = [r.strip() for r in roles.split(",") if r.strip()]
+        if isinstance(revisions, str):
+            revisions = [r.strip() for r in revisions.split(",")
+                         if r.strip()]
         return cls(
             service_discovery=raw.get("service_discovery", "static"),
             routing_logic=raw.get("routing_logic", "roundrobin"),
             static_backends=backends,
             static_models=models,
             static_roles=roles,
+            static_revisions=[str(r) for r in revisions],
+            canary_weights={
+                str(url): float(w)
+                for url, w in (raw.get("canary_weights") or {}).items()},
+            migrating=[str(u) for u in raw.get("migrating", [])],
+            rollout_status=raw.get("rollout_status") or {},
             session_key=raw.get("session_key"),
             k8s_namespace=raw.get("k8s_namespace", "default"),
             k8s_port=int(raw.get("k8s_port", 8000)),
@@ -75,6 +96,10 @@ class DynamicRouterConfig:
             "static_backends": self.static_backends,
             "static_models": self.static_models,
             "static_roles": self.static_roles,
+            "static_revisions": self.static_revisions,
+            "canary_weights": self.canary_weights,
+            "migrating": self.migrating,
+            "rollout_status": self.rollout_status,
             "session_key": self.session_key,
         }
 
@@ -82,6 +107,8 @@ class DynamicRouterConfig:
 def apply_dynamic_config(config: DynamicRouterConfig) -> None:
     from production_stack_tpu.router.routing.logic import (
         reconfigure_routing_logic,
+        set_canary_weights,
+        set_migrating_urls,
     )
     from production_stack_tpu.router.service_discovery import (
         reconfigure_service_discovery,
@@ -92,6 +119,7 @@ def apply_dynamic_config(config: DynamicRouterConfig) -> None:
             "static", urls=config.static_backends,
             models=config.static_models or None,
             roles=config.static_roles or None,
+            revisions=config.static_revisions or None,
         )
     else:
         reconfigure_service_discovery(
@@ -101,6 +129,8 @@ def apply_dynamic_config(config: DynamicRouterConfig) -> None:
     reconfigure_routing_logic(
         config.routing_logic, session_key=config.session_key
     )
+    set_canary_weights(config.canary_weights)
+    set_migrating_urls(config.migrating)
 
 
 class DynamicConfigWatcher(metaclass=SingletonMeta):

@@ -121,6 +121,10 @@ class CircuitBreaker:
         self._consecutive_opens = 0
         self._half_open_inflight = 0
         self.opens_total = 0
+        # Cumulative failures charged to this endpoint — exported as
+        # vllm:server_errors_total; the rollout judge reads a canary's
+        # bake-window delta of it (docs/fleet.md).
+        self.failures_total = 0
 
     @property
     def state(self) -> BreakerState:
@@ -184,6 +188,7 @@ class CircuitBreaker:
 
     def record_failure(self) -> None:
         with self._lock:
+            self.failures_total += 1
             if self._state == BreakerState.HALF_OPEN:
                 self._half_open_inflight = max(
                     0, self._half_open_inflight - 1)

@@ -44,6 +44,51 @@ logger = init_logger(__name__)
 
 RouteResult = Union[str, "asyncio.Future[str]"]
 
+# -- canary traffic weighting (fleet rollouts, docs/fleet.md) ---------------
+# url -> dispatch traffic share for baking canaries, and the set of
+# backends in a migrate-mode drain. Both are pushed by the dynamic
+# config (apply_dynamic_config) whenever the fleet rewrites its file.
+_canary_weights: Dict[str, float] = {}
+_migrating_urls: frozenset = frozenset()
+_canary_rng = random.Random()
+
+
+def set_canary_weights(weights: Optional[Dict[str, float]]) -> None:
+    global _canary_weights
+    _canary_weights = dict(weights or {})
+
+
+def set_migrating_urls(urls) -> None:
+    global _migrating_urls
+    _migrating_urls = frozenset(urls or ())
+
+
+def get_migrating_urls() -> frozenset:
+    """Backends whose mid-stream deaths are planned migrations: the
+    failover path resumes their streams elsewhere under the
+    ``migrated`` outcome instead of charging a crash."""
+    return _migrating_urls
+
+
+def canary_split(candidates: List[EndpointInfo]) -> List[EndpointInfo]:
+    """Steer one dispatch between baking canaries and the stable set.
+
+    With probability equal to its weight a canary takes the request
+    (the candidate list collapses to canaries only); otherwise canaries
+    drop out so the stable set keeps serving the remainder. Only the
+    initial dispatch is weighted — retry/failover/resume paths pass
+    their candidates straight to the policy so a struggling stable set
+    can still fail over onto a healthy canary."""
+    if not _canary_weights or not candidates:
+        return candidates
+    canaries = [ep for ep in candidates if ep.url in _canary_weights]
+    if not canaries or len(canaries) == len(candidates):
+        return candidates
+    weight = max(_canary_weights[ep.url] for ep in canaries)
+    if _canary_rng.random() < weight:
+        return canaries
+    return [ep for ep in candidates if ep.url not in _canary_weights]
+
 
 def usable_endpoints(endpoints: List[EndpointInfo],
                      exclude=()) -> List[EndpointInfo]:

@@ -39,6 +39,9 @@ class EndpointInfo:
     # the role only steers the router's two-hop disagg dispatch — so
     # engines that predate role reporting default to "both".
     role: str = "both"
+    # Build revision serving on this endpoint (fleet rollouts,
+    # docs/fleet.md); empty for unversioned deployments.
+    revision: str = ""
 
     def serves_model(self, model: str) -> bool:
         if model in self.model_names:
@@ -89,7 +92,8 @@ class StaticServiceDiscovery(ServiceDiscovery):
 
     def __init__(self, urls: List[str],
                  models: Optional[List[str]] = None,
-                 roles: Optional[List[str]] = None):
+                 roles: Optional[List[str]] = None,
+                 revisions: Optional[List[str]] = None):
         if models and len(models) != len(urls):
             raise ValueError(
                 "static models list must match static backends list"
@@ -97,6 +101,10 @@ class StaticServiceDiscovery(ServiceDiscovery):
         if roles and len(roles) != len(urls):
             raise ValueError(
                 "static roles list must match static backends list"
+            )
+        if revisions and len(revisions) != len(urls):
+            raise ValueError(
+                "static revisions list must match static backends list"
             )
         if roles:
             for role in roles:
@@ -112,6 +120,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 model_names=[models[i]] if models else [],
                 added_timestamp=now,
                 role=roles[i] if roles else "both",
+                revision=revisions[i] if revisions else "",
             )
             for i, url in enumerate(urls)
         ]
@@ -344,6 +353,7 @@ def initialize_service_discovery(discovery_type: str,
         holder.instance = StaticServiceDiscovery(
             urls=kwargs["urls"], models=kwargs.get("models"),
             roles=kwargs.get("roles"),
+            revisions=kwargs.get("revisions"),
         )
     else:
         holder.instance = K8sServiceDiscovery(

@@ -278,6 +278,29 @@ fleet_scale_events = Gauge(
     "Fleet-manager scale decisions applied per pool and direction",
     ["pool", "direction"])
 
+# -- canary rollouts (fleet/rollout.py, docs/fleet.md) ----------------------
+rollout_phase = Gauge(
+    "vllm:rollout_phase",
+    "Rollout controller phase per pool as a one-hot labeled gauge "
+    "(idle/canary/bake/roll/paused/rolled_back)", ["pool", "phase"])
+rollout_replicas = Gauge(
+    "vllm:rollout_replicas",
+    "Replica count per pool and build revision during rollouts",
+    ["pool", "revision"])
+rollout_rollbacks = Gauge(
+    "vllm:rollout_rollbacks_total",
+    "Automatic rollbacks the rollout controller has executed per pool",
+    ["pool"])
+rollout_alarm = Gauge(
+    "vllm:rollout_alarm",
+    "1 while a pool's rollout is frozen behind a failed canary; "
+    "latched until an operator resumes or aborts (docs/fleet.md)",
+    ["pool"])
+server_revision = Gauge(
+    "vllm:server_revision",
+    "Build revision serving on each endpoint as a one-hot labeled "
+    "info gauge", ["server", "revision"])
+
 # -- resilience layer (router/resilience.py) --------------------------------
 circuit_breaker_state = Gauge(
     "vllm:circuit_breaker_state",
@@ -286,6 +309,11 @@ circuit_breaker_state = Gauge(
 circuit_breaker_opens = Gauge(
     "vllm:circuit_breaker_opens_total",
     "Times this endpoint's circuit breaker has opened", _LBL)
+server_errors = Gauge(
+    "vllm:server_errors_total",
+    "Failures the router has charged to this endpoint's circuit "
+    "breaker (the rollout judge reads the canary's bake-window delta)",
+    _LBL)
 endpoint_healthy = Gauge(
     "vllm:endpoint_healthy",
     "Active health-probe verdict per endpoint (1=healthy)", _LBL)
@@ -583,6 +611,9 @@ def refresh_gauges() -> None:
                 include_unhealthy=True):
             up = mgr is None or mgr.endpoint_available(ep.url)
             healthy_pods_total.labels(server=ep.url).set(1 if up else 0)
+            if getattr(ep, "revision", ""):
+                server_revision.labels(
+                    server=ep.url, revision=ep.revision).set(1)
     except ValueError:
         pass
     if mgr is not None:
@@ -591,6 +622,8 @@ def refresh_gauges() -> None:
                 int(breaker.state))
             circuit_breaker_opens.labels(server=url).set(
                 breaker.opens_total)
+            server_errors.labels(server=url).set(
+                breaker.failures_total)
         if mgr.health is not None:
             for url, st in mgr.health.snapshot().items():
                 endpoint_healthy.labels(server=url).set(

@@ -134,11 +134,18 @@ class _SseRelay:
     has (docs/crash_recovery.md)."""
 
     _CKPT_PREFIX = b": checkpoint "
+    # In-band cut marker written by a migrate-draining engine right
+    # before it severs the connection (docs/fleet.md). The router's
+    # dynamic-config migrating list races the engine's cut (the engine
+    # closes milliseconds after the drain POST; the config watcher
+    # polls), so the marker travels in the stream itself.
+    _MIGRATE_MARKER = b": migrating"
 
     def __init__(self):
         self.buf = bytearray()
         self.descriptor: Optional[dict] = None
         self.delivered_chars = 0
+        self.migrating = False
 
     def feed(self, chunk: bytes) -> bytes:
         self.buf.extend(chunk)
@@ -155,6 +162,9 @@ class _SseRelay:
                         event[len(self._CKPT_PREFIX):].decode())
                 except (ValueError, UnicodeDecodeError):
                     pass
+                continue
+            if event.rstrip(b"\n") == self._MIGRATE_MARKER:
+                self.migrating = True
                 continue
             self._count(event)
             out.extend(event)
@@ -204,10 +214,14 @@ class _BackendStreamError(Exception):
     on a healthy replacement (docs/crash_recovery.md)."""
 
     def __init__(self, reason: str, response: web.StreamResponse,
-                 relay: "Optional[_SseRelay]" = None):
+                 relay: "Optional[_SseRelay]" = None,
+                 url: Optional[str] = None):
         super().__init__(reason)
         self.response = response
         self.relay = relay
+        # The backend that died: _failover_stream classifies a death
+        # on a migrate-draining backend as a planned migration.
+        self.url = url
 
 
 class _ClientDisconnectedError(Exception):
@@ -344,12 +358,25 @@ async def _capture_slow_exemplar(app: web.Application, server_url: str,
                 payload = await resp.json()
                 engine_spans = [s for s in payload.get("spans", [])
                                 if isinstance(s, dict)]
+    except asyncio.CancelledError:
+        # The capture task raced the replica's exit (a drain tore the
+        # session down): the router-side half still archives below.
+        logger.debug("Slow-exemplar trace fetch from %s for %s "
+                     "cancelled mid-pull", server_url, request_id)
     except Exception as e:
         logger.debug("Slow-exemplar trace fetch from %s for %s "
                      "failed: %s", server_url, request_id, e)
     spans = [router_span] + engine_spans
-    entry["spans"] = spans
-    entry["waterfall"] = render_waterfall(spans, request_id)
+    try:
+        entry["spans"] = spans
+        entry["waterfall"] = render_waterfall(spans, request_id)
+    except Exception as e:
+        # Malformed engine spans must not cost the exemplar: fall back
+        # to the router-side waterfall alone.
+        logger.debug("Slow-exemplar waterfall stitch for %s failed "
+                     "(%s); archiving router span only", request_id, e)
+        entry["spans"] = [router_span]
+        entry["waterfall"] = render_waterfall([router_span], request_id)
     archive.add(entry)
 
 
@@ -374,6 +401,7 @@ async def route_general_request(request: web.Request,
                                 endpoint_path: str) -> web.StreamResponse:
     """Proxy one OpenAI-API request to a chosen engine, streaming back."""
     from production_stack_tpu.router.routing.logic import (
+        canary_split,
         filter_by_role,
         get_routing_logic,
         usable_endpoints,
@@ -520,6 +548,10 @@ async def route_general_request(request: web.Request,
             candidates = usable_endpoints(healthy, exclude=tried)
             if not candidates:
                 break
+            if attempts == 0:
+                # Canary traffic weighting applies to the initial
+                # dispatch only; failover keeps the whole pool.
+                candidates = canary_split(candidates)
             engine_stats = get_engine_stats_scraper().get_engine_stats()
             request_stats = monitor.get_request_stats(time.time())
             choice = policy.route_request(
@@ -582,7 +614,7 @@ async def route_general_request(request: web.Request,
                 # never a silent truncation (docs/crash_recovery.md).
                 return await _failover_stream(
                     request, e, request_id, healthy,
-                    tried | {server_url}, mgr)
+                    tried | {server_url}, mgr, model=model)
             except _ClientDisconnectedError as e:
                 # Routine client disconnect: nothing to send and nobody
                 # to send it to — end quietly instead of surfacing a 500.
@@ -793,7 +825,8 @@ async def _route_disagg(request: web.Request, body: bytes, payload: dict,
             # path.
             return await _failover_stream(
                 request, e, request_id, decode_pool,
-                tried | {server_url}, mgr)
+                tried | {server_url}, mgr,
+                model=payload.get("model"))
         except _ClientDisconnectedError as e:
             if e.response is not None:
                 return e.response
@@ -852,6 +885,9 @@ async def _pipe_resume(request: web.Request, server_url: str,
     # Any half-event from the dead backend is re-emitted whole by the
     # replacement (delivered_chars only counts complete events).
     relay.buf.clear()
+    # Fresh leg, fresh verdict: only this leg's own migrate marker may
+    # classify its death as a planned migration.
+    relay.migrating = False
     blame: Optional[bool] = None
     try:
         async with session.post(
@@ -876,7 +912,7 @@ async def _pipe_resume(request: web.Request, server_url: str,
                     blame = True
                     raise _BackendStreamError(
                         f"{type(e).__name__}: {e}", response,
-                        relay=relay) from e
+                        relay=relay, url=server_url) from e
                 out = relay.feed(chunk)
                 if not out:
                     continue
@@ -914,7 +950,8 @@ async def _pipe_resume(request: web.Request, server_url: str,
 async def _failover_stream(request: web.Request,
                            err: _BackendStreamError, request_id: str,
                            pool, exclude: set,
-                           mgr) -> web.StreamResponse:
+                           mgr, model: Optional[str] = None
+                           ) -> web.StreamResponse:
     """Mid-stream failover (docs/crash_recovery.md): the backend died
     after bytes reached the client. When the relay captured a
     checkpoint descriptor, resume the stream byte-exactly on a healthy
@@ -923,14 +960,44 @@ async def _failover_stream(request: web.Request,
     request must not take down the whole pool. Every unrecoverable
     path ends the stream with a terminal in-band error event."""
     from production_stack_tpu.router.routing.logic import (
+        get_migrating_urls,
         usable_endpoints,
     )
     global poison_quarantines_total
     response, relay = err.response, err.relay
     exclude = set(exclude)
+    roles = {getattr(ep, "role", "both") for ep in pool}
+
+    def live_pool():
+        """Resume candidates must come from *live* discovery, not the
+        dispatch-time snapshot: replicas added after dispatch — exactly
+        the new-revision replicas a migrate-mode rollout drains onto
+        (docs/fleet.md) — are invisible to the snapshot. Falls back to
+        the snapshot when discovery is empty or unavailable."""
+        try:
+            live = [ep for ep in get_service_discovery().get_endpoint_info()
+                    if getattr(ep, "role", "both") in roles
+                    and (model is None or ep.serves_model(model))]
+        except Exception:
+            return pool
+        return live or pool
+
     try:
         while True:
-            crashes = _note_crash(request_id)
+            # A stream cut by a migrate-draining backend is a planned
+            # migration (fleet rollouts, docs/fleet.md): no crash blame
+            # toward poison quarantine, and the resume lands under the
+            # "migrated" outcome. The in-band marker from the engine's
+            # drain cut is authoritative; the dynamic-config migrating
+            # list backs it up for engines that predate the marker.
+            migration = ((relay is not None
+                          and getattr(relay, "migrating", False))
+                         or (err.url is not None
+                             and err.url in get_migrating_urls()))
+            if migration:
+                crashes = _poison_crashes.get(request_id, 0)
+            else:
+                crashes = _note_crash(request_id)
             if relay is None or relay.descriptor is None:
                 _bump_resume("no_checkpoint")
                 return await _terminal_sse_error(
@@ -948,13 +1015,20 @@ async def _failover_stream(request: web.Request,
                     f"request quarantined after {crashes} engine "
                     f"crashes")
             while True:
-                candidates = usable_endpoints(pool, exclude=exclude)
+                candidates = usable_endpoints(live_pool(),
+                                              exclude=exclude)
                 if not candidates:
                     _bump_resume("exhausted")
                     return await _terminal_sse_error(
                         request, response, relay,
                         "upstream engine died mid-stream and no "
                         "healthy replacement accepted the resume")
+                # Prefer backends that are not themselves mid-migrate:
+                # a migrated stream must land on a replica that will
+                # outlive it.
+                migrating = get_migrating_urls()
+                candidates = sorted(
+                    candidates, key=lambda ep: ep.url in migrating)
                 server_url = candidates[0].url
                 if mgr is not None and not mgr.on_attempt(server_url):
                     exclude.add(server_url)
@@ -975,12 +1049,14 @@ async def _failover_stream(request: web.Request,
                     exclude.add(server_url)
                     err = e
                     break  # outer loop: record the new crash
-                _bump_resume("resumed")
+                _bump_resume("migrated" if migration else "resumed")
                 if mgr is not None:
                     mgr.failovers_total += 1
-                logger.info("Resumed stream %s on %s (%d chars "
-                            "already delivered)", request_id,
-                            server_url, relay.delivered_chars)
+                logger.info("%s stream %s on %s (%d chars "
+                            "already delivered)",
+                            "Migrated" if migration else "Resumed",
+                            request_id, server_url,
+                            relay.delivered_chars)
                 return response
     except _ClientDisconnectedError:
         return response
@@ -1118,7 +1194,7 @@ async def _proxy_stream(request: web.Request, server_url: str,
                     # and hand the relay up for a checkpoint resume.
                     raise _BackendStreamError(
                         f"{type(e).__name__}: {e}", response,
-                        relay=relay) from e
+                        relay=relay, url=server_url) from e
                 if relay is not None:
                     chunk = relay.feed(chunk)
                 if not chunk:

@@ -74,6 +74,18 @@ def render_snapshot(snap: dict, changed: Optional[set] = None) -> str:
             f"/{arch.get('capacity', 0)} "
             f"({arch.get('archived_total', 0)} archived)")
 
+    rollout = snap.get("rollout") or {}
+    for pool, info in sorted(rollout.items()):
+        line = (f"rollout {pool}: {info.get('phase', '-')} "
+                f"{info.get('current_build') or '-'} -> "
+                f"{info.get('target_build') or '-'} "
+                f"rollbacks={info.get('rollbacks', 0)}")
+        if info.get("verdict"):
+            line += f" verdict={info['verdict']}"
+        if info.get("alarm"):
+            line += "  ALARM"
+        lines.append(line)
+
     servers = snap.get("servers") or {}
     if servers:
         lines.append("")
@@ -88,7 +100,7 @@ def render_snapshot(snap: dict, changed: Optional[set] = None) -> str:
             shed = sum((s.get("qos_shed") or {}).values())
             compiles = sum((s.get("compile_events") or {}).values())
             mark = "*" if url in changed else " "
-            lines.append(
+            row = (
                 f"{url:<41}{mark} {health:<7} "
                 f"{str(s.get('role') or '-'):<7} "
                 f"{_fmt(s.get('running'), 4)} "
@@ -97,6 +109,11 @@ def render_snapshot(snap: dict, changed: Optional[set] = None) -> str:
                 f"{s.get('prefix_hit_rate', 0.0):>6.2f} "
                 f"{s.get('mfu', 0.0):>6.2f} "
                 f"{shed:>5} {compiles:>8}")
+            # Revision suffix only during rollouts, so the plain table
+            # stays byte-stable for the golden tests.
+            if s.get("revision"):
+                row += f" rev={s['revision']}"
+            lines.append(row)
     return "\n".join(lines)
 
 

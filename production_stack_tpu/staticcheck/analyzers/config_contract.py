@@ -73,6 +73,8 @@ _FLEET_SECTION_CLASSES = {
     "": "FleetSpec",
     "pools[].": "PoolSpec",
     "pools[].autoscaler.": "AutoscalerSpec",
+    "pools[].revision.": "RevisionSpec",
+    "pools[].rollout.": "RolloutSpec",
 }
 
 

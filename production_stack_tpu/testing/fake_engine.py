@@ -44,6 +44,12 @@ Fault injection (for resilience tests): ``--fault MODE`` at startup or
 - ``slow_itl``       SLO-breach timing fault: every streamed token
                      takes ``--slow-itl-s`` seconds instead of
                      ``1/speed``
+- ``degrade_new_revision``  rollout-canary fault bundle
+                     (docs/fleet.md): slow_ttft AND slow_itl at once
+                     while /health stays green — the shape of a bad
+                     build that boots fine but serves badly, which
+                     only the rollout judge's bake-window scoring
+                     catches
 - ``null``/absent    healthy (clears a previously set fault)
 
 Disaggregation (docs/disaggregation.md): ``--role prefill|decode|both``
@@ -101,7 +107,7 @@ from production_stack_tpu.qos import (
 FAULT_MODES = (
     "error500", "hang", "slow_first_token", "abort_mid_stream", "crash",
     "hang_step", "unhealthy", "kv_missing", "overload",
-    "slow_ttft", "slow_itl",
+    "slow_ttft", "slow_itl", "degrade_new_revision",
 )
 
 ENGINE_ROLES = ("prefill", "decode", "both")
@@ -170,7 +176,8 @@ class FakeEngineState:
                  checkpoint_interval: int = 0,
                  crash_after_tokens: int = 4,
                  kv_hot_capacity: int = 128,
-                 kv_total_pages: int = 512):
+                 kv_total_pages: int = 512,
+                 build_id: str = ""):
         self.model = model
         self.speed = speed  # tokens per second
         self.ttft = ttft  # seconds before first token
@@ -190,6 +197,14 @@ class FakeEngineState:
         self.disagg_prefills = 0  # descriptors emitted
         self.disagg_decodes = 0  # handoffs streamed
         self.draining = False  # POST /drain flips; 503s new admissions
+        # Migrate-mode drain (fleet rollouts, docs/fleet.md): in-flight
+        # checkpointed streams are cut at their next checkpoint
+        # boundary so the router resumes them on a live replica instead
+        # of waiting out multi-minute generations.
+        self.migrate_drain = False
+        # Build revision reported in /version and /health so rollout
+        # tests and bench can assert revision membership.
+        self.build_id = build_id
         self.cache_usage = None  # POST /gauges override; None = derived
         # QoS (docs/qos.md): when priority-aware the fake reads the
         # x-priority header; the overload fault sheds non-interactive
@@ -418,10 +433,12 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     ttft_eff = state.ttft * (1.0 - 0.9 * hit_frac)
     # SLO-breach timing faults: breach-but-succeed, so the router's
     # SLO ledger classifies a completed request as bad and captures
-    # its exemplar (docs/observability.md).
-    if state.fault == "slow_ttft":
+    # its exemplar (docs/observability.md). degrade_new_revision is
+    # both at once — a bad build that boots healthy but serves badly.
+    if state.fault in ("slow_ttft", "degrade_new_revision"):
         ttft_eff += state.slow_ttft_s
-    tok_delay = (state.slow_itl_s if state.fault == "slow_itl"
+    tok_delay = (state.slow_itl_s
+                 if state.fault in ("slow_itl", "degrade_new_revision")
                  else 1.0 / state.speed)
     words = [f"tok{i} " for i in range(n_tokens)]
     tracer, arrival = state.tracer, time.time()
@@ -504,6 +521,28 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                     and (i + 1) % state.checkpoint_interval == 0):
                 await resp.write(_ckpt_frame(request_id, model,
                                              n_tokens, i + 1))
+                if state.migrate_drain and i + 1 < n_tokens:
+                    # Migrate-mode drain cut (docs/fleet.md): the
+                    # checkpoint just shipped; dropping the socket
+                    # abruptly (no FIN handshake semantics a client
+                    # would read as completion) makes the router
+                    # resume the stream byte-exactly on a live
+                    # replica instead of waiting this one out.
+                    if tracer is not None:
+                        tracer.event(request_id, "migrate_ship",
+                                     tokens_done=i + 1)
+                        tracer.finish(request_id, reason="migrate",
+                                      arrival_ts=arrival,
+                                      first_token_ts=first_ts,
+                                      prompt_tokens=8,
+                                      output_tokens=i + 1)
+                    # In-band marker so the router classifies this cut
+                    # as a migration even before its dynamic-config
+                    # watcher observes the migrating list.
+                    await resp.write(b": migrating\n\n")
+                    if request.transport is not None:
+                        request.transport.close()
+                    return resp
         await resp.write(_sse(_chunk(request_id, model, None,
                                      finish="stop")))
         await resp.write(b"data: [DONE]\n\n")
@@ -544,9 +583,11 @@ async def completions(request: web.Request) -> web.Response:
         # Same SLO-breach timing faults as chat_completions: the whole
         # body is delayed by the faulted ttft + per-token cadence.
         ttft_eff = state.ttft * (1.0 - 0.9 * hit_frac)
-        if state.fault == "slow_ttft":
+        if state.fault in ("slow_ttft", "degrade_new_revision"):
             ttft_eff += state.slow_ttft_s
-        tok_delay = (state.slow_itl_s if state.fault == "slow_itl"
+        tok_delay = (state.slow_itl_s
+                     if state.fault in ("slow_itl",
+                                        "degrade_new_revision")
                      else 1.0 / state.speed)
         await asyncio.sleep(ttft_eff + n_tokens * tok_delay)
         state.total_served += 1
@@ -796,6 +837,22 @@ async def resume(request: web.Request) -> web.StreamResponse:
                     and (i + 1) % state.checkpoint_interval == 0):
                 await resp.write(_ckpt_frame(request_id, model,
                                              n_tokens, i + 1))
+                if state.migrate_drain and i + 1 < n_tokens:
+                    # Same migrate cut as chat_completions: a resumed
+                    # stream can migrate onward mid-roll.
+                    if tracer is not None:
+                        tracer.event(request_id, "migrate_ship",
+                                     tokens_done=i + 1)
+                        tracer.finish(request_id, reason="migrate",
+                                      arrival_ts=arrival,
+                                      prompt_tokens=8,
+                                      output_tokens=i + 1)
+                    # Same in-band migration marker as the original
+                    # stream leg.
+                    await resp.write(b": migrating\n\n")
+                    if request.transport is not None:
+                        request.transport.close()
+                    return resp
         await resp.write(_sse(_chunk(request_id, model, None,
                                      finish="stop")))
         await resp.write(b"data: [DONE]\n\n")
@@ -837,6 +894,7 @@ async def health(request: web.Request) -> web.Response:
             "role": state.role,
             "draining": state.draining,
             "active_requests": state.running,
+            "build_id": state.build_id,
         }, status=503)
     if state.fault == "hang":
         await asyncio.sleep(3600)
@@ -845,6 +903,7 @@ async def health(request: web.Request) -> web.Response:
         "role": state.role,
         "draining": state.draining,
         "active_requests": state.running,
+        "build_id": state.build_id,
     })
 
 
@@ -860,6 +919,8 @@ async def drain(request: web.Request) -> web.Response:
         except Exception:
             body = {}
     state.draining = True
+    if body.get("migrate"):
+        state.migrate_drain = True
     if body.get("exit"):
         async def exit_when_idle():
             import os
@@ -1096,8 +1157,11 @@ async def debug_compiles(request: web.Request) -> web.Response:
 
 async def version(request: web.Request) -> web.Response:
     """GET /version: same shape as the real server (the package
-    version — the fake IS this package)."""
-    return web.json_response({"version": __version__})
+    version — the fake IS this package), plus the deployed build id
+    for rollout membership checks."""
+    state: FakeEngineState = request.app["state"]
+    return web.json_response({"version": __version__,
+                              "build_id": state.build_id})
 
 
 async def debug_steps(request: web.Request) -> web.Response:
@@ -1143,7 +1207,8 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
                       checkpoint_interval: int = 0,
                       crash_after_tokens: int = 4,
                       kv_hot_capacity: int = 128,
-                      kv_total_pages: int = 512) -> web.Application:
+                      kv_total_pages: int = 512,
+                      build_id: str = "") -> web.Application:
     state = FakeEngineState(model=model, speed=speed, ttft=ttft,
                             fault=fault, fault_ttft=fault_ttft,
                             role=role, priority_aware=priority_aware,
@@ -1151,7 +1216,8 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
                             checkpoint_interval=checkpoint_interval,
                             crash_after_tokens=crash_after_tokens,
                             kv_hot_capacity=kv_hot_capacity,
-                            kv_total_pages=kv_total_pages)
+                            kv_total_pages=kv_total_pages,
+                            build_id=build_id)
     if span_log or trace_ring > 0:
         # Same default as the real server: flight recorder on, span
         # log only when a path is given.
@@ -1235,6 +1301,11 @@ def main(argv=None) -> None:
                              "budget")
     parser.add_argument("--kv-total-pages", type=int, default=512,
                         help="total_pages reported by GET /kv/summary")
+    parser.add_argument("--build-id", default="",
+                        help="Build revision reported in /version and "
+                             "/health, like the real engine's flag — "
+                             "rollout tests assert revision membership "
+                             "with it (docs/fleet.md)")
     args = parser.parse_args(argv)
     app = build_fake_engine(args.model, args.speed, args.ttft,
                             fault=args.fault, fault_ttft=args.fault_ttft,
@@ -1245,7 +1316,8 @@ def main(argv=None) -> None:
                                 args.checkpoint_interval_tokens),
                             crash_after_tokens=args.crash_after_tokens,
                             kv_hot_capacity=args.kv_hot_capacity,
-                            kv_total_pages=args.kv_total_pages)
+                            kv_total_pages=args.kv_total_pages,
+                            build_id=args.build_id)
     app["state"].slow_ttft_s = args.slow_ttft_s
     app["state"].slow_itl_s = args.slow_itl_s
     web.run_app(app, host=args.host, port=args.port, print=None)

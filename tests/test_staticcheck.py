@@ -545,6 +545,50 @@ def test_config_contract_catches_fleet_spec_drift():
     assert "unknown fleet spec field ghost_field" in messages
 
 
+def test_config_contract_catches_rollout_spec_drift():
+    """The rollout/revision sub-specs (docs/fleet.md) are contract
+    surface too: an undocumented or unparsed RolloutSpec/RevisionSpec
+    field must be flagged under its pools[].rollout. / pools[].revision.
+    spec path."""
+    fixture = dict(_CONFIG_FIXTURE)
+    fixture["production_stack_tpu/fleet/spec.py"] = textwrap.dedent("""\
+        FLEET_INTERNAL_FIELDS = ()
+
+        class RevisionSpec:
+            build_id: str = ""
+
+        class RolloutSpec:
+            canary_weight: float = 0.1
+            secret_rollout_knob: float = 0.0
+
+        class PoolSpec:
+            name: str = ""
+            revision: RevisionSpec = None
+            rollout: RolloutSpec = None
+
+        class FleetSpec:
+            pools: list = None
+
+        def from_dict(raw):
+            return (raw.get("pools"), raw.get("name"),
+                    raw.get("revision"), raw.get("rollout"),
+                    raw.get("build_id"), raw.get("canary_weight"))
+        """)
+    fixture["docs/fleet.md"] = (
+        "pools name revision rollout build_id canary_weight\n")
+    findings = _run(fixture, "config-contract")
+    messages = "\n".join(f.message for f in findings)
+    # The planted knob is neither parseable from a spec file...
+    assert ("fleet spec field pools[].rollout.secret_rollout_knob is "
+            "never parsed" in messages)
+    # ...nor documented in docs/fleet.md.
+    assert ("fleet spec field pools[].rollout.secret_rollout_knob is "
+            "not documented" in messages)
+    # The documented, parsed fields stay clean.
+    assert "pools[].rollout.canary_weight" not in messages
+    assert "pools[].revision.build_id" not in messages
+
+
 # ---- kv-parity ---------------------------------------------------------
 
 

@@ -628,7 +628,11 @@ def test_fake_engine_serves_version_like_the_real_server():
         try:
             resp = await client.get("/version")
             assert resp.status == 200
-            assert await resp.json() == {"version": __version__}
+            # Same shape as EngineServer.version: the build identity
+            # rides along so rollouts can verify a canary's revision
+            # (docs/fleet.md); empty when no --build-id was given.
+            assert await resp.json() == {"version": __version__,
+                                         "build_id": ""}
         finally:
             await client.close()
 
