@@ -970,6 +970,13 @@ def run_unified_worker(mode: str) -> None:
                    - st0["engine_ragged_pad_rows_total"])
     pad_ratio = ragged_pads / ragged_rows if ragged_rows else 0.0
     itl_p99 = pctl(itl, 0.99) or 0.0
+    # Resolved unified attention impl (observatory one-hot value):
+    # the string keys the A/B run to the kernel actually served;
+    # ragged_kernel_active is its numeric shadow so benchcompare can
+    # hold "the fused kernel stayed resolved" as a direction.
+    unified_impl = engine.runner.observatory.attention_impls().get(
+        "unified", "")
+    ragged_active = int(unified_impl.startswith("pallas_ragged"))
     print(json.dumps({
         "metric": f"unified-step bench ({mode}): interactive ITL p99 "
                   "under bursty long-prompt arrivals",
@@ -985,6 +992,8 @@ def run_unified_worker(mode: str) -> None:
             "ttft_p99_s": round(pctl(ttft, 0.99) or 0.0, 4),
             "ragged_steps": int(ragged_steps),
             "ragged_pad_ratio": round(pad_ratio, 4),
+            "attention_impl_unified": unified_impl,
+            "ragged_kernel_active": ragged_active,
             "interactive_tokens": interactive_tokens,
             "long_requests_finished": long_done,
         },
@@ -2417,6 +2426,8 @@ def main() -> None:
             ue = un_result.get("extra", {})
             for key in ("decode_tok_s", "itl_p99_s", "ttft_p99_s",
                         "ragged_pad_ratio", "ragged_steps",
+                        "attention_impl_unified",
+                        "ragged_kernel_active",
                         "interactive_tokens",
                         "long_requests_finished"):
                 result["extra"][f"{tag}_{key}"] = ue.get(key)

@@ -164,6 +164,87 @@ def bench_prefill(b, t, prior_ctx, page_size, *, kv_heads=8,
     return t_pallas, t_xla
 
 
+def bench_ragged(r, w, mix, page_size, *, kv_heads=8, q_heads=32,
+                 head_dim=64, int8=False, iters=20):
+    """Fused ragged kernel vs the XLA gather at a mixed-row shape.
+
+    ``mix = (decode_rows, verify_rows, prefill_rows, decode_ctx,
+    prefill_prior)``; remaining rows are pads (kv_lens 0), matching
+    the unified planner's common case of a lightly mixed step. Verify
+    rows carry a 3-draft span. The XLA side runs ops.attention
+    .paged_attention over the same [r, w] block with the positions the
+    composed path materializes — exactly what _unified_impl composed
+    before the fused kernel. The model runner's empirical 'auto' gate
+    (_ragged_microbench_verdict) reads these rows (kind == 'ragged')
+    and serves the kernel only when every measured cell wins.
+    """
+    import jax.numpy as jnp
+    from production_stack_tpu.ops.attention import paged_attention
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    n_dec, n_ver, n_pre, dec_ctx, pre_prior = mix
+    span = 4  # 1 committed + 3 drafts on verify rows
+    kv = np.zeros((r,), np.int32)
+    li = np.zeros((r,), np.int32)
+    dl = np.zeros((r,), np.int32)
+    i = 0
+    for _ in range(n_dec):
+        kv[i], li[i] = dec_ctx, 0
+        i += 1
+    for _ in range(n_ver):
+        kv[i], li[i], dl[i] = dec_ctx + span - 1, span - 1, span - 1
+        i += 1
+    for _ in range(n_pre):
+        kv[i], li[i] = pre_prior + w, w - 1
+        i += 1
+
+    max_ctx = int(kv.max())
+    max_pages_per_seq = -(-max_ctx // page_size)
+    num_pages = r * max_pages_per_seq + 2
+    rng = np.random.RandomState(0)
+    dtype = jnp.bfloat16
+    kc = jnp.asarray(
+        rng.randn(kv_heads, num_pages, head_dim, page_size), dtype)
+    vc = jnp.asarray(
+        rng.randn(kv_heads, num_pages, head_dim, page_size), dtype)
+    if int8:
+        from production_stack_tpu.ops.quant_kv import (
+            QuantKV,
+            quantize_kv,
+        )
+
+        def _q(c):
+            qc, scale = quantize_kv(jnp.transpose(c, (0, 1, 3, 2)))
+            return QuantKV(jnp.transpose(qc, (0, 1, 3, 2)), scale)
+
+        kc, vc = _q(kc), _q(vc)
+    pt = np.zeros((r, max_pages_per_seq), np.int32)
+    nxt = 1
+    for row in range(r):
+        for j in range(-(-int(kv[row]) // page_size)):
+            pt[row, j] = nxt
+            nxt += 1
+    # The engine's layout invariant recovers each row's first query
+    # position (docs/unified_step.md).
+    pos = np.maximum(
+        (kv - 1 - li)[:, None] + np.arange(w, dtype=np.int32)[None],
+        0).astype(np.int32)
+    pt, pos = jnp.asarray(pt), jnp.asarray(pos)
+    kv, li, dl = map(jnp.asarray, (kv, li, dl))
+    q = jnp.asarray(rng.randn(r, w, q_heads, head_dim), dtype)
+
+    t_pallas = _time(
+        lambda x, kc, vc, pt, kv, li, dl: paged_ragged_attention(
+            x, kc, vc, pt, kv, li, dl),
+        q, (kc, vc, pt, kv, li, dl), iters=iters)
+    t_xla = _time(
+        lambda x, kc, vc, pt, pos, kv: paged_attention(
+            x, kc, vc, pt, pos, kv),
+        q, (kc, vc, pt, pos, kv), iters=iters)
+    return t_pallas, t_xla
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -192,6 +273,7 @@ def main():
     if args.quick:
         decode_cases = [(8, 512, 128)]
         prefill_cases = [(4, 128, 0, 128)]
+        ragged_cases = [(4, 128, (2, 1, 1, 96, 0), 128, False)]
         iters = 3
     else:
         decode_cases = [
@@ -204,6 +286,20 @@ def main():
             for b, t, prior in ((4, 512, 0), (4, 512, 1536),
                                 (8, 512, 1536), (4, 512, 7680),
                                 (1, 512, 15872))
+        ]
+        # Mixed-row shapes the unified planner actually emits
+        # (docs/unified_step.md): mostly-decode steps with one or two
+        # chunks riding along, with and without verify spans, bf16
+        # AND int8 (one kernel serves both caches).
+        ragged_cases = [
+            (r, w, mix, 128, int8)
+            for r, w, mix in (
+                (8, 128, (6, 0, 1, 2048, 1536)),
+                (8, 128, (4, 2, 1, 2048, 1536)),
+                (16, 512, (12, 0, 2, 4096, 3584)),
+                (16, 512, (8, 4, 2, 8192, 7680)),
+            )
+            for int8 in (False, True)
         ]
         iters = 256
 
@@ -226,23 +322,49 @@ def main():
             "speedup": round(t_xla / t_pal, 2),
         })
         print(rows[-1])
+    for r, w, mix, ps, int8 in ragged_cases:
+        t_pal, t_xla = bench_ragged(r, w, mix, ps, int8=int8,
+                                    iters=iters)
+        rows.append({
+            "kind": "ragged", "rows": r, "width": w,
+            "mix": "dec%d/ver%d/pre%d" % mix[:3],
+            "ctx": mix[3], "page_size": ps,
+            "kv_dtype": "int8" if int8 else "bf16",
+            "pallas_us": round(t_pal * 1e6, 1),
+            "xla_us": round(t_xla * 1e6, 1),
+            "speedup": round(t_xla / t_pal, 2),
+        })
+        print(rows[-1])
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump({
             "backend": jax.default_backend(),
             "device_kind": device.device_kind,
+            "notes": (
+                "Per-kernel device time vs the XLA gather path "
+                "(speedup = xla_us / pallas_us). Consumed by the "
+                "model runner's empirical 'auto' gates: decode rows "
+                "retired the decode kernel (PALLAS_DECODE_IN_AUTO); "
+                "ragged rows (kind='ragged', the fused unified-step "
+                "kernel, bf16 + int8 kv_dtype) gate "
+                "attention_impl_unified resolution — 'auto' serves "
+                "the fused kernel only when backend=='tpu' and every "
+                "ragged cell wins (_ragged_microbench_verdict)."),
             "rows": rows,
         }, f, indent=1)
     print(f"# wrote {args.out}")
 
     # Markdown table for the docs.
-    print("\n| kind | B | ctx/chunk | page | pallas µs | xla µs | "
+    print("\n| kind | B/R | ctx/chunk | page | pallas µs | xla µs | "
           "xla/pallas |")
     print("|---|---|---|---|---|---|---|")
     for r in rows:
         ctx = r.get("ctx", f"{r.get('chunk')}+{r.get('prior_ctx')}")
-        print(f"| {r['kind']} | {r['batch']} | {ctx} | "
+        if r["kind"] == "ragged":
+            ctx = f"{r['mix']}@w{r['width']} ({r['kv_dtype']})"
+        b = r.get("batch", r.get("rows"))
+        print(f"| {r['kind']} | {b} | {ctx} | "
               f"{r['page_size']} | {r['pallas_us']} | {r['xla_us']} | "
               f"{r['speedup']} |")
 

@@ -37,6 +37,10 @@ _HIGHER_BETTER = (
     # --worker rollout: streams carried across revisions intact, and
     # a bad canary actually caught by the judge (docs/fleet.md).
     "rollout_migrated", "rollout_detected", "rollout_attainment",
+    # unified A/B: the fused ragged kernel stayed resolved for the
+    # unified step (0/1 shadow of attention_impl_unified — a
+    # regression back to the composed path reads as a drop to 0).
+    "ragged_kernel",
 )
 _LOWER_BETTER = (
     "p50", "p90", "p99", "latency", "itl", "ttft", "seconds", "_ms",

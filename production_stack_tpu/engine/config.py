@@ -64,6 +64,11 @@ class ModelConfig:
     # lesson, VERDICT §weak 3). None = follow attention_impl.
     attention_impl_decode: Optional[str] = None
     attention_impl_prefill: Optional[str] = None
+    # Unified-step ([R, W] mixed batch) kernel, resolved separately: the
+    # fused ragged kernel (pallas_ragged) needs both a lowering probe
+    # AND a measured microbench win before auto serves it; None =
+    # compose the family prefill impl (model_runner._resolve_unified_impl).
+    attention_impl_unified: Optional[str] = None
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -576,6 +581,7 @@ INTERNAL_FIELDS = {
     # compile probe, not operator-set (--attention-impl is the knob).
     "model.attention_impl_decode",
     "model.attention_impl_prefill",
+    "model.attention_impl_unified",
     # Data parallelism is derived mesh residue (devices not consumed
     # by tp/pp/sp), never requested directly.
     "parallel.data_parallel_size",

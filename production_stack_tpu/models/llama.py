@@ -69,6 +69,26 @@ def dispatch_attention(config: ModelConfig, q, k_cache, v_cache,
             return out[:, None], k_cache, v_cache
     else:
         impl = config.attention_impl_prefill or config.attention_impl
+        if impl.startswith("pallas_ragged"):
+            # Fused unified-step kernel: rebuild the row descriptors
+            # from the planner's layout invariant (docs/unified_step.md
+            # — every row kind satisfies positions[:, 0] == kv_lens - 1
+            # - last_index, so last_index is recoverable losslessly and
+            # nothing new threads through the family forwards).
+            from production_stack_tpu.ops.ragged_attention_pallas import (
+                paged_ragged_attention,
+            )
+            last_index = kv_lens - 1 - positions[:, 0]
+            res = paged_ragged_attention(
+                q, k_cache, v_cache, page_table, kv_lens, last_index,
+                layer=layer,
+                interpret=impl.endswith("-interpret"),
+            )
+            if layer is not None:
+                out, k_cache, v_cache = res
+            else:
+                out = res
+            return out, k_cache, v_cache
         if impl.startswith("pallas"):
             from production_stack_tpu.ops.prefill_attention_pallas import (
                 paged_prefill_attention,

@@ -32,6 +32,8 @@ ATTENTION_IMPLS = {
                       "paged_decode_attention"),
     "pallas_prefill": ("production_stack_tpu.ops.prefill_attention_pallas",
                        "paged_prefill_attention"),
+    "pallas_ragged": ("production_stack_tpu.ops.ragged_attention_pallas",
+                      "paged_ragged_attention"),
 }
 
 

@@ -35,6 +35,21 @@ except AttributeError:  # jax 0.4.x: ANY keeps the operand un-blocked in HBM
 HBM = _HBM
 NEG_INF = -1e30
 
+# Mosaic's VMEM tile for 32-bit (and the floor for narrower) types:
+# a block's last two dims must each be a multiple of these or equal to
+# the whole array dim — and the *backend* (machine-code) pass is
+# stricter than the Python lowering rules about the "or equal" escape
+# hatch for the query/output blocks (BENCH_r02: head_dim=64 block
+# shapes lowered fine cross-platform and then failed on the chip).
+# The query-side kernels therefore pad to true tile multiples.
+SUBLANE_TILE = 8
+LANE_TILE = 128
+
+
+def tile_pad(n: int, tile: int) -> int:
+    """Round ``n`` up to a multiple of ``tile``."""
+    return -(-n // tile) * tile
+
 
 def hbm_block_spec():
     """A BlockSpec that keeps the operand un-blocked in HBM (the
@@ -92,6 +107,38 @@ def pad_page_table(page_table: jnp.ndarray, pages_per_chunk: int):
         )
         max_pages = page_table.shape[1]
     return page_table, max_pages
+
+
+def pad_query_rows(qg: jnp.ndarray, rows_pad: int, d_pad: int):
+    """Zero-pad a [B, KV, rows, D] flattened query block to the Mosaic
+    tile-aligned [B, KV, rows_pad, d_pad] the kernels take. Zero pad
+    lanes contribute nothing to the q·k contraction (0 × anything
+    accumulates 0 once the matching k-scratch sublanes are zeroed —
+    ``zero_pad_sublanes``), and pad rows are sliced back off the
+    output by the wrapper."""
+    b, kv, rows, d = qg.shape
+    if rows_pad == rows and d_pad == d:
+        return qg
+    return jnp.pad(qg, ((0, 0), (0, 0),
+                        (0, rows_pad - rows), (0, d_pad - d)))
+
+
+def zero_pad_sublanes(k_scratch, v_scratch, head_dim: int,
+                      head_dim_pad: int) -> None:
+    """Zero the KV scratch sublanes past ``head_dim`` once per kernel
+    instance (both DMA slots, both sides). The page DMAs only ever
+    fill ``[:head_dim]``, and uninitialized VMEM can hold NaNs —
+    0 (pad q lane) × NaN (pad k sublane) would poison the scores
+    accumulator. ``head_dim`` is a sublane multiple (the page tile's
+    own layout requires it), so the slice is tile-legal."""
+    if head_dim_pad == head_dim:
+        return
+    pad = head_dim_pad - head_dim
+    width = k_scratch.shape[-1]
+    for side in (k_scratch, v_scratch):
+        for slot in range(2):
+            side[slot, pl.ds(head_dim, pad), :] = jnp.zeros(
+                (pad, width), side.dtype)
 
 
 def kv_scratch_shapes(head_dim: int, pages_per_chunk: int,
@@ -167,7 +214,8 @@ def make_page_dma(*, b, h, page_table_ref, layer_ref,
                   k_hbm, v_hbm, ks_hbm, vs_hbm,
                   k_scratch, v_scratch, ks_scratch, vs_scratch,
                   sem, ssem, pages_per_chunk: int, page_size: int,
-                  has_layer: bool, quantized: bool):
+                  has_layer: bool, quantized: bool,
+                  dma_sublanes: "int | None" = None):
     """Build the (issue, wait) pair for the double-buffered page-burst
     DMA shared by every paged kernel.
 
@@ -179,8 +227,19 @@ def make_page_dma(*, b, h, page_table_ref, layer_ref,
     compiled kernel serves every layer and the caller never slices
     (an HLO slice feeding a pallas custom-call materializes the
     whole 10s-of-MB layer as a copy).
+
+    ``dma_sublanes`` bounds the destination's sublane window when the
+    KV scratch is padded past the page tile's head_dim (small-head
+    fix: the scratch is lane/sublane tile-padded while the HBM pages
+    keep their real [head_dim, page_size] shape).
     """
     c = pages_per_chunk
+
+    def dst(scratch, slot, j):
+        win = pl.ds(j * page_size, page_size)
+        if dma_sublanes is None:
+            return scratch.at[slot, :, win]
+        return scratch.at[slot, pl.ds(0, dma_sublanes), win]
 
     def dma(slot, chunk_idx, j):
         pid = page_table_ref[b, chunk_idx * c + j]
@@ -192,14 +251,10 @@ def make_page_dma(*, b, h, page_table_ref, layer_ref,
             v_src = v_hbm.at[h, pid]
         copies = [
             pltpu.make_async_copy(
-                k_src,
-                k_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
-                sem.at[0, slot, j],
+                k_src, dst(k_scratch, slot, j), sem.at[0, slot, j],
             ),
             pltpu.make_async_copy(
-                v_src,
-                v_scratch.at[slot, :, pl.ds(j * page_size, page_size)],
-                sem.at[1, slot, j],
+                v_src, dst(v_scratch, slot, j), sem.at[1, slot, j],
             ),
         ]
         if quantized:

@@ -18,7 +18,12 @@ of T query tokens per sequence:
   ([head_dim, page_size]) so the slices are tile-aligned and K needs
   no transpose before the ``q @ k^T`` MXU contraction,
 - queries arrive flattened [G*T, D] so both matmuls stay plain 2D MXU
-  contractions,
+  contractions, zero-padded to true (8, 128) tile multiples — the
+  whole-dim block escape hatch the Python lowering rules allow is not
+  honored by Mosaic's machine-code pass for small-head models
+  (head_dim=64 lowered cross-platform and then failed on chip,
+  BENCH_r02), so the wrapper pads rows/head_dim outright and the
+  kernel zeroes the matching KV-scratch pad sublanes,
 - causal masking is rebuilt in-kernel from a scalar-prefetched per-row
   chunk start: query positions within a prefill chunk are contiguous
   (engine/model_runner.py run_prefill), so ``start + iota`` recovers
@@ -43,18 +48,23 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from production_stack_tpu.ops.paged_kv_common import (
+    LANE_TILE,
     NEG_INF,
+    SUBLANE_TILE,
     cache_alias_map,
     dma_semaphore_shapes,
     hbm_block_spec,
     kv_scratch_shapes,
     make_page_dma,
     pad_page_table,
+    pad_query_rows,
     passthrough_out_shapes,
     rewrap_cache_outputs,
     run_page_walk,
+    tile_pad,
     unwrap_cache,
     validate_layer_arg,
+    zero_pad_sublanes,
 )
 
 # Pages per DMA burst (2 x 128-token pages = a 256-token KV tile per
@@ -69,8 +79,9 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
                     m_ref, l_ref, acc_ref,
                     k_scratch, v_scratch, ks_scratch, vs_scratch,
                     sem, ssem, *,
-                    page_size: int, pages_per_chunk: int, group: int,
-                    chunk: int, head_dim: int, max_pages: int,
+                    page_size: int, pages_per_chunk: int,
+                    chunk: int, head_dim: int, head_dim_pad: int,
+                    rows_pad: int, max_pages: int,
                     has_layer: bool, quantized: bool):
     # ks_hbm/vs_hbm carry the per-slot f32 dequant scales of an int8
     # cache (ops/quant_kv.py), pre-reshaped by the wrapper to
@@ -79,7 +90,6 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
     h = pl.program_id(1)
     c = pages_per_chunk
     chunk_tokens = c * page_size
-    rows = group * chunk
     max_chunks = max_pages // c  # static unroll bound
 
     kv_len = kv_lens_ref[b]
@@ -93,6 +103,7 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
         ks_scratch=ks_scratch, vs_scratch=vs_scratch,
         sem=sem, ssem=ssem, pages_per_chunk=c, page_size=page_size,
         has_layer=has_layer, quantized=quantized,
+        dma_sublanes=(head_dim if head_dim_pad != head_dim else None),
     )
 
     # Padded rows (kv_len == 0 -> num_chunks == 0) must not issue the
@@ -105,14 +116,15 @@ def _prefill_kernel(page_table_ref, kv_lens_ref, q_start_ref,
     m_ref[...] = jnp.full_like(m_ref, NEG_INF)
     l_ref[...] = jnp.zeros_like(l_ref)
     acc_ref[...] = jnp.zeros_like(acc_ref)
+    zero_pad_sublanes(k_scratch, v_scratch, head_dim, head_dim_pad)
 
-    q = q_ref[0, 0].astype(jnp.float32)  # [G*T, D]
+    q = q_ref[0, 0].astype(jnp.float32)  # [rows_pad, D_pad]
 
     # Row r of the flattened queries is (g, t) = (r // T, r % T) whose
     # absolute position is q_start + t (chunk positions contiguous).
     q_pos = q_start + jax.lax.broadcasted_iota(
-        jnp.int32, (rows, chunk_tokens), 0
-    ) % chunk  # [G*T, C*P]
+        jnp.int32, (rows_pad, chunk_tokens), 0
+    ) % chunk  # [rows_pad, C*P]
 
     run_page_walk(
         q=q, kv_len=kv_len, num_chunks=num_chunks,
@@ -175,10 +187,19 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     page_table, max_pages = pad_page_table(page_table, c)
 
     # [B, T, KV, G, D] -> [B, KV, G*T, D]: rows of one kv head's
-    # queries, flattened so kernel matmuls are 2D.
+    # queries, flattened so kernel matmuls are 2D, then tile-padded
+    # to true (8, 128) multiples. Mosaic's machine-code pass is
+    # stricter than the Python lowering rules about whole-dim q/o
+    # blocks (the BENCH_r02 small-head failure: head_dim=64 lowered
+    # cross-platform and failed on chip), so the wrapper pads and the
+    # kernel zeroes the matching KV-scratch sublanes.
+    rows = group * t
+    rows_pad = max(tile_pad(rows, SUBLANE_TILE), SUBLANE_TILE)
+    d_pad = tile_pad(head_dim, LANE_TILE)
     qg = (q.reshape(b, t, num_kv_heads, group, head_dim)
           .transpose(0, 2, 3, 1, 4)
-          .reshape(b, num_kv_heads, group * t, head_dim))
+          .reshape(b, num_kv_heads, rows, head_dim))
+    qg = pad_query_rows(qg, rows_pad, d_pad)
 
     # Only the per-row chunk start crosses into the kernel (SMEM
     # scalar prefetch); positions are rebuilt as start + iota.
@@ -186,7 +207,8 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
 
     base_kernel = functools.partial(
         _prefill_kernel, page_size=page_size, pages_per_chunk=c,
-        group=group, chunk=t, head_dim=head_dim, max_pages=max_pages,
+        chunk=t, head_dim=head_dim, head_dim_pad=d_pad,
+        rows_pad=rows_pad, max_pages=max_pages,
         has_layer=has_layer, quantized=quantized,
     )
     n_cache_in = 4 if quantized else 2
@@ -212,12 +234,12 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
 
     hbm = hbm_block_spec()
     scratch_shapes = [
-        pltpu.VMEM((group * t, 1), jnp.float32),  # m
-        pltpu.VMEM((group * t, 1), jnp.float32),  # l
-        pltpu.VMEM((group * t, head_dim), jnp.float32),  # acc
+        pltpu.VMEM((rows_pad, 1), jnp.float32),  # m
+        pltpu.VMEM((rows_pad, 1), jnp.float32),  # l
+        pltpu.VMEM((rows_pad, d_pad), jnp.float32),  # acc
     ]
     scratch_shapes += kv_scratch_shapes(
-        head_dim, c, page_size, k_data.dtype, v_data.dtype, quantized)
+        d_pad, c, page_size, k_data.dtype, v_data.dtype, quantized)
     scratch_shapes += dma_semaphore_shapes(c, quantized)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -225,13 +247,13 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
         grid=(b, num_kv_heads),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, group * t, head_dim),
+                (1, 1, rows_pad, d_pad),
                 lambda bi, hi, pt, kl, qs, la: (bi, hi, 0, 0),
             ),
         ] + [hbm] * n_cache_in,
         out_specs=[
             pl.BlockSpec(
-                (1, 1, group * t, head_dim),
+                (1, 1, rows_pad, d_pad),
                 lambda bi, hi, pt, kl, qs, la: (bi, hi, 0, 0),
             ),
         ] + [hbm] * n_pass,
@@ -239,7 +261,7 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
     )
 
     out_shape = [jax.ShapeDtypeStruct(
-        (b, num_kv_heads, group * t, head_dim), q.dtype)]
+        (b, num_kv_heads, rows_pad, d_pad), q.dtype)]
     operands = [page_table, kv_lens, q_start, layer_arr, qg,
                 k_data, v_data]
     if quantized:
@@ -255,7 +277,8 @@ def paged_prefill_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
         input_output_aliases=aliases,
         interpret=interpret,
     )(*operands)
-    out = (res[0].reshape(b, num_kv_heads, group, t, head_dim)
+    out = (res[0][:, :, :rows, :head_dim]
+           .reshape(b, num_kv_heads, group, t, head_dim)
            .transpose(0, 3, 1, 2, 4)
            .reshape(b, t, num_q_heads, head_dim))
     if has_layer:

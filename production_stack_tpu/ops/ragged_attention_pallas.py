@@ -1,0 +1,309 @@
+"""Pallas TPU kernel: fused ragged attention for the unified step.
+
+The unified ragged step (docs/unified_step.md) runs genuinely mixed
+batches — decode rows, speculative-verify rows, and prefill-chunk rows
+— through ONE fixed-shape [R, W] program. Before this kernel that
+program *composed* the T>1 prefill attention path (or the XLA gather)
+per layer; this module is the fused form: one grid, one page walk, and
+the per-row raggedness rebuilt in-kernel from the step's three-int row
+descriptors, scalar-prefetched through SMEM:
+
+- ``kv_lens[r]``   — valid cached tokens after this step's KV write
+  (0 marks a pad row),
+- ``last_index[r]`` — the row's last live query slot (a decode row's
+  is its draft count; a prefill chunk's is chunk_len - 1),
+- ``draft_lens[r]`` — how many of the trailing live slots are
+  speculative drafts (the sampler's scoring span is
+  ``[last_index - draft_lens, last_index]``).
+
+The engine's layout invariant (model_runner.run_unified) makes the
+row's first query position recoverable as ``q_start = kv_len - 1 -
+last_index`` for every row kind, so the mask is three terms over a
+[rows, C*P] absolute-position tile:
+
+    slot <= last_index          (live query slots only — pad slots
+                                 past a chunk's real length score
+                                 nothing instead of garbage)
+    token_pos <= q_start + slot (causal; a decode row degenerates to
+                                 the 1-query case, and a verify row's
+                                 draft span masks itself: draft KV is
+                                 written at positions < kv_len and
+                                 each draft query's window ends at its
+                                 own position, so no extra span term
+                                 is needed — draft_lens still rides
+                                 the prefetch tuple so the descriptor
+                                 contract reaches SMEM whole and a
+                                 future span-local mask (e.g. tree
+                                 drafts) is an in-kernel change, not
+                                 an operand change)
+    token_pos < kv_len          (nothing past the cached context)
+
+Pad rows (``kv_lens == 0`` → zero page chunks) issue no DMAs and run
+no compute via ``pl.when`` — an unwaited DMA would leak its semaphore
+signal into the next grid step's waits.
+
+Everything else is the shared paged-KV machine (ops/paged_kv_common):
+grid (row, kv_head), double-buffered HBM→VMEM page-burst DMA with the
+int8 dequant scales streamed through the same pipeline (one kernel
+serves bf16 AND QuantKV caches), flash-style online softmax in VMEM
+scratch, and query/output blocks padded to true (8, 128) tile
+multiples (the small-head fix — see prefill_attention_pallas).
+
+Contract matches ops.attention.paged_attention over the live slots;
+parity (pure-decode / pure-prefill / mixed / verify spans / pad rows /
+int8) is pinned in tests/test_pallas_attention.py and TPU
+cross-lowering in tests/test_pallas_lowering.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from production_stack_tpu.ops.paged_kv_common import (
+    LANE_TILE,
+    NEG_INF,
+    SUBLANE_TILE,
+    cache_alias_map,
+    dma_semaphore_shapes,
+    hbm_block_spec,
+    kv_scratch_shapes,
+    make_page_dma,
+    pad_page_table,
+    pad_query_rows,
+    passthrough_out_shapes,
+    rewrap_cache_outputs,
+    run_page_walk,
+    tile_pad,
+    unwrap_cache,
+    validate_layer_arg,
+    zero_pad_sublanes,
+)
+
+# Pages per DMA burst — same trade as the prefill kernel: ragged
+# scores are [G*W_pad, tile], so a fatter KV tile costs VMEM
+# quadratically while the MXU is already saturated.
+_PAGES_PER_CHUNK = 2
+
+
+def _ragged_kernel(page_table_ref, kv_lens_ref, last_index_ref,
+                   draft_lens_ref, layer_ref, q_ref,
+                   k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
+                   m_ref, l_ref, acc_ref,
+                   k_scratch, v_scratch, ks_scratch, vs_scratch,
+                   sem, ssem, *,
+                   page_size: int, pages_per_chunk: int, width: int,
+                   head_dim: int, head_dim_pad: int, rows_pad: int,
+                   max_pages: int, has_layer: bool, quantized: bool):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    c = pages_per_chunk
+    chunk_tokens = c * page_size
+    max_chunks = max_pages // c  # static unroll bound
+
+    kv_len = kv_lens_ref[b]
+    last_index = last_index_ref[b]
+    # The causal rebuild needs only (kv_len, last_index); the draft
+    # span is self-masking (module docstring). Prefetched regardless:
+    # the descriptor tuple reaches SMEM whole.
+    del draft_lens_ref
+    q_start = kv_len - 1 - last_index
+    num_chunks = (kv_len + chunk_tokens - 1) // chunk_tokens
+
+    issue, wait = make_page_dma(
+        b=b, h=h, page_table_ref=page_table_ref, layer_ref=layer_ref,
+        k_hbm=k_hbm, v_hbm=v_hbm, ks_hbm=ks_hbm, vs_hbm=vs_hbm,
+        k_scratch=k_scratch, v_scratch=v_scratch,
+        ks_scratch=ks_scratch, vs_scratch=vs_scratch,
+        sem=sem, ssem=ssem, pages_per_chunk=c, page_size=page_size,
+        has_layer=has_layer, quantized=quantized,
+        dma_sublanes=(head_dim if head_dim_pad != head_dim else None),
+    )
+
+    # Pad rows (kv_len == 0 -> num_chunks == 0) issue no DMAs and run
+    # no compute: the walk below skips every chunk, and an unwaited
+    # warmup DMA would leak its semaphore signal into the next grid
+    # step's waits.
+    @pl.when(num_chunks > 0)
+    def _warmup():
+        issue(0, 0)
+
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    zero_pad_sublanes(k_scratch, v_scratch, head_dim, head_dim_pad)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [rows_pad, D_pad]
+
+    # Row r of the flattened queries is (g, slot) = (r // W, r % W);
+    # its absolute position is q_start + slot for every row kind (the
+    # engine's layout invariant — module docstring).
+    slot = jax.lax.broadcasted_iota(
+        jnp.int32, (rows_pad, chunk_tokens), 0
+    ) % width
+    q_pos = q_start + slot
+    live = slot <= last_index
+
+    run_page_walk(
+        q=q, kv_len=kv_len, num_chunks=num_chunks,
+        max_chunks=max_chunks, chunk_tokens=chunk_tokens,
+        head_dim=head_dim, issue=issue, wait=wait,
+        k_scratch=k_scratch, v_scratch=v_scratch,
+        ks_scratch=ks_scratch, vs_scratch=vs_scratch,
+        m_ref=m_ref, l_ref=l_ref, acc_ref=acc_ref,
+        mask_fn=lambda token_pos: (live & (token_pos <= q_pos)
+                                   & (token_pos < kv_len)),
+        quantized=quantized,
+    )
+
+    # Dead slots (past last_index) saw only fully-masked tiles, so
+    # their accumulator holds exp(0)-weighted garbage — write zeros
+    # instead (the documented contract; pad rows already land here
+    # with acc == 0). One column of the slot iota is the per-row mask.
+    live_col = live[:, :1]
+    denom = jnp.maximum(l_ref[...], 1e-30)
+    o_ref[0, 0] = jnp.where(
+        live_col, acc_ref[...] / denom, 0.0).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_ragged_attention(q: jnp.ndarray, k_cache_layer: jnp.ndarray,
+                           v_cache_layer: jnp.ndarray,
+                           page_table: jnp.ndarray,
+                           kv_lens: jnp.ndarray,
+                           last_index: jnp.ndarray,
+                           draft_lens: "jnp.ndarray | None" = None,
+                           layer: "jnp.ndarray | int | None" = None,
+                           interpret: bool = False) -> jnp.ndarray:
+    """Fused ragged attention over the unified step's [R, W] block.
+
+    Args:
+      q:           [R, W, num_q_heads, head_dim] ragged query block
+      k/v_cache_layer: [num_kv_heads, num_pages, head_dim, page_size],
+                   or the full stacked [L, ...] cache with ``layer``
+                   given (scalar; reaches the kernel via SMEM prefetch
+                   so no per-layer slice is ever materialized)
+      page_table:  [R, max_pages] int32 physical page ids
+      kv_lens:     [R] int32 valid cached tokens incl. this step's
+                   write; 0 marks a pad row (no DMAs, no compute)
+      last_index:  [R] int32 last live query slot of the row
+      draft_lens:  [R] int32 speculative-draft count (None -> zeros;
+                   attention is invariant to it — the draft span is
+                   causally self-masking — but callers holding the
+                   full descriptor tuple pass it through unchanged)
+      interpret:   run in interpreter mode (CPU testing)
+
+    Returns [R, W, num_q_heads, head_dim] for the 4D per-layer cache
+    form; ``(out, k_cache, v_cache)`` for the stacked 5D form (caches
+    pass through the kernel aliased — see paged_decode_attention).
+    Slots past a row's ``last_index`` are fully masked (zero output),
+    unlike the XLA path's garbage-attention pad slots — both are
+    discarded by the sampler's span gather.
+    """
+    has_layer = validate_layer_arg(k_cache_layer, layer)
+    (quantized, k_data, v_data,
+     k_scale, v_scale, scale_shape) = unwrap_cache(
+        k_cache_layer, v_cache_layer)
+    layer_arr = jnp.asarray(
+        [0 if layer is None else layer], jnp.int32)
+    if draft_lens is None:
+        draft_lens = jnp.zeros_like(kv_lens)
+    r, w, num_q_heads, head_dim = q.shape
+    num_kv_heads, _, _, page_size = k_data.shape[-4:]
+    group = num_q_heads // num_kv_heads
+    c = _PAGES_PER_CHUNK
+
+    page_table, max_pages = pad_page_table(page_table, c)
+
+    # [R, W, KV, G, D] -> [R, KV, G*W, D] rows of one kv head's
+    # queries, then tile-padded (small-head fix: Mosaic's machine-code
+    # pass wants true (8, 128) multiples in the q/o blocks).
+    rows = group * w
+    rows_pad = max(tile_pad(rows, SUBLANE_TILE), SUBLANE_TILE)
+    d_pad = tile_pad(head_dim, LANE_TILE)
+    qg = (q.reshape(r, w, num_kv_heads, group, head_dim)
+          .transpose(0, 2, 3, 1, 4)
+          .reshape(r, num_kv_heads, rows, head_dim))
+    qg = pad_query_rows(qg, rows_pad, d_pad)
+
+    base_kernel = functools.partial(
+        _ragged_kernel, page_size=page_size, pages_per_chunk=c,
+        width=w, head_dim=head_dim, head_dim_pad=d_pad,
+        rows_pad=rows_pad, max_pages=max_pages,
+        has_layer=has_layer, quantized=quantized,
+    )
+    n_cache_in = 4 if quantized else 2
+    n_pass = n_cache_in if has_layer else 0
+
+    def kernel(pt, kl, li, dl, la, q_ref, *refs):
+        cache_in = refs[:n_cache_in]
+        o_ref = refs[n_cache_in]
+        scratch = refs[n_cache_in + 1 + n_pass:]
+        if quantized:
+            k, v, ks, vs = cache_in
+            (m, l, acc, k_s, v_s, ks_s, vs_s, sem, ssem) = scratch
+        else:
+            k, v = cache_in
+            ks = vs = ks_s = vs_s = ssem = None
+            (m, l, acc, k_s, v_s, sem) = scratch
+        base_kernel(pt, kl, li, dl, la, q_ref, k, v, ks, vs, o_ref,
+                    m, l, acc, k_s, v_s, ks_s, vs_s, sem, ssem)
+
+    hbm = hbm_block_spec()
+    scratch_shapes = [
+        pltpu.VMEM((rows_pad, 1), jnp.float32),  # m
+        pltpu.VMEM((rows_pad, 1), jnp.float32),  # l
+        pltpu.VMEM((rows_pad, d_pad), jnp.float32),  # acc
+    ]
+    scratch_shapes += kv_scratch_shapes(
+        d_pad, c, page_size, k_data.dtype, v_data.dtype, quantized)
+    scratch_shapes += dma_semaphore_shapes(c, quantized)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        # page_table, kv_lens, last_index, draft_lens, layer
+        num_scalar_prefetch=5,
+        grid=(r, num_kv_heads),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, rows_pad, d_pad),
+                lambda bi, hi, pt, kl, li, dl, la: (bi, hi, 0, 0),
+            ),
+        ] + [hbm] * n_cache_in,
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, rows_pad, d_pad),
+                lambda bi, hi, pt, kl, li, dl, la: (bi, hi, 0, 0),
+            ),
+        ] + [hbm] * n_pass,
+        scratch_shapes=scratch_shapes,
+    )
+
+    out_shape = [jax.ShapeDtypeStruct(
+        (r, num_kv_heads, rows_pad, d_pad), q.dtype)]
+    operands = [page_table, kv_lens, last_index, draft_lens,
+                layer_arr, qg, k_data, v_data]
+    if quantized:
+        operands += [k_scale, v_scale]
+    if has_layer:
+        out_shape += passthrough_out_shapes(
+            k_data, v_data, k_scale, v_scale, quantized)
+    aliases = cache_alias_map(5, n_cache_in, has_layer)
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid_spec=grid_spec,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*operands)
+    out = (res[0][:, :, :rows, :head_dim]
+           .reshape(r, num_kv_heads, group, w, head_dim)
+           .transpose(0, 3, 1, 2, 4)
+           .reshape(r, w, num_q_heads, head_dim))
+    if has_layer:
+        kc, vc = rewrap_cache_outputs(res, scale_shape, quantized)
+        return out, kc, vc
+    return out

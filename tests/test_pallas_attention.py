@@ -338,6 +338,223 @@ def test_decode_int8_stacked_cache_layer_form():
                                       np.asarray(src.scale))
 
 
+# ---- fused ragged kernel (unified step, docs/unified_step.md) ---------------
+
+
+def _ragged_setup(kv_lens, last_index, draft_lens=None, w=8,
+                  num_pages=64, page_size=8, kv_heads=2, q_heads=8,
+                  head_dim=64, max_pages=8, seed=0):
+    """Unified-step state from explicit per-row descriptors, plus the
+    [R, W] positions the XLA-composed path materializes (recovered
+    through the engine's layout invariant q_start = kv_len - 1 -
+    last_index — model_runner.run_unified)."""
+    rng = np.random.RandomState(seed)
+    r = len(kv_lens)
+    kv_lens = np.asarray(kv_lens, np.int32)
+    last_index = np.asarray(last_index, np.int32)
+    q = rng.randn(r, w, q_heads, head_dim).astype(np.float32)
+    k_cache = rng.randn(
+        kv_heads, num_pages, head_dim, page_size).astype(np.float32)
+    v_cache = rng.randn(
+        kv_heads, num_pages, head_dim, page_size).astype(np.float32)
+    page_table = np.zeros((r, max_pages), np.int32)
+    next_page = 1
+    for i in range(r):
+        for j in range(-(-int(kv_lens[i]) // page_size)):
+            page_table[i, j] = next_page % num_pages or 1
+            next_page += 1
+    positions = np.maximum(
+        (kv_lens - 1 - last_index)[:, None]
+        + np.arange(w, dtype=np.int32)[None], 0).astype(np.int32)
+    dl = (None if draft_lens is None
+          else jnp.asarray(np.asarray(draft_lens, np.int32)))
+    return (jnp.asarray(q), jnp.asarray(k_cache),
+            jnp.asarray(v_cache), jnp.asarray(page_table),
+            jnp.asarray(kv_lens), jnp.asarray(last_index), dl,
+            jnp.asarray(positions))
+
+
+def _assert_live_parity(out, ref, kv_lens, last_index):
+    """Compare the live slots only: the composed path computes
+    garbage attention in pad slots where the fused kernel writes
+    zeros — both are discarded by the sampler's span gather."""
+    out, ref = np.asarray(out), np.asarray(ref)
+    for i in range(out.shape[0]):
+        if int(kv_lens[i]) == 0:
+            continue
+        n = int(last_index[i]) + 1
+        np.testing.assert_allclose(
+            out[i, :n], ref[i, :n], rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_kernel_pure_decode():
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    (q, kc, vc, pt, kv, li, dl, pos) = _ragged_setup(
+        kv_lens=[17, 1, 48, 33], last_index=[0, 0, 0, 0], seed=43)
+    out = paged_ragged_attention(q, kc, vc, pt, kv, li, dl,
+                                 interpret=True)
+    ref = paged_attention(q, kc, vc, pt, pos, kv)
+    _assert_live_parity(out, ref, kv, li)
+
+
+def test_ragged_kernel_pure_prefill():
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    # Full-width chunks: one first chunk (q_start 0), one mid-prompt.
+    (q, kc, vc, pt, kv, li, dl, pos) = _ragged_setup(
+        kv_lens=[8, 29], last_index=[7, 7], seed=47)
+    out = paged_ragged_attention(q, kc, vc, pt, kv, li, dl,
+                                 interpret=True)
+    ref = paged_attention(q, kc, vc, pt, pos, kv)
+    _assert_live_parity(out, ref, kv, li)
+
+
+def test_ragged_kernel_mixed_rows_and_pads():
+    """The flagship mix: decode + spec-verify + short chunk + full
+    chunk + pad rows, one grid."""
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    (q, kc, vc, pt, kv, li, dl, pos) = _ragged_setup(
+        kv_lens=[20, 23, 13, 30, 0, 0],
+        last_index=[0, 3, 4, 7, 0, 0],
+        draft_lens=[0, 3, 0, 0, 0, 0], seed=53)
+    out = paged_ragged_attention(q, kc, vc, pt, kv, li, dl,
+                                 interpret=True)
+    ref = paged_attention(q, kc, vc, pt, pos, kv)
+    _assert_live_parity(out, ref, kv, li)
+    # Dead slots and pad rows are fully masked to zero (the composed
+    # path leaves garbage there; both are sliced off by the span
+    # gather — this contract is what makes the fused output safe to
+    # gather from without a validity mask).
+    out = np.asarray(out)
+    assert np.all(out[1, 4:] == 0)
+    assert np.all(out[4] == 0) and np.all(out[5] == 0)
+
+
+def test_ragged_kernel_verify_span_matches_composed():
+    """A spec-verify row's draft span must score exactly like the
+    composed prefill path scores it (the draft span is causally
+    self-masking — no extra mask term)."""
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    (q, kc, vc, pt, kv, li, dl, pos) = _ragged_setup(
+        kv_lens=[25, 41], last_index=[3, 2],
+        draft_lens=[3, 2], seed=59)
+    out = paged_ragged_attention(q, kc, vc, pt, kv, li, dl,
+                                 interpret=True)
+    ref = paged_attention(q, kc, vc, pt, pos, kv)
+    _assert_live_parity(out, ref, kv, li)
+
+
+def test_ragged_kernel_draft_lens_invariance():
+    """Attention is invariant to draft_lens (the descriptor rides the
+    prefetch tuple for the contract; the span is self-masking)."""
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    (q, kc, vc, pt, kv, li, dl, _pos) = _ragged_setup(
+        kv_lens=[25, 41], last_index=[3, 2],
+        draft_lens=[3, 2], seed=61)
+    with_dl = paged_ragged_attention(q, kc, vc, pt, kv, li, dl,
+                                     interpret=True)
+    without = paged_ragged_attention(q, kc, vc, pt, kv, li, None,
+                                     interpret=True)
+    np.testing.assert_array_equal(np.asarray(with_dl),
+                                  np.asarray(without))
+
+
+def test_ragged_kernel_gqa_wide():
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    (q, kc, vc, pt, kv, li, dl, pos) = _ragged_setup(
+        kv_lens=[20, 23, 30, 0], last_index=[0, 2, 5, 0],
+        draft_lens=[0, 2, 0, 0], kv_heads=4, q_heads=16, w=16,
+        seed=67)
+    out = paged_ragged_attention(q, kc, vc, pt, kv, li, dl,
+                                 interpret=True)
+    ref = paged_attention(q, kc, vc, pt, pos, kv)
+    _assert_live_parity(out, ref, kv, li)
+
+
+def test_ragged_stacked_cache_layer_form():
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    (q, kc, vc, pt, kv, li, dl, _pos) = _ragged_setup(
+        kv_lens=[20, 23, 30, 0], last_index=[0, 2, 5, 0],
+        draft_lens=[0, 2, 0, 0], seed=71)
+    L, layer = 3, 2
+    rng = np.random.RandomState(73)
+    k5 = jnp.asarray(rng.randn(L, *kc.shape).astype(np.float32))
+    v5 = jnp.asarray(rng.randn(L, *vc.shape).astype(np.float32))
+    out, k_thru, v_thru = paged_ragged_attention(
+        q, k5, v5, pt, kv, li, dl, layer=layer, interpret=True)
+    ref = paged_ragged_attention(
+        q, k5[layer], v5[layer], pt, kv, li, dl, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(k_thru), np.asarray(k5))
+    np.testing.assert_array_equal(np.asarray(v_thru), np.asarray(v5))
+
+
+def test_paged_ragged_attention_int8_parity():
+    """int8 parity for paged_ragged_attention (kv-parity staticcheck
+    contract): on the SAME quantized cache the fused kernel matches
+    the XLA reference exactly over the live slots, and tracks the
+    full-precision answer within the rounding budget."""
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    (q, kc, vc, pt, kv, li, dl, pos) = _ragged_setup(
+        kv_lens=[20, 23, 13, 30, 0], last_index=[0, 3, 4, 7, 0],
+        draft_lens=[0, 3, 0, 0, 0], seed=79)
+    k8, v8 = _quantize_cache(kc), _quantize_cache(vc)
+    out = paged_ragged_attention(q, k8, v8, pt, kv, li, dl,
+                                 interpret=True)
+    ref = paged_attention(q, k8, v8, pt, pos, kv)
+    _assert_live_parity(out, ref, kv, li)
+    full = paged_attention(q, kc, vc, pt, pos, kv)
+    out, full = np.asarray(out), np.asarray(full)
+    for i in range(out.shape[0]):
+        if int(kv[i]) == 0:
+            continue
+        n = int(li[i]) + 1
+        np.testing.assert_allclose(out[i, :n], full[i, :n],
+                                   atol=0.15)
+
+
+def test_ragged_int8_stacked_cache_layer_form():
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    (q, kc, vc, pt, kv, li, dl, _pos) = _ragged_setup(
+        kv_lens=[20, 23, 30, 0], last_index=[0, 2, 5, 0],
+        draft_lens=[0, 2, 0, 0], seed=83)
+    L, layer = 3, 1
+    rng = np.random.RandomState(89)
+    k5 = _quantize_cache(jnp.asarray(
+        rng.randn(L, *kc.shape).astype(np.float32)))
+    v5 = _quantize_cache(jnp.asarray(
+        rng.randn(L, *vc.shape).astype(np.float32)))
+    out, k_thru, v_thru = paged_ragged_attention(
+        q, k5, v5, pt, kv, li, dl, layer=layer, interpret=True)
+    ref = paged_ragged_attention(
+        q, k5[layer], v5[layer], pt, kv, li, dl, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    for thru, src in ((k_thru, k5), (v_thru, v5)):
+        np.testing.assert_array_equal(np.asarray(thru.data),
+                                      np.asarray(src.data))
+        np.testing.assert_array_equal(np.asarray(thru.scale),
+                                      np.asarray(src.scale))
+
+
 def test_prefill_int8_stacked_cache_layer_form():
     from production_stack_tpu.ops.prefill_attention_pallas import (
         paged_prefill_attention,

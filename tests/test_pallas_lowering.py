@@ -169,6 +169,71 @@ def test_decode_burst_program_lowers_for_tpu():
     traced.lower(lowering_platforms=("tpu",))
 
 
+def _ragged_args(r=8, w=512, num_pages=64, page_size=128, kv_heads=8,
+                 q_heads=32, head_dim=64, max_pages=64):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(
+        rng.randn(r, w, q_heads, head_dim), jnp.bfloat16)
+    kc = jnp.asarray(
+        rng.randn(kv_heads, num_pages, head_dim, page_size),
+        jnp.bfloat16)
+    vc = jnp.asarray(
+        rng.randn(kv_heads, num_pages, head_dim, page_size),
+        jnp.bfloat16)
+    pt = jnp.zeros((r, max_pages), jnp.int32)
+    kv = jnp.full((r,), w, jnp.int32)
+    li = jnp.full((r,), w - 1, jnp.int32)
+    dl = jnp.zeros((r,), jnp.int32)
+    return q, kc, vc, pt, kv, li, dl
+
+
+def test_ragged_kernel_lowers_for_tpu():
+    """The fused unified-step kernel at a serving-shape [R, W]
+    block."""
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    _lower_for_tpu(paged_ragged_attention, *_ragged_args())
+
+
+@pytest.mark.parametrize("w", [16, 64, 256])
+def test_ragged_kernel_lowers_every_width(w):
+    """Every W bucket the mixed planner can emit must lower (the
+    model runner's _ragged_lowering_error matrix)."""
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    _lower_for_tpu(paged_ragged_attention, *_ragged_args(w=w))
+
+
+def test_ragged_kernel_lowers_small_head_thin_rows():
+    """head_dim=64 with a thin row block: the q/o blocks are not
+    naturally (8, 128)-divisible and must pad to true tile multiples
+    — the class of shape that lowered cross-platform and then failed
+    Mosaic's machine-code pass on chip in BENCH_r02."""
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    _lower_for_tpu(
+        paged_ragged_attention,
+        *_ragged_args(r=4, w=4, kv_heads=8, q_heads=8, head_dim=64))
+
+
+def test_prefill_kernel_lowers_small_head_thin_rows():
+    """The BENCH_r02 failing class for the prefill kernel: MHA
+    (group 1) at a thin verify-style chunk with head_dim=64 — the
+    whole-array block escape hatch the Python lowering rules allow is
+    NOT honored by the machine-code pass, so the kernel now pads to
+    true (8, 128) multiples; this shape is also in the model runner's
+    probe matrix via the spec/unified probes."""
+    from production_stack_tpu.ops.prefill_attention_pallas import (
+        paged_prefill_attention,
+    )
+    _lower_for_tpu(
+        paged_prefill_attention,
+        *_prefill_args(b=8, t=4, kv_heads=8, q_heads=8, head_dim=64))
+
+
 def _quantize_lowering_cache(cache):
     from production_stack_tpu.ops.quant_kv import QuantKV, quantize_kv
     perm = (0, 1, 3, 2)
@@ -198,6 +263,20 @@ def test_prefill_kernel_int8_lowers_for_tpu():
         paged_prefill_attention, q,
         _quantize_lowering_cache(kc), _quantize_lowering_cache(vc),
         pt, pos, kl)
+
+
+def test_ragged_kernel_int8_lowers_for_tpu():
+    """paged_ragged_attention over int8 QuantKV pages (scale DMAs
+    through the shared pipeline) must pass the Mosaic lowering
+    rules."""
+    from production_stack_tpu.ops.ragged_attention_pallas import (
+        paged_ragged_attention,
+    )
+    q, kc, vc, pt, kv, li, dl = _ragged_args()
+    _lower_for_tpu(
+        paged_ragged_attention, q,
+        _quantize_lowering_cache(kc), _quantize_lowering_cache(vc),
+        pt, kv, li, dl)
 
 
 def test_decode_burst_program_int8_lowers_for_tpu():

@@ -20,9 +20,16 @@ from production_stack_tpu.engine.sequence import (
 )
 
 
-def _engine(unified=False, async_on=False, kv_dtype="auto", **sched_kw):
+def _engine(unified=False, async_on=False, kv_dtype="auto",
+            unified_impl=None, **sched_kw):
+    model = tiny_model_config("llama")
+    if unified_impl is not None:
+        # Pin the unified step's kernel (e.g. the fused ragged kernel
+        # in interpret mode — how CPU tier-1 holds the byte-parity
+        # contract against the XLA-composed path).
+        model.attention_impl_unified = unified_impl
     config = EngineConfig(
-        model=tiny_model_config("llama"),
+        model=model,
         cache=CacheConfig(page_size=16, num_pages=128,
                           kv_cache_dtype=kv_dtype),
         scheduler=SchedulerConfig(max_num_seqs=4,
@@ -92,6 +99,58 @@ def test_greedy_parity_bimodal_vs_unified(kv_dtype):
     assert bimodal.metrics.ragged_steps_total == 0
 
 
+@pytest.mark.parametrize("kv_dtype", ["auto", "int8"])
+def test_greedy_parity_composed_vs_ragged_kernel(kv_dtype):
+    """Greedy streams must be byte-identical between the XLA-composed
+    unified step and the fused Pallas ragged kernel (interpret mode)
+    — over a staggered mixed run WITH drafted rows, so every row kind
+    (decode, spec-verify with draft spans, prefill chunk, pad)
+    crosses the kernel's in-kernel mask rebuild, for bf16 AND int8
+    KV."""
+    base = [3, 9, 27, 9] * 14
+    prompts = [base, base[:24] * 2, list(reversed(base))]
+    max_tokens = [14, 26, 20]
+
+    def run(engine):
+        seqs = []
+        for p, m in zip(prompts, max_tokens):
+            sid = engine.add_request(p, SamplingParams(
+                temperature=0.0, max_tokens=m, ignore_eos=True))
+            seqs.append(engine.sequences[sid])
+        late_added = False
+        for _ in range(500):
+            engine.step()
+            if (not late_added
+                    and seqs[0].state == SequenceState.FINISHED):
+                sid = engine.add_request(base[:20] * 2, SamplingParams(
+                    temperature=0.0, max_tokens=10, ignore_eos=True))
+                seqs.append(engine.sequences[sid])
+                late_added = True
+            if late_added and not engine.has_work():
+                break
+        assert late_added and not engine.has_work()
+        return [list(s.output_token_ids) for s in seqs]
+
+    composed = _engine(unified=True, kv_dtype=kv_dtype,
+                       speculative_k=3)
+    expected = run(composed)
+    ragged = _engine(unified=True, kv_dtype=kv_dtype,
+                     unified_impl="pallas_ragged-interpret",
+                     speculative_k=3)
+    got = run(ragged)
+    assert got == expected
+    # The run genuinely mixed AND drafted — both engines — and the
+    # fused kernel genuinely served the unified phase (observatory
+    # one-hot, the vllm:engine_attention_impl{phase="unified"} value).
+    for eng in (composed, ragged):
+        assert eng.metrics.ragged_steps_total > 0
+        assert eng.stats()["spec_decode_num_draft_tokens_total"] > 0
+    impls = ragged.runner.observatory.attention_impls()
+    assert impls["unified"] == "pallas_ragged-interpret"
+    assert composed.runner.observatory.attention_impls()[
+        "unified"] == "xla"
+
+
 def test_spec_decode_under_async_mixed():
     """speculative_k x async_scheduling is a dissolved rule: verify
     steps reconcile through the assume-1 stale-drop path
@@ -159,6 +218,29 @@ def test_mixed_run_zero_recompiles():
     assert ragged0 > 0
     obs = engine.runner.observatory
     assert obs.compile_events_total() > 0  # the warm-up compiled
+    before_events = obs.compile_events_total()
+    before_caches = obs.executable_cache_sizes()
+    _run_mixed(engine, seed=13)
+    assert engine.metrics.ragged_steps_total > ragged0
+    assert obs.compile_events_total() == before_events
+    assert obs.executable_cache_sizes() == before_caches
+
+
+def test_mixed_run_zero_recompiles_with_ragged_kernel():
+    """The recompile guard with the fused ragged kernel active: the
+    kernel's [rows_pad, d_pad] padding and descriptor prefetch are
+    functions of the (row bucket, W bucket) pair only, so repeated
+    mixed runs must add zero compiled executables."""
+    engine = _engine(unified=True,
+                     unified_impl="pallas_ragged-interpret")
+    engine.add_request(list(range(2, 50)), SamplingParams(
+        temperature=0.0, max_tokens=2, ignore_eos=True))
+    while engine.has_work():
+        engine.step()
+    _run_mixed(engine, seed=7)
+    ragged0 = engine.metrics.ragged_steps_total
+    assert ragged0 > 0
+    obs = engine.runner.observatory
     before_events = obs.compile_events_total()
     before_caches = obs.executable_cache_sizes()
     _run_mixed(engine, seed=13)
