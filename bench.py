@@ -1000,6 +1000,142 @@ def run_unified_worker(mode: str) -> None:
     }))
 
 
+def run_scaleout_worker() -> None:
+    """Scale-out bench (docs/parallelism.md): goodput per chip as
+    independent tp=2 replicas are added on the 8-device host. Each
+    replica is its own engine on its own 2-device mesh built through
+    ``build_mesh(devices=...)`` — the slice-as-replica layout the
+    topology-aware MeshPlan produces on multi-slice hardware, scaled
+    down to virtual CPU devices. Replicas share nothing (dp is the
+    no-communication axis), so aggregate decode goodput should track
+    the chip count; the per-chip numbers at 1/2/4 replicas and the
+    1->2 / 1->4 linearity ratios ride out under ``scaleout_*`` keys.
+
+    Methodology: the bench host time-shares every virtual device over
+    the same CPU cores, so running replicas concurrently would
+    measure core contention, not replica scaling. Instead all N
+    engines are built and live at once (a mesh overlapping a
+    neighbour's devices, or state accidentally shared across
+    replicas, surfaces here), then each replica's decode rate is
+    measured solo and summed — valid because the replicas exchange
+    nothing by construction. Deviation from linear therefore exposes
+    shared-software interference (a global lock, a spanning mesh, a
+    shared cache), which is the regression this phase guards.
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import numpy as np
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        ParallelConfig,
+        SchedulerConfig,
+        tiny_model_config,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sequence import SamplingParams
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/jax-comp-cache")
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    devices = jax.devices()
+    chips_per_replica = 2  # tiny-llama has 2 kv heads -> tp=2 max
+    duration = float(os.environ.get("BENCH_SCALEOUT_DURATION_S", "6"))
+    rng = np.random.RandomState(0)
+
+    def make_replica(device_pair):
+        mesh = build_mesh(tensor_parallel_size=chips_per_replica,
+                          devices=list(device_pair))
+        return LLMEngine(EngineConfig(
+            model=tiny_model_config("llama"),
+            cache=CacheConfig(page_size=16, num_pages=128),
+            scheduler=SchedulerConfig(max_num_seqs=4,
+                                      max_model_len=256,
+                                      prefill_chunk_size=32),
+            parallel=ParallelConfig(
+                tensor_parallel_size=chips_per_replica),
+        ), mesh=mesh)
+
+    def decode_tokens(engine, stop_at, seed):
+        """Steady full-batch decode until the wall deadline; returns
+        tokens generated inside the window."""
+        rng = np.random.RandomState(seed)  # thread-local
+        samp = SamplingParams(max_tokens=160, temperature=0.0,
+                              ignore_eos=True)
+        seqs = [engine.add_request(
+            [int(x) for x in rng.randint(1, 500, size=32)], samp)
+            for _ in range(4)]
+        tokens = 0
+        while time.time() < stop_at:
+            for out in engine.step():
+                if out.new_token is not None:
+                    tokens += 1
+                if out.finished:  # keep the batch full to the bell
+                    seqs.append(engine.add_request(
+                        [int(x) for x in rng.randint(1, 500, size=32)],
+                        samp))
+        for sid in seqs:
+            engine.abort_request(sid)
+        return tokens
+
+    extra = {"scaleout_chips_per_replica": chips_per_replica,
+             "scaleout_duration_s": duration}
+    per_chip = {}
+    for n_replicas in (1, 2, 4):
+        needed = n_replicas * chips_per_replica
+        if needed > len(devices):
+            extra[f"scaleout_skipped_r{n_replicas}"] = (
+                f"needs {needed} devices, have {len(devices)}")
+            continue
+        engines = [make_replica(devices[i * chips_per_replica:
+                                        (i + 1) * chips_per_replica])
+                   for i in range(n_replicas)]
+        # Warm the decode program on every replica outside the window.
+        for eng in engines:
+            eng.generate(
+                [int(x) for x in rng.randint(1, 500, size=32)],
+                SamplingParams(max_tokens=4, temperature=0.0,
+                               ignore_eos=True))
+        # Solo-measure each live replica, sum the rates (see
+        # docstring: concurrent threads on a time-shared host would
+        # measure core contention, not replica scaling).
+        rates = []
+        for i, eng in enumerate(engines):
+            start = time.time()
+            tokens = decode_tokens(eng, start + duration,
+                                   seed=100 + i)
+            rates.append(tokens / max(time.time() - start, 1e-6))
+        agg = sum(rates)
+        per_chip[n_replicas] = agg / needed
+        extra[f"scaleout_goodput_tok_s_r{n_replicas}"] = round(agg, 1)
+        extra[f"scaleout_goodput_per_chip_tok_s_r{n_replicas}"] = (
+            round(per_chip[n_replicas], 1))
+        sys.stderr.write(
+            f"[bench] scaleout r{n_replicas}: {agg:.1f} tok/s "
+            f"aggregate, {per_chip[n_replicas]:.1f} tok/s/chip\n")
+    for n in (2, 4):
+        if 1 in per_chip and n in per_chip and per_chip[1] > 0:
+            extra[f"scaleout_linearity_1_to_{n}"] = round(
+                per_chip[n] / per_chip[1], 3)
+    print(json.dumps({
+        "metric": "scale-out bench: decode goodput per chip at "
+                  "1/2/4 tp=2 replicas",
+        "value": extra.get("scaleout_linearity_1_to_2", 0.0),
+        "unit": "fraction of linear",
+        "vs_baseline": 0.0,
+        "extra": extra,
+    }))
+
+
 def run_autoscale_worker() -> None:
     """Fleet autoscale bench (docs/fleet.md): router + fleet manager +
     a pool of fake-engine subprocesses driven through a load step up
@@ -2264,6 +2400,8 @@ def main() -> None:
         elif impl == "kvecon":
             run_kvecon_worker(
                 os.environ.get("BENCH_KVECON_POLICY", "summary"))
+        elif impl == "scaleout":
+            run_scaleout_worker()
         else:
             run_worker(impl, tpu="--tpu" in sys.argv)
         return
@@ -2542,6 +2680,30 @@ def main() -> None:
             for key in ("prefix_hit_rate", "ttft_p50_s",
                         "ttft_p99_s", "requests_total", "dropped"):
                 result["extra"][f"{tag}_{key}"] = ke.get(key)
+
+        # Scale-out phase (docs/parallelism.md): independent tp=2
+        # replicas on disjoint 2-device meshes — the slice-as-replica
+        # layout MeshPlan produces — at 1/2/4 replicas on the
+        # 8-virtual-device host. Aggregate decode goodput per chip
+        # and the 1->2 / 1->4 linearity ratios ride in extra under
+        # scaleout_*; the acceptance bar is per-chip goodput within
+        # 10% of linear going 1 -> 2 replicas.
+        sys.stderr.write(f"[bench] running scaleout worker "
+                         f"(timeout {timeout}s)...\n")
+        so_result, so_err = _spawn_worker(
+            "scaleout", False, timeout,
+            extra_env={
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                              " --xla_force_host_platform_device_"
+                              "count=8").strip()})
+        if so_result is None:
+            errors["scaleout_error"] = so_err
+            sys.stderr.write(f"[bench] WARNING: {so_err}\n")
+        else:
+            for key, value in so_result.get("extra", {}).items():
+                if key.startswith("scaleout_"):
+                    result["extra"][key] = value
 
     if result is None:
         # Never hang the driver: report the failure as the metric line.

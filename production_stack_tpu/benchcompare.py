@@ -41,6 +41,11 @@ _HIGHER_BETTER = (
     # unified step (0/1 shadow of attention_impl_unified — a
     # regression back to the composed path reads as a drop to 0).
     "ragged_kernel",
+    # --worker scaleout: fraction-of-linear per-chip goodput as
+    # replicas are added (docs/parallelism.md) — the goodput/tok_s
+    # fragments above already classify the raw scaleout_goodput_*
+    # keys; this covers the derived 1->N ratios.
+    "linearity",
 )
 _LOWER_BETTER = (
     "p50", "p90", "p99", "latency", "itl", "ttft", "seconds", "_ms",

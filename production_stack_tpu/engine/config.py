@@ -300,6 +300,26 @@ class ParallelConfig:
     # Prompts this long (tokens) take the sp prefill path; defaults to
     # 2 x prefill_chunk_size when context_parallel_size > 1.
     long_prefill_threshold: Optional[int] = None
+    # Forced ICI-slice count for topology discovery
+    # (parallel/topology.py): 0 = auto-discover (TPU slice coords,
+    # process grouping). >0 splits the visible devices into that many
+    # equal contiguous slices — how the XLA_FLAGS-forced CPU harness
+    # rehearses multi-slice layouts in CI.
+    num_slices: int = 0
+    # Per-axis placement overrides for the MeshPlan, as
+    # "axis=ici|any" pairs ("tp=ici,pp=any"). 'auto' keeps the
+    # defaults: tp/sp confined to one ICI domain (a replica is a
+    # slice), dp/pp free to cross slices over DCN.
+    mesh_placement: str = "auto"
+
+    def __post_init__(self):
+        if self.num_slices < 0:
+            raise ValueError("parallel.num_slices must be >= 0")
+        # Reject placement typos at config time, not first dispatch.
+        from production_stack_tpu.parallel.topology import (
+            parse_placement,
+        )
+        parse_placement(self.mesh_placement)
 
 
 @dataclasses.dataclass
@@ -473,14 +493,11 @@ class EngineConfig:
                 "cache.kv_cache_dtype must be 'auto', 'bf16' or "
                 f"'int8' (got {self.cache.kv_cache_dtype!r})")
         if self.cache.resolved_kv_dtype() == "int8":
-            if (self.parallel.pipeline_parallel_size > 1
-                    or self.parallel.context_parallel_size > 1):
-                raise ValueError(
-                    "kv_cache_dtype='int8' is incompatible with "
-                    "pipeline/context parallelism (the pp shard split "
-                    "and the sp ring walk move plain cache arrays, "
-                    "not QuantKV pytrees; docs/kv_quantization.md "
-                    "§interactions)")
+            # int8 now composes with pipeline/context parallelism:
+            # the pp/sp shard_map boundaries carry QuantKV pytree
+            # specs (congruent data+scale sharding, mirroring
+            # shard_cache) — the former exclusivity raises dissolved
+            # with the topology-aware mesh (docs/parallelism.md).
             # Spend the SAME HBM byte budget on more (narrower)
             # pages: a full-precision slot is head_dim * itemsize
             # bytes, an int8 slot head_dim + 4 (f32 scale) — ~1.9x
@@ -593,10 +610,6 @@ INTERNAL_FIELDS = {
 # tests/ referencing both `token` and field_b's name — deleting
 # either the rejection or its test is a staticcheck failure.
 EXCLUSIVITY_RULES = (
-    ("cache.kv_cache_dtype", "parallel.pipeline_parallel_size",
-     "kv_cache_dtype"),
-    ("cache.kv_cache_dtype", "parallel.context_parallel_size",
-     "kv_cache_dtype"),
     ("scheduler.speculative_k", "scheduler.deferred_kv_writes",
      "deferred_kv"),
     ("engine_role", "scheduler.speculative_k", "engine_role"),
@@ -605,6 +618,11 @@ EXCLUSIVITY_RULES = (
 #   async_scheduling x decode_steps, async_scheduling x
 #   speculative_k, engine_role x async_scheduling. Those combos are
 #   now legal compositions, not rejected pairs.
+# Dissolved by the topology-aware mesh + pp/cp ragged step
+#   (docs/parallelism.md): kv_cache_dtype x pipeline_parallel_size,
+#   kv_cache_dtype x context_parallel_size — QuantKV pytree specs
+#   flow through the pp/sp shard_map boundaries with congruent
+#   data+scale sharding (parallel/mesh.py shard_cache).
 
 
 def bench_1b_model_config() -> ModelConfig:

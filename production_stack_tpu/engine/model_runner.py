@@ -129,13 +129,19 @@ def unified_step_eligible(pipeline_parallel: int = 1,
 
     Used by the server's '--unified-step auto' resolution and
     bench.py's pass gating — one definition so the call sites cannot
-    drift (the deferred_kv_eligible pattern). The ragged program is a
-    single-runner path: the pp/sp runners use their own step bodies,
+    drift (the deferred_kv_eligible pattern). The pp and cp runners
+    now execute the ragged [R, W] block natively — pipeline stages
+    thread the per-row descriptor triple through their microbatch
+    handoffs, and the sp runner shards the W axis
+    (docs/parallelism.md) — so pp/cp no longer disqualify. Still out:
     the multihost bridge broadcasts bimodal payload kinds, and a
     disaggregated role engine by construction never holds prefill and
-    decode work at once — so none of them can mix rows."""
-    return (pipeline_parallel == 1 and context_parallel == 1
-            and not distributed and engine_role == "both")
+    decode work at once, so neither can mix rows. The pp/cp arguments
+    stay in the signature so the call sites (server resolution, bench
+    gating) keep passing their full config — a future disqualifier
+    lands in one place."""
+    del pipeline_parallel, context_parallel  # no longer disqualifying
+    return not distributed and engine_role == "both"
 
 
 def pallas_backend_error(page_size: int) -> Optional[str]:
@@ -422,6 +428,16 @@ class ModelRunner:
                 raise ValueError(
                     "sp x tp needs attention/kv heads divisible by "
                     f"tensor_parallel_size {sp_tp}")
+            # Ragged unified / spec-verify dispatches on the cp
+            # runner shard their W (token) axis over 'sp'
+            # (context_serving.shard_w_forward): multi-token rows
+            # split across the ring devices instead of replicating
+            # the whole [R, W] block per device. Single-token decode
+            # dispatches pass through unsharded.
+            from production_stack_tpu.parallel.context_serving import (
+                shard_w_forward,
+            )
+            self._forward = shard_w_forward(self._forward, mesh)
 
         self._deferred = config.scheduler.deferred_kv_writes
         if self._deferred:
@@ -665,12 +681,10 @@ class ModelRunner:
         # table; the acceptance rule runs in-graph (spec_verify).
         self.spec_width = 0
         if config.scheduler.speculative_k > 0:
-            if (config.parallel.pipeline_parallel_size > 1
-                    or self._sp_size > 1):
-                raise NotImplementedError(
-                    "speculative decoding with pipeline/context "
-                    "parallelism (the pp/sp runners use their own "
-                    "step bodies)")
+            # Composes with pp/cp: the verify program routes through
+            # self._forward, which the pp wiring above already swapped
+            # for the staged pipeline body (same signature), and the
+            # cp wrapper below shards the verify span's W axis.
             self.spec_width = config.scheduler.speculative_k + 1
             # The Pallas prefill kernel may not lower at the thin
             # (decode_width, S) verify shape (Mosaic tiling rules are
@@ -729,12 +743,11 @@ class ModelRunner:
         self.last_unified_rows = 0
         self._unified = bool(config.scheduler.unified_step)
         if self._unified:
-            if (config.parallel.pipeline_parallel_size > 1
-                    or self._sp_size > 1):
-                raise NotImplementedError(
-                    "unified_step with pipeline/context parallelism "
-                    "(the pp/sp runners use their own step bodies — "
-                    "unified_step_eligible)")
+            # Composes with pp (the ragged [R, W] block rides the
+            # staged forward — rows become microbatches, the per-row
+            # descriptor triple threads through each ppermute handoff)
+            # and with cp (the sp wrapper shards the W axis) —
+            # unified_step_eligible dropped both disqualifiers.
             # Resolve the unified step's own attention impl: the
             # fused ragged kernel when it lowers AND is the measured
             # winner, else the composed prefill kernel (probed at the

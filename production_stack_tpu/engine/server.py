@@ -533,6 +533,25 @@ class EngineServer:
         self.kv_summary = PrefixSummaryTracker(
             top_k=kve.summary_top_k, admit_hits=kve.admit_hits,
             ttl_s=kve.ttl_s)
+        # Topology observability (docs/parallelism.md): which slice
+        # this process's first local device belongs to, resolved once
+        # (jax.devices() order is stable for the process lifetime).
+        self._slice_id_cache: Optional[int] = None
+
+    def _slice_id(self) -> int:
+        if self._slice_id_cache is None:
+            try:
+                from production_stack_tpu.parallel.topology import (
+                    discover_topology,
+                )
+                import jax
+                topo = discover_topology(
+                    num_slices=self.engine.config.parallel.num_slices)
+                self._slice_id_cache = topo.slice_of(
+                    jax.local_devices()[0])
+            except Exception:
+                self._slice_id_cache = 0
+        return self._slice_id_cache
 
     # -- decoding helpers ---------------------------------------------------
 
@@ -2216,6 +2235,34 @@ class EngineServer:
             for phase, impl in sorted(obs.attention_impls().items()):
                 lines.append("vllm:engine_attention_impl{phase=\""
                              f"{phase}\",impl=\"{impl}\"}} 1.0")
+        # Topology observability (docs/parallelism.md): the mesh the
+        # engine actually runs on, which slice this process owns, and
+        # per-slice liveness from the multihost bridge (a dead host
+        # names ONE slice here instead of indicting the whole pool).
+        lines.append("# TYPE vllm:engine_mesh_shape gauge")
+        mesh = getattr(self.engine.runner, "mesh", None)
+        par = self.engine.config.parallel
+        axis_sizes = (dict(zip(mesh.axis_names, mesh.devices.shape))
+                      if mesh is not None else
+                      {"dp": 1, "pp": par.pipeline_parallel_size,
+                       "sp": par.context_parallel_size,
+                       "tp": par.tensor_parallel_size})
+        for axis in ("dp", "pp", "sp", "tp"):
+            lines.append("vllm:engine_mesh_shape{axis=\""
+                         f"{axis}\"}} "
+                         f"{float(axis_sizes.get(axis, 1))}")
+        lines.append("# TYPE vllm:engine_slice_id gauge")
+        lines.append(
+            f"vllm:engine_slice_id {float(self._slice_id())}")
+        lines.append("# TYPE vllm:engine_slice_live gauge")
+        bridge = getattr(self.engine.runner, "bridge", None)
+        if bridge is not None:
+            live_map = bridge.check_liveness()
+        else:
+            live_map = {self._slice_id(): True}
+        for slice_id, live in sorted(live_map.items()):
+            lines.append("vllm:engine_slice_live{slice=\""
+                         f"{slice_id}\"}} {float(live)}")
         # vLLM-parity request-latency histograms + token counters.
         lines.extend(self.engine.metrics.render())
         lines.append("")
@@ -2377,12 +2424,18 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
 
     if (args.tensor_parallel_size > 1
             or args.pipeline_parallel_size > 1
-            or args.context_parallel_size > 1):
+            or args.context_parallel_size > 1
+            or args.num_slices > 1):
         from production_stack_tpu.parallel.mesh import build_mesh
+        from production_stack_tpu.parallel.topology import (
+            parse_placement,
+        )
         mesh = build_mesh(
             tensor_parallel_size=args.tensor_parallel_size,
             pipeline_parallel_size=args.pipeline_parallel_size,
             context_parallel_size=args.context_parallel_size,
+            num_slices=args.num_slices,
+            placement=parse_placement(args.mesh_placement),
         )
 
     config = EngineConfig(
@@ -2412,6 +2465,8 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             pipeline_parallel_size=args.pipeline_parallel_size,
             context_parallel_size=args.context_parallel_size,
             long_prefill_threshold=args.long_prefill_threshold,
+            num_slices=args.num_slices,
+            mesh_placement=args.mesh_placement,
         ),
         offload=OffloadConfig(
             enable=args.enable_kv_offload or bool(args.kv_remote_url),
@@ -2560,6 +2615,16 @@ def parse_args(argv=None):
                         help="Prompt length (tokens) that takes the "
                              "context-parallel prefill path (default "
                              "2 x prefill-chunk-size)")
+    parser.add_argument("--num-slices", type=int, default=0,
+                        help="Force the device topology into N equal "
+                             "contiguous slices (CPU harness / "
+                             "override); 0 auto-discovers ICI or "
+                             "process grouping (parallel/topology.py)")
+    parser.add_argument("--mesh-placement", default="auto",
+                        help="Per-axis mesh placement as 'axis=ici' / "
+                             "'axis=any' pairs (comma separated); "
+                             "'auto' keeps tp/sp inside one ICI "
+                             "domain and lets dp/pp cross slices")
     parser.add_argument("--disable-prefix-caching", action="store_true")
     parser.add_argument("--enable-lora", action="store_true",
                         help="Enable multi-LoRA adapter serving")
@@ -2772,7 +2837,14 @@ def main(argv=None) -> None:
         init_distributed(args.coordinator_address, args.num_processes,
                          args.process_id)
         engine, served_name = build_engine_from_args(args)
-        bridge = MultihostStepBridge(engine.runner)
+        # Size the liveness ledger from the discovered topology so a
+        # dead host's missing acks name one slice on /metrics.
+        from production_stack_tpu.parallel.topology import (
+            discover_topology,
+        )
+        topo = discover_topology(num_slices=args.num_slices)
+        bridge = MultihostStepBridge(engine.runner,
+                                     num_slices=topo.num_slices)
         # Build the embedder on EVERY host now: embed programs run
         # collectives over the global mesh, so workers must be able to
         # mirror KIND_EMBED payloads — a host-0-only lazy build would

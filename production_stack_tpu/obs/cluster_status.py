@@ -45,6 +45,17 @@ def _server_entry(stats, now: float) -> dict:
         "step_time_median_s": {
             k: round(float(v), 6) for k, v in
             sorted(g("step_time_median_by_kind", {}).items())},
+        # Topology (docs/parallelism.md): the engine's mesh axis
+        # sizes, which slice its devices sit on, and per-slice
+        # liveness from the multihost bridge.
+        "mesh": {
+            "shape": {k: int(v) for k, v in
+                      sorted(g("mesh_shape_by_axis", {}).items())},
+            "slice_id": int(g("engine_slice_id")),
+            "slices_live": {k: bool(v) for k, v in
+                            sorted(g("slice_live_by_id",
+                                     {}).items())},
+        },
     }
 
 

@@ -44,6 +44,8 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from production_stack_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from production_stack_tpu.engine.config import ModelConfig
@@ -70,6 +72,41 @@ Params = Dict[str, jnp.ndarray]
 # round-3 "second family" widening).
 SP_FAMILIES = ("llama", "mistral", "qwen2", "gpt2")
 
+
+def shard_w_forward(forward, mesh: Mesh):
+    """Wrap the engine forward so multi-token dispatches shard their W
+    (token) axis over ``sp``.
+
+    The cp runner's unified ragged step (docs/unified_step.md) and
+    spec-verify program route through the PLAIN forward — without a
+    constraint GSPMD replicates the whole [R, W] block on every ring
+    device. Pinning tokens/positions/valid to P(None, 'sp') makes the
+    partitioner split the W axis (QK^T's query axis — parallel, not a
+    reduction), so the math and therefore the greedy byte stream are
+    unchanged while each device computes W/sp columns. Single-token
+    decode dispatches (W == 1) pass through unsharded — nothing to
+    split."""
+    from jax.sharding import NamedSharding
+
+    from production_stack_tpu.parallel.mesh import _on_mesh
+
+    w_sharding = NamedSharding(mesh, _on_mesh(P(None, "sp"), mesh))
+
+    def wrapped(params, config, tokens, positions, page_table,
+                kv_lens, valid, k_cache, v_cache,
+                lora=None, lora_ids=None):
+        if tokens.shape[1] > 1:
+            constrain = (
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, w_sharding))
+            tokens = constrain(tokens)
+            positions = constrain(positions)
+            valid = constrain(valid)
+        return forward(params, config, tokens, positions, page_table,
+                       kv_lens, valid, k_cache, v_cache,
+                       lora=lora, lora_ids=lora_ids)
+
+    return wrapped
 
 
 def sp_prefill_forward(params: Params, config: ModelConfig,
@@ -243,7 +280,14 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
     repl = P()
     # KV cache shards its head axis over 'tp' (parallel/mesh.py
     # cache_spec): each device scatters the K/V heads it computed.
+    # QuantKV caches carry a pytree spec — the 4-D scale leaf drops
+    # the (always-replicated) head_dim entry, congruent with how
+    # shard_cache places the two leaves.
     cache_sp = on_mesh(P(None, "tp", None, None, None))
+    from production_stack_tpu.ops.quant_kv import QuantKV
+    if isinstance(k_cache, QuantKV):
+        cache_sp = QuantKV(cache_sp,
+                           P(*cache_sp[:3], cache_sp[4]))
     def lp_spec(k):
         spec = on_mesh(specs.get(k, repl))
         if isinstance(layer_params[k], tuple):
@@ -262,7 +306,7 @@ def sp_prefill_forward(params: Params, config: ModelConfig,
     else:
         from production_stack_tpu.engine.lora import lora_stack_specs
         lora_ab_spec = lora_stack_specs(lora_ab, None, on_mesh)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=({k: lp_spec(k) for k in layer_params},
                   {k: on_mesh(specs.get(k, repl)) for k in shared},

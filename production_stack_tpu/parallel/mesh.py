@@ -23,23 +23,34 @@ def build_mesh(tensor_parallel_size: int = 1,
                data_parallel_size: int = 1,
                pipeline_parallel_size: int = 1,
                context_parallel_size: int = 1,
-               devices=None) -> Mesh:
+               devices=None,
+               num_slices: int = 0,
+               placement=None) -> Mesh:
     """(dp, pp, sp, tp) mesh. tp is innermost so tensor-parallel
     collectives ride adjacent ICI links; sp ring hops are next (ring
     attention's ppermute neighbours stay adjacent); pp stage hops
-    cross the slowest dimension (or DCN on multi-slice)."""
-    devices = devices if devices is not None else jax.devices()
-    needed = (tensor_parallel_size * data_parallel_size
-              * pipeline_parallel_size * context_parallel_size)
-    if len(devices) < needed:
-        raise ValueError(
-            f"Mesh needs {needed} devices, have {len(devices)}"
-        )
-    grid = np.asarray(devices[:needed]).reshape(
-        data_parallel_size, pipeline_parallel_size,
-        context_parallel_size, tensor_parallel_size
+    cross the slowest dimension (or DCN on multi-slice).
+
+    Thin wrapper over the declarative ``MeshPlan``
+    (parallel/topology.py): the device grid is laid out slice-major
+    over the DISCOVERED topology and the plan is validated against it
+    — tp straddling a slice boundary is a config-time ValueError here,
+    not a silent DCN-slow collective at first dispatch."""
+    from production_stack_tpu.parallel.topology import (
+        MeshPlan,
+        discover_topology,
     )
-    return Mesh(grid, axis_names=("dp", "pp", "sp", "tp"))
+    topology = discover_topology(devices, num_slices=num_slices)
+    plan = MeshPlan(
+        tp=tensor_parallel_size, dp=data_parallel_size,
+        pp=pipeline_parallel_size, sp=context_parallel_size,
+        **({"placement": placement} if placement else {}))
+    if plan.num_devices > topology.num_devices:
+        raise ValueError(
+            f"Mesh needs {plan.num_devices} devices, "
+            f"have {topology.num_devices}"
+        )
+    return plan.build(topology)
 
 
 # PartitionSpecs per parameter name. Layer-stacked params have a leading
@@ -114,11 +125,30 @@ def _pp_size(mesh: Optional[Mesh]) -> int:
     return mesh.shape["pp"]
 
 
+# The canonical axis vocabulary (parallel/topology.py AXIS_ORDER):
+# _on_mesh may legally drop one of these when a caller-built mesh
+# carries a subset, but anything else in a spec is a typo.
+_KNOWN_AXES = ("dp", "pp", "sp", "tp")
+
+
 def _on_mesh(spec: P, mesh: Mesh) -> P:
-    """Drop axis names the mesh doesn't carry (a caller-built mesh may
-    have only a subset of build_mesh's four axes — e.g. an ('sp',)
-    mesh for context-parallel prefill): absent axes mean replicated."""
-    return P(*(a if a in mesh.axis_names else None for a in spec))
+    """Drop KNOWN axis names the mesh doesn't carry (a caller-built
+    mesh may have only a subset of build_mesh's four axes — e.g. an
+    ('sp',) mesh for context-parallel prefill): absent known axes mean
+    replicated. An axis name that is neither on the mesh nor in the
+    canonical vocabulary is a spec typo — silently replicating it
+    would shard nothing and waste HBM quietly, so fail loudly."""
+    def keep(a):
+        names = a if isinstance(a, (tuple, list)) else (a,)
+        for name in names:
+            if (name is not None and name not in mesh.axis_names
+                    and name not in _KNOWN_AXES):
+                raise ValueError(
+                    f"PartitionSpec axis {name!r} is neither a mesh "
+                    f"axis {tuple(mesh.axis_names)} nor a known axis "
+                    f"{_KNOWN_AXES} — misspelled spec?")
+        return a if all(n in mesh.axis_names for n in names) else None
+    return P(*(keep(a) for a in spec))
 
 
 def shard_params(params: Dict[str, jax.Array], config: ModelConfig,

@@ -38,6 +38,16 @@ Design (idiomatic JAX, static shapes):
 Families: the llama body covers llama/mistral/qwen2; gpt2 has its own
 layer body (layer_norm + learned positions + gelu — round-2 gap:
 pp was llama-only).
+
+Ragged unified step (docs/unified_step.md, docs/parallelism.md): the
+forward is shape-generic in T, so the unified [R, W] mixed block and
+the spec-verify span ride the SAME staged body — ragged rows become
+microbatches and the per-row descriptor triple (kv_lens, last_index
+via positions/valid, draft spans) reshapes into the per-tick
+microbatch views, threading through every ppermute handoff
+unchanged. QuantKV int8 caches cross the shard_map boundary with a
+pytree spec (data + head_dim-less scale sharded congruently), which
+is what dissolved the int8 x pp exclusivity rule.
 """
 
 from __future__ import annotations
@@ -46,6 +56,8 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from production_stack_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from production_stack_tpu.engine.config import ModelConfig
@@ -357,6 +369,14 @@ def pp_paged_forward(params: Params, config: ModelConfig,
     lp_specs = {k: lp_spec(k) for k in layer_params}
     shared_specs = {k: on_mesh(specs.get(k, P())) for k in shared}
     cache_spec = on_mesh(mesh_cache_spec(mesh))
+    # QuantKV caches (int8 pages + per-slot f32 scales) cross the
+    # shard_map boundary as a pytree spec: the 4-D scale leaf lacks
+    # the head_dim axis, so its spec drops that entry — congruent
+    # data+scale sharding, mirroring parallel/mesh.py shard_cache.
+    from production_stack_tpu.ops.quant_kv import QuantKV
+    if isinstance(k_cache, QuantKV):
+        cache_spec = QuantKV(cache_spec,
+                             P(*cache_spec[:3], cache_spec[4]))
     repl = P()
     # Adapter stacks: leading L over pp; under tp each target shards
     # like its base projection (the shared rule —
@@ -368,7 +388,7 @@ def pp_paged_forward(params: Params, config: ModelConfig,
     else:
         from production_stack_tpu.engine.lora import lora_stack_specs
         lora_ab_spec = lora_stack_specs(lora_ab, "pp", on_mesh)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(lp_specs, shared_specs, cache_spec, cache_spec,
                   repl, repl, repl, repl, repl,

@@ -1,0 +1,260 @@
+"""Topology discovery + declarative mesh planning (``MeshPlan``).
+
+The flat ``build_mesh`` grid reshape (parallel/mesh.py) assumed every
+device is one ICI hop from every other — true on a single slice,
+false the moment a deployment spans slices (multislice TPU) or hosts
+(CPU rigs, the forced-device CI harness). This module makes the mesh
+*topology-aware*:
+
+- ``discover_topology`` groups devices into **slices** (ICI domains):
+  TPU ``slice_index`` coords when the runtime exposes them, process
+  grouping otherwise, and an explicit ``num_slices`` override so the
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` CPU harness
+  can rehearse multi-slice layouts in CI.
+- ``MeshPlan`` is the declarative replacement for the positional
+  ``build_mesh`` arguments: axis sizes plus per-axis *placement*
+  ("ici" = must not straddle a slice boundary, "any" = may cross
+  slices over DCN). The plan validates against the discovered
+  topology at build time, so ``tp`` straddling a slice boundary is a
+  config-time ``ValueError``, not a silent DCN-slow collective.
+- The slice-as-replica rule falls out of the device order: slices
+  concatenate slice-major and ``dp`` is the outermost axis, so with
+  ``dp == num_slices`` each data-parallel replica IS one slice and
+  only ``dp`` traffic (none, for serving) crosses DCN.
+
+``parallel.mesh.build_mesh`` delegates here and keeps its signature —
+existing callers get topology validation for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# Mesh axis order, outermost first: tp innermost so tensor-parallel
+# collectives ride adjacent ICI links; sp ring hops next; pp stage
+# hops cross the slowest dimension; dp (pure replication) outermost
+# so a replica maps onto a contiguous — ideally whole-slice — device
+# block.
+AXIS_ORDER: Tuple[str, ...] = ("dp", "pp", "sp", "tp")
+
+# Default per-axis placement: tensor-parallel and the sp ring want
+# every hop on ICI; pipeline hops and replica fan-out tolerate DCN.
+DEFAULT_PLACEMENT: Dict[str, str] = {
+    "dp": "any",
+    "pp": "any",
+    "sp": "ici",
+    "tp": "ici",
+}
+
+# Forced slice count for rigs where discovery has nothing to go on
+# (the CI harness: one process, N fake CPU devices). CLI surface is
+# --num-slices (engine/server.py); the env var serves bare pytest.
+_FAKE_SLICES_ENV = "PSTPU_NUM_SLICES"
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceTopology:
+    """Devices grouped into ICI domains ("slices"), slice-major.
+
+    ``source`` records how the grouping was derived: "ici" (TPU
+    slice_index coords), "process" (one slice per host process),
+    "forced" (explicit num_slices override), or "flat" (no grouping
+    signal — one slice).
+    """
+
+    slices: Tuple[Tuple[object, ...], ...]
+    source: str = "flat"
+
+    @property
+    def num_slices(self) -> int:
+        return len(self.slices)
+
+    @property
+    def slice_size(self) -> int:
+        return len(self.slices[0]) if self.slices else 0
+
+    @property
+    def devices(self) -> Tuple[object, ...]:
+        return tuple(d for s in self.slices for d in s)
+
+    @property
+    def num_devices(self) -> int:
+        return sum(len(s) for s in self.slices)
+
+    def slice_of(self, device) -> int:
+        for i, group in enumerate(self.slices):
+            if any(d is device or d == device for d in group):
+                return i
+        raise ValueError(f"device {device!r} not in this topology")
+
+    def describe(self) -> str:
+        return (f"{self.num_devices} devices in {self.num_slices} "
+                f"slice(s) of {self.slice_size} ({self.source})")
+
+
+def discover_topology(devices: Optional[Sequence] = None,
+                      num_slices: int = 0) -> DeviceTopology:
+    """Group ``devices`` (default: ``jax.devices()``) into slices.
+
+    Precedence: an explicit ``num_slices`` (or the PSTPU_NUM_SLICES
+    env var) forces an even contiguous split — the CI harness's fake
+    multislice; otherwise TPU ``slice_index`` attributes group real
+    multislice deployments; otherwise multiple process indices group
+    one slice per host; otherwise everything is one flat slice.
+    """
+    devices = (list(jax.devices()) if devices is None
+               else list(devices))
+    if not devices:
+        raise ValueError("discover_topology needs at least one device")
+    if num_slices <= 0:
+        num_slices = int(os.environ.get(_FAKE_SLICES_ENV, "0") or 0)
+    if num_slices > 0:
+        n = len(devices)
+        if num_slices > n or n % num_slices:
+            raise ValueError(
+                f"num_slices={num_slices} must evenly divide the "
+                f"{n} visible devices")
+        size = n // num_slices
+        return DeviceTopology(
+            tuple(tuple(devices[i * size:(i + 1) * size])
+                  for i in range(num_slices)),
+            source="forced")
+    slice_ids = [getattr(d, "slice_index", None) for d in devices]
+    if all(s is not None for s in slice_ids) and len(set(slice_ids)) > 1:
+        groups: Dict[int, list] = {}
+        for d, s in zip(devices, slice_ids):
+            groups.setdefault(int(s), []).append(d)
+        return DeviceTopology(
+            tuple(tuple(groups[s]) for s in sorted(groups)),
+            source="ici")
+    procs = [getattr(d, "process_index", 0) for d in devices]
+    if len(set(procs)) > 1:
+        pgroups: Dict[int, list] = {}
+        for d, p in zip(devices, procs):
+            pgroups.setdefault(int(p), []).append(d)
+        return DeviceTopology(
+            tuple(tuple(pgroups[p]) for p in sorted(pgroups)),
+            source="process")
+    return DeviceTopology((tuple(devices),), source="flat")
+
+
+def parse_placement(text: str) -> Dict[str, str]:
+    """Parse a ``--mesh-placement`` override: "tp=ici,pp=any,...".
+
+    "auto" (or empty) keeps :data:`DEFAULT_PLACEMENT`. Unknown axis
+    names and placement values are rejected loudly.
+    """
+    placement = dict(DEFAULT_PLACEMENT)
+    if not text or text == "auto":
+        return placement
+    for entry in text.split(","):
+        axis, _, where = entry.strip().partition("=")
+        if axis not in AXIS_ORDER:
+            raise ValueError(
+                f"mesh_placement axis {axis!r} unknown "
+                f"(axes: {'/'.join(AXIS_ORDER)})")
+        if where not in ("ici", "any"):
+            raise ValueError(
+                f"mesh_placement for {axis!r} must be 'ici' or 'any' "
+                f"(got {where!r})")
+        placement[axis] = where
+    return placement
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Declarative mesh: axis sizes + per-axis placement.
+
+    Field reference lives in docs/parallelism.md (staticcheck's
+    config-contract keeps the two in sync). ``placement`` maps axis
+    name -> "ici" (the axis's contiguous device block must fit inside
+    one slice) or "any" (may span slices over DCN).
+    """
+
+    tp: int = 1
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    placement: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_PLACEMENT))
+
+    def __post_init__(self):
+        for axis in AXIS_ORDER:
+            if getattr(self, axis) < 1:
+                raise ValueError(f"MeshPlan.{axis} must be >= 1")
+        for axis, where in self.placement.items():
+            if axis not in AXIS_ORDER:
+                raise ValueError(
+                    f"MeshPlan placement axis {axis!r} unknown "
+                    f"(axes: {'/'.join(AXIS_ORDER)})")
+            if where not in ("ici", "any"):
+                raise ValueError(
+                    f"MeshPlan placement for {axis!r} must be 'ici' "
+                    f"or 'any' (got {where!r})")
+
+    @property
+    def axis_sizes(self) -> Dict[str, int]:
+        return {axis: getattr(self, axis) for axis in AXIS_ORDER}
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp
+
+    def _inner_block(self, axis: str) -> int:
+        """Contiguous device-block length axis ``axis`` spans: its own
+        size times every axis inner to it (device order is row-major
+        over AXIS_ORDER, so inner axes vary fastest)."""
+        sizes = self.axis_sizes
+        block = 1
+        for a in reversed(AXIS_ORDER):
+            block *= sizes[a]
+            if a == axis:
+                return block
+        raise ValueError(f"unknown axis {axis!r}")
+
+    def validate(self, topology: DeviceTopology) -> None:
+        """Reject plans the discovered topology cannot carry."""
+        if self.num_devices > topology.num_devices:
+            raise ValueError(
+                f"MeshPlan needs {self.num_devices} devices, "
+                f"topology has {topology.num_devices} "
+                f"({topology.describe()})")
+        sizes = {len(s) for s in topology.slices}
+        if len(sizes) > 1:
+            raise ValueError(
+                "MeshPlan needs equal-size slices "
+                f"(got sizes {sorted(sizes)})")
+        slice_size = topology.slice_size
+        for axis in AXIS_ORDER:
+            where = self.placement.get(
+                axis, DEFAULT_PLACEMENT[axis])
+            if where != "ici" or getattr(self, axis) == 1:
+                continue
+            block = self._inner_block(axis)
+            if block > slice_size or slice_size % block:
+                raise ValueError(
+                    f"MeshPlan axis '{axis}' (size "
+                    f"{getattr(self, axis)}, contiguous block "
+                    f"{block}) would straddle a slice boundary: "
+                    f"slices are {slice_size} devices wide "
+                    f"({topology.describe()}). Shrink the axis or "
+                    f"place it 'any' to allow DCN hops.")
+
+    def build(self, topology: Optional[DeviceTopology] = None) -> Mesh:
+        """Validate against ``topology`` (default: discovered) and
+        build the ``(dp, pp, sp, tp)`` mesh over slice-major devices —
+        so ``dp == num_slices`` makes each replica one slice."""
+        if topology is None:
+            topology = discover_topology()
+        self.validate(topology)
+        grid = np.asarray(
+            topology.devices[: self.num_devices], dtype=object
+        ).reshape(self.dp, self.pp, self.sp, self.tp)
+        return Mesh(grid, axis_names=AXIS_ORDER)

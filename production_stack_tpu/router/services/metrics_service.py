@@ -220,6 +220,22 @@ engine_attention_impl = Gauge(
     "Engine-reported resolved attention impl per phase as a one-hot "
     "labeled info gauge — alarms the silent XLA fallback (scraped)",
     ["server", "phase", "impl"])
+# Topology observability (docs/parallelism.md): each engine's mesh
+# axis sizes, the slice its devices sit on, and per-slice liveness
+# from its multihost bridge, re-exported per server.
+engine_mesh_shape = Gauge(
+    "vllm:engine_mesh_shape",
+    "Engine-reported mesh axis size per axis (dp/pp/sp/tp) "
+    "(scraped)", ["server", "axis"])
+engine_slice_id = Gauge(
+    "vllm:engine_slice_id",
+    "Engine-reported topology slice of the engine's devices "
+    "(scraped)", _LBL)
+engine_slice_live = Gauge(
+    "vllm:engine_slice_live",
+    "Engine-reported per-slice liveness from the multihost step "
+    "bridge; a dead host drops exactly one slice to 0 (scraped)",
+    ["server", "slice"])
 # KV economy (docs/kv_economy.md): each engine's KV-state summary and
 # its view of the shared cluster cache tier, re-exported per server,
 # plus the routing policy's expected-hit signal.
@@ -567,6 +583,13 @@ def refresh_gauges() -> None:
         for phase, impl in es.attention_impl_by_phase.items():
             engine_attention_impl.labels(
                 server=server, phase=phase, impl=impl).set(1)
+        for axis, value in es.mesh_shape_by_axis.items():
+            engine_mesh_shape.labels(
+                server=server, axis=axis).set(value)
+        engine_slice_id.labels(server=server).set(es.engine_slice_id)
+        for slice_id, live in es.slice_live_by_id.items():
+            engine_slice_live.labels(
+                server=server, slice=slice_id).set(live)
         engine_kv_summary_hot_chains.labels(server=server).set(
             es.kv_summary_hot_chains or len(es.kv_hot_chains))
         engine_kv_free_page_headroom.labels(server=server).set(

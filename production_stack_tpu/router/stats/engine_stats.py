@@ -74,6 +74,10 @@ _METRIC_MAP = {
     # Zero-loss drain (docs/fleet.md): 1 while the engine rejects new
     # admissions and finishes its in-flight sequences.
     "vllm:engine_draining": "engine_draining",
+    # Topology observability (docs/parallelism.md): which slice this
+    # engine process's devices belong to; the labeled mesh-shape and
+    # per-slice-liveness families are handled in from_prometheus_text.
+    "vllm:engine_slice_id": "engine_slice_id",
     # Device performance observatory (docs/observability.md): the
     # unlabeled MFU gauge; the labeled compile/HBM/step-time families
     # are handled in from_prometheus_text.
@@ -221,6 +225,15 @@ class EngineStats:
     engine_mfu: float = 0.0
     attention_impl_by_phase: Dict[str, str] = field(
         default_factory=dict)
+    # Topology observability (docs/parallelism.md): the engine's mesh
+    # axis sizes (vllm:engine_mesh_shape{axis="dp|pp|sp|tp"}), the
+    # slice its devices sit on (vllm:engine_slice_id), and per-slice
+    # liveness from the multihost bridge
+    # (vllm:engine_slice_live{slice}) — a dead host shows up here as
+    # ONE slice going 0.0 while the rest of the mesh stays 1.0.
+    mesh_shape_by_axis: Dict[str, float] = field(default_factory=dict)
+    engine_slice_id: float = 0.0
+    slice_live_by_id: Dict[str, float] = field(default_factory=dict)
     # KV economy (docs/kv_economy.md): the engine's rolling KV-state
     # summary. Gauges mirror GET /kv/summary; kv_hot_chains carries
     # the advertised chain hashes themselves (hash -> decayed hits),
@@ -285,6 +298,14 @@ class EngineStats:
                         == "vllm:engine_step_time_median_seconds"):
                     stats.step_time_median_by_kind[
                         sample.labels.get("kind", "")] = sample.value
+                    continue
+                if sample.name == "vllm:engine_mesh_shape":
+                    stats.mesh_shape_by_axis[
+                        sample.labels.get("axis", "")] = sample.value
+                    continue
+                if sample.name == "vllm:engine_slice_live":
+                    stats.slice_live_by_id[
+                        sample.labels.get("slice", "")] = sample.value
                     continue
                 if (sample.name == "vllm:engine_attention_impl"
                         and sample.value == 1.0):

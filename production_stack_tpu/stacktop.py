@@ -91,6 +91,7 @@ def render_snapshot(snap: dict, changed: Optional[set] = None) -> str:
         lines.append("")
         lines.append(
             f"{'SERVER':<42} {'HEALTH':<7} {'ROLE':<7} "
+            f"{'MESH':<9} "
             f"{'RUN':>4} {'WAIT':>4} {'CACHE':>6} {'HIT':>6} "
             f"{'MFU':>6} {'SHED':>5} {'COMPILES':>8}")
         for url in sorted(servers):
@@ -99,10 +100,24 @@ def render_snapshot(snap: dict, changed: Optional[set] = None) -> str:
                 "ok" if s.get("healthy", True) else "DOWN")
             shed = sum((s.get("qos_shed") or {}).values())
             compiles = sum((s.get("compile_events") or {}).values())
+            # Mesh axis sizes as dpxppxspxtp; a trailing "!" flags a
+            # dead slice (docs/parallelism.md) — the one-glance cue
+            # that a multi-host replica lost a host.
+            mesh_info = s.get("mesh") or {}
+            shape = mesh_info.get("shape") or {}
+            if shape:
+                mesh = "x".join(str(int(shape.get(a, 1)))
+                                for a in ("dp", "pp", "sp", "tp"))
+                slices_live = mesh_info.get("slices_live") or {}
+                if slices_live and not all(slices_live.values()):
+                    mesh += "!"
+            else:
+                mesh = "-"
             mark = "*" if url in changed else " "
             row = (
                 f"{url:<41}{mark} {health:<7} "
                 f"{str(s.get('role') or '-'):<7} "
+                f"{mesh:<9} "
                 f"{_fmt(s.get('running'), 4)} "
                 f"{_fmt(s.get('waiting'), 4)} "
                 f"{s.get('cache_usage', 0.0):>6.2f} "

@@ -49,6 +49,9 @@ from production_stack_tpu.staticcheck.core import (
 
 CONFIG_FILE = "production_stack_tpu/engine/config.py"
 SERVER_FILE = "production_stack_tpu/engine/server.py"
+TOPOLOGY_FILE = "production_stack_tpu/parallel/topology.py"
+MESH_FILE = "production_stack_tpu/parallel/mesh.py"
+PARALLELISM_DOC = "docs/parallelism.md"
 FLEET_SPEC_FILE = "production_stack_tpu/fleet/spec.py"
 FLEET_CLI_FILE = "production_stack_tpu/fleet/__main__.py"
 FLEET_DOC_FILE = "docs/fleet.md"
@@ -275,6 +278,67 @@ def check(project: Project) -> List[Finding]:
 
     # (5) fleet spec fields parsed + documented (or marked internal).
     findings.extend(_check_fleet_spec(project))
+
+    # (6) MeshPlan fields threaded through build_mesh + documented.
+    findings.extend(_check_mesh_plan(project))
+    return findings
+
+
+def _check_mesh_plan(project: Project) -> List[Finding]:
+    """The topology-aware mesh surface (docs/parallelism.md): every
+    ``MeshPlan`` dataclass field must be reachable from
+    ``parallel/mesh.py build_mesh`` (a keyword in a MeshPlan(...)
+    call, or named as a string literal for dict-threaded kwargs) and
+    documented in docs/parallelism.md — a plan knob nobody can set,
+    or set but nobody can read about, is drift."""
+    findings: List[Finding] = []
+    topology = project.source(TOPOLOGY_FILE)
+    mesh = project.source(MESH_FILE)
+    for path, sf in ((TOPOLOGY_FILE, topology), (MESH_FILE, mesh)):
+        if sf is None or sf.tree is None:
+            findings.append(_finding(
+                path, "config-contract surface file missing — if the "
+                      "parallel layer moved, update "
+                      "staticcheck/analyzers/config_contract.py"))
+    if findings:
+        return findings
+    plan_fields = _dataclass_fields(topology.tree).get("MeshPlan")
+    if not plan_fields:
+        return [_finding(
+            TOPOLOGY_FILE,
+            "MeshPlan class not found in parallel/topology.py — if "
+            "the mesh plan moved, update "
+            "staticcheck/analyzers/config_contract.py")]
+    reachable: Set[str] = set()
+    for node in ast.walk(mesh.tree):
+        if (isinstance(node, ast.Call)
+                and tail_name(node.func) == "MeshPlan"):
+            reachable.update(kw.arg for kw in node.keywords
+                             if kw.arg is not None)
+        if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                         str):
+            reachable.add(node.value)
+    doc = project.source(PARALLELISM_DOC)
+    doc_text = doc.text if doc is not None else ""
+    if not doc_text:
+        findings.append(_finding(
+            PARALLELISM_DOC,
+            "docs/parallelism.md missing — the MeshPlan surface has "
+            "no documented contract"))
+    for field in sorted(plan_fields):
+        if field not in reachable:
+            findings.append(_finding(
+                TOPOLOGY_FILE,
+                f"MeshPlan field {field} is not threaded through "
+                "parallel/mesh.py build_mesh — operators cannot set "
+                "it from the engine config"))
+        if doc_text and not re.search(
+                r"(?<!\w)" + re.escape(field) + r"(?![\w-])",
+                doc_text):
+            findings.append(_finding(
+                TOPOLOGY_FILE,
+                f"MeshPlan field {field} is not documented in "
+                "docs/parallelism.md"))
     return findings
 
 

@@ -1,0 +1,15 @@
+"""Version-compatibility shims for the pinned accelerator stack."""
+
+import jax
+
+
+def shard_map(body, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` graduated out of ``jax.experimental`` (and
+    renamed ``check_rep`` -> ``check_vma``) around jax 0.5; serve both
+    spellings so the parallel layer runs on either runtime."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

@@ -71,26 +71,20 @@ def _greedy(engine, prompts, max_tokens=12):
 def test_kv_dtype_validation():
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         _engine(kv_dtype="fp8")
-    with pytest.raises(ValueError, match="kv_cache_dtype"):
-        EngineConfig(
+    # int8 composes with pipeline and context parallelism: the
+    # shard_map boundaries carry congruent QuantKV pytree specs
+    # (docs/parallelism.md), so these configs now construct cleanly.
+    for parallel in (ParallelConfig(pipeline_parallel_size=2),
+                     ParallelConfig(context_parallel_size=2)):
+        cfg = EngineConfig(
             model=tiny_model_config("llama"),
             cache=CacheConfig(page_size=16, num_pages=64,
                               kv_cache_dtype="int8"),
             scheduler=SchedulerConfig(max_num_seqs=4,
                                       max_model_len=256),
-            parallel=ParallelConfig(pipeline_parallel_size=2),
+            parallel=parallel,
         )
-    # Context parallelism moves plain cache arrays through the sp
-    # ring walk, so int8 QuantKV pages are rejected the same way.
-    with pytest.raises(ValueError, match="kv_cache_dtype"):
-        EngineConfig(
-            model=tiny_model_config("llama"),
-            cache=CacheConfig(page_size=16, num_pages=64,
-                              kv_cache_dtype="int8"),
-            scheduler=SchedulerConfig(max_num_seqs=4,
-                                      max_model_len=256),
-            parallel=ParallelConfig(context_parallel_size=2),
-        )
+        assert cfg.cache.resolved_kv_dtype() == "int8"
 
 
 def test_page_budget_expansion_and_idempotency():

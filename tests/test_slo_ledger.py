@@ -230,6 +230,9 @@ def test_stacktop_plain_render_golden():
             "waiting": 1, "cache_usage": 0.5, "prefix_hit_rate": 0.25,
             "mfu": 0.12, "qos_shed": {"batch": 2},
             "compile_events": {"decode": 7},
+            "mesh": {"shape": {"dp": 1, "pp": 2, "sp": 1, "tp": 2},
+                     "slice_id": 0,
+                     "slices_live": {"0": True}},
         }},
     }
     out = render_snapshot(snap)
@@ -241,11 +244,17 @@ def test_stacktop_plain_render_golden():
         "slow archive: 1/64 (5 archived)",
         "",
         "SERVER                                     HEALTH  ROLE    "
-        " RUN WAIT  CACHE    HIT    MFU  SHED COMPILES",
+        "MESH       RUN WAIT  CACHE    HIT    MFU  SHED COMPILES",
         "http://e1                                  ok      decode  "
-        "   3    1   0.50   0.25   0.12     2        7",
+        "1x2x1x2      3    1   0.50   0.25   0.12     2        7",
     ])
     assert out == expected
+    # A dead slice flags the mesh column; a mesh-less (older) snapshot
+    # renders the placeholder.
+    snap["servers"]["http://e1"]["mesh"]["slices_live"]["1"] = False
+    assert "1x2x1x2!" in render_snapshot(snap)
+    del snap["servers"]["http://e1"]["mesh"]
+    assert "decode  -  " in render_snapshot(snap)
     # A changed server gets its marker; an unhealthy one renders DOWN.
     marked = render_snapshot(snap, changed={"http://e1"})
     assert "http://e1                                * ok" in marked

@@ -482,6 +482,15 @@ _CONFIG_FIXTURE = {
         def parse_args(parser):
             parser.add_argument("--fleet-spec-file")
         """,
+    "production_stack_tpu/parallel/topology.py": """\
+        class MeshPlan:
+            tp: int = 1
+            ghost_axis: int = 1
+        """,
+    "production_stack_tpu/parallel/mesh.py": """\
+        def build_mesh(tensor_parallel_size=1):
+            return MeshPlan(tp=tensor_parallel_size)
+        """,
 }
 
 
@@ -496,6 +505,10 @@ def test_config_contract_catches_planted_drift():
     assert "--page-size appears in no markdown doc" in messages
     # Fleet CLI flags are held to the same docs bar.
     assert "--fleet-spec-file appears in no markdown doc" in messages
+    # MeshPlan field build_mesh never threads (negative fixture).
+    assert ("MeshPlan field ghost_axis is not threaded" in messages)
+    assert ("MeshPlan field ghost_axis is not documented"
+            in messages or "docs/parallelism.md missing" in messages)
 
 
 def test_config_contract_accepts_markers_docs_and_tests():
@@ -506,6 +519,9 @@ def test_config_contract_accepts_markers_docs_and_tests():
         "| `--page-size` | 16 | Tokens per KV page |\n"
         "| `--fleet-spec-file` | required | Fleet spec path |\n")
     fixture["docs/fleet.md"] = "pools name tolerance\n"
+    fixture["production_stack_tpu/parallel/topology.py"] = (
+        "class MeshPlan:\n    tp: int = 1\n")
+    fixture["docs/parallelism.md"] = "MeshPlan `tp` axis placement\n"
     fixture["tests/test_exclusivity.py"] = textwrap.dedent("""\
         import pytest
 

@@ -1,0 +1,260 @@
+"""Topology-aware mesh planning (docs/parallelism.md): slice
+discovery on the forced CPU harness, MeshPlan placement validation
+(slice-as-replica, ICI-straddle rejection), the loud unknown-axis
+error in param spec resolution, and the multihost step bridge over
+the in-process fake transport (follower step ordering, per-slice
+liveness, dead-follower detection).
+
+Runs on the virtual 8-device CPU mesh (tests/conftest.py)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from production_stack_tpu.parallel.topology import (
+    AXIS_ORDER,
+    DEFAULT_PLACEMENT,
+    MeshPlan,
+    discover_topology,
+    parse_placement,
+)
+
+
+# ---- discovery ---------------------------------------------------------
+
+
+def test_forced_slices_partition_evenly():
+    topo = discover_topology(num_slices=2)
+    assert topo.source == "forced"
+    assert topo.num_slices == 2
+    assert topo.slice_size == 4
+    assert topo.devices == tuple(jax.devices()[:8])
+    # Slice-major: first half of the device order is slice 0.
+    assert topo.slice_of(jax.devices()[0]) == 0
+    assert topo.slice_of(jax.devices()[7]) == 1
+
+
+def test_forced_slices_env_var(monkeypatch):
+    monkeypatch.setenv("PSTPU_NUM_SLICES", "4")
+    topo = discover_topology()
+    assert (topo.source, topo.num_slices) == ("forced", 4)
+
+
+def test_forced_slices_must_divide():
+    with pytest.raises(ValueError, match="evenly divide"):
+        discover_topology(num_slices=3)
+
+
+def test_flat_topology_is_one_slice():
+    topo = discover_topology()
+    assert topo.source == "flat"
+    assert topo.num_slices == 1
+    assert topo.slice_size == len(jax.devices())
+
+
+# ---- placement parsing -------------------------------------------------
+
+
+def test_parse_placement_auto_and_overrides():
+    assert parse_placement("auto") == DEFAULT_PLACEMENT
+    assert parse_placement("")["tp"] == "ici"
+    got = parse_placement("pp=ici, dp=any")
+    assert got["pp"] == "ici" and got["tp"] == "ici"
+    with pytest.raises(ValueError, match="axis 'ep' unknown"):
+        parse_placement("ep=ici")
+    with pytest.raises(ValueError, match="must be 'ici' or 'any'"):
+        parse_placement("tp=dcn")
+
+
+# ---- MeshPlan validation + build ---------------------------------------
+
+
+def test_plan_rejects_tp_straddling_a_slice():
+    """The tentpole rule: tp confined to one ICI domain. tp=8 over
+    two 4-wide slices is rejected at config time, not discovered as a
+    slow DCN collective at step time."""
+    topo = discover_topology(num_slices=2)
+    with pytest.raises(ValueError, match="straddle a slice boundary"):
+        MeshPlan(tp=8).validate(topo)
+    # Same size placed 'any' is allowed (operator opted into DCN).
+    MeshPlan(tp=8, placement={**DEFAULT_PLACEMENT,
+                              "tp": "any"}).validate(topo)
+
+
+def test_slice_as_replica_build():
+    """dp == num_slices + slice-major devices => each dp replica is
+    exactly one slice's device set."""
+    topo = discover_topology(num_slices=2)
+    mesh = MeshPlan(dp=2, tp=4).build(topo)
+    assert mesh.axis_names == AXIS_ORDER
+    assert mesh.devices.shape == (2, 1, 1, 4)
+    for replica in range(2):
+        replica_devices = set(mesh.devices[replica].flatten().tolist())
+        assert replica_devices == set(topo.slices[replica])
+
+
+def test_plan_rejects_oversubscription_and_bad_axes():
+    topo = discover_topology(num_slices=2)
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        MeshPlan(dp=2, tp=8, placement={
+            **DEFAULT_PLACEMENT, "tp": "any"}).validate(topo)
+    with pytest.raises(ValueError, match="must be >= 1"):
+        MeshPlan(tp=0)
+    with pytest.raises(ValueError, match="placement axis"):
+        MeshPlan(placement={"ep": "ici"})
+
+
+def test_build_mesh_delegates_to_plan():
+    """The legacy flat entrypoint now validates topology: a tp size
+    that straddles forced slices raises through build_mesh too."""
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(tensor_parallel_size=2, num_slices=2)
+    assert mesh.shape["tp"] == 2
+    with pytest.raises(ValueError, match="straddle"):
+        build_mesh(tensor_parallel_size=8, num_slices=2)
+
+
+def test_parallel_config_validates_topology_fields():
+    from production_stack_tpu.engine.config import ParallelConfig
+
+    ParallelConfig(num_slices=2, mesh_placement="tp=ici")
+    with pytest.raises(ValueError, match="num_slices"):
+        ParallelConfig(num_slices=-1)
+    with pytest.raises(ValueError, match="mesh_placement"):
+        ParallelConfig(mesh_placement="bogus=ici")
+
+
+# ---- unknown-axis regression (satellite fix) ---------------------------
+
+
+def test_on_mesh_unknown_axis_is_loud():
+    """_on_mesh used to silently replicate specs naming a misspelled
+    axis; now it is a ValueError naming the axis."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from production_stack_tpu.parallel.mesh import _on_mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2),
+                axis_names=("tp",))
+    with pytest.raises(ValueError, match="'tpu' is neither"):
+        _on_mesh(P(None, "tpu"), mesh)
+    # Known axes absent from a subset mesh still degrade to
+    # replication (legal: an ('sp',)-only mesh sees 'tp' specs).
+    assert _on_mesh(P(None, "sp"), mesh) == P(None, None)
+    assert _on_mesh(P(None, "tp"), mesh) == P(None, "tp")
+
+
+# ---- multihost bridge over the fake transport --------------------------
+
+
+class _StubRunner:
+    """Just enough runner surface for _payload_template +
+    execute_payload recording."""
+
+    prefill_width = 2
+    decode_width = 2
+    max_pages_per_seq = 4
+    unified_rows = 4
+    unified_span = 4
+    lora_registry = None
+
+    def __init__(self):
+        self.executed = []
+
+    def execute_payload(self, kind, payload, t):
+        self.executed.append((kind, t, payload))
+
+
+def _bridge_pair(num_slices=2, timeout_s=10.0):
+    from production_stack_tpu.parallel.distributed import (
+        FakeTransport,
+        MultihostStepBridge,
+    )
+
+    transport = FakeTransport(2)
+    leader = MultihostStepBridge(
+        _StubRunner(), endpoint=transport.endpoint(0),
+        num_slices=num_slices, liveness_timeout_s=timeout_s)
+    follower = MultihostStepBridge(
+        _StubRunner(), endpoint=transport.endpoint(1),
+        num_slices=num_slices, liveness_timeout_s=timeout_s)
+    return leader, follower
+
+
+def test_follower_mirrors_step_order_and_values():
+    from production_stack_tpu.parallel.distributed import (
+        KIND_DECODE,
+        KIND_PREFILL,
+    )
+
+    leader, follower = _bridge_pair()
+    worker = threading.Thread(target=follower.worker_loop)
+    worker.start()
+
+    prefill = leader._payload_template(KIND_PREFILL, 8)
+    prefill["tokens"][:] = 7
+    decode = leader._payload_template(KIND_DECODE, 1)
+    decode["kv_lens"][:] = 3
+    with leader.lock:
+        leader.publish(KIND_PREFILL, 8, prefill)
+    with leader.lock:
+        leader.publish(KIND_DECODE, 1, decode)
+    leader.shutdown()
+    worker.join(timeout=30)
+    assert not worker.is_alive()
+
+    executed = follower.runner.executed
+    assert [(k, t) for k, t, _ in executed] == [(KIND_PREFILL, 8),
+                                               (KIND_DECODE, 1)]
+    assert (executed[0][2]["tokens"] == 7).all()
+    assert (executed[1][2]["kv_lens"] == 3).all()
+    # Both slices acked/live: leader heartbeats its own slice on
+    # publish, the follower's acks cover slice 1.
+    assert leader.check_liveness() == {0: True, 1: True}
+
+
+def test_follower_rejects_template_drift():
+    """A payload whose structure disagrees with what the follower
+    derives from the header is a loud error, not silent divergence."""
+    from production_stack_tpu.parallel.distributed import (
+        FakeTransport,
+        _template_mismatch,
+    )
+
+    a = {"tokens": np.zeros((2, 8), np.int32)}
+    assert _template_mismatch(a, {"tokens": np.zeros((2, 8),
+                                                     np.int32)}) is None
+    assert "shape" in _template_mismatch(
+        a, {"tokens": np.zeros((2, 4), np.int32)})
+    assert "key drift" in _template_mismatch(
+        a, {"drafts": np.zeros((2, 8), np.int32)})
+
+    transport = FakeTransport(2)
+    leader, follower = (transport.endpoint(0), transport.endpoint(1))
+    leader.broadcast({"tokens": np.zeros((2, 4), np.int32)})
+    with pytest.raises(ValueError, match="does not match"):
+        follower.broadcast({"tokens": np.zeros((2, 8), np.int32)})
+
+
+def test_dead_follower_names_one_slice():
+    """No follower running: its acks never arrive, so after the
+    liveness window exactly its slice reads dead while the leader's
+    own slice (heartbeaten at publish) stays live."""
+    from production_stack_tpu.parallel.distributed import KIND_DECODE
+
+    leader, _ = _bridge_pair(timeout_s=0.05)
+    payload = leader._payload_template(KIND_DECODE, 1)
+    with leader.lock:
+        leader.publish(KIND_DECODE, 1, payload)
+    time.sleep(0.1)
+    with leader.lock:
+        leader.publish(KIND_DECODE, 1, payload)
+    live = leader.check_liveness()
+    assert live[0] is True
+    assert live[1] is False
+    assert leader.liveness.dead_slices() == [1]

@@ -305,8 +305,10 @@ def test_eligibility_and_server_resolution():
         unified_step_eligible,
     )
     assert unified_step_eligible()
-    assert not unified_step_eligible(pipeline_parallel=4)
-    assert not unified_step_eligible(context_parallel=8)
+    # pp and cp runners execute the ragged [R, W] block natively
+    # (docs/parallelism.md), so neither disqualifies any more.
+    assert unified_step_eligible(pipeline_parallel=4)
+    assert unified_step_eligible(context_parallel=8)
     assert not unified_step_eligible(distributed=True)
     assert not unified_step_eligible(engine_role="prefill")
     assert not unified_step_eligible(engine_role="decode")
@@ -320,7 +322,7 @@ def test_eligibility_and_server_resolution():
     assert _resolve_unified_step(
         parse_args(["--unified-step", "on", "--distributed"]))
     assert not _resolve_unified_step(parse_args(["--distributed"]))
-    assert not _resolve_unified_step(
+    assert _resolve_unified_step(
         parse_args(["--pipeline-parallel-size", "4"]))
     assert not _resolve_unified_step(
         parse_args(["--engine-role", "prefill"]))
@@ -347,3 +349,93 @@ def test_ragged_metrics_rendered_and_scraped():
     assert stats.engine_ragged_steps == 1.0
     assert stats.engine_ragged_rows == 16.0
     assert stats.engine_ragged_pad_rows == 11.0
+
+
+# ---- unified step on the pp / cp runners (docs/parallelism.md) ---------
+
+
+def _parallel_engine(unified, pp=1, sp=1, kv_dtype="auto",
+                     **sched_kw):
+    """Engine on a (pp) or (sp) mesh over the virtual 8-device CPU
+    harness (tests/conftest.py); pp needs layers % stages == 0."""
+    from production_stack_tpu.engine.config import ParallelConfig
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    model = tiny_model_config("llama")
+    model.num_hidden_layers = 4  # divisible by pp=2
+    config = EngineConfig(
+        model=model,
+        cache=CacheConfig(page_size=16, num_pages=128,
+                          kv_cache_dtype=kv_dtype),
+        scheduler=SchedulerConfig(max_num_seqs=4,
+                                  max_model_len=256,
+                                  prefill_chunk_size=32,
+                                  unified_step=unified,
+                                  **sched_kw),
+        parallel=ParallelConfig(
+            pipeline_parallel_size=pp,
+            context_parallel_size=sp,
+            long_prefill_threshold=64 if sp > 1 else None,
+        ),
+    )
+    mesh = (build_mesh(pipeline_parallel_size=pp,
+                       context_parallel_size=sp)
+            if pp > 1 or sp > 1 else None)
+    return LLMEngine(config, mesh=mesh)
+
+
+@pytest.mark.parametrize("kv_dtype", ["auto", "int8"])
+def test_greedy_parity_bimodal_vs_unified_pp(kv_dtype):
+    """pp=2: the mixed staggered run through the staged ragged
+    program is byte-identical to the bimodal pp scheduler — the
+    dissolved int8 x pp rule rides the same congruent QuantKV specs."""
+    bimodal = _parallel_engine(False, pp=2, kv_dtype=kv_dtype,
+                               speculative_k=3)
+    expected = _run_mixed(bimodal)
+    unified = _parallel_engine(True, pp=2, kv_dtype=kv_dtype,
+                               speculative_k=3)
+    got = _run_mixed(unified)
+    assert got == expected
+    assert [len(t) for t in got] == _MAX_TOKENS
+    assert unified.metrics.ragged_steps_total > 0
+    assert bimodal.metrics.ragged_steps_total == 0
+
+
+@pytest.mark.parametrize("kv_dtype", ["auto", "int8"])
+def test_greedy_parity_bimodal_vs_unified_cp(kv_dtype):
+    """cp=2: multi-token unified dispatches shard their W axis over
+    sp (a parallel query axis — no numeric change), so the greedy
+    stream matches the bimodal cp engine byte for byte."""
+    bimodal = _parallel_engine(False, sp=2, kv_dtype=kv_dtype,
+                               speculative_k=3)
+    expected = _run_mixed(bimodal)
+    unified = _parallel_engine(True, sp=2, kv_dtype=kv_dtype,
+                               speculative_k=3)
+    got = _run_mixed(unified)
+    assert got == expected
+    assert [len(t) for t in got] == _MAX_TOKENS
+    assert unified.metrics.ragged_steps_total > 0
+    assert bimodal.metrics.ragged_steps_total == 0
+
+
+def test_pp_mixed_run_zero_recompiles():
+    """The row-bucket lattice holds on the pp runner: a second mixed
+    staggered run (fresh token values, same step shape) adds zero
+    compiled executables — ragged microbatching through the ppermute
+    ring reuses the same staged programs."""
+    engine = _parallel_engine(True, pp=2)
+    engine.add_request(list(range(2, 50)), SamplingParams(
+        temperature=0.0, max_tokens=2, ignore_eos=True))
+    while engine.has_work():
+        engine.step()
+    _run_mixed(engine, seed=7)
+    ragged0 = engine.metrics.ragged_steps_total
+    assert ragged0 > 0
+    obs = engine.runner.observatory
+    assert obs.compile_events_total() > 0
+    before_events = obs.compile_events_total()
+    before_caches = obs.executable_cache_sizes()
+    _run_mixed(engine, seed=13)
+    assert engine.metrics.ragged_steps_total > ragged0
+    assert obs.compile_events_total() == before_events
+    assert obs.executable_cache_sizes() == before_caches
