@@ -368,6 +368,24 @@ def build():
         panel("Server Errors (rate)",
               [target('rate(vllm:server_errors_total[5m])')],
               16, 145),
+        # ---- Self-tuning controllers (docs/autotuning.md) -------------------
+        row("Self-Tuning", 152),
+        panel("Autotune Decision Rate by Controller",
+              [target('sum by(controller) (rate('
+                      'vllm:autotune_decisions_total[5m]))',
+                      "{{controller}}")],
+              0, 153),
+        panel("Frozen Controllers (guardrail latched)",
+              [target('vllm:engine_autotune_frozen',
+                      "{{controller}} {{server}}")],
+              8, 153, w=4, kind="stat"),
+        panel("Active Controllers per Engine",
+              [target('vllm:engine_autotune_active_controllers')],
+              12, 153, w=4, kind="stat"),
+        panel("Knob Values by Controller",
+              [target('vllm:engine_autotune_knob_value',
+                      "{{controller}} {{server}}")],
+              16, 153),
     ]
     return {
         "title": "TPU Stack — Serving Overview",

@@ -1,0 +1,31 @@
+"""Self-tuning serving: host-side controllers that close the
+telemetry -> knob loop (docs/autotuning.md).
+
+Engine-side controllers tick from the engine loop and read the
+metrics registry / observatory directly; the fleet-side pool-split
+controller rides the autoscaler's one-scrape signal path. Everything
+is off by default (``--autotune off|shadow|on``)."""
+
+from production_stack_tpu.autotune.controller import (
+    MODES, Autotuner, Controller)
+from production_stack_tpu.autotune.controllers import (
+    CheckpointIntervalController, KVEconController,
+    PrefillBudgetController, QoSShedController, SpecKController,
+    build_engine_controllers, observatory_drift_flags)
+from production_stack_tpu.autotune.fleet import PoolSplitController
+from production_stack_tpu.autotune.guardrail import DriftGuardrail
+
+__all__ = [
+    "MODES",
+    "Autotuner",
+    "Controller",
+    "DriftGuardrail",
+    "SpecKController",
+    "PrefillBudgetController",
+    "KVEconController",
+    "CheckpointIntervalController",
+    "QoSShedController",
+    "PoolSplitController",
+    "build_engine_controllers",
+    "observatory_drift_flags",
+]

@@ -1,0 +1,126 @@
+"""Fleet-side self-tuning: the prefill-vs-decode pool split.
+
+Disaggregated fleets fix the prefill/decode replica split in the
+fleet spec, but the profitable split follows the workload: a burst of
+long prompts starves the prefill pool while decode replicas idle, and
+vice versa. :class:`PoolSplitController` rides the autoscaler's
+existing one-scrape signal path — the per-phase request-time means
+the router already exports (``vllm:engine_request_prefill_time_mean_
+seconds`` / ``..._decode_...``, docs/observability.md) — and biases
+one replica of headroom between a prefill-role pool and a decode-role
+pool when the phase-time ratio drifts from its own baseline.
+
+It runs AFTER the per-pool :class:`PoolAutoscaler` in
+``FleetManager.autoscale_once`` (SLO target tracking keeps priority;
+the split only spends headroom inside each pool's min/max band), and
+it carries the same guardrail semantics as the engine-side
+controllers: a rising 5m SLO burn within the freeze window of a move
+freezes the controller, latched until reset. Off unless the fleet
+spec sets ``autotune_pool_split`` (docs/fleet.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from production_stack_tpu.autotune.guardrail import DriftGuardrail
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class PoolSplitController:
+    """Bias the replica split between one prefill-role and one
+    decode-role pool by the phase-time ratio's drift from its own
+    baseline (first complete observation)."""
+
+    name = "pool_split"
+
+    def __init__(self, ratio_band: float = 0.5,
+                 cooldown_s: float = 60.0,
+                 freeze_window_s: float = 30.0,
+                 burn_threshold: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ratio_band = float(ratio_band)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._baseline: Optional[float] = None
+        self._last_move = -float("inf")
+        self._burn = -1.0
+        self.guardrail = DriftGuardrail(
+            freeze_window_s=freeze_window_s,
+            burn_threshold=burn_threshold,
+            burn_rate=lambda: self._burn, clock=clock)
+        self.moves_total = 0
+
+    @property
+    def frozen(self) -> bool:
+        return self.guardrail.is_frozen(self.name)
+
+    def reset(self) -> None:
+        self.guardrail.reset(self.name)
+
+    def rebalance(self, pools, signals_by_pool: Dict[str, object],
+                  desired: Dict[str, int]) -> Dict[str, int]:
+        """One tick: returns the (possibly adjusted) desired counts.
+
+        ``pools`` are PoolSpec objects; ``signals_by_pool`` maps pool
+        name -> PoolSignals from the same scrape the autoscalers just
+        consumed; ``desired`` is the post-autoscale target map (not
+        mutated — a copy is returned)."""
+        out = dict(desired)
+        prefill = next((p for p in pools if p.role == "prefill"), None)
+        decode = next((p for p in pools if p.role == "decode"), None)
+        if prefill is None or decode is None:
+            return out
+        now = self.clock()
+        # Guardrail first: burn is fleet-wide, mirrored in every
+        # pool's signals.
+        for sig in signals_by_pool.values():
+            burn = getattr(sig, "slo_burn_rate", -1.0)
+            if burn >= 0:
+                self._burn = max(self._burn, burn)
+        self.guardrail.scan(now)
+        if self.frozen:
+            return out
+        pmean = self._phase_mean(signals_by_pool, "prefill_time_mean_s")
+        dmean = self._phase_mean(signals_by_pool, "decode_time_mean_s")
+        if pmean <= 0 or dmean <= 0:
+            return out
+        ratio = pmean / dmean
+        if self._baseline is None:
+            self._baseline = ratio
+            return out
+        if now - self._last_move < self.cooldown_s:
+            return out
+        drift = ratio / self._baseline
+        src = dst = None
+        if drift > 1.0 + self.ratio_band:
+            # Prefill phase got relatively slower: shift headroom in.
+            src, dst = decode, prefill
+        elif drift < 1.0 / (1.0 + self.ratio_band):
+            src, dst = prefill, decode
+        if src is None:
+            return out
+        if (out[src.name] - 1 < src.min_replicas
+                or out[dst.name] + 1 > dst.max_replicas):
+            return out
+        out[src.name] -= 1
+        out[dst.name] += 1
+        self._last_move = now
+        self.moves_total += 1
+        self.guardrail.note_applied(self.name, now)
+        logger.info(
+            "autotune pool split: %s -> %s (phase ratio %.2f, "
+            "baseline %.2f)", src.name, dst.name, ratio,
+            self._baseline)
+        return out
+
+    @staticmethod
+    def _phase_mean(signals_by_pool: Dict[str, object],
+                    attr: str) -> float:
+        worst = -1.0
+        for sig in signals_by_pool.values():
+            worst = max(worst, getattr(sig, attr, -1.0))
+        return worst

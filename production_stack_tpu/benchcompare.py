@@ -46,6 +46,10 @@ _HIGHER_BETTER = (
     # fragments above already classify the raw scaleout_goodput_*
     # keys; this covers the derived 1->N ratios.
     "linearity",
+    # --worker drift: shadow mode's greedy output must stay
+    # byte-identical to off (docs/autotuning.md) — a drop to 0 means
+    # shadow perturbed a sampled token.
+    "byte_identical",
 )
 _LOWER_BETTER = (
     "p50", "p90", "p99", "latency", "itl", "ttft", "seconds", "_ms",
@@ -55,6 +59,9 @@ _LOWER_BETTER = (
     # streams broken mid-rollout should be zero.
     "rollout_5xx", "rollout_broken", "rollout_rollback",
     "rollout_alarm",
+    # --worker drift: guardrail freezes during the scripted phases
+    # mean the sentinel blamed the controllers for the workload.
+    "frozen",
 )
 
 

@@ -877,6 +877,8 @@ class LLMEngine:
                     # reflects the model, not request budgets.
                     drafted += len(spec_drafts[i])
                     accepted += len(toks) - 1
+                    seq.spec_drafted_total += len(spec_drafts[i])
+                    seq.spec_accepted_total += max(0, len(toks) - 1)
                 emitted = 0
                 for k, tok in enumerate(toks):
                     if seq.state != SequenceState.RUNNING:
@@ -932,6 +934,8 @@ class LLMEngine:
                 if spec_drafts is not None:
                     drafted += len(spec_drafts[i])
                     accepted += len(toks) - 1
+                    seq.spec_drafted_total += len(spec_drafts[i])
+                    seq.spec_accepted_total += max(0, len(toks) - 1)
                 emitted = 0
                 for k, tok in enumerate(toks):
                     if seq.state != SequenceState.RUNNING:
@@ -1125,6 +1129,8 @@ class LLMEngine:
                 if spec_drafts is not None:
                     drafted += len(spec_drafts[i])
                     accepted += len(toks) - 1
+                    seq.spec_drafted_total += len(spec_drafts[i])
+                    seq.spec_accepted_total += max(0, len(toks) - 1)
                 emitted = 0
                 for k, tok in enumerate(toks):
                     if seq.state != SequenceState.RUNNING:

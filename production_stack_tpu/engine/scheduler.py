@@ -143,6 +143,16 @@ class Scheduler:
             from production_stack_tpu.engine.spec import NgramProposer
             self.proposer = NgramProposer(
                 config.speculative_k, config.speculative_min_match)
+        # Self-tuning knobs (docs/autotuning.md), both host-side
+        # plan-time values — no compiled shape depends on either.
+        # Prefill token budget a unified (mixed) step may admit;
+        # defaults to a dedicated prefill step's full bandwidth.
+        self.mixed_prefill_budget = (config.prefill_chunk_size
+                                     * config.prefill_batch_size)
+        # QoS degrade-ladder clamp: while set, non-interactive rows
+        # (priority > 0) are planned spec-off, reserving draft/verify
+        # slack for interactive traffic under overload.
+        self.spec_degrade_clamp = False
 
     # ---- queue management -------------------------------------------------
 
@@ -275,14 +285,15 @@ class Scheduler:
                 return None
         drafts: Dict[str, List[int]] = {}
         for seq in self.running:
-            if seq.spec_off:
+            if seq.spec_off or (self.spec_degrade_clamp
+                                and seq.priority > 0):
                 # QoS degradation (docs/qos.md): throttled-tenant rows
                 # ride the verify step as plain single-token rows.
                 continue
             # Cap so emitted tokens (accepted + bonus) never exceed
             # the row's budget — a draft the budget can't emit would
             # also write KV past max_model_len.
-            d = self.proposer.propose(seq, self._seq_budget(seq) - 1)
+            d = self.proposer.propose(seq, self._draft_limit(seq))
             if d:
                 drafts[seq.seq_id] = d
         if not drafts:
@@ -337,10 +348,11 @@ class Scheduler:
         drafts: Dict[str, List[int]] = {}
         if self.proposer is not None:
             for seq in self.running:
-                if seq.spec_off:
+                if seq.spec_off or (self.spec_degrade_clamp
+                                    and seq.priority > 0):
                     continue
                 d = self.proposer.propose(seq,
-                                          self._seq_budget(seq) - 1)
+                                          self._draft_limit(seq))
                 if d:
                     drafts[seq.seq_id] = d
         # Reserve decode-side pages first (1 + draft_len per row);
@@ -352,8 +364,7 @@ class Scheduler:
         if not self.running:
             return None
         prefill = self._plan_prefill(
-            max_tokens=(self.config.prefill_chunk_size
-                        * self.config.prefill_batch_size))
+            max_tokens=self.mixed_prefill_budget)
         if prefill is not None and prefill.sp:
             # Context-parallel whole-prompt plans run alone (their
             # dispatch shards the sequence over the mesh); the
@@ -455,6 +466,17 @@ class Scheduler:
 
     def _seq_budget(self, seq: Sequence) -> int:
         return decode_budget(seq, self.config.max_model_len)
+
+    def _draft_limit(self, seq: Sequence) -> int:
+        """Longest draft this row may carry: the emit budget, further
+        capped per-sequence by the spec-k autotune controller
+        (docs/autotuning.md). The cap only shortens the draft list —
+        a non-shape input — so the compiled verify span is
+        untouched."""
+        limit = self._seq_budget(seq) - 1
+        if seq.spec_k_cap is not None:
+            limit = min(limit, seq.spec_k_cap)
+        return limit
 
     def _plan_prefill(self, max_tokens: Optional[int] = None
                       ) -> Optional[PrefillPlan]:

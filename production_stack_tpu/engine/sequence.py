@@ -195,6 +195,15 @@ class Sequence:
     # requests spec-off; the scheduler then never spends speculative
     # draft/verify slack on them (docs/qos.md).
     spec_off: bool = False
+    # Self-tuning telemetry + knob (docs/autotuning.md): lifetime
+    # draft/accept counters the spec-k controller windows per tick,
+    # and its per-sequence draft-length cap. The cap rides the same
+    # non-shape draft inputs as spec_off — the proposer just drafts
+    # fewer tokens, the compiled verify shape never changes. None =
+    # uncapped (--speculative-k governs).
+    spec_drafted_total: int = 0
+    spec_accepted_total: int = 0
+    spec_k_cap: Optional[int] = None
     # Cluster KV economy (docs/kv_economy.md): parked in AWAITING_KV
     # at admission to probe the shared cache for this prompt's prefix
     # before prefill. Unlike a disagg handoff, a cold-start probe

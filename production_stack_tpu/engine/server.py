@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional
 from aiohttp import web
 
 from production_stack_tpu.engine.config import (
+    AutotuneConfig,
     bench_1b_model_config,
     CacheConfig,
     EngineConfig,
@@ -90,6 +91,11 @@ class AsyncEngine:
         # between steps. The asyncio /health handler reads it — a hung
         # device program blocks this thread, not the event loop.
         self._step_started: Optional[float] = None
+        # Self-tuning (docs/autotuning.md): the EngineServer installs
+        # an Autotuner here; the loop ticks it between steps so
+        # controllers touch scheduler/config state from the same
+        # thread that reads it. None = no tuning.
+        self.autotuner = None
 
     def current_step_s(self) -> float:
         """Seconds the in-flight engine step has been running
@@ -156,6 +162,11 @@ class AsyncEngine:
                         finish_reason="abort",
                     ))
                 continue  # admit as many as possible before stepping
+            if self.autotuner is not None:
+                try:
+                    self.autotuner.maybe_tick()
+                except Exception:
+                    logger.exception("autotune tick failed")
             if not self.engine.has_work():
                 continue
             self._step_started = time.time()
@@ -537,6 +548,28 @@ class EngineServer:
         # this process's first local device belongs to, resolved once
         # (jax.devices() order is stable for the process lifetime).
         self._slice_id_cache: Optional[int] = None
+        # Self-tuning controllers (docs/autotuning.md). Constructed
+        # unconditionally — maybe_tick() is a cheap no-op in 'off'
+        # mode — so /autotune/status always answers and flipping the
+        # mode needs no re-wiring.
+        from production_stack_tpu.autotune import (
+            Autotuner, build_engine_controllers,
+            observatory_drift_flags)
+        at = (getattr(engine.config, "autotune", None)
+              or AutotuneConfig())
+        try:
+            controllers = build_engine_controllers(self, at)
+            drift_flags = observatory_drift_flags(engine.runner)
+        except AttributeError:
+            # Stub engines (tests) lack the scheduler/metrics surface
+            # the catalog reads; they still get a live, empty
+            # autotuner so /autotune/status answers.
+            controllers, drift_flags = [], None
+        self.autotuner = Autotuner(
+            at, controllers,
+            tracer=getattr(engine, "tracer", None),
+            drift_flags=drift_flags)
+        self.async_engine.autotuner = self.autotuner
 
     def _slice_id(self) -> int:
         if self._slice_id_cache is None:
@@ -2172,6 +2205,25 @@ class EngineServer:
         # rejected and in-flight sequences finish.
         lines.append("# TYPE vllm:engine_draining gauge")
         lines.append(f"vllm:engine_draining {float(self.draining)}")
+        # Self-tuning (docs/autotuning.md): controllers allowed to
+        # act, latched guardrail freezes, live knob values, and
+        # cumulative decision counts (applied + shadow).
+        at = self.autotuner
+        lines.append("# TYPE vllm:autotune_active_controllers gauge")
+        lines.append("vllm:autotune_active_controllers "
+                     f"{float(at.active_count())}")
+        lines.append("# TYPE vllm:autotune_frozen gauge")
+        for name, frozen in sorted(at.frozen_flags().items()):
+            lines.append("vllm:autotune_frozen{controller=\""
+                         f"{name}\"}} {float(frozen)}")
+        lines.append("# TYPE vllm:autotune_knob_value gauge")
+        for name, value in sorted(at.knob_values().items()):
+            lines.append("vllm:autotune_knob_value{controller=\""
+                         f"{name}\"}} {float(value)}")
+        lines.append("# TYPE vllm:autotune_decisions_total counter")
+        for name, count in sorted(at.decisions_total.items()):
+            lines.append("vllm:autotune_decisions_total{controller=\""
+                         f"{name}\"}} {float(count)}")
         # QoS under overload (docs/qos.md): per-class shed counts from
         # the 429 gate and per-outcome preemption counts (did the
         # victim's KV pages ship to the offload tier, or will the
@@ -2269,6 +2321,25 @@ class EngineServer:
         return web.Response(text="\n".join(lines),
                             content_type="text/plain")
 
+    async def autotune_status(self, request: web.Request
+                              ) -> web.Response:
+        """Self-tuning introspection (docs/autotuning.md): mode,
+        cadence, and per-controller knob/clamp/frozen/decision
+        state."""
+        return web.json_response(self.autotuner.status())
+
+    async def autotune_reset(self, request: web.Request
+                             ) -> web.Response:
+        """Operator reset for guardrail freezes: unlatch one
+        controller ({"controller": name}) or all (empty body)."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        name = (body or {}).get("controller")
+        cleared = self.autotuner.reset(name)
+        return web.json_response({"reset": cleared})
+
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=1024 ** 3)
         app.router.add_post("/v1/chat/completions",
@@ -2291,6 +2362,8 @@ class EngineServer:
         app.router.add_get("/version", self.version)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/kv/summary", self.kv_summary_handler)
+        app.router.add_get("/autotune/status", self.autotune_status)
+        app.router.add_post("/autotune/reset", self.autotune_reset)
         app.router.add_post("/debug/profiler/start", self.profiler_start)
         app.router.add_post("/debug/profiler/stop", self.profiler_stop)
         app.router.add_get("/debug/trace/{request_id}", self.debug_trace)
@@ -2489,6 +2562,21 @@ def build_engine_from_args(args) -> tuple[LLMEngine, str]:
             ttl_s=args.kv_ttl_s,
             watermark_high=args.kv_watermark_high,
             watermark_low=args.kv_watermark_low,
+        ),
+        autotune=AutotuneConfig(
+            mode=args.autotune,
+            interval_s=args.autotune_interval_s,
+            dead_band=args.autotune_dead_band,
+            controllers=args.autotune_controllers,
+            freeze_window_s=args.autotune_freeze_window_s,
+            burn_threshold=args.autotune_burn_threshold,
+            target_itl_ms=args.autotune_target_itl_ms,
+            min_spec_k=args.autotune_min_spec_k,
+            min_checkpoint_interval_tokens=(
+                args.autotune_min_checkpoint_interval_tokens),
+            max_checkpoint_interval_tokens=(
+                args.autotune_max_checkpoint_interval_tokens),
+            min_shed_threshold=args.autotune_min_shed_threshold,
         ),
         seed=args.seed,
         engine_role=args.engine_role,
@@ -2767,6 +2855,53 @@ def parse_args(argv=None):
     parser.add_argument("--kv-watermark-low", type=float, default=1.0,
                         help="Fill fraction the host KV pool drains "
                              "down to once the high watermark trips")
+    # Self-tuning controllers (docs/autotuning.md).
+    parser.add_argument("--autotune", default="off",
+                        choices=["off", "shadow", "on"],
+                        help="Self-tuning controllers: off, shadow "
+                             "(compute + span-log decisions without "
+                             "applying), or on (close the loop)")
+    parser.add_argument("--autotune-interval-s", type=float,
+                        default=2.0,
+                        help="Seconds between controller ticks")
+    parser.add_argument("--autotune-dead-band", type=float,
+                        default=0.05,
+                        help="Relative dead-band: drop proposals "
+                             "within this fraction of the current "
+                             "knob value")
+    parser.add_argument("--autotune-controllers", default="all",
+                        help="Comma-separated controller allowlist "
+                             "(spec_k,prefill_budget,kvecon,"
+                             "checkpoint_interval,qos_shed) or 'all'")
+    parser.add_argument("--autotune-freeze-window-s", type=float,
+                        default=30.0,
+                        help="Guardrail blame window: freeze "
+                             "controllers that applied a decision "
+                             "this recently when perf drift flips "
+                             "or 5m burn rises")
+    parser.add_argument("--autotune-burn-threshold", type=float,
+                        default=1.0,
+                        help="5m SLO burn rate at/above which a rise "
+                             "trips the guardrail")
+    parser.add_argument("--autotune-target-itl-ms", type=float,
+                        default=50.0,
+                        help="Decode ITL p99 target the prefill-"
+                             "budget controller steers toward")
+    parser.add_argument("--autotune-min-spec-k", type=int, default=1,
+                        help="Floor for the per-sequence speculative "
+                             "draft cap (ceiling is --speculative-k)")
+    parser.add_argument("--autotune-min-checkpoint-interval-tokens",
+                        type=int, default=64,
+                        help="Floor for the tuned checkpoint "
+                             "interval")
+    parser.add_argument("--autotune-max-checkpoint-interval-tokens",
+                        type=int, default=4096,
+                        help="Ceiling for the tuned checkpoint "
+                             "interval")
+    parser.add_argument("--autotune-min-shed-threshold", type=float,
+                        default=0.5,
+                        help="Floor for the tuned QoS shed gate "
+                             "(ceiling is --shed-threshold)")
     return parser.parse_args(argv)
 
 

@@ -64,6 +64,7 @@ SPAN_EVENTS = (
     "migrate_ship",
     "watchdog_trip",
     "crash_respawn",
+    "autotune_decision",
     "finish",
 )
 

@@ -64,6 +64,11 @@ class PoolSignals:
     # Fleet-wide: the router's vllm:slo_burn_rate{window="5m"} gauge
     # has no server label, so every pool sees the same value.
     slo_burn_rate: float = -1.0
+    # Phase-time histogram means (docs/autotuning.md): the pool-split
+    # controller biases the prefill-vs-decode replica split on the
+    # ratio of these, riding the same one-scrape signal path.
+    prefill_time_mean_s: float = -1.0  # worst replica
+    decode_time_mean_s: float = -1.0   # worst replica
 
     def _max(self, attr: str, value: float) -> None:
         setattr(self, attr, max(getattr(self, attr), value))
@@ -80,6 +85,10 @@ _SIGNAL_METRICS = {
     "vllm:num_requests_waiting": ("waiting", "sum"),
     "vllm:engine_gpu_cache_usage_perc": ("cache_usage", "max"),
     "vllm:engine_disagg_awaiting_kv_requests": ("awaiting_kv", "sum"),
+    "vllm:engine_request_prefill_time_mean_seconds":
+        ("prefill_time_mean_s", "max"),
+    "vllm:engine_request_decode_time_mean_seconds":
+        ("decode_time_mean_s", "max"),
 }
 
 

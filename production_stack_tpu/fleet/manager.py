@@ -117,6 +117,14 @@ class FleetManager:
             p.name: p.revision for p in spec.pools}
         from production_stack_tpu.fleet.rollout import RolloutController
         self.rollout = RolloutController(self)
+        # Self-tuning pool split (docs/autotuning.md): biases the
+        # prefill-vs-decode replica split after the per-pool
+        # autoscalers have spoken. Spec-gated, off by default.
+        self.pool_split = None
+        if spec.autotune_pool_split:
+            from production_stack_tpu.autotune.fleet import (
+                PoolSplitController)
+            self.pool_split = PoolSplitController(clock=clock)
 
     # ---- plumbing ---------------------------------------------------------
 
@@ -466,6 +474,16 @@ class FleetManager:
                 fleet_scale_events.labels(
                     pool=pool.name, direction=direction).inc()
                 self.desired[pool.name] = want
+        if self.pool_split is not None and signals_by_pool:
+            adjusted = self.pool_split.rebalance(
+                self.spec.pools, signals_by_pool, self.desired)
+            for name, want in adjusted.items():
+                if want != self.desired[name]:
+                    direction = ("up" if want > self.desired[name]
+                                 else "down")
+                    fleet_scale_events.labels(
+                        pool=name, direction=direction).inc()
+                    self.desired[name] = want
         self._refresh_gauges()
         return dict(self.desired)
 

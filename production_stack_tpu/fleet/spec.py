@@ -292,6 +292,11 @@ class FleetSpec:
     # pause|resume|abort`` writes it (docs/fleet.md).  Empty disables
     # the operator channel.
     rollout_control_path: str = ""
+    # Self-tuning pool split (docs/autotuning.md): bias the
+    # prefill-vs-decode replica split from the phase-time signals the
+    # autoscaler already scrapes. Off by default; only meaningful with
+    # one prefill-role and one decode-role pool.
+    autotune_pool_split: bool = False
 
     def __post_init__(self) -> None:
         if not self.pools:
@@ -326,6 +331,8 @@ class FleetSpec:
             reconcile_interval_s=float(raw.get("reconcile_interval_s", 1.0)),
             autoscale_interval_s=float(raw.get("autoscale_interval_s", 5.0)),
             rollout_control_path=raw.get("rollout_control_path", ""),
+            autotune_pool_split=bool(
+                raw.get("autotune_pool_split", False)),
         )
 
     @classmethod

@@ -45,6 +45,19 @@ def _server_entry(stats, now: float) -> dict:
         "step_time_median_s": {
             k: round(float(v), 6) for k, v in
             sorted(g("step_time_median_by_kind", {}).items())},
+        # Self-tuning (docs/autotuning.md): controllers allowed to
+        # act, latched guardrail freezes, and live knob values —
+        # stacktop's AUTOTUNE column renders active count + a '!' on
+        # any frozen controller.
+        "autotune": {
+            "active": int(g("autotune_active_controllers")),
+            "frozen": {k: bool(v) for k, v in
+                       sorted(g("autotune_frozen_by_controller",
+                                {}).items())},
+            "knobs": {k: round(float(v), 4) for k, v in
+                      sorted(g("autotune_knob_by_controller",
+                               {}).items())},
+        },
         # Topology (docs/parallelism.md): the engine's mesh axis
         # sizes, which slice its devices sit on, and per-slice
         # liveness from the multihost bridge.

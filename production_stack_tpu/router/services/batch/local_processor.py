@@ -1,11 +1,16 @@
 """SQLite-backed local batch processor.
 
-Capability parity with reference batch_service/local_processor.py, with
-two deliberate upgrades: (1) the reference's processing loop is a stub
-(local_processor.py:157-208 TODO) — ours actually executes each JSONL line
-against a discovered engine and writes the OpenAI-format output file;
-(2) sqlite access goes through ``asyncio.to_thread`` (no aiosqlite in the
-environment) with a single serialized connection.
+Executes OpenAI-format batch jobs on this router without external
+infrastructure: batch metadata persists in a local SQLite database
+(surviving router restarts), and a background worker claims pending
+batches, runs each JSONL input line as a request against a discovered
+engine endpoint, and writes the OpenAI-format output/error files back
+through the files Storage layer.
+
+SQLite has no async driver in this environment, so all database access
+is funneled through ``asyncio.to_thread`` onto a single shared
+connection serialized by a lock — the event loop never blocks on disk,
+and writer concurrency is a non-issue by construction.
 """
 
 from __future__ import annotations

@@ -276,6 +276,21 @@ kv_route_expected_hit_tokens = Gauge(
     "vllm:kv_route_expected_hit_tokens",
     "Expected prefix-hit tokens of the last request KVStateAwarePolicy "
     "routed to this engine", _LBL)
+# Self-tuning controllers (docs/autotuning.md): per-engine active
+# count, latched guardrail freezes, and live knob values, re-exported
+# for stacktop's AUTOTUNE column and the Self-Tuning dashboard row.
+engine_autotune_active = Gauge(
+    "vllm:engine_autotune_active_controllers",
+    "Engine-reported self-tuning controllers currently allowed to "
+    "act; 0 in off/shadow mode (scraped)", _LBL)
+engine_autotune_frozen = Gauge(
+    "vllm:engine_autotune_frozen",
+    "Engine-reported guardrail freeze per controller; 1 latches "
+    "until POST /autotune/reset (scraped)", ["server", "controller"])
+engine_autotune_knob = Gauge(
+    "vllm:engine_autotune_knob_value",
+    "Engine-reported live knob value per self-tuning controller "
+    "(scraped)", ["server", "controller"])
 
 # -- fleet manager (production_stack_tpu/fleet/, docs/fleet.md) -------------
 # Set by an in-process fleet manager (or its embedded exporter); the
@@ -608,6 +623,16 @@ def refresh_gauges() -> None:
             es.kv_cluster_admissions)
         engine_kv_cluster_rejections.labels(server=server).set(
             es.kv_cluster_rejections)
+        engine_autotune_active.labels(server=server).set(
+            es.autotune_active_controllers)
+        for controller, value in \
+                es.autotune_frozen_by_controller.items():
+            engine_autotune_frozen.labels(
+                server=server, controller=controller).set(value)
+        for controller, value in \
+                es.autotune_knob_by_controller.items():
+            engine_autotune_knob.labels(
+                server=server, controller=controller).set(value)
     from production_stack_tpu.router.routing.logic import (
         KVStateAwarePolicy,
         get_routing_logic,

@@ -93,6 +93,10 @@ _METRIC_MAP = {
     "vllm:kv_cluster_misses_total": "kv_cluster_misses",
     "vllm:kv_cluster_admissions_total": "kv_cluster_admissions",
     "vllm:kv_cluster_rejections_total": "kv_cluster_rejections",
+    # Self-tuning (docs/autotuning.md): controllers currently allowed
+    # to act on this engine; the labeled frozen/knob families are
+    # handled in from_prometheus_text.
+    "vllm:autotune_active_controllers": "autotune_active_controllers",
 }
 
 # Engine latency histograms the scraper summarizes: it keeps each
@@ -130,6 +134,9 @@ _ROUTER_UNSCRAPED = frozenset({
     "vllm:request_success_total",
     "vllm:request_failure_total",
     "vllm:num_preemptions_total",
+    # Autotune decision counts are an operator/dashboard rate, not a
+    # routing signal — cluster Prometheus reads them directly.
+    "vllm:autotune_decisions_total",
 })
 
 
@@ -251,6 +258,16 @@ class EngineStats:
     kv_cluster_rejections: float = 0.0
     kv_hot_chains: Dict[int, float] = field(default_factory=dict)
     kv_summary_time: float = 0.0
+    # Self-tuning (docs/autotuning.md): count of controllers allowed
+    # to act (0 in off/shadow), latched guardrail freezes per
+    # controller (vllm:autotune_frozen{controller}), and live knob
+    # values (vllm:autotune_knob_value{controller}) — stacktop's
+    # AUTOTUNE column and the fleet dashboard read these.
+    autotune_active_controllers: float = 0.0
+    autotune_frozen_by_controller: Dict[str, float] = field(
+        default_factory=dict)
+    autotune_knob_by_controller: Dict[str, float] = field(
+        default_factory=dict)
 
     @classmethod
     def from_prometheus_text(cls, text: str) -> "EngineStats":
@@ -306,6 +323,16 @@ class EngineStats:
                 if sample.name == "vllm:engine_slice_live":
                     stats.slice_live_by_id[
                         sample.labels.get("slice", "")] = sample.value
+                    continue
+                if sample.name == "vllm:autotune_frozen":
+                    stats.autotune_frozen_by_controller[
+                        sample.labels.get("controller", "")
+                    ] = sample.value
+                    continue
+                if sample.name == "vllm:autotune_knob_value":
+                    stats.autotune_knob_by_controller[
+                        sample.labels.get("controller", "")
+                    ] = sample.value
                     continue
                 if (sample.name == "vllm:engine_attention_impl"
                         and sample.value == 1.0):

@@ -93,7 +93,7 @@ def render_snapshot(snap: dict, changed: Optional[set] = None) -> str:
             f"{'SERVER':<42} {'HEALTH':<7} {'ROLE':<7} "
             f"{'MESH':<9} "
             f"{'RUN':>4} {'WAIT':>4} {'CACHE':>6} {'HIT':>6} "
-            f"{'MFU':>6} {'SHED':>5} {'COMPILES':>8}")
+            f"{'MFU':>6} {'SHED':>5} {'COMPILES':>8} {'AUTOTUNE':>8}")
         for url in sorted(servers):
             s = servers[url]
             health = "drain" if s.get("draining") else (
@@ -113,6 +113,13 @@ def render_snapshot(snap: dict, changed: Optional[set] = None) -> str:
                     mesh += "!"
             else:
                 mesh = "-"
+            # Self-tuning (docs/autotuning.md): controllers allowed to
+            # act right now; "!" flags a guardrail-frozen controller
+            # waiting on an operator POST /autotune/reset.
+            auto_info = s.get("autotune") or {}
+            auto = str(int(auto_info.get("active", 0)))
+            if any((auto_info.get("frozen") or {}).values()):
+                auto += "!"
             mark = "*" if url in changed else " "
             row = (
                 f"{url:<41}{mark} {health:<7} "
@@ -123,7 +130,7 @@ def render_snapshot(snap: dict, changed: Optional[set] = None) -> str:
                 f"{s.get('cache_usage', 0.0):>6.2f} "
                 f"{s.get('prefix_hit_rate', 0.0):>6.2f} "
                 f"{s.get('mfu', 0.0):>6.2f} "
-                f"{shed:>5} {compiles:>8}")
+                f"{shed:>5} {compiles:>8} {auto:>8}")
             # Revision suffix only during rollouts, so the plain table
             # stays byte-stable for the golden tests.
             if s.get("revision"):

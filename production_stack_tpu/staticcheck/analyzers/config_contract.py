@@ -68,6 +68,7 @@ _SECTION_CLASSES = {
     "offload": "OffloadConfig",
     "qos": "QoSConfig",
     "kvecon": "KVEconConfig",
+    "autotune": "AutotuneConfig",
 }
 
 # Fleet-spec classes whose dataclass fields are operator surface,

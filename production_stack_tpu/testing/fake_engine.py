@@ -164,6 +164,11 @@ FAKE_ONLY_ROUTES = {
                            "fleet rollup (router/app.py serves the "
                            "real one) so stacktop render tests run "
                            "without a router",
+    "POST /autotune/knobs": "plants knob values / frozen flags the "
+                            "fake reports in /metrics and "
+                            "/cluster/status, so router and fleet "
+                            "self-tuning tests run without a real "
+                            "engine's controller loop",
 }
 
 
@@ -242,6 +247,20 @@ class FakeEngineState:
         self.prefix_query_tokens = 0
         # POST /kv/summary overrides (None = derived from kv_hot).
         self.kv_summary_override: Optional[dict] = None
+        # Self-tuning (docs/autotuning.md): the fake has no controller
+        # loop — POST /autotune/knobs plants these, and they surface in
+        # /metrics, /autotune/status and /cluster/status exactly where
+        # the real server reports its live controllers.
+        self.autotune_mode = "off"
+        self.autotune_knobs: "dict[str, float]" = {}
+        self.autotune_frozen: "dict[str, bool]" = {}
+        self.autotune_decisions: "dict[str, float]" = {}
+
+    def autotune_active(self) -> int:
+        if self.autotune_mode != "on":
+            return 0
+        return sum(1 for name in self.autotune_knobs
+                   if not self.autotune_frozen.get(name))
 
     def observe_prefix(self, body: dict) -> float:
         """Score the request against the hot set (fraction of prompt
@@ -1009,6 +1028,70 @@ async def set_kv_summary(request: web.Request) -> web.Response:
     return web.json_response(state.kv_summary_payload())
 
 
+async def set_autotune_knobs(request: web.Request) -> web.Response:
+    """POST /autotune/knobs: plant the self-tuning state this fake
+    reports — {"mode": "on", "knobs": {"spec_k": 4}, "frozen":
+    {"spec_k": true}, "decisions": {"spec_k": 12}} — each key optional,
+    merged into current state; {"clear": true} resets everything.
+    Echoes the resulting state (same shape as GET /autotune/status)."""
+    state: FakeEngineState = request.app["state"]
+    body = await request.json()
+    if body.get("clear"):
+        state.autotune_mode = "off"
+        state.autotune_knobs = {}
+        state.autotune_frozen = {}
+        state.autotune_decisions = {}
+    if "mode" in body:
+        state.autotune_mode = str(body["mode"])
+    for name, val in (body.get("knobs") or {}).items():
+        state.autotune_knobs[str(name)] = float(val)
+    for name, val in (body.get("frozen") or {}).items():
+        state.autotune_frozen[str(name)] = bool(val)
+    for name, val in (body.get("decisions") or {}).items():
+        state.autotune_decisions[str(name)] = float(val)
+    return await autotune_status(request)
+
+
+async def autotune_status(request: web.Request) -> web.Response:
+    """GET /autotune/status: same shape as the real server's handler,
+    fed from the planted knob/frozen/decision state."""
+    state: FakeEngineState = request.app["state"]
+    return web.json_response({
+        "mode": state.autotune_mode,
+        "interval_s": 2.0,
+        "active_controllers": state.autotune_active(),
+        "controllers": [
+            {"name": name,
+             "knob": state.autotune_knobs[name],
+             "lo": 0.0, "hi": 0.0,
+             "frozen": bool(state.autotune_frozen.get(name)),
+             "decisions": int(state.autotune_decisions.get(name, 0)),
+             "applied": int(state.autotune_decisions.get(name, 0))}
+            for name in sorted(state.autotune_knobs)
+        ],
+    })
+
+
+async def autotune_reset(request: web.Request) -> web.Response:
+    """POST /autotune/reset: operator unfreeze, same contract as the
+    real server — optional {"controller": name} limits the reset."""
+    state: FakeEngineState = request.app["state"]
+    target = None
+    if request.can_read_body:
+        try:
+            target = (await request.json()).get("controller")
+        except Exception:
+            target = None
+    if target is None:
+        cleared = [k for k, v in sorted(state.autotune_frozen.items())
+                   if v]
+        state.autotune_frozen = {}
+    else:
+        cleared = ([target]
+                   if state.autotune_frozen.pop(target, False) else [])
+    return web.json_response({"reset": cleared})
+
+
 async def metrics(request: web.Request) -> web.Response:
     state: FakeEngineState = request.app["state"]
     cache_usage = (state.cache_usage if state.cache_usage is not None
@@ -1045,6 +1128,29 @@ async def metrics(request: web.Request) -> web.Response:
         "vllm:kv_cluster_rejections_total 0.0",
         "# TYPE vllm:engine_draining gauge",
         f"vllm:engine_draining {float(state.draining)}",
+        # Self-tuning (docs/autotuning.md): planted via
+        # POST /autotune/knobs — same families as the real server.
+        "# TYPE vllm:autotune_active_controllers gauge",
+        "vllm:autotune_active_controllers "
+        f"{float(state.autotune_active())}",
+        "# TYPE vllm:autotune_frozen gauge",
+        *(
+            "vllm:autotune_frozen{controller=\"" f"{name}\"}} "
+            f"{float(bool(frozen))}"
+            for name, frozen in sorted(state.autotune_frozen.items())
+        ),
+        "# TYPE vllm:autotune_knob_value gauge",
+        *(
+            "vllm:autotune_knob_value{controller=\"" f"{name}\"}} "
+            f"{float(value)}"
+            for name, value in sorted(state.autotune_knobs.items())
+        ),
+        "# TYPE vllm:autotune_decisions_total counter",
+        *(
+            "vllm:autotune_decisions_total{controller=\"" f"{name}\"}} "
+            f"{float(count)}"
+            for name, count in sorted(state.autotune_decisions.items())
+        ),
         "# TYPE vllm:qos_shed_total counter",
         *(
             "vllm:qos_shed_total{class=\"" f"{cls}\"}} {float(count)}"
@@ -1116,6 +1222,11 @@ async def cluster_status(request: web.Request) -> web.Response:
                                "kv_scales": 0.0,
                                "step_buffers": 65536.0},
         step_time_median_by_kind={"decode": 0.025, "prefill": 0.5},
+        autotune_active_controllers=float(state.autotune_active()),
+        autotune_frozen_by_controller={
+            k: float(bool(v))
+            for k, v in state.autotune_frozen.items()},
+        autotune_knob_by_controller=dict(state.autotune_knobs),
     )
     url = f"http://{request.host}"
     ep = SimpleNamespace(url=url, model_name=state.model,
@@ -1237,6 +1348,9 @@ def build_fake_engine(model: str = "fake/model", speed: float = 100.0,
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/kv/summary", kv_summary)
     app.router.add_post("/kv/summary", set_kv_summary)
+    app.router.add_get("/autotune/status", autotune_status)
+    app.router.add_post("/autotune/reset", autotune_reset)
+    app.router.add_post("/autotune/knobs", set_autotune_knobs)
     app.router.add_get("/cluster/status", cluster_status)
     app.router.add_get("/debug/trace/{request_id}", debug_trace)
     app.router.add_get("/debug/steps", debug_steps)

@@ -132,6 +132,11 @@ def test_dashboard_metrics_exist():
         # (and the router's aggregated re-export) rather than by
         # EngineMetrics or a prometheus_client Gauge (docs/qos.md).
         "vllm:preempt_offload_total", "vllm:qos_shed_total",
+        # Self-tuning decision counter (docs/autotuning.md): labeled
+        # per controller, rendered by engine/server.py /metrics and
+        # scraped by cluster Prometheus directly (engine-local; the
+        # router re-exports only the autotune gauges).
+        "vllm:autotune_decisions_total",
     }
     from production_stack_tpu.engine.metrics import EngineMetrics
     for line in EngineMetrics().render():

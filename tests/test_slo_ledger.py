@@ -233,6 +233,9 @@ def test_stacktop_plain_render_golden():
             "mesh": {"shape": {"dp": 1, "pp": 2, "sp": 1, "tp": 2},
                      "slice_id": 0,
                      "slices_live": {"0": True}},
+            "autotune": {"active": 2,
+                         "frozen": {"qos_shed": False},
+                         "knobs": {"qos_shed": 0.95}},
         }},
     }
     out = render_snapshot(snap)
@@ -244,11 +247,16 @@ def test_stacktop_plain_render_golden():
         "slow archive: 1/64 (5 archived)",
         "",
         "SERVER                                     HEALTH  ROLE    "
-        "MESH       RUN WAIT  CACHE    HIT    MFU  SHED COMPILES",
+        "MESH       RUN WAIT  CACHE    HIT    MFU  SHED COMPILES "
+        "AUTOTUNE",
         "http://e1                                  ok      decode  "
-        "1x2x1x2      3    1   0.50   0.25   0.12     2        7",
+        "1x2x1x2      3    1   0.50   0.25   0.12     2        7 "
+        "       2",
     ])
     assert out == expected
+    # A guardrail-frozen controller flags the AUTOTUNE column.
+    snap["servers"]["http://e1"]["autotune"]["frozen"]["spec_k"] = True
+    assert "      2!" in render_snapshot(snap)
     # A dead slice flags the mesh column; a mesh-less (older) snapshot
     # renders the placeholder.
     snap["servers"]["http://e1"]["mesh"]["slices_live"]["1"] = False

@@ -533,6 +533,38 @@ def test_config_contract_accepts_markers_docs_and_tests():
     assert _run(fixture, "config-contract") == []
 
 
+def test_config_contract_covers_autotune_section():
+    """The autotune section (docs/autotuning.md) is operator surface:
+    an AutotuneConfig field with no flag, alias or internal marker must
+    be flagged under its autotune. path like any other section."""
+    fixture = dict(_CONFIG_FIXTURE)
+    fixture["production_stack_tpu/engine/config.py"] = textwrap.dedent("""\
+        class CacheConfig:
+            page_size: int = 16
+
+        class AutotuneConfig:
+            mode: str = "off"
+            ghost_gain: float = 0.5
+
+        class EngineConfig:
+            cache: CacheConfig = None
+            autotune: AutotuneConfig = None
+
+        CLI_FLAG_ALIASES = {"autotune.mode": "--autotune"}
+        """)
+    fixture["production_stack_tpu/engine/server.py"] = textwrap.dedent("""\
+        def parse_args(parser):
+            parser.add_argument("--page-size", type=int)
+            parser.add_argument("--autotune")
+        """)
+    findings = _run(fixture, "config-contract")
+    messages = "\n".join(f.message for f in findings)
+    assert ("config field autotune.ghost_gain has no CLI flag"
+            in messages)
+    # The aliased mode field is reachable, so only the ghost drifts.
+    assert "config field autotune.mode" not in messages
+
+
 def test_config_contract_catches_fleet_spec_drift():
     fixture = dict(_CONFIG_FIXTURE)
     fixture["production_stack_tpu/fleet/spec.py"] = textwrap.dedent("""\
