@@ -14,6 +14,7 @@ from production_stack_tpu.staticcheck.analyzers import (  # noqa: F401
     metrics_contract,
     network_timeout,
     page_lifecycle,
+    shape_flow,
     slo_contract,
     span_contract,
     state_machine,

@@ -21,6 +21,18 @@ values, commonly handed to ``run_in_executor``; if one is called
 inline the call site itself is still scanned. Waive a justified case
 with ``# lint: allow-async-blocking`` on the call line.
 
+**Transitive variant** (interprocedural, PR 20): a *sync* helper
+that blocks — directly or through further resolved calls — is
+flagged at the ``async def`` call site with the full call chain
+(``async def poll → utils.py:read_config → open()``), because the
+handler is where the event loop stalls. The same helper reached only
+from sync code is not flagged; an async callee that blocks is
+reported inside itself, not re-reported at every caller; and a
+helper reference merely *passed* to ``run_in_executor``/
+``asyncio.to_thread`` produces no call edge, so the sanctioned
+pattern stays clean. Unresolved call edges never produce findings
+(summaries.py soundness stance).
+
 Generalizes the PR1 timeout lint / PR3 dispatch lint approach to the
 whole async surface.
 """
@@ -30,10 +42,12 @@ from __future__ import annotations
 import ast
 from typing import List
 
+from production_stack_tpu.staticcheck import callgraph, summaries
 from production_stack_tpu.staticcheck.core import (
     Finding,
     Project,
     recv_name,
+    render_chain,
     rule,
     tail_name,
 )
@@ -104,7 +118,9 @@ def async_blocking_calls(tree: ast.AST):
 
 
 @rule("async-blocking",
-      "no blocking calls (sleep/requests/sync IO) in async def bodies")
+      "no blocking calls (sleep/requests/sync IO) in async def "
+      "bodies, including through sync helpers (transitive)",
+      interprocedural=True)
 def check(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     for sf in project.files(*SCOPE):
@@ -114,4 +130,45 @@ def check(project: Project) -> List[Finding]:
             findings.append(sf.finding(
                 "async-blocking", call,
                 f"in async def {fn.name}: {reason}"))
+    findings.extend(_transitive_findings(project))
+    return findings
+
+
+def _transitive_findings(project: Project) -> List[Finding]:
+    """Blocking work reached through sync helpers, flagged where the
+    event loop actually stalls: the call site inside the coroutine."""
+    graph = callgraph.for_project(project)
+    sums = summaries.for_project(project)
+    findings: List[Finding] = []
+    for sf in project.files(*SCOPE):
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            info = graph.function_at(sf.relpath, node)
+            if info is None:
+                continue
+            for edge in graph.resolved_edges_from(info.qual):
+                callee_info = graph.functions.get(edge.callee)
+                if callee_info is None or callee_info.is_async:
+                    continue  # async callees report themselves
+                summary = sums.get(edge.callee)
+                if summary.may_block is None:
+                    continue
+                if blocking_reason(edge.call):
+                    continue  # the direct walk already flagged it
+                chain = (
+                    (sf.relpath, edge.lineno,
+                     f"async def {node.name}"),
+                    (sf.relpath, edge.lineno, callee_info.label()),
+                ) + summary.may_block
+                findings.append(sf.finding(
+                    "async-blocking", edge.call,
+                    f"in async def {node.name}: call to "
+                    f"{edge.target_text}() blocks the event loop "
+                    f"via {render_chain(chain)} — move the blocking "
+                    "work to run_in_executor/asyncio.to_thread or "
+                    "make the helper async",
+                    chain=chain))
     return findings

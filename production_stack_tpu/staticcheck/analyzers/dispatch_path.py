@@ -34,6 +34,14 @@ must track reality: a listed name missing from model_runner.py is
 itself a finding, so a renamed function cannot silently fall out of
 coverage.
 
+**Transitive variant** (interprocedural, PR 20): a helper called
+from a DISPATCH_PATH function whose summary says it may host-sync
+(``.item()`` / ``device_get`` / ``.block_until_ready()`` anywhere in
+its resolved call tree) is flagged at the dispatch-path call site
+with the full chain — the blocking read re-serializes the pipeline
+no matter how many frames down it hides. Resolved edges only; an
+unresolved edge never manufactures a finding.
+
 Migrated from tests/test_dispatch_path_lint.py (PR 3), now a thin
 wrapper over this rule.
 """
@@ -48,10 +56,15 @@ from production_stack_tpu.staticcheck.core import (
     Finding,
     Project,
     recv_name,
+    render_chain,
     rule,
     tail_name,
 )
-from production_stack_tpu.staticcheck import dataflow
+from production_stack_tpu.staticcheck import (
+    callgraph,
+    dataflow,
+    summaries,
+)
 
 RUNNER = "production_stack_tpu/engine/model_runner.py"
 
@@ -161,8 +174,41 @@ def dispatch_path_functions(tree: ast.AST):
                 yield node
 
 
+def _transitive_findings(project: Project, sf, fn) -> List[Finding]:
+    """Host syncs hidden below a dispatch-path function boundary."""
+    graph = callgraph.for_project(project)
+    sums = summaries.for_project(project)
+    info = graph.function_at(sf.relpath, fn)
+    if info is None:
+        return []
+    findings: List[Finding] = []
+    for edge in graph.resolved_edges_from(info.qual):
+        callee_info = graph.functions.get(edge.callee)
+        if callee_info is None or callee_info.name in DISPATCH_PATH:
+            continue  # covered by its own dispatch-path scan
+        summary = sums.get(edge.callee)
+        if summary.may_host_sync is None:
+            continue
+        if is_blocking_call(edge.call):
+            continue  # the intraprocedural scan already flagged it
+        chain = (
+            (sf.relpath, edge.lineno, fn.name),
+            (sf.relpath, edge.lineno, callee_info.label()),
+        ) + summary.may_host_sync
+        findings.append(sf.finding(
+            "host-read", edge.call,
+            f"call to {edge.target_text}() in dispatch-path "
+            f"function {fn.name} reaches a blocking host read via "
+            f"{render_chain(chain)} — it re-serializes the async "
+            "pipeline (docs/async_pipeline.md)",
+            chain=chain))
+    return findings
+
+
 @rule("host-read",
-      "no blocking host reads inside the async dispatch path")
+      "no blocking host reads inside the async dispatch path, "
+      "including through helpers (transitive)",
+      interprocedural=True)
 def check(project: Project) -> List[Finding]:
     sf = project.source(RUNNER)
     if sf is None or sf.tree is None:
@@ -171,6 +217,7 @@ def check(project: Project) -> List[Finding]:
     seen = set()
     for fn in dispatch_path_functions(sf.tree):
         seen.add(fn.name)
+        findings.extend(_transitive_findings(project, sf, fn))
         cfg = CFG(fn, raises=lambda _s, _t: False)
         block_in, _ = dataflow.solve(
             cfg, frozenset(), _host_transfer, join="intersection")

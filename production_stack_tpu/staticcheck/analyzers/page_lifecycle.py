@@ -13,30 +13,36 @@ Two fact families, per function in engine/scheduler.py and
 engine/engine.py:
 
 - **orphan allocation**: ``x = <...>.allocate_pages(...)`` (or
-  ``x = list(<...>.allocate_pages(...))``) binds fresh pages to a
-  local. Direct attribute transfer (``seq.pages = ...``,
+  ``x = list(<...>.allocate_pages(...))``, or a call to a helper the
+  summary engine proves *returns* a fresh allocation) binds fresh
+  pages to a local. Direct attribute transfer (``seq.pages = ...``,
   ``seq.pages.extend(...)``) is immediately owned and never tracked.
-  The fact dies at the first statement that *uses* the local — by
-  then the pages are visible to whatever cleanup that code path owns
-  (this deliberately checks "alloc reaches SOME consumer on every
-  path", the pattern every historical leak violated, not full
-  ownership transfer). A fact alive at the normal or exceptional exit
-  is a leak finding at the allocation line.
+  The fact dies at the first statement that *takes custody* of the
+  local. Custody used to be "any read"; since the interprocedural
+  layer (PR 20) a read that provably cannot retain the pages — a
+  ``len()``-class builtin, or a bare name passed to a *resolved*
+  callee whose summary says that parameter never escapes — keeps the
+  fact alive, so "the callee consumed it" is now proved, not
+  assumed. An unresolved callee still counts as custody
+  (conservative: it can never manufacture a finding). A fact alive
+  at the normal or exceptional exit is a leak finding at the
+  allocation line.
 
 - **orphan park**: a sequence enters ``AWAITING_KV`` (``.state =`` /
   ``.transition(...)`` / ``Sequence(state=...)``) and must reach a
   queue or terminal sink — ``add_sequence``, ``appendleft``/
   ``append``, ``abort_sequence``/``_finish``/``finish_handoff``,
-  registration in an engine container, or ``pop``/``remove`` on the
-  failure path — before every exit. Unlike allocations, only those
-  sinks kill the fact: a tracer event reading ``seq.seq_id`` is not
-  custody.
+  registration in an engine container, ``pop``/``remove`` on the
+  failure path — or a resolved callee that takes custody of the
+  sequence, before every exit. A tracer event reading ``seq.seq_id``
+  is still not custody.
 
-Exception edges use a narrow raises-predicate: ``raise``/``assert``,
-any call inside a ``try`` body, and calls to the APIs that actually
-throw on these paths (``allocate_pages``, ``add_sequence``) — so a
-``logger.warning`` cannot manufacture a phantom leak path, and
-``try/except OutOfPagesError`` cleanup is modeled exactly.
+Exception edges: ``raise``/``assert``, any call inside a ``try``
+body, calls to the known-raising cache APIs (``allocate_pages``,
+``add_sequence``), **and any call whose resolved callee's may-raise
+summary is nonempty** — so a helper that raises three frames down
+creates the exception path it really has, while a ``logger.warning``
+(unresolved) still cannot manufacture a phantom leak path.
 
 Waive a deliberate orphan with ``# lint: allow-page-lifecycle`` on
 the allocation/park line.
@@ -45,7 +51,8 @@ the allocation/park line.
 from __future__ import annotations
 
 import ast
-from typing import FrozenSet, List, Set, Tuple
+import collections
+from typing import Dict, FrozenSet, List, Set, Tuple
 
 from production_stack_tpu.staticcheck.cfg import (
     CFG,
@@ -61,16 +68,19 @@ from production_stack_tpu.staticcheck.core import (
     rule,
     tail_name,
 )
-from production_stack_tpu.staticcheck import dataflow
+from production_stack_tpu.staticcheck import (
+    callgraph,
+    dataflow,
+    summaries,
+)
 
 SCOPE = (
     "production_stack_tpu/engine/scheduler.py",
     "production_stack_tpu/engine/engine.py",
 )
 
-# Calls that genuinely raise on the allocation/admission paths; plus
-# raise/assert and anything already under a try, these are the only
-# sources of exception edges for this rule.
+# Calls that genuinely raise on the allocation/admission paths even
+# when the callee cannot be resolved (cache-object methods).
 RAISING_CALLS = {"allocate_pages", "add_sequence"}
 
 # Custody sinks for a parked sequence (see module docstring).
@@ -80,22 +90,129 @@ PARK_SINKS = {"add_sequence", "append", "appendleft", "pop", "remove",
 Fact = Tuple[str, str, int]  # ("alloc"|"park", var, lineno)
 
 
-def _raises(stmt: ast.AST, in_try: bool) -> bool:
-    if isinstance(stmt, (ast.Raise, ast.Assert)):
-        return True
-    if not contains_call(stmt):
+class _FnContext:
+    """Everything the transfer/raises closures need for one function:
+    its call edges keyed by call-node identity, plus the summary
+    table."""
+
+    def __init__(self, project: Project, sf, fn):
+        graph = callgraph.for_project(project)
+        self.sums = summaries.for_project(project)
+        info = graph.function_at(sf.relpath, fn)
+        self.edges_by_call: Dict[int, callgraph.CallEdge] = (
+            {id(e.call): e for e in graph.edges_from(info.qual)}
+            if info is not None else {})
+
+    def callee_summary(self, call: ast.Call):
+        edge = self.edges_by_call.get(id(call))
+        if edge is None or edge.callee is None:
+            return None, None
+        return edge, self.sums.get(edge.callee)
+
+    def call_may_raise(self, call: ast.Call) -> bool:
+        _edge, summ = self.callee_summary(call)
+        return summ is not None and bool(summ.may_raise)
+
+    def noncustodial_names(self, el) -> Set[str]:
+        """Names whose every occurrence in ``el`` is a provably
+        non-custodial read: an argument of a read-only builtin, or a
+        bare name passed to a resolved callee whose summary says that
+        parameter never escapes the callee's frame."""
+        if not isinstance(el, ast.AST):
+            return set()
+        total = collections.Counter(
+            n.id for n in ast.walk(el) if isinstance(n, ast.Name))
+        safe: collections.Counter = collections.Counter()
+        for call in ast.walk(el):
+            if not isinstance(call, ast.Call):
+                continue
+            edge = self.edges_by_call.get(id(call))
+            if edge is None:
+                continue
+            if edge.kind == "builtin" and \
+                    edge.target_text in summaries.READONLY_BUILTINS:
+                for arg in call.args:
+                    if isinstance(arg, ast.Name):
+                        safe[arg.id] += 1
+                continue
+            if edge.callee is None:
+                continue
+            callee_sum = self.sums.get(edge.callee)
+            for pos, arg in enumerate(call.args):
+                if not isinstance(arg, ast.Name):
+                    continue
+                param = self.sums.callee_param_for_arg(edge, pos,
+                                                       None)
+                if param is not None and \
+                        param not in callee_sum.consumed_params:
+                    safe[arg.id] += 1
+            for kw in call.keywords:
+                if not isinstance(kw.value, ast.Name) or \
+                        kw.arg is None:
+                    continue
+                param = self.sums.callee_param_for_arg(edge, 0,
+                                                       kw.arg)
+                if param is not None and \
+                        param not in callee_sum.consumed_params:
+                    safe[kw.value.id] += 1
+        return {name for name, count in total.items()
+                if safe.get(name, 0) >= count}
+
+    def custody_transfers(self, el) -> Set[str]:
+        """Names handed to a resolved callee that (possibly) takes
+        custody — kills park facts the way an explicit sink does."""
+        out: Set[str] = set()
+        if not isinstance(el, ast.AST):
+            return out
+        for call in ast.walk(el):
+            if not isinstance(call, ast.Call):
+                continue
+            edge = self.edges_by_call.get(id(call))
+            if edge is None or edge.callee is None:
+                continue
+            callee_sum = self.sums.get(edge.callee)
+            for pos, arg in enumerate(call.args):
+                if not isinstance(arg, ast.Name):
+                    continue
+                param = self.sums.callee_param_for_arg(edge, pos,
+                                                       None)
+                if param is not None and \
+                        param in callee_sum.consumed_params:
+                    out.add(arg.id)
+        return out
+
+    def alloc_via_callee(self, value: ast.Call) -> bool:
+        """Is this call a helper the summaries prove returns a fresh
+        allocation?"""
+        _edge, summ = self.callee_summary(value)
+        return summ is not None and summ.returns_alloc
+
+
+def _raises_for(ctx: _FnContext):
+    def _raises(stmt: ast.AST, in_try: bool) -> bool:
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            return True
+        if not contains_call(stmt):
+            return False
+        if in_try:
+            return True
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if tail_name(node.func) in RAISING_CALLS:
+                return True
+            if ctx.call_may_raise(node):
+                return True
         return False
-    if in_try:
-        return True
-    return any(isinstance(n, ast.Call)
-               and tail_name(n.func) in RAISING_CALLS
-               for n in ast.walk(stmt))
+    return _raises
 
 
-def _alloc_target(stmt: ast.AST) -> str:
+def _alloc_target(stmt: ast.AST, ctx: _FnContext = None) -> str:
     """Name bound to a fresh allocation by this statement, or ''.
-    Matches ``x = <...>.allocate_pages(...)`` and
-    ``x = list/tuple(<...>.allocate_pages(...))``."""
+    Matches ``x = <...>.allocate_pages(...)``,
+    ``x = list/tuple(<...>.allocate_pages(...))`` and — with a
+    context — ``x = self._helper(...)`` where the helper's summary
+    says it returns a fresh allocation."""
     if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
         return ""
     target = stmt.targets[0]
@@ -106,8 +223,11 @@ def _alloc_target(stmt: ast.AST) -> str:
             and isinstance(value.func, ast.Name)
             and value.func.id in ("list", "tuple") and value.args):
         value = value.args[0]
-    if (isinstance(value, ast.Call)
-            and tail_name(value.func) == "allocate_pages"):
+    if not isinstance(value, ast.Call):
+        return ""
+    if tail_name(value.func) == "allocate_pages":
+        return target.id
+    if ctx is not None and ctx.alloc_via_callee(value):
         return target.id
     return ""
 
@@ -191,28 +311,34 @@ def _park_sunk_vars(el) -> Set[str]:
     return out
 
 
-def _transfer(state: FrozenSet[Fact], el, _kind) -> FrozenSet[Fact]:
-    reads = _names_read(el)
-    sunk = _park_sunk_vars(el)
-    alloc_var = _alloc_target(el) if isinstance(el, ast.AST) else ""
-    park_var = _park_target(el) if isinstance(el, ast.AST) else ""
-    out = set()
-    for fact in state:
-        kind, var, _line = fact
-        if kind == "alloc":
-            if var in reads:
-                continue  # consumed (or rebound) here
-        else:  # park
-            if var in sunk:
-                continue
-            if _rebinds(el, var) and park_var != var:
-                continue  # rebound to something else
-        out.add(fact)
-    if alloc_var:
-        out.add(("alloc", alloc_var, el.lineno))
-    if park_var:
-        out.add(("park", park_var, el.lineno))
-    return frozenset(out)
+def _transfer_for(ctx: _FnContext):
+    def _transfer(state: FrozenSet[Fact], el, _kind
+                  ) -> FrozenSet[Fact]:
+        reads = _names_read(el)
+        if reads:
+            reads = reads - ctx.noncustodial_names(el)
+        sunk = _park_sunk_vars(el) | ctx.custody_transfers(el)
+        alloc_var = _alloc_target(el, ctx) if isinstance(el, ast.AST) \
+            else ""
+        park_var = _park_target(el) if isinstance(el, ast.AST) else ""
+        out = set()
+        for fact in state:
+            kind, var, _line = fact
+            if kind == "alloc":
+                if var in reads:
+                    continue  # custody taken (or rebound) here
+            else:  # park
+                if var in sunk:
+                    continue
+                if _rebinds(el, var) and park_var != var:
+                    continue  # rebound to something else
+            out.add(fact)
+        if alloc_var:
+            out.add(("alloc", alloc_var, el.lineno))
+        if park_var:
+            out.add(("park", park_var, el.lineno))
+        return frozenset(out)
+    return _transfer
 
 
 def _rebinds(el, var: str) -> bool:
@@ -224,21 +350,24 @@ def _rebinds(el, var: str) -> bool:
 
 @rule("page-lifecycle",
       "KV page allocations / AWAITING_KV parks reach their paired "
-      "release or queue sink on every path (incl. exception edges)")
+      "release or queue sink on every path (incl. exception edges); "
+      "callee custody proved via summaries (transitive)",
+      interprocedural=True)
 def check(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     for sf in project.files(*SCOPE):
         if sf.tree is None:
             continue  # parse-error rule reports it
         for fn in function_defs(sf.tree):
+            ctx = _FnContext(project, sf, fn)
             # Cheap prefilter: only functions that allocate or park.
-            if not any(_alloc_target(s) or _park_target(s)
+            if not any(_alloc_target(s, ctx) or _park_target(s)
                        for s in ast.walk(fn)
                        if isinstance(s, ast.stmt)):
                 continue
-            cfg = CFG(fn, raises=_raises)
+            cfg = CFG(fn, raises=_raises_for(ctx))
             exits = dataflow.facts_at_exit(
-                cfg, frozenset(), _transfer, join="union")
+                cfg, frozenset(), _transfer_for(ctx), join="union")
             leaked: Set[Tuple[Fact, str]] = set()
             for exit_name, facts in exits.items():
                 for fact in facts:
@@ -255,8 +384,9 @@ def check(project: Project) -> List[Finding]:
                         "page-lifecycle", line,
                         f"KV pages allocated into '{var}' in {fn.name} "
                         f"can leak: a {how} is reachable before "
-                        "anything consumes them — free_sequence them "
-                        "or transfer ownership on that path"))
+                        "anything takes custody of them — "
+                        "free_sequence them or transfer ownership on "
+                        "that path"))
                 else:
                     findings.append(sf.finding(
                         "page-lifecycle", line,

@@ -1,0 +1,405 @@
+"""Rule ``shape-flow``: a static recompile-budget proof for the jit
+boundary.
+
+The serving stack's "zero per-step recompiles" story rests on every
+shape-determining Python scalar that reaches an ``InstrumentedJit`` /
+``jax.jit`` call site being drawn from a *closed* value set: a bucket
+lattice (``_bucket_for`` / ``_row_bucket_for`` / ``prefill_buckets``),
+an init-fixed config constant, or a bool/string static flag. One
+un-snapped ``len(rows)`` handed to a jitted program compiles a fresh
+executable per distinct batch size — the latency cliff the bucket
+lattices exist to prevent, and one a functional test never sees
+(everything still returns the right tokens, 40 compiles later).
+
+This rule makes the budget a static proof. It finds every jit
+*handle* (``self._x = InstrumentedJit(...)`` / ``x = jax.jit(...)``)
+and every call through one, then classifies each argument's
+**value flow** interprocedurally over the call graph
+(staticcheck/callgraph.py):
+
+- **snapped** — literals, ``self.*``/attribute reads (init-fixed
+  config), a call to a snap helper (``_bucket_for``,
+  ``_row_bucket_for``, ``prefill_buckets``), or arithmetic/min/max
+  over snapped values. A local whose every assignment is snapped is
+  snapped — so the inline pow2 lattice idiom (``t = 16`` then
+  ``t *= 2`` in a loop) proves itself: comparisons against raw data
+  steer *which* lattice point is chosen but cannot leave the lattice.
+- **raw** — ``len(...)`` (a data-dependent unbounded int) and
+  anything arithmetic derives from one. A bare parameter traces to
+  every *resolved* caller's actual argument; a call to a resolved
+  helper traces into that helper's return expressions — both
+  directions report the **full chain** from the jit call site to the
+  raw origin.
+- **opaque** — array-valued expressions (subscripts like
+  ``payload["tokens"]``, ``jnp.asarray``/``_as_device`` wrappers,
+  unresolved calls). Never flagged: device arrays carry their shapes
+  from their (bucket-padded) construction sites, and an unresolved
+  edge must never manufacture a finding (callgraph.py soundness
+  stance). The proof obligation here is precisely the *Python
+  scalars* crossing the boundary.
+
+A deliberate un-snapped source carries ``# lint: shape-source`` on
+its line (assignment or call-site argument) — the declaration is the
+reviewable artifact: every recompile trigger is either lattice-
+bounded by construction or explicitly signed off (see
+CONTRIBUTING.md). ``# lint: allow-shape-flow`` on the call-site line
+waives the whole site, same as every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from production_stack_tpu.staticcheck.core import (
+    Finding,
+    Project,
+    render_chain,
+    rule,
+    tail_name,
+)
+from production_stack_tpu.staticcheck import callgraph, summaries
+
+Frame = Tuple[str, int, str]
+
+# The bucket-lattice snap vocabulary (engine/model_runner.py).
+SNAP_HELPERS = {"_bucket_for", "_row_bucket_for", "prefill_buckets"}
+
+# Builtins that only *select or combine* — raw iff an input is raw.
+_COMBINERS = {"min", "max", "abs", "round", "int", "sum", "pow",
+              "divmod"}
+
+_SHAPE_SOURCE_RE = re.compile(r"#\s*lint:\s*shape-source\b")
+
+_MAX_DEPTH = 6
+
+
+def _shape_source_lines(sf) -> Set[int]:
+    cached = getattr(sf, "_shape_source_lines", None)
+    if cached is None:
+        cached = {i for i, line in enumerate(sf.lines, start=1)
+                  if _SHAPE_SOURCE_RE.search(line)}
+        sf._shape_source_lines = cached
+    return cached
+
+
+def jit_handles(tree: ast.AST) -> Set[str]:
+    """Names bound to an InstrumentedJit / jax.jit result in this
+    module: ``self._step_jit = InstrumentedJit(...)``,
+    ``x = jax.jit(...)`` — the attr/local name is the handle."""
+    handles: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        value = node.value
+        is_jit = False
+        if isinstance(value, ast.Call):
+            t = tail_name(value.func)
+            if t == "InstrumentedJit":
+                is_jit = True
+            elif t == "jit":
+                # jax.jit(...) itself, not jax.jit(fn)(...) inline.
+                is_jit = True
+        if not is_jit:
+            continue
+        target = node.targets[0]
+        if isinstance(target, ast.Attribute):
+            handles.add(target.attr)
+        elif isinstance(target, ast.Name):
+            handles.add(target.id)
+    return handles
+
+
+class _FnCtx:
+    """One function's classification context: its source file, the
+    call edges keyed by call-node identity, the flow-insensitive
+    local assignment map, and its parameter list."""
+
+    def __init__(self, sf, info, graph):
+        self.sf = sf
+        self.info = info
+        self.edges_by_call = {id(e.call): e
+                              for e in graph.edges_from(info.qual)}
+        args = info.node.args
+        self.params = [a.arg for a in (args.posonlyargs + args.args
+                                       + args.kwonlyargs)]
+        # name -> [RHS exprs bound to it anywhere in the function]
+        self.locals: Dict[str, List[ast.AST]] = {}
+        for node in summaries.own_body_nodes(info.node):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self.locals.setdefault(target.id, []).append(
+                            node.value)
+                    elif isinstance(target, ast.Tuple):
+                        for elt in target.elts:
+                            if isinstance(elt, ast.Name):
+                                self.locals.setdefault(
+                                    elt.id, []).append(node.value)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name):
+                self.locals.setdefault(node.target.id, []).append(
+                    node.value)
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name) and node.value is not None:
+                self.locals.setdefault(node.target.id, []).append(
+                    node.value)
+
+
+class _Classifier:
+    """Interprocedural raw-int tracer. ``find_raw`` returns the chain
+    of frames from the expression down to an un-snapped origin, or
+    None when the expression provably stays inside the lattice (or is
+    array-valued/opaque)."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.graph = callgraph.for_project(project)
+        self.sums = summaries.for_project(project)
+        self._ctx_cache: Dict[str, _FnCtx] = {}
+        self._param_memo: Dict[Tuple[str, str],
+                               Optional[Tuple[Frame, ...]]] = {}
+        self._ret_memo: Dict[str, Optional[Tuple[Frame, ...]]] = {}
+
+    def ctx_for(self, qual: str) -> Optional[_FnCtx]:
+        ctx = self._ctx_cache.get(qual)
+        if ctx is None:
+            info = self.graph.functions.get(qual)
+            if info is None:
+                return None
+            sf = self.project.source(info.path)
+            if sf is None:
+                return None
+            ctx = _FnCtx(sf, info, self.graph)
+            self._ctx_cache[qual] = ctx
+        return ctx
+
+    # ---- classification -------------------------------------------------
+
+    def find_raw(self, expr: ast.AST, ctx: _FnCtx, depth: int,
+                 visiting: Set[Tuple[str, str]]
+                 ) -> Optional[Tuple[Frame, ...]]:
+        if depth > _MAX_DEPTH:
+            return None  # honest give-up: never guess a finding
+        line = getattr(expr, "lineno", 0)
+        if line in _shape_source_lines(ctx.sf):
+            return None  # declared shape source
+        if isinstance(expr, ast.Constant):
+            return None
+        if isinstance(expr, ast.Attribute):
+            return None  # init-fixed config / device array
+        if isinstance(expr, (ast.Subscript, ast.JoinedStr, ast.List,
+                             ast.Tuple, ast.Set, ast.Dict,
+                             ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp, ast.Lambda)):
+            return None  # array/container-valued: opaque
+        if isinstance(expr, ast.Call):
+            return self._classify_call(expr, ctx, depth, visiting)
+        if isinstance(expr, ast.BinOp):
+            return (self.find_raw(expr.left, ctx, depth, visiting)
+                    or self.find_raw(expr.right, ctx, depth,
+                                     visiting))
+        if isinstance(expr, ast.UnaryOp):
+            return self.find_raw(expr.operand, ctx, depth, visiting)
+        if isinstance(expr, (ast.BoolOp,)):
+            for value in expr.values:
+                chain = self.find_raw(value, ctx, depth, visiting)
+                if chain:
+                    return chain
+            return None
+        if isinstance(expr, ast.IfExp):
+            return (self.find_raw(expr.body, ctx, depth, visiting)
+                    or self.find_raw(expr.orelse, ctx, depth,
+                                     visiting))
+        if isinstance(expr, ast.Starred):
+            return self.find_raw(expr.value, ctx, depth, visiting)
+        if isinstance(expr, ast.Name):
+            return self._classify_name(expr, ctx, depth, visiting)
+        return None
+
+    def _classify_call(self, call: ast.Call, ctx: _FnCtx, depth: int,
+                       visiting: Set[Tuple[str, str]]
+                       ) -> Optional[Tuple[Frame, ...]]:
+        tname = tail_name(call.func)
+        if tname in SNAP_HELPERS:
+            return None  # the snap IS the proof, whatever feeds it
+        if isinstance(call.func, ast.Name) and call.func.id == "len":
+            return ((ctx.sf.relpath, call.lineno,
+                     "len(…) — un-snapped data-dependent int"),)
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in _COMBINERS:
+            for arg in call.args:
+                chain = self.find_raw(arg, ctx, depth, visiting)
+                if chain:
+                    return chain
+            return None
+        edge = ctx.edges_by_call.get(id(call))
+        if edge is None or edge.callee is None:
+            return None  # builtin/unresolved: opaque, never a finding
+        chain = self._return_raw(edge.callee, depth + 1, visiting)
+        if chain:
+            site: Frame = (ctx.sf.relpath, call.lineno,
+                           f"{edge.target_text}()")
+            return (site,) + chain
+        return None
+
+    def _classify_name(self, name: ast.Name, ctx: _FnCtx, depth: int,
+                       visiting: Set[Tuple[str, str]]
+                       ) -> Optional[Tuple[Frame, ...]]:
+        key = (ctx.info.qual, name.id)
+        if key in visiting:
+            return None  # cycle (e.g. t *= 2): stays in its lattice
+        rhss = ctx.locals.get(name.id)
+        if rhss:
+            visiting = visiting | {key}
+            for rhs in rhss:
+                if getattr(rhs, "lineno", 0) in \
+                        _shape_source_lines(ctx.sf):
+                    continue  # this binding is a declared source
+                chain = self.find_raw(rhs, ctx, depth, visiting)
+                if chain:
+                    origin: Frame = (
+                        ctx.sf.relpath, rhs.lineno,
+                        f"{name.id} = …")
+                    return (origin,) + chain if chain[0][1] != \
+                        rhs.lineno else chain
+            return None
+        if name.id in ctx.params:
+            return self._param_raw(ctx, name.id, depth, visiting)
+        return None  # module constant / import: fixed at import time
+
+    def _param_raw(self, ctx: _FnCtx, param: str, depth: int,
+                   visiting: Set[Tuple[str, str]]
+                   ) -> Optional[Tuple[Frame, ...]]:
+        """Trace a parameter to every resolved caller's actual."""
+        key = (ctx.info.qual, param)
+        if key in self._param_memo:
+            return self._param_memo[key]
+        if key in visiting:
+            return None
+        visiting = visiting | {key}
+        self._param_memo[key] = None  # provisional (recursion-safe)
+        result: Optional[Tuple[Frame, ...]] = None
+        for edge in self.graph.callers.get(ctx.info.qual, []):
+            caller_ctx = self.ctx_for(edge.caller)
+            if caller_ctx is None:
+                continue
+            actual = self._actual_for_param(edge, ctx, param)
+            if actual is None:
+                continue  # defaulted or unmappable: no flow
+            chain = self.find_raw(actual, caller_ctx, depth + 1,
+                                  visiting)
+            if chain:
+                site: Frame = (
+                    caller_ctx.sf.relpath, edge.lineno,
+                    f"{caller_ctx.info.label()} passes {param}")
+                result = (site,) + chain
+                break
+        self._param_memo[key] = result
+        return result
+
+    def _actual_for_param(self, edge, callee_ctx: _FnCtx,
+                          param: str) -> Optional[ast.AST]:
+        params = callee_ctx.params
+        if param not in params:
+            return None
+        for kw in edge.call.keywords:
+            if kw.arg == param:
+                return kw.value
+        idx = params.index(param)
+        if params and params[0] in ("self", "cls") and \
+                isinstance(edge.call.func, ast.Attribute):
+            idx -= 1
+        if 0 <= idx < len(edge.call.args):
+            arg = edge.call.args[idx]
+            if isinstance(arg, ast.Starred):
+                return None
+            return arg
+        return None
+
+    def _return_raw(self, qual: str, depth: int,
+                    visiting: Set[Tuple[str, str]]
+                    ) -> Optional[Tuple[Frame, ...]]:
+        """Does this callee's return value derive from a raw int?
+        Parameters inside the callee are opaque here — the caller
+        direction covers values threaded straight through."""
+        if qual in self._ret_memo:
+            return self._ret_memo[qual]
+        if depth > _MAX_DEPTH:
+            return None
+        self._ret_memo[qual] = None  # provisional
+        ctx = self.ctx_for(qual)
+        result: Optional[Tuple[Frame, ...]] = None
+        if ctx is not None:
+            for node in summaries.own_body_nodes(ctx.info.node):
+                if not isinstance(node, ast.Return) or \
+                        node.value is None:
+                    continue
+                if isinstance(node.value, ast.Name) and \
+                        node.value.id in ctx.params:
+                    continue  # pass-through: caller side owns it
+                chain = self.find_raw(node.value, ctx, depth,
+                                      visiting)
+                if chain:
+                    ret: Frame = (ctx.sf.relpath, node.value.lineno,
+                                  f"return in {ctx.info.label()}")
+                    result = (ret,) + chain if chain[0][1] != \
+                        node.value.lineno else chain
+                    break
+        self._ret_memo[qual] = result
+        return result
+
+
+@rule("shape-flow",
+      "every Python scalar reaching an InstrumentedJit/jax.jit call "
+      "site traces to a bucket snap, a fixed config constant, or a "
+      "declared shape-source (transitive)",
+      interprocedural=True)
+def check(project: Project) -> List[Finding]:
+    classifier = _Classifier(project)
+    graph = classifier.graph
+    findings: List[Finding] = []
+    for sf in project.files(f"{callgraph.PACKAGE}/**/*.py"):
+        if sf.tree is None:
+            continue
+        handles = jit_handles(sf.tree)
+        if not handles:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            info = graph.function_at(sf.relpath, node)
+            if info is None:
+                continue
+            ctx = classifier.ctx_for(info.qual)
+            if ctx is None:
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                fname = tail_name(call.func)
+                if fname not in handles:
+                    continue
+                if not isinstance(call.func,
+                                  (ast.Name, ast.Attribute)):
+                    continue
+                for arg in list(call.args) + \
+                        [kw.value for kw in call.keywords]:
+                    chain = classifier.find_raw(arg, ctx, 0, set())
+                    if not chain:
+                        continue
+                    full = ((sf.relpath, call.lineno,
+                             f"jit call {fname}(…) in "
+                             f"{node.name}"),) + chain
+                    findings.append(sf.finding(
+                        "shape-flow", call,
+                        f"argument to jitted {fname}() in "
+                        f"{node.name} derives from an un-snapped "
+                        "data-dependent int via "
+                        f"{render_chain(full)} — snap it through "
+                        "_bucket_for/_row_bucket_for/prefill_buckets "
+                        "or declare it with '# lint: shape-source'",
+                        chain=full))
+    return findings

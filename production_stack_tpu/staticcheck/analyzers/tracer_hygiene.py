@@ -34,6 +34,14 @@ and either arm of a conditional expression), kernels passed to
 ``pl.pallas_call`` (including ``partial(kernel, ...)``), and every
 ``def`` nested inside one of those.
 
+**Transitive variant** (interprocedural, PR 20): a helper that
+host-syncs (``.item()``, ``jax.device_get``,
+``.block_until_ready()``) — directly or deeper — is flagged at its
+call site *inside the traced function*, with the full call chain, so
+a sync hidden one call below the jit boundary is no longer invisible.
+Only resolved call-graph edges propagate; an unresolved edge never
+manufactures a finding.
+
 Waiver: ``# lint: allow-tracer-hygiene`` on the flagged line.
 """
 
@@ -47,10 +55,15 @@ from production_stack_tpu.staticcheck.core import (
     Finding,
     Project,
     recv_name,
+    render_chain,
     rule,
     tail_name,
 )
-from production_stack_tpu.staticcheck import dataflow
+from production_stack_tpu.staticcheck import (
+    callgraph,
+    dataflow,
+    summaries,
+)
 
 SCOPE = (
     "production_stack_tpu/ops/*.py",
@@ -259,10 +272,45 @@ def check_tree(sf) -> List[Finding]:
     return findings
 
 
+def _transitive_findings(project: Project, sf) -> List[Finding]:
+    """Host syncs reached through helpers called from traced code."""
+    if sf.tree is None:
+        return []
+    graph = callgraph.for_project(project)
+    sums = summaries.for_project(project)
+    findings: List[Finding] = []
+    for fn in traced_functions(sf.tree):
+        info = graph.function_at(sf.relpath, fn)
+        if info is None:
+            continue
+        for edge in graph.resolved_edges_from(info.qual):
+            summary = sums.get(edge.callee)
+            if summary.may_host_sync is None:
+                continue
+            if summaries.host_sync_reason(edge.call):
+                continue  # the direct walk already flagged it
+            callee_info = graph.functions.get(edge.callee)
+            chain = (
+                (sf.relpath, edge.lineno, f"traced {fn.name}"),
+                (sf.relpath, edge.lineno, callee_info.label()),
+            ) + summary.may_host_sync
+            findings.append(sf.finding(
+                "tracer-hygiene", edge.call,
+                f"call to {edge.target_text}() in traced function "
+                f"{fn.name} reaches a device->host sync via "
+                f"{render_chain(chain)} — host reads cannot live "
+                "below a jit/pallas boundary",
+                chain=chain))
+    return findings
+
+
 @rule("tracer-hygiene",
-      "no recompile/host-sync hazards in jitted or pallas code")
+      "no recompile/host-sync hazards in jitted or pallas code, "
+      "including through helpers (transitive)",
+      interprocedural=True)
 def check(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     for sf in project.files(*SCOPE):
         findings.extend(check_tree(sf))
+        findings.extend(_transitive_findings(project, sf))
     return findings

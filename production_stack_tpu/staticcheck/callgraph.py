@@ -1,0 +1,478 @@
+"""Project-wide call graph over stdlib ``ast``.
+
+The intraprocedural rules (PR 5 patterns, PR 14 CFG/dataflow) stop at
+function boundaries: a blocking or host-syncing helper one call deep
+is invisible, and "this allocation is consumed" silently assumes the
+callee does what its name suggests. This module gives the suite the
+missing edge set so ``summaries.py`` can propagate facts bottom-up
+and the analyzers can report the *full call chain* at the place the
+invariant actually holds (the async handler, the traced function, the
+jit call site).
+
+Resolution is deliberately modest and **honest**:
+
+- direct calls to module-level functions (``helper(...)``), including
+  through ``from mod import helper [as h]`` / ``import mod [as m]``
+  aliases for modules inside the project;
+- ``self.method(...)`` / ``cls.method(...)`` against the enclosing
+  class, then its base classes when those resolve to project classes
+  (single pass up the chain, depth-bounded);
+- calls through local bindings the tree actually uses:
+  ``h = helper`` / ``h = functools.partial(helper, ...)`` then
+  ``h(...)`` (flow-insensitive, last-binding-wins within a scope);
+- nested ``def``s called by name from their enclosing function.
+
+Everything else — ``obj.method(...)`` on an arbitrary receiver,
+calls through containers, getattr, callbacks handed in as arguments —
+becomes an **unresolved edge**: recorded with the best-effort callee
+text, never guessed at. Analyzers must treat unresolved edges as
+"unknown", which means transitive *findings* require a fully resolved
+chain, while transitive *fact kills* (e.g. "callee consumed the
+pages") stay conservative. An unresolved edge can therefore never
+manufacture a finding; the cost is admitted, not hidden (see
+docs/static_analysis.md, soundness caveats).
+
+Function identity is the **qualified name** ``path.py::Class.method``
+/ ``path.py::func`` / ``path.py::outer.<locals>.inner`` — stable
+across line edits, so summaries and finding chains survive unrelated
+refactors.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+PACKAGE = "production_stack_tpu"
+
+# Builtin / stdlib callables we never try to resolve and never report
+# as interesting unresolved edges (pure host work, no project code).
+_BUILTIN_NAMES = frozenset({
+    "len", "range", "list", "tuple", "set", "dict", "frozenset",
+    "sorted", "reversed", "enumerate", "zip", "map", "filter", "sum",
+    "min", "max", "abs", "round", "int", "float", "bool", "str",
+    "bytes", "repr", "print", "isinstance", "issubclass", "getattr",
+    "setattr", "hasattr", "iter", "next", "super", "type", "id",
+    "hash", "vars", "dir", "any", "all", "divmod", "pow", "format",
+    "open", "ValueError", "TypeError", "KeyError", "RuntimeError",
+    "Exception", "StopIteration", "NotImplementedError",
+})
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One (async) function definition in the project."""
+
+    qual: str                # "path.py::Class.method" etc.
+    path: str                # repo-relative posix path
+    node: object             # ast.FunctionDef | ast.AsyncFunctionDef
+    class_name: Optional[str]
+    is_async: bool
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+    def label(self) -> str:
+        """Human-readable frame label for chain rendering."""
+        short = self.path.rsplit("/", 1)[-1]
+        inner = self.qual.split("::", 1)[1]
+        return f"{short}:{inner}"
+
+
+@dataclasses.dataclass
+class CallEdge:
+    """One call site inside ``caller``. ``callee`` is a qualified
+    name when resolution succeeded, else None (honest unknown)."""
+
+    caller: str
+    call: ast.Call
+    callee: Optional[str]
+    target_text: str         # best-effort callee rendering
+    kind: str                # direct|method|alias|partial|unresolved|builtin
+
+    @property
+    def lineno(self) -> int:
+        return self.call.lineno
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted rendering of a call target for messages."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        return _dotted(node.func) + "(...)"
+    return "<expr>"
+
+
+def _module_to_path(module: str) -> Optional[str]:
+    """``production_stack_tpu.engine.scheduler`` -> project relpath
+    (None for anything outside the package)."""
+    if not module or not module.startswith(PACKAGE):
+        return None
+    return module.replace(".", "/") + ".py"
+
+
+class _Scope:
+    """Name bindings visible at some definition nesting level:
+    functions defined here, plus alias/partial bindings."""
+
+    def __init__(self):
+        # local callable name -> ("qual", qualname) | ("import", path, name)
+        self.bindings: Dict[str, Tuple] = {}
+
+
+class CallGraph:
+    """Functions, edges, callers, SCCs for one :class:`Project`."""
+
+    def __init__(self):
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.edges: Dict[str, List[CallEdge]] = {}
+        self.callers: Dict[str, List[CallEdge]] = {}
+        # path -> {class name -> {method name -> qual}}
+        self._classes: Dict[str, Dict[str, Dict[str, str]]] = {}
+        # path -> {class name -> [base class names as written]}
+        self._bases: Dict[str, Dict[str, List[str]]] = {}
+        # path -> {module-level function name -> qual}
+        self._module_funcs: Dict[str, Dict[str, str]] = {}
+        # path -> {alias -> ("mod", module_path) | ("sym", path, name)}
+        self._imports: Dict[str, Dict[str, Tuple]] = {}
+        # (path, id(def node)) -> FunctionInfo, for function_at()
+        self._by_node: Dict[Tuple[str, int], FunctionInfo] = {}
+
+    # ---- construction ---------------------------------------------------
+
+    @classmethod
+    def build(cls, project) -> "CallGraph":
+        graph = cls()
+        files = [sf for sf in project.files(f"{PACKAGE}/**/*.py")
+                 if sf.tree is not None]
+        for sf in files:
+            graph._collect_defs(sf)
+        for sf in files:
+            graph._collect_edges(sf)
+        for edge_list in graph.edges.values():
+            for edge in edge_list:
+                if edge.callee is not None:
+                    graph.callers.setdefault(edge.callee, []).append(edge)
+        return graph
+
+    def _collect_defs(self, sf) -> None:
+        path = sf.relpath
+        self._classes[path] = {}
+        self._bases[path] = {}
+        self._module_funcs[path] = {}
+        self._imports[path] = {}
+        self._collect_imports(sf.tree, path)
+
+        def visit(node, prefix: str, class_name: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{path}::{prefix}{child.name}"
+                    info = FunctionInfo(
+                        qual=qual, path=path, node=child,
+                        class_name=class_name,
+                        is_async=isinstance(child,
+                                            ast.AsyncFunctionDef))
+                    self.functions[qual] = info
+                    self._by_node[(path, id(child))] = info
+                    if not prefix:
+                        self._module_funcs[path][child.name] = qual
+                    elif class_name and prefix == f"{class_name}.":
+                        self._classes[path][class_name][
+                            child.name] = qual
+                    visit(child,
+                          f"{prefix}{child.name}.<locals>.", class_name)
+                elif isinstance(child, ast.ClassDef):
+                    if not prefix:  # nested classes: skip method maps
+                        self._classes[path][child.name] = {}
+                        self._bases[path][child.name] = [
+                            _dotted(b) for b in child.bases]
+                        visit(child, f"{child.name}.", child.name)
+                    else:
+                        visit(child, f"{prefix}{child.name}.",
+                              child.name)
+
+        visit(sf.tree, "", None)
+
+    def _collect_imports(self, tree, path: str) -> None:
+        table = self._imports[path]
+        pkg_dir = path.rsplit("/", 1)[0] if "/" in path else ""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = _module_to_path(alias.name)
+                    if target:
+                        local = alias.asname or alias.name.split(".")[0]
+                        table[local] = ("mod", target)
+            elif isinstance(node, ast.ImportFrom):
+                module = node.module or ""
+                if node.level:  # relative import
+                    base = pkg_dir
+                    for _ in range(node.level - 1):
+                        base = base.rsplit("/", 1)[0] if "/" in base \
+                            else ""
+                    module_base = (f"{base}/{module.replace('.', '/')}"
+                                   if module else base)
+                elif module.startswith(PACKAGE):
+                    module_base = module.replace(".", "/")
+                else:
+                    continue
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # `from pkg.mod import name`: name may be a symbol
+                    # in mod.py or the submodule pkg/mod/name.py.
+                    table[local] = ("sym", f"{module_base}.py",
+                                    alias.name,
+                                    f"{module_base}/{alias.name}.py")
+
+    # ---- edge extraction ------------------------------------------------
+
+    def _collect_edges(self, sf) -> None:
+        path = sf.relpath
+
+        def walk_function(info: FunctionInfo,
+                          scope_bindings: Dict[str, Tuple]) -> None:
+            bindings = dict(scope_bindings)
+            # Pre-bind nested defs and local aliases (flow-insensitive;
+            # a later rebinding wins for calls after it, which a single
+            # top-to-bottom pass approximates well enough for a lint).
+            for child in ast.iter_child_nodes(info.node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = (f"{info.qual}.<locals>.{child.name}")
+                    if qual in self.functions:
+                        bindings[child.name] = ("qual", qual)
+            edges = self.edges.setdefault(info.qual, [])
+
+            def visit(node):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        continue  # its calls belong to the nested fn
+                    if isinstance(child, ast.Assign):
+                        self._track_binding(child, bindings, path, info)
+                    if isinstance(child, ast.Call):
+                        edges.append(self._resolve_call(
+                            child, info, bindings, path))
+                    visit(child)
+
+            visit(info.node)
+            # Recurse into nested defs with the enclosing bindings.
+            for child in ast.iter_child_nodes(info.node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{info.qual}.<locals>.{child.name}"
+                    nested = self.functions.get(qual)
+                    if nested is not None:
+                        walk_function(nested, bindings)
+
+        for qual, info in list(self.functions.items()):
+            if info.path != path or "<locals>" in qual:
+                continue  # nested defs walked from their parent
+            walk_function(info, {})
+
+    def _track_binding(self, assign: ast.Assign,
+                       bindings: Dict[str, Tuple], path: str,
+                       info: FunctionInfo) -> None:
+        """``h = helper`` / ``h = functools.partial(helper, ...)``."""
+        if len(assign.targets) != 1 or not isinstance(
+                assign.targets[0], ast.Name):
+            return
+        name = assign.targets[0].id
+        value = assign.value
+        if isinstance(value, ast.Call) and \
+                _tail(value.func) == "partial" and value.args:
+            value = value.args[0]
+        target = self._lookup(value, info, bindings, path)
+        if target is not None:
+            bindings[name] = ("qual", target)
+        elif name in bindings:
+            del bindings[name]  # rebound to something unknown
+
+    def _lookup(self, func: ast.AST, info: FunctionInfo,
+                bindings: Dict[str, Tuple],
+                path: str) -> Optional[str]:
+        """Resolve a callable reference to a qualified name, or None."""
+        if isinstance(func, ast.Name):
+            bound = bindings.get(func.id)
+            if bound is not None and bound[0] == "qual":
+                return bound[1]
+            qual = self._module_funcs.get(path, {}).get(func.id)
+            if qual is not None:
+                return qual
+            imp = self._imports.get(path, {}).get(func.id)
+            if imp is not None and imp[0] == "sym":
+                return self._module_funcs.get(imp[1], {}).get(imp[2])
+            return None
+        if isinstance(func, ast.Attribute):
+            root = func.value
+            if isinstance(root, ast.Name):
+                if root.id in ("self", "cls") and info.class_name:
+                    return self._method(path, info.class_name,
+                                        func.attr)
+                imp = self._imports.get(path, {}).get(root.id)
+                if imp is not None and imp[0] == "mod":
+                    return self._module_funcs.get(imp[1], {}).get(
+                        func.attr)
+                if imp is not None and imp[0] == "sym":
+                    # `from pkg import mod` then `mod.fn(...)`.
+                    sub_path = imp[3] if len(imp) > 3 else None
+                    if sub_path:
+                        return self._module_funcs.get(sub_path,
+                                                      {}).get(func.attr)
+        return None
+
+    def _method(self, path: str, class_name: str,
+                method: str, depth: int = 0) -> Optional[str]:
+        """Look up a method on a class, then its project-resolvable
+        bases (depth-bounded to keep cycles harmless)."""
+        if depth > 8:
+            return None
+        qual = self._classes.get(path, {}).get(class_name, {}).get(
+            method)
+        if qual is not None:
+            return qual
+        for base in self._bases.get(path, {}).get(class_name, []):
+            base_name = base.split(".")[-1]
+            # Same module first, then imported symbol.
+            if base_name in self._classes.get(path, {}):
+                found = self._method(path, base_name, method,
+                                     depth + 1)
+                if found:
+                    return found
+            imp = self._imports.get(path, {}).get(base_name)
+            if imp is not None and imp[0] == "sym" \
+                    and base_name in self._classes.get(imp[1], {}):
+                found = self._method(imp[1], base_name, method,
+                                     depth + 1)
+                if found:
+                    return found
+        return None
+
+    def _resolve_call(self, call: ast.Call, info: FunctionInfo,
+                      bindings: Dict[str, Tuple],
+                      path: str) -> CallEdge:
+        func = call.func
+        text = _dotted(func)
+        if isinstance(func, ast.Name) and func.id in _BUILTIN_NAMES \
+                and func.id not in bindings \
+                and func.id not in self._module_funcs.get(path, {}):
+            return CallEdge(caller=info.qual, call=call, callee=None,
+                            target_text=text, kind="builtin")
+        target = self._lookup(func, info, bindings, path)
+        if target is not None:
+            kind = "method" if isinstance(func, ast.Attribute) \
+                else "direct"
+            if isinstance(func, ast.Name) and \
+                    bindings.get(func.id, (None,))[0] == "qual":
+                kind = "alias"
+            return CallEdge(caller=info.qual, call=call,
+                            callee=target, target_text=text,
+                            kind=kind)
+        return CallEdge(caller=info.qual, call=call, callee=None,
+                        target_text=text, kind="unresolved")
+
+    # ---- queries --------------------------------------------------------
+
+    def function_at(self, path: str,
+                    node) -> Optional[FunctionInfo]:
+        """The FunctionInfo wrapping this exact def node, if known."""
+        return self._by_node.get((path, id(node)))
+
+    def edges_from(self, qual: str) -> List[CallEdge]:
+        return self.edges.get(qual, [])
+
+    def resolved_edges_from(self, qual: str) -> List[CallEdge]:
+        return [e for e in self.edges.get(qual, [])
+                if e.callee is not None]
+
+    def sccs(self) -> List[List[str]]:
+        """Strongly connected components over resolved edges, in
+        reverse topological order (callees before callers) — the
+        bottom-up order ``summaries.py`` wants. Iterative Tarjan."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+
+        succ = {q: [e.callee for e in self.edges.get(q, [])
+                    if e.callee is not None and e.callee in
+                    self.functions]
+                for q in self.functions}
+
+        for root in sorted(self.functions):
+            if root in index:
+                continue
+            work = [(root, iter(succ.get(root, [])))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append((nxt, iter(succ.get(nxt, []))))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        member = stack.pop()
+                        on_stack.discard(member)
+                        scc.append(member)
+                        if member == node:
+                            break
+                    out.append(sorted(scc))
+        return out
+
+
+def _tail(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def for_project(project) -> CallGraph:
+    """Build (once) and memoize the call graph on the project — every
+    interprocedural rule in one run shares a single graph."""
+    graph = getattr(project, "_callgraph", None)
+    if graph is None:
+        lock = getattr(project, "_ipc_lock", None)
+        if lock is not None:
+            with lock:
+                graph = getattr(project, "_callgraph", None)
+                if graph is None:
+                    graph = CallGraph.build(project)
+                    project._callgraph = graph
+        else:
+            graph = CallGraph.build(project)
+            project._callgraph = graph
+    return graph

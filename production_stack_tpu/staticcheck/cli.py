@@ -19,7 +19,9 @@ vs the ref (git diff -U0; exit semantics unchanged) so pre-commit
 stays fast as the rule count grows. ``--sarif out.sarif`` writes a
 SARIF 2.1.0 report for PR annotation alongside the normal output;
 the ``--json`` payload is byte-stable and unaffected by either flag's
-absence.
+absence. ``--jobs N`` parses files and runs the rules on N threads
+against one shared parsed-AST/call-graph cache — output is identical
+to ``--jobs 1``, only faster (CI runs ``--jobs 4``).
 """
 
 from __future__ import annotations
@@ -74,14 +76,24 @@ def main(argv=None) -> int:
     parser.add_argument("--sarif", default=None, metavar="PATH",
                         help="also write a SARIF 2.1.0 report of the "
                              "new findings to PATH")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="run per-file parsing and the rules on "
+                             "N threads (findings identical to "
+                             "--jobs 1)")
     args = parser.parse_args(argv)
+
+    if args.jobs < 1:
+        print("error: --jobs must be >= 1", file=sys.stderr)
+        return 2
 
     # Side-effect import: registers every analyzer.
     from production_stack_tpu.staticcheck import analyzers  # noqa: F401
 
     if args.list_rules:
         for name in sorted(REGISTRY):
-            print(f"{name}: {REGISTRY[name].description}")
+            mark = (" [interprocedural]"
+                    if REGISTRY[name].interprocedural else "")
+            print(f"{name}{mark}: {REGISTRY[name].description}")
         return 0
 
     root = pathlib.Path(args.root) if args.root else _default_root()
@@ -92,7 +104,8 @@ def main(argv=None) -> int:
 
     try:
         project = Project.from_root(root)
-        findings = run_rules(project, rules=args.rule)
+        findings = run_rules(project, rules=args.rule,
+                             jobs=args.jobs)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2

@@ -31,24 +31,62 @@ See docs/static_analysis.md for the rule catalog and how to add one.
 from __future__ import annotations
 
 import ast
+import concurrent.futures
 import dataclasses
+import datetime
 import hashlib
 import pathlib
 import re
-from typing import Callable, Dict, Iterable, List, Optional
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
-_WAIVER_RE = re.compile(r"#\s*lint:\s*allow-([A-Za-z0-9_-]+)")
+_WAIVER_RE = re.compile(
+    r"#\s*lint:\s*allow-([A-Za-z0-9_-]+)(?:\s+until=([^\s#]+))?")
+_DATE_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$")
+
+# Rendered call chains are capped at this many frames; the tail is
+# summarized as "… (+N frames)" so --json and SARIF stay bounded and
+# byte-stable no matter how deep the interprocedural path goes.
+CHAIN_CAP = 6
+
+
+def cap_frames(frames: Iterable[Tuple[str, int, str]]
+               ) -> Tuple[Tuple[Tuple[str, int, str], ...], int]:
+    """(first CHAIN_CAP frames, count of dropped frames)."""
+    frames = tuple(tuple(f) for f in frames)
+    if len(frames) <= CHAIN_CAP:
+        return frames, 0
+    return frames[:CHAIN_CAP], len(frames) - CHAIN_CAP
+
+
+def render_chain(frames: Iterable[Tuple[str, int, str]]) -> str:
+    """``a → b → c … (+N frames)`` — labels only, capped."""
+    kept, dropped = cap_frames(frames)
+    text = " → ".join(label for _p, _l, label in kept)
+    if dropped:
+        text += f" … (+{dropped} frames)"
+    return text
 
 
 @dataclasses.dataclass
 class Finding:
-    """One rule violation at (path, line)."""
+    """One rule violation at (path, line).
+
+    ``chain`` is the interprocedural call path behind the finding
+    (empty for intraprocedural findings): up to ``CHAIN_CAP``
+    ``(path, line, label)`` frames, already capped by the creating
+    analyzer via :func:`cap_frames`, with the overflow count in
+    ``chain_dropped``. The chain is deliberately **excluded** from the
+    fingerprint — renaming a mid-chain helper must not churn the
+    baseline for a finding whose flagged line did not change."""
 
     rule: str
     path: str  # repo-relative, posix separators
     line: int  # 1-based; 0 for file/project-level contract findings
     message: str
     snippet: str = ""
+    chain: Tuple[Tuple[str, int, str], ...] = ()
+    chain_dropped: int = 0
 
     def fingerprint(self) -> str:
         """Stable identity for baseline matching: rule + path + the
@@ -62,7 +100,7 @@ class Finding:
         return digest[:12]
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "rule": self.rule,
             "path": self.path,
             "line": self.line,
@@ -70,6 +108,13 @@ class Finding:
             "snippet": self.snippet,
             "fingerprint": self.fingerprint(),
         }
+        if self.chain:
+            out["chain"] = [
+                {"path": p, "line": line, "label": label}
+                for p, line, label in self.chain]
+            if self.chain_dropped:
+                out["chain_dropped"] = self.chain_dropped
+        return out
 
     def render(self) -> str:
         loc = f"{self.path}:{self.line}" if self.line else self.path
@@ -89,6 +134,8 @@ class SourceFile:
         self._tree: Optional[ast.Module] = None
         self._parse_error: Optional[str] = None
         self._waivers: Optional[Dict[int, set]] = None
+        self._waiver_expiries: Optional[Dict[int, Dict[str, str]]] = \
+            None
 
     @property
     def tree(self) -> Optional[ast.Module]:
@@ -112,18 +159,37 @@ class SourceFile:
             for i, line in enumerate(self.lines, start=1):
                 tokens = _WAIVER_RE.findall(line)
                 if tokens:
-                    self._waivers[i] = set(tokens)
+                    self._waivers[i] = {name for name, _until in
+                                        tokens}
         return self._waivers
+
+    @property
+    def waiver_expiries(self) -> Dict[int, Dict[str, str]]:
+        """{1-based line: {rule: raw until= string}} for waivers that
+        carry an expiry (``# lint: allow-<rule> until=YYYY-MM-DD``).
+        The raw string is kept so the expiry check can parse strictly
+        and fail loudly on a malformed date."""
+        if self._waiver_expiries is None:
+            self._waiver_expiries = {}
+            for i, line in enumerate(self.lines, start=1):
+                dated = {name: until for name, until in
+                         _WAIVER_RE.findall(line) if until}
+                if dated:
+                    self._waiver_expiries[i] = dated
+        return self._waiver_expiries
 
     def line_at(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
             return self.lines[lineno - 1]
         return ""
 
-    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+    def finding(self, rule: str, node_or_line, message: str,
+                chain: Iterable = ()) -> Finding:
         line = getattr(node_or_line, "lineno", node_or_line) or 0
+        frames, dropped = cap_frames(chain)
         return Finding(rule=rule, path=self.relpath, line=line,
-                       message=message, snippet=self.line_at(line))
+                       message=message, snippet=self.line_at(line),
+                       chain=frames, chain_dropped=dropped)
 
 
 def _glob_to_re(pattern: str) -> re.Pattern:
@@ -170,6 +236,9 @@ class Project:
         self.root = root
         self._sources = sources
         self._cache: Dict[str, SourceFile] = {}
+        # Guards the memoized call graph / summaries when rules run
+        # under --jobs (reentrant: summaries build the call graph).
+        self._ipc_lock = threading.RLock()
 
     @classmethod
     def from_root(cls, root) -> "Project":
@@ -203,9 +272,24 @@ class Project:
         if relpath not in self._sources:
             return None
         if relpath not in self._cache:
-            self._cache[relpath] = SourceFile(
-                relpath, self._sources[relpath])
+            with self._ipc_lock:
+                if relpath not in self._cache:
+                    self._cache[relpath] = SourceFile(
+                        relpath, self._sources[relpath])
         return self._cache[relpath]
+
+    def warm_parse_cache(self, jobs: int = 1) -> None:
+        """Parse every python file up front (optionally in a thread
+        pool) so rules running under ``--jobs`` share one AST per file
+        instead of racing to parse."""
+        sources = self.files("**/*.py")
+        if jobs <= 1:
+            for sf in sources:
+                sf.tree  # noqa: B018 - force the parse
+            return
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=jobs) as pool:
+            list(pool.map(lambda sf: sf.tree, sources))
 
 
 @dataclasses.dataclass
@@ -213,31 +297,65 @@ class Rule:
     name: str
     description: str
     run: Callable[[Project], List[Finding]]
+    # True for rules that reason through the project call graph
+    # (callgraph.py / summaries.py); surfaced by --list-rules.
+    interprocedural: bool = False
 
 
 REGISTRY: Dict[str, Rule] = {}
 
 
-def rule(name: str, description: str):
+def rule(name: str, description: str, interprocedural: bool = False):
     """Register ``fn(project) -> list[Finding]`` as analyzer ``name``."""
     def decorator(fn):
-        REGISTRY[name] = Rule(name=name, description=description, run=fn)
+        REGISTRY[name] = Rule(name=name, description=description,
+                              run=fn, interprocedural=interprocedural)
         return fn
     return decorator
 
 
-def _waived(project: Project, finding: Finding) -> bool:
+def _parse_waiver_date(raw: str) -> Optional[datetime.date]:
+    """Strict ``YYYY-MM-DD`` parse; None for anything else (wrong
+    shape, impossible date)."""
+    if not _DATE_RE.match(raw):
+        return None
+    try:
+        year, month, day = (int(part) for part in raw.split("-"))
+        return datetime.date(year, month, day)
+    except ValueError:
+        return None
+
+
+def _waived(project: Project, finding: Finding,
+            today: Optional[datetime.date] = None) -> bool:
     sf = project.source(finding.path)
     if sf is None or finding.line == 0:
         return False
-    return finding.rule in sf.waivers.get(finding.line, set())
+    if finding.rule not in sf.waivers.get(finding.line, set()):
+        return False
+    # A dated waiver stops suppressing the moment it expires (or if
+    # its date never parsed) — the finding resurfaces alongside the
+    # expired-waiver finding instead of staying silently waived.
+    raw = sf.waiver_expiries.get(finding.line, {}).get(finding.rule)
+    if raw is not None:
+        until = _parse_waiver_date(raw)
+        if until is None:
+            return False
+        if until < (today or datetime.date.today()):
+            return False
+    return True
 
 
-def _waiver_findings(project: Project) -> List[Finding]:
+def _waiver_findings(project: Project,
+                     today: Optional[datetime.date] = None
+                     ) -> List[Finding]:
     """A misspelled waiver silently disables nothing — it IS a
     finding, so the typo surfaces in the same run that was supposed
-    to be suppressed."""
-    known = set(REGISTRY) | {"unknown-waiver"}
+    to be suppressed. Dated waivers get the same loud-failure
+    treatment: an expired or unparseable ``until=`` is an
+    ``expired-waiver`` finding."""
+    known = set(REGISTRY) | {"unknown-waiver", "expired-waiver"}
+    today = today or datetime.date.today()
     out = []
     # Scope: package sources only. Test files quote waiver syntax in
     # fixture strings (including deliberate typos), which a raw-line
@@ -250,14 +368,37 @@ def _waiver_findings(project: Project) -> List[Finding]:
                     f"waiver names unknown rule '{token}' (known: "
                     f"{', '.join(sorted(REGISTRY))}) — fix the "
                     "spelling or the waiver is dead weight"))
+        for line, dated in sf.waiver_expiries.items():
+            for token in sorted(dated):
+                if token not in known:
+                    continue  # already an unknown-waiver finding
+                until = _parse_waiver_date(dated[token])
+                if until is None:
+                    out.append(sf.finding(
+                        "expired-waiver", line,
+                        f"waiver for '{token}' has unparseable "
+                        f"until={dated[token]!r} (strict YYYY-MM-DD) "
+                        "— the waiver is treated as expired"))
+                elif until < today:
+                    out.append(sf.finding(
+                        "expired-waiver", line,
+                        f"waiver for '{token}' expired on "
+                        f"{until.isoformat()} — renew it with a new "
+                        "date and rationale, or fix the finding"))
     return out
 
 
 def run_rules(project: Project,
-              rules: Optional[Iterable[str]] = None) -> List[Finding]:
+              rules: Optional[Iterable[str]] = None,
+              jobs: int = 1) -> List[Finding]:
     """Run analyzers (all registered by default) plus the waiver
-    spelling check; waived findings are dropped, everything else is
-    returned sorted."""
+    spelling/expiry checks; waived findings are dropped, everything
+    else is returned sorted.
+
+    ``jobs > 1`` runs the analyzers in a thread pool after warming
+    the shared parse cache (and the call-graph/summary memos, which
+    every interprocedural rule shares); output is identical to a
+    serial run — findings are sorted and rules are pure readers."""
     # Import for side effect: analyzer modules self-register.
     from production_stack_tpu.staticcheck import analyzers  # noqa: F401
 
@@ -266,8 +407,16 @@ def run_rules(project: Project,
     if unknown:
         raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
     findings: List[Finding] = []
-    for name in names:
-        findings.extend(REGISTRY[name].run(project))
+    if jobs > 1:
+        project.warm_parse_cache(jobs=jobs)
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=jobs) as pool:
+            for result in pool.map(
+                    lambda name: REGISTRY[name].run(project), names):
+                findings.extend(result)
+    else:
+        for name in names:
+            findings.extend(REGISTRY[name].run(project))
     findings.extend(_waiver_findings(project))
     # Files any analyzer failed to parse fail the run explicitly —
     # an unparseable file is unanalyzed, not clean.

@@ -7,6 +7,13 @@ driver's rule catalog, one result per finding with a physical
 location and the staticcheck fingerprint carried in
 ``partialFingerprints`` so GitHub's alert dedup tracks ours.
 
+Interprocedural findings (PR 20) carry their call chain as a SARIF
+``codeFlow`` — one thread flow, one location per frame, already
+capped at ``core.CHAIN_CAP`` frames by ``Finding`` itself — so the
+PR annotation shows the same async-handler → helper → primitive path
+the terminal message renders, and the report size stays bounded no
+matter how deep the real chain was.
+
 ``--json`` stays the machine-readable contract (byte-stable); SARIF
 is a second emitter over the same findings, never a replacement.
 """
@@ -19,6 +26,31 @@ from production_stack_tpu.staticcheck.core import Finding, Rule
 
 SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _code_flow(f: Finding) -> dict:
+    """One SARIF codeFlow from a finding's (already capped) call
+    chain. The dropped-frame count is noted on the last location's
+    message rather than re-expanding the chain."""
+    locations = []
+    frames = list(f.chain)
+    for i, (path, line, label) in enumerate(frames):
+        text = label
+        if f.chain_dropped and i == len(frames) - 1:
+            text = f"{label} (+{f.chain_dropped} more frames)"
+        locations.append({
+            "location": {
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": path,
+                        "uriBaseId": "%SRCROOT%",
+                    },
+                    "region": {"startLine": max(line, 1)},
+                },
+                "message": {"text": text},
+            },
+        })
+    return {"threadFlows": [{"locations": locations}]}
 
 
 def render(findings: Iterable[Finding],
@@ -46,6 +78,8 @@ def render(findings: Iterable[Finding],
         }
         if f.rule in index:
             result["ruleIndex"] = index[f.rule]
+        if f.chain:
+            result["codeFlows"] = [_code_flow(f)]
         results.append(result)
     return {
         "$schema": SARIF_SCHEMA,

@@ -1,0 +1,360 @@
+"""Bottom-up function summaries over the project call graph.
+
+``callgraph.py`` gives the edges; this module computes, per function,
+the facts the interprocedural rules consume — processed SCC by SCC in
+reverse topological order (callees first), iterating each SCC to a
+fixpoint so mutual recursion converges instead of recursing:
+
+- **may_block**: the function can execute a blocking primitive
+  (``time.sleep``, sync ``requests``, ``subprocess.run``, sync
+  ``open`` — the ``async-blocking`` vocabulary) on its own frame or
+  through any *resolved* callee. Carried as a chain of
+  ``(path, line, label)`` frames down to the primitive so the finding
+  at an ``async def`` call site can print the whole path.
+- **may_host_sync**: same shape, for device->host syncs
+  (``.item()``, ``jax.device_get``, ``.block_until_ready()``).
+- **may_raise**: exception type names the function can raise,
+  transitively through resolved callees. The page-lifecycle rule
+  turns "calls a function that may raise" into CFG exception edges —
+  proving cleanup instead of assuming helpers are total.
+- **consumed_params / returns_alloc**: page-ownership in/out. A
+  parameter is *consumed* when the callee may take custody of it
+  (stores it, returns it, passes it onward to a consuming or
+  unresolved callee); it is provably **non-custodial** only when
+  every use is a read (comparisons, ``len()``-class builtins,
+  resolved non-consuming callees). ``returns_alloc`` marks functions
+  whose return value is a fresh ``allocate_pages`` result, so an
+  allocation two frames deep still creates a leak fact at the caller.
+
+Soundness stance (see docs/static_analysis.md): facts that *create*
+findings (may_block, may_host_sync) propagate only through resolved
+edges — an unresolved edge can never manufacture a finding. Facts
+that *suppress* findings (consumed_params) treat unresolved callees
+as consuming — an unresolved edge can never manufacture a finding
+there either. All lattices are finite and grow monotonically, so the
+per-SCC fixpoint terminates.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from production_stack_tpu.staticcheck import callgraph
+
+Frame = Tuple[str, int, str]  # (path, 1-based line, label)
+
+# Builtins that only *read* their arguments — passing a tracked value
+# to one of these is not a transfer of custody.
+READONLY_BUILTINS = frozenset({
+    "len", "print", "repr", "str", "format", "isinstance", "bool",
+    "sum", "min", "max", "any", "all", "sorted", "enumerate", "id",
+    "hash", "abs", "round", "int", "float",
+})
+
+# Host-sync primitives (the host-read / tracer-hygiene vocabulary).
+_HOST_SYNC_TAILS = {"device_get", "block_until_ready", "item"}
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionSummary:
+    qual: str
+    may_block: Optional[Tuple[Frame, ...]] = None
+    may_host_sync: Optional[Tuple[Frame, ...]] = None
+    may_raise: FrozenSet[str] = frozenset()
+    consumed_params: FrozenSet[str] = frozenset()
+    returns_alloc: bool = False
+
+
+_EMPTY = FunctionSummary(qual="")
+
+
+def own_body_nodes(fn_node):
+    """Every AST node on the function's own frame — nested def/class
+    bodies excluded (their effects belong to their own summaries)."""
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            yield child
+            yield from visit(child)
+    yield from visit(fn_node)
+
+
+def _tail(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def host_sync_reason(call: ast.Call) -> str:
+    """Why this call syncs device->host ('' if it doesn't)."""
+    func = call.func
+    name = _tail(func)
+    if name == "device_get":
+        return "jax.device_get blocks on device results"
+    if isinstance(func, ast.Attribute):
+        if name == "block_until_ready":
+            return ".block_until_ready() is a host sync"
+        if name == "item":
+            return ".item() is a device->host sync"
+    return ""
+
+
+def _short(text: str, limit: int = 48) -> str:
+    return text if len(text) <= limit else text[:limit - 1] + "…"
+
+
+class Summaries:
+    """Summary table for one project; build via :func:`for_project`."""
+
+    def __init__(self, graph: callgraph.CallGraph):
+        self.graph = graph
+        self.by_qual: Dict[str, FunctionSummary] = {}
+        self._compute()
+
+    # ---- queries --------------------------------------------------------
+
+    def get(self, qual: Optional[str]) -> FunctionSummary:
+        if qual is None:
+            return _EMPTY
+        return self.by_qual.get(qual, _EMPTY)
+
+    def for_edge(self, edge: callgraph.CallEdge) -> FunctionSummary:
+        return self.get(edge.callee)
+
+    # ---- computation ----------------------------------------------------
+
+    def _compute(self) -> None:
+        graph = self.graph
+        for qual in graph.functions:
+            self.by_qual[qual] = FunctionSummary(qual=qual)
+        for scc in graph.sccs():
+            # Monotone lattices: the raise/custody sets only grow and
+            # chains always take the shortest candidate, so the
+            # fixpoint converges; the iteration cap is a pure backstop.
+            for _ in range(64):
+                changed = False
+                for qual in scc:
+                    new = self._summarize(qual)
+                    if new != self.by_qual[qual]:
+                        self.by_qual[qual] = new
+                        changed = True
+                if not changed:
+                    break
+                if len(scc) == 1 and not self._self_recursive(scc[0]):
+                    break
+
+    def _self_recursive(self, qual: str) -> bool:
+        return any(e.callee == qual
+                   for e in self.graph.edges_from(qual))
+
+    def _summarize(self, qual: str) -> FunctionSummary:
+        info = self.graph.functions[qual]
+        fn = info.node
+        edges_by_call = {id(e.call): e
+                         for e in self.graph.edges_from(qual)}
+
+        block_candidates: List[Tuple[Frame, ...]] = []
+        sync_candidates: List[Tuple[Frame, ...]] = []
+        may_raise: set = set()
+
+        # Lazy import: async_blocking imports this module at top
+        # level; by the time summaries are *computed* both are loaded.
+        from production_stack_tpu.staticcheck.analyzers import (
+            async_blocking,
+        )
+
+        for node in own_body_nodes(fn):
+            if isinstance(node, ast.Raise) and node.exc is not None:
+                exc = node.exc
+                if isinstance(exc, ast.Call):
+                    exc = exc.func
+                name = _tail(exc)
+                if name:
+                    may_raise.add(name)
+            if not isinstance(node, ast.Call):
+                continue
+            if async_blocking.blocking_reason(node):
+                block_candidates.append(
+                    ((info.path, node.lineno,
+                      _short(callgraph._dotted(node.func) + "()")),))
+            if host_sync_reason(node):
+                sync_candidates.append(
+                    ((info.path, node.lineno,
+                      _short(callgraph._dotted(node.func) + "()")),))
+            edge = edges_by_call.get(id(node))
+            if edge is None or edge.callee is None:
+                continue
+            callee = self.by_qual.get(edge.callee, _EMPTY)
+            callee_info = self.graph.functions.get(edge.callee)
+            label = (callee_info.label() if callee_info
+                     else edge.target_text)
+            site: Frame = (info.path, node.lineno, label)
+            if callee.may_block is not None:
+                block_candidates.append((site,) + callee.may_block)
+            if callee.may_host_sync is not None:
+                sync_candidates.append((site,) + callee.may_host_sync)
+            may_raise |= callee.may_raise
+
+        # Shortest chain wins — keeps recursive SCCs convergent and
+        # the rendered path maximally direct.
+        may_block = min(block_candidates, key=lambda c: (len(c), c),
+                        default=None)
+        may_host_sync = min(sync_candidates,
+                            key=lambda c: (len(c), c), default=None)
+        consumed = self._consumed_params(info, edges_by_call)
+        returns_alloc = self._returns_alloc(fn, edges_by_call)
+        return FunctionSummary(
+            qual=qual,
+            may_block=may_block,
+            may_host_sync=may_host_sync,
+            may_raise=frozenset(may_raise),
+            consumed_params=consumed,
+            returns_alloc=returns_alloc,
+        )
+
+    # ---- page ownership -------------------------------------------------
+
+    def _param_names(self, fn) -> List[str]:
+        args = fn.args
+        return [a.arg for a in (args.posonlyargs + args.args
+                                + args.kwonlyargs)]
+
+    def callee_param_for_arg(self, edge: callgraph.CallEdge,
+                              pos: int,
+                              kw: Optional[str]) -> Optional[str]:
+        """Map an actual argument (position or keyword) to the callee
+        parameter name, accounting for the bound ``self``/``cls`` of
+        method-style calls. None when unmappable."""
+        callee_info = self.graph.functions.get(edge.callee or "")
+        if callee_info is None:
+            return None
+        params = self._param_names(callee_info.node)
+        if kw is not None:
+            return kw if kw in params else None
+        offset = 0
+        if callee_info.class_name and params \
+                and params[0] in ("self", "cls") \
+                and isinstance(edge.call.func, ast.Attribute):
+            offset = 1
+        idx = pos + offset
+        return params[idx] if idx < len(params) else None
+
+    def _consumed_params(self, info: callgraph.FunctionInfo,
+                         edges_by_call: Dict[int, callgraph.CallEdge]
+                         ) -> FrozenSet[str]:
+        """Parameters that may leave the callee's frame (custody)."""
+        fn = info.node
+        params = set(self._param_names(fn))
+        params.discard("self")
+        params.discard("cls")
+        if not params:
+            return frozenset()
+        consumed: set = set()
+
+        def refs(node) -> set:
+            return {n.id for n in ast.walk(node)
+                    if isinstance(n, ast.Name) and n.id in params}
+
+        # Captured by a nested def -> custody unknowable, be safe.
+        for child in ast.walk(fn):
+            if child is fn:
+                continue
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                consumed |= {n.id for n in ast.walk(child)
+                             if isinstance(n, ast.Name)
+                             and n.id in params}
+
+        for node in own_body_nodes(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign, ast.Return,
+                                 ast.Yield, ast.YieldFrom, ast.Raise,
+                                 ast.withitem, ast.Delete)):
+                consumed |= refs(node)
+            elif isinstance(node, ast.Call):
+                edge = edges_by_call.get(id(node))
+                builtin_ok = (edge is not None
+                              and edge.kind == "builtin"
+                              and edge.target_text in
+                              READONLY_BUILTINS)
+                resolved = (edge is not None
+                            and edge.callee is not None)
+                # Receiver custody: p.method(...) may retain p.
+                recv = node.func
+                if isinstance(recv, ast.Attribute):
+                    consumed |= refs(recv.value)
+                for pos, arg in enumerate(node.args):
+                    for name in refs(arg):
+                        if builtin_ok:
+                            continue
+                        if resolved and isinstance(arg, ast.Name):
+                            callee_param = self.callee_param_for_arg(
+                                edge, pos, None)
+                            callee_sum = self.get(edge.callee)
+                            if callee_param is not None and \
+                                    callee_param not in \
+                                    callee_sum.consumed_params:
+                                continue
+                        consumed.add(name)
+                for kwnode in node.keywords:
+                    for name in refs(kwnode.value):
+                        if builtin_ok:
+                            continue
+                        if resolved and kwnode.arg is not None and \
+                                isinstance(kwnode.value, ast.Name):
+                            callee_param = self.callee_param_for_arg(
+                                edge, 0, kwnode.arg)
+                            callee_sum = self.get(edge.callee)
+                            if callee_param is not None and \
+                                    callee_param not in \
+                                    callee_sum.consumed_params:
+                                continue
+                        consumed.add(name)
+        return frozenset(consumed & params)
+
+    def _returns_alloc(self, fn,
+                       edges_by_call: Dict[int, callgraph.CallEdge]
+                       ) -> bool:
+        """Does this function return a fresh allocate_pages result
+        (directly, via list()/tuple(), or via a callee that does)?"""
+        for node in own_body_nodes(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and \
+                    isinstance(value.func, ast.Name) and \
+                    value.func.id in ("list", "tuple") and value.args:
+                value = value.args[0]
+            if not isinstance(value, ast.Call):
+                continue
+            if _tail(value.func) == "allocate_pages":
+                return True
+            edge = edges_by_call.get(id(value)) or \
+                edges_by_call.get(id(node.value))
+            if edge is not None and edge.callee is not None and \
+                    self.get(edge.callee).returns_alloc:
+                return True
+        return False
+
+
+def for_project(project) -> Summaries:
+    """Build (once) and memoize summaries on the project."""
+    sums = getattr(project, "_summaries", None)
+    if sums is None:
+        lock = getattr(project, "_ipc_lock", None)
+        if lock is not None:
+            with lock:
+                sums = getattr(project, "_summaries", None)
+                if sums is None:
+                    sums = Summaries(callgraph.for_project(project))
+                    project._summaries = sums
+        else:
+            sums = Summaries(callgraph.for_project(project))
+            project._summaries = sums
+    return sums
